@@ -1,0 +1,16 @@
+"""consensus_specs_tpu — a TPU-native executable consensus-spec framework.
+
+Capabilities mirror the reference consensus-specs repo (eth2spec v1.1.10,
+see SURVEY.md): SSZ type system + Merkleization, BLS12-381 signatures,
+per-fork executable beacon-chain specs (phase0/altair/bellatrix/capella),
+fork choice, light client sync, and a dual-mode pytest / test-vector
+generator framework.
+
+TPU-first design: the two compute-bound primitives — SHA-256 Merkleization
+and BLS12-381 verification — are batched JAX/Pallas kernels selected through
+backend hook points (`ssz.hashing.set_backend`, `crypto.bls.use_backend`),
+so whole-epoch batches run on device while protocol control flow stays on
+host (the boundary drawn by BASELINE.json).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,68 @@
+"""Pluggable SHA-256 hashing backend for SSZ Merkleization.
+
+The reference delegates hashing to pycryptodome via a 9-line shim
+(eth2spec/utils/hash_function.py:8) and remerkleable's per-node
+`merkle_root()`. Here the hasher is an explicit, swappable backend whose
+unit of work is a *batch* of 64-byte blocks — the natural shape for a
+TPU kernel (one Merkle level = one batched call), while the default host
+backend just loops hashlib.
+
+Backend contract: ``fn(data: bytes) -> bytes`` where ``len(data) % 64 == 0``
+and the result is the concatenation of the 32-byte SHA-256 digests of each
+64-byte block.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Callable, Optional
+
+HashManyFn = Callable[[bytes], bytes]
+
+
+def _host_hash_many(data: bytes) -> bytes:
+    n = len(data) // 64
+    out = bytearray(32 * n)
+    sha = hashlib.sha256
+    for i in range(n):
+        out[32 * i : 32 * i + 32] = sha(data[64 * i : 64 * i + 64]).digest()
+    return bytes(out)
+
+
+_backend: HashManyFn = _host_hash_many
+_backend_name: str = "hashlib"
+
+
+def set_backend(fn: Optional[HashManyFn], name: str = "custom") -> None:
+    """Install a batched hasher; ``None`` restores the hashlib host backend."""
+    global _backend, _backend_name
+    if fn is None:
+        _backend, _backend_name = _host_hash_many, "hashlib"
+    else:
+        _backend, _backend_name = fn, name
+
+
+def backend_name() -> str:
+    return _backend_name
+
+
+def hash_many(data: bytes) -> bytes:
+    """SHA-256 of each consecutive 64-byte block of ``data``, concatenated."""
+    if len(data) % 64:
+        raise ValueError(f"hash_many input must be a multiple of 64 bytes, got {len(data)}")
+    if not data:
+        return b""
+    return _backend(data)
+
+
+def sha256(data: bytes) -> bytes:
+    """Plain one-shot SHA-256 (arbitrary length) — always on host.
+
+    Spec-level `hash()` (eth2spec/utils/hash_function.py:8). Used for seeds,
+    shuffling, randao mixes; the batched path is `hash_many`.
+    """
+    return hashlib.sha256(data).digest()
+
+
+def hash_pair(a: bytes, b: bytes) -> bytes:
+    """SHA-256(a || b) for two 32-byte nodes, through the batched backend."""
+    return hash_many(a + b)

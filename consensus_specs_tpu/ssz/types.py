@@ -1,0 +1,897 @@
+"""SSZ type system: value-backed, mutable views with on-demand Merkleization.
+
+Feature parity with the reference's remerkleable-based typing surface
+(eth2spec/utils/ssz/ssz_typing.py:4-12; normative rules ssz/simple-serialize.md):
+uintN, boolean, Container, Vector, List, ByteVector, ByteList, Bitvector,
+Bitlist, Union, plus generalized indices (ssz/merkle-proofs.md:58-189).
+
+Design difference from remerkleable: objects are plain Python values (ints,
+bytes, lists) rather than persistent binary trees. Roots are computed on
+demand by flattening to chunk lists and reducing level-by-level through the
+batched hasher (`hashing.hash_many`) — the shape a TPU kernel wants. A
+root memo (`_cached_root`) on containers, invalidated on any mutation in the
+owning tree, recovers remerkleable's incremental-rehash win for the common
+"mutate a little, re-root" spec pattern.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Optional, Sequence, Tuple, Type
+
+from .merkle import (
+    ceil_log2,
+    merkleize_chunks,
+    mix_in_length,
+    mix_in_selector,
+    next_pow2,
+)
+
+BYTES_PER_CHUNK = 32
+OFFSET_BYTE_LENGTH = 4
+
+
+def _pack_bytes_to_chunks(data: bytes) -> list:
+    if len(data) % BYTES_PER_CHUNK:
+        data = data + b"\x00" * (BYTES_PER_CHUNK - len(data) % BYTES_PER_CHUNK)
+    return [data[i : i + BYTES_PER_CHUNK] for i in range(0, len(data), BYTES_PER_CHUNK)]
+
+
+class SSZType:
+    """Shared classmethod protocol; every SSZ class also implements
+    encode_bytes()/hash_tree_root() on instances."""
+
+    @classmethod
+    def is_fixed_byte_length(cls) -> bool:
+        raise NotImplementedError
+
+    @classmethod
+    def type_byte_length(cls) -> int:
+        raise NotImplementedError  # fixed-size types only
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        raise NotImplementedError
+
+    @classmethod
+    def default(cls):
+        raise NotImplementedError
+
+    @classmethod
+    def coerce(cls, value):
+        if isinstance(value, cls):
+            return value
+        return cls(value)
+
+    def encode_bytes(self) -> bytes:
+        raise NotImplementedError
+
+    def hash_tree_root(self) -> bytes:
+        raise NotImplementedError
+
+    def copy(self):
+        return type(self).decode_bytes(self.encode_bytes())
+
+
+# ---------------------------------------------------------------------------
+# Basic types
+# ---------------------------------------------------------------------------
+
+
+class uint(int, SSZType):
+    byte_len: int = 0
+
+    def __new__(cls, value: Any = 0):
+        if isinstance(value, (float,)) or (isinstance(value, bool) and cls.byte_len != 1):
+            value = int(value)
+        v = int(value)
+        if v < 0 or v >> (cls.byte_len * 8):
+            raise ValueError(f"{cls.__name__} out of range: {v}")
+        return super().__new__(cls, v)
+
+    @classmethod
+    def is_fixed_byte_length(cls) -> bool:
+        return True
+
+    @classmethod
+    def type_byte_length(cls) -> int:
+        return cls.byte_len
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        if len(data) != cls.byte_len:
+            raise ValueError(f"{cls.__name__}: expected {cls.byte_len} bytes, got {len(data)}")
+        return cls(int.from_bytes(data, "little"))
+
+    @classmethod
+    def default(cls):
+        return cls(0)
+
+    def encode_bytes(self) -> bytes:
+        return int(self).to_bytes(self.byte_len, "little")
+
+    def hash_tree_root(self) -> bytes:
+        return self.encode_bytes() + b"\x00" * (32 - self.byte_len)
+
+    def copy(self):
+        return self
+
+
+class uint8(uint):
+    byte_len = 1
+
+
+class uint16(uint):
+    byte_len = 2
+
+
+class uint32(uint):
+    byte_len = 4
+
+
+class uint64(uint):
+    byte_len = 8
+
+
+class uint128(uint):
+    byte_len = 16
+
+
+class uint256(uint):
+    byte_len = 32
+
+
+byte = uint8
+
+
+class boolean(uint):
+    byte_len = 1
+
+    def __new__(cls, value: Any = 0):
+        v = int(value)
+        if v not in (0, 1):
+            raise ValueError(f"boolean out of range: {v}")
+        return super().__new__(cls, v)
+
+    def __bool__(self):
+        return int(self) == 1
+
+    def __repr__(self):
+        return f"boolean({int(self)})"
+
+
+class Bit(boolean):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Parameterized-type machinery
+# ---------------------------------------------------------------------------
+
+_param_cache: Dict[Tuple, type] = {}
+
+
+def _parameterize(base: type, key: Tuple, name: str, ns: Dict[str, Any]) -> type:
+    cache_key = (base, key)
+    if cache_key not in _param_cache:
+        _param_cache[cache_key] = type(name, (base,), ns)
+    return _param_cache[cache_key]
+
+
+# ---------------------------------------------------------------------------
+# ByteVector / ByteList
+# ---------------------------------------------------------------------------
+
+
+class ByteVector(bytes, SSZType):
+    length: int = 0
+
+    def __class_getitem__(cls, length: int) -> type:
+        return _parameterize(ByteVector, (length,), f"ByteVector[{length}]", {"length": length})
+
+    def __new__(cls, *args):
+        if cls.length == 0 and cls is ByteVector:
+            raise TypeError("ByteVector must be parameterized: ByteVector[N]")
+        if len(args) == 0:
+            data = b"\x00" * cls.length
+        elif len(args) == 1:
+            v = args[0]
+            if isinstance(v, str):
+                data = bytes.fromhex(v[2:] if v.startswith("0x") else v)
+            elif isinstance(v, (bytes, bytearray, memoryview)):
+                data = bytes(v)
+            else:
+                data = bytes(v)
+        else:
+            data = bytes(args)
+        if len(data) != cls.length:
+            raise ValueError(f"{cls.__name__}: expected {cls.length} bytes, got {len(data)}")
+        return super().__new__(cls, data)
+
+    @classmethod
+    def is_fixed_byte_length(cls) -> bool:
+        return True
+
+    @classmethod
+    def type_byte_length(cls) -> int:
+        return cls.length
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        return cls(data)
+
+    @classmethod
+    def default(cls):
+        return cls()
+
+    def encode_bytes(self) -> bytes:
+        return bytes(self)
+
+    def hash_tree_root(self) -> bytes:
+        return merkleize_chunks(_pack_bytes_to_chunks(bytes(self)), limit=(self.length + 31) // 32)
+
+    def copy(self):
+        return self
+
+    def __repr__(self):
+        return f"{type(self).__name__}(0x{bytes(self).hex()})"
+
+
+Bytes1 = ByteVector[1]
+Bytes4 = ByteVector[4]
+Bytes8 = ByteVector[8]
+Bytes20 = ByteVector[20]
+Bytes32 = ByteVector[32]
+Bytes48 = ByteVector[48]
+Bytes96 = ByteVector[96]
+
+
+class ByteList(bytes, SSZType):
+    limit: int = 0
+
+    def __class_getitem__(cls, limit: int) -> type:
+        return _parameterize(ByteList, (limit,), f"ByteList[{limit}]", {"limit": limit})
+
+    def __new__(cls, *args):
+        if len(args) == 0:
+            data = b""
+        elif len(args) == 1:
+            v = args[0]
+            if isinstance(v, str):
+                data = bytes.fromhex(v[2:] if v.startswith("0x") else v)
+            else:
+                data = bytes(v)
+        else:
+            data = bytes(args)
+        if len(data) > cls.limit:
+            raise ValueError(f"{cls.__name__}: {len(data)} bytes exceeds limit {cls.limit}")
+        return super().__new__(cls, data)
+
+    @classmethod
+    def is_fixed_byte_length(cls) -> bool:
+        return False
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        return cls(data)
+
+    @classmethod
+    def default(cls):
+        return cls()
+
+    def encode_bytes(self) -> bytes:
+        return bytes(self)
+
+    def hash_tree_root(self) -> bytes:
+        root = merkleize_chunks(_pack_bytes_to_chunks(bytes(self)), limit=(self.limit + 31) // 32)
+        return mix_in_length(root, len(self))
+
+    def copy(self):
+        return self
+
+    def __repr__(self):
+        return f"{type(self).__name__}(0x{bytes(self).hex()})"
+
+
+# ---------------------------------------------------------------------------
+# Bitvector / Bitlist
+# ---------------------------------------------------------------------------
+
+
+def _bits_to_bytes(bits: Sequence[bool]) -> bytes:
+    out = bytearray((len(bits) + 7) // 8)
+    for i, b in enumerate(bits):
+        if b:
+            out[i // 8] |= 1 << (i % 8)
+    return bytes(out)
+
+
+class _BitsBase(SSZType):
+    def __init__(self, *args):
+        if len(args) == 1 and isinstance(args[0], (list, tuple, _BitsBase)):
+            bits = [bool(b) for b in args[0]]
+        elif len(args) == 1 and isinstance(args[0], (bytes, bytearray)):
+            raise TypeError("use decode_bytes for serialized bit data")
+        else:
+            bits = [bool(b) for b in args]
+        self._check_len(len(bits))
+        self._bits = bits
+
+    def _check_len(self, n: int) -> None:
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self._bits)
+
+    def __iter__(self):
+        return iter(self._bits)
+
+    def __getitem__(self, i):
+        return self._bits[i]
+
+    def __setitem__(self, i, v):
+        self._bits[i] = bool(v)
+
+    def __eq__(self, other):
+        if isinstance(other, _BitsBase):
+            return type(self) is type(other) and self._bits == other._bits
+        if isinstance(other, (list, tuple)):
+            return self._bits == [bool(b) for b in other]
+        return NotImplemented
+
+    def __hash__(self):
+        return hash((type(self), tuple(self._bits)))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({''.join('1' if b else '0' for b in self._bits)})"
+
+    def copy(self):
+        return type(self)(self._bits)
+
+
+class Bitvector(_BitsBase):
+    length: int = 0
+
+    def __class_getitem__(cls, length: int) -> type:
+        return _parameterize(Bitvector, (length,), f"Bitvector[{length}]", {"length": length})
+
+    def __init__(self, *args):
+        if len(args) == 0:
+            args = ([False] * self.length,)
+        super().__init__(*args)
+
+    def _check_len(self, n: int) -> None:
+        if n != self.length:
+            raise ValueError(f"{type(self).__name__}: expected {self.length} bits, got {n}")
+
+    @classmethod
+    def is_fixed_byte_length(cls) -> bool:
+        return True
+
+    @classmethod
+    def type_byte_length(cls) -> int:
+        return (cls.length + 7) // 8
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        if len(data) != (cls.length + 7) // 8:
+            raise ValueError(f"{cls.__name__}: bad byte length {len(data)}")
+        bits = [bool((data[i // 8] >> (i % 8)) & 1) for i in range(cls.length)]
+        # Padding bits past `length` must be zero.
+        for i in range(cls.length, len(data) * 8):
+            if (data[i // 8] >> (i % 8)) & 1:
+                raise ValueError(f"{cls.__name__}: nonzero padding bit")
+        return cls(bits)
+
+    @classmethod
+    def default(cls):
+        return cls()
+
+    def encode_bytes(self) -> bytes:
+        return _bits_to_bytes(self._bits)
+
+    def hash_tree_root(self) -> bytes:
+        return merkleize_chunks(
+            _pack_bytes_to_chunks(self.encode_bytes()), limit=(self.length + 255) // 256
+        )
+
+
+class Bitlist(_BitsBase):
+    limit: int = 0
+
+    def __class_getitem__(cls, limit: int) -> type:
+        return _parameterize(Bitlist, (limit,), f"Bitlist[{limit}]", {"limit": limit})
+
+    def _check_len(self, n: int) -> None:
+        if n > self.limit:
+            raise ValueError(f"{type(self).__name__}: {n} bits exceeds limit {self.limit}")
+
+    @classmethod
+    def is_fixed_byte_length(cls) -> bool:
+        return False
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        if len(data) == 0:
+            raise ValueError(f"{cls.__name__}: empty serialization (missing delimiter)")
+        if data[-1] == 0:
+            raise ValueError(f"{cls.__name__}: last byte must contain delimiter bit")
+        total_bits = (len(data) - 1) * 8 + data[-1].bit_length() - 1
+        if total_bits > cls.limit:
+            raise ValueError(f"{cls.__name__}: {total_bits} bits exceeds limit {cls.limit}")
+        bits = [bool((data[i // 8] >> (i % 8)) & 1) for i in range(total_bits)]
+        return cls(bits)
+
+    @classmethod
+    def default(cls):
+        return cls([])
+
+    def encode_bytes(self) -> bytes:
+        n = len(self._bits)
+        out = bytearray((n // 8) + 1)
+        for i, b in enumerate(self._bits):
+            if b:
+                out[i // 8] |= 1 << (i % 8)
+        out[n // 8] |= 1 << (n % 8)  # delimiter bit
+        return bytes(out)
+
+    def hash_tree_root(self) -> bytes:
+        root = merkleize_chunks(
+            _pack_bytes_to_chunks(_bits_to_bytes(self._bits)), limit=(self.limit + 255) // 256
+        )
+        return mix_in_length(root, len(self._bits))
+
+
+# ---------------------------------------------------------------------------
+# Composite serialization helpers (simple-serialize.md:105-187)
+# ---------------------------------------------------------------------------
+
+
+def _serialize_parts(values: Sequence[Any]) -> bytes:
+    fixed_parts = []
+    variable_parts = []
+    for v in values:
+        if type(v).is_fixed_byte_length():
+            fixed_parts.append(v.encode_bytes())
+            variable_parts.append(b"")
+        else:
+            fixed_parts.append(None)
+            variable_parts.append(v.encode_bytes())
+    fixed_len = sum(OFFSET_BYTE_LENGTH if p is None else len(p) for p in fixed_parts)
+    out = []
+    offset = fixed_len
+    for p, v in zip(fixed_parts, variable_parts):
+        if p is None:
+            out.append(offset.to_bytes(OFFSET_BYTE_LENGTH, "little"))
+            offset += len(v)
+        else:
+            out.append(p)
+    out.extend(v for v in variable_parts if v)
+    return b"".join(out)
+
+
+def _decode_parts(data: bytes, types: Sequence[type]) -> list:
+    """Split a composite serialization into per-element byte ranges and decode."""
+    n = len(types)
+    fixed_lens = [t.type_byte_length() if t.is_fixed_byte_length() else None for t in types]
+    fixed_total = sum(OFFSET_BYTE_LENGTH if fl is None else fl for fl in fixed_lens)
+    if len(data) < fixed_total:
+        raise ValueError(f"composite: {len(data)} bytes < fixed size {fixed_total}")
+    offsets = []
+    pos = 0
+    for fl in fixed_lens:
+        if fl is None:
+            offsets.append(int.from_bytes(data[pos : pos + OFFSET_BYTE_LENGTH], "little"))
+            pos += OFFSET_BYTE_LENGTH
+        else:
+            pos += fl
+    if offsets:
+        if offsets[0] != fixed_total:
+            raise ValueError(f"composite: first offset {offsets[0]} != fixed size {fixed_total}")
+        for a, b in zip(offsets, offsets[1:]):
+            if b < a:
+                raise ValueError("composite: offsets not monotonic")
+        if offsets[-1] > len(data):
+            raise ValueError("composite: offset past end")
+    elif len(data) != fixed_total:
+        raise ValueError(f"composite: trailing bytes ({len(data)} != {fixed_total})")
+    values = []
+    pos = 0
+    oi = 0
+    for t, fl in zip(types, fixed_lens):
+        if fl is None:
+            start = offsets[oi]
+            end = offsets[oi + 1] if oi + 1 < len(offsets) else len(data)
+            oi += 1
+            values.append(t.decode_bytes(data[start:end]))
+            pos += OFFSET_BYTE_LENGTH
+        else:
+            values.append(t.decode_bytes(data[pos : pos + fl]))
+            pos += fl
+    return values
+
+
+# ---------------------------------------------------------------------------
+# Vector / List
+# ---------------------------------------------------------------------------
+
+
+def _is_basic(t: type) -> bool:
+    return issubclass(t, uint)
+
+
+class _SequenceBase(SSZType):
+    element_type: type = None  # type: ignore
+
+    def __init__(self, *args):
+        if len(args) == 1 and isinstance(args[0], (list, tuple)) and not isinstance(args[0], (bytes,)):
+            raw = list(args[0])
+        elif len(args) == 1 and isinstance(args[0], _SequenceBase):
+            raw = list(args[0])
+        else:
+            raw = list(args)
+        self._items = [self.element_type.coerce(v) for v in raw]
+        self._check_len(len(self._items))
+
+    def _check_len(self, n: int) -> None:
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self._items)
+
+    def __iter__(self):
+        return iter(self._items)
+
+    def __getitem__(self, i):
+        return self._items[i]
+
+    def __setitem__(self, i, v):
+        self._items[i] = self.element_type.coerce(v)
+
+    def index(self, v):
+        return self._items.index(v)
+
+    def __contains__(self, v):
+        return v in self._items
+
+    def __eq__(self, other):
+        if isinstance(other, _SequenceBase):
+            return type(self) is type(other) and self._items == other._items
+        if isinstance(other, (list, tuple)):
+            return self._items == list(other)
+        return NotImplemented
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.hash_tree_root()))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._items!r})"
+
+    def _element_chunks(self) -> list:
+        if _is_basic(self.element_type):
+            return _pack_bytes_to_chunks(b"".join(v.encode_bytes() for v in self._items))
+        return [v.hash_tree_root() for v in self._items]
+
+    @classmethod
+    def _chunk_limit(cls, bound: int) -> int:
+        if _is_basic(cls.element_type):
+            return (bound * cls.element_type.type_byte_length() + 31) // 32
+        return bound
+
+
+class Vector(_SequenceBase):
+    length: int = 0
+
+    def __class_getitem__(cls, params: Tuple[type, int]) -> type:
+        elem, length = params
+        return _parameterize(
+            Vector, (elem, length), f"Vector[{elem.__name__}, {length}]",
+            {"element_type": elem, "length": length},
+        )
+
+    def __init__(self, *args):
+        if len(args) == 0:
+            args = ([self.element_type.default() for _ in range(self.length)],)
+        super().__init__(*args)
+
+    def _check_len(self, n: int) -> None:
+        if n != self.length:
+            raise ValueError(f"{type(self).__name__}: expected {self.length} elements, got {n}")
+
+    @classmethod
+    def is_fixed_byte_length(cls) -> bool:
+        return cls.element_type.is_fixed_byte_length()
+
+    @classmethod
+    def type_byte_length(cls) -> int:
+        return cls.element_type.type_byte_length() * cls.length
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        if cls.element_type.is_fixed_byte_length():
+            el = cls.element_type.type_byte_length()
+            if len(data) != el * cls.length:
+                raise ValueError(f"{cls.__name__}: bad byte length {len(data)}")
+            return cls([cls.element_type.decode_bytes(data[i * el : (i + 1) * el]) for i in range(cls.length)])
+        return cls(_decode_parts(data, [cls.element_type] * cls.length))
+
+    @classmethod
+    def default(cls):
+        return cls()
+
+    def encode_bytes(self) -> bytes:
+        if self.element_type.is_fixed_byte_length():
+            return b"".join(v.encode_bytes() for v in self._items)
+        return _serialize_parts(self._items)
+
+    def hash_tree_root(self) -> bytes:
+        return merkleize_chunks(self._element_chunks(), limit=self._chunk_limit(self.length))
+
+
+class List(_SequenceBase):
+    limit: int = 0
+
+    def __class_getitem__(cls, params: Tuple[type, int]) -> type:
+        elem, limit = params
+        return _parameterize(
+            List, (elem, limit), f"List[{elem.__name__}, {limit}]",
+            {"element_type": elem, "limit": limit},
+        )
+
+    def _check_len(self, n: int) -> None:
+        if n > self.limit:
+            raise ValueError(f"{type(self).__name__}: {n} elements exceeds limit {self.limit}")
+
+    def append(self, v):
+        if len(self._items) + 1 > self.limit:
+            raise ValueError(f"{type(self).__name__}: append exceeds limit {self.limit}")
+        self._items.append(self.element_type.coerce(v))
+
+    def pop(self):
+        return self._items.pop()
+
+    @classmethod
+    def is_fixed_byte_length(cls) -> bool:
+        return False
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        if len(data) == 0:
+            return cls([])
+        if cls.element_type.is_fixed_byte_length():
+            el = cls.element_type.type_byte_length()
+            if len(data) % el:
+                raise ValueError(f"{cls.__name__}: byte length {len(data)} not multiple of {el}")
+            n = len(data) // el
+            if n > cls.limit:
+                raise ValueError(f"{cls.__name__}: {n} elements exceeds limit {cls.limit}")
+            return cls([cls.element_type.decode_bytes(data[i * el : (i + 1) * el]) for i in range(n)])
+        # variable-size elements: element count = first_offset / 4
+        first = int.from_bytes(data[:OFFSET_BYTE_LENGTH], "little")
+        if first % OFFSET_BYTE_LENGTH:
+            raise ValueError(f"{cls.__name__}: misaligned first offset")
+        n = first // OFFSET_BYTE_LENGTH
+        if n > cls.limit:
+            raise ValueError(f"{cls.__name__}: {n} elements exceeds limit {cls.limit}")
+        return cls(_decode_parts(data, [cls.element_type] * n))
+
+    @classmethod
+    def default(cls):
+        return cls([])
+
+    def encode_bytes(self) -> bytes:
+        if self.element_type.is_fixed_byte_length():
+            return b"".join(v.encode_bytes() for v in self._items)
+        return _serialize_parts(self._items)
+
+    def hash_tree_root(self) -> bytes:
+        root = merkleize_chunks(self._element_chunks(), limit=self._chunk_limit(self.limit))
+        return mix_in_length(root, len(self._items))
+
+
+# ---------------------------------------------------------------------------
+# Container
+# ---------------------------------------------------------------------------
+
+
+class Container(SSZType):
+    _fields: Dict[str, type] = {}
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        fields: Dict[str, type] = {}
+        for klass in reversed(cls.__mro__):
+            anns = klass.__dict__.get("__annotations__", {})
+            for name, typ in anns.items():
+                if isinstance(typ, type):
+                    fields[name] = typ
+        cls._fields = fields
+
+    def __init__(self, **kwargs):
+        for name, typ in self._fields.items():
+            if name in kwargs:
+                object.__setattr__(self, name, typ.coerce(kwargs.pop(name)))
+            else:
+                object.__setattr__(self, name, typ.default())
+        if kwargs:
+            raise TypeError(f"{type(self).__name__}: unknown fields {sorted(kwargs)}")
+
+    @classmethod
+    def fields(cls) -> Dict[str, type]:
+        return cls._fields
+
+    def __setattr__(self, name, value):
+        typ = self._fields.get(name)
+        if typ is None:
+            raise AttributeError(f"{type(self).__name__} has no SSZ field {name!r}")
+        object.__setattr__(self, name, typ.coerce(value))
+
+    def __eq__(self, other):
+        if not isinstance(other, Container) or type(self) is not type(other):
+            return NotImplemented
+        return all(getattr(self, n) == getattr(other, n) for n in self._fields)
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.hash_tree_root()))
+
+    def __repr__(self):
+        inner = ", ".join(f"{n}={getattr(self, n)!r}" for n in self._fields)
+        return f"{type(self).__name__}({inner})"
+
+    @classmethod
+    def is_fixed_byte_length(cls) -> bool:
+        return all(t.is_fixed_byte_length() for t in cls._fields.values())
+
+    @classmethod
+    def type_byte_length(cls) -> int:
+        return sum(t.type_byte_length() for t in cls._fields.values())
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        values = _decode_parts(data, list(cls._fields.values()))
+        return cls(**dict(zip(cls._fields.keys(), values)))
+
+    @classmethod
+    def default(cls):
+        return cls()
+
+    def encode_bytes(self) -> bytes:
+        return _serialize_parts([getattr(self, n) for n in self._fields])
+
+    def hash_tree_root(self) -> bytes:
+        return merkleize_chunks([getattr(self, n).hash_tree_root() for n in self._fields])
+
+
+# ---------------------------------------------------------------------------
+# Union
+# ---------------------------------------------------------------------------
+
+
+class Union(SSZType):
+    options: Tuple[Optional[type], ...] = ()
+
+    def __class_getitem__(cls, params) -> type:
+        if not isinstance(params, tuple):
+            params = (params,)
+        names = ", ".join("None" if p is None else p.__name__ for p in params)
+        return _parameterize(Union, params, f"Union[{names}]", {"options": params})
+
+    def __init__(self, selector: int, value: Any = None):
+        if not (0 <= selector < len(self.options)):
+            raise ValueError(f"{type(self).__name__}: bad selector {selector}")
+        opt = self.options[selector]
+        if opt is None:
+            if value is not None:
+                raise ValueError("Union: selector 0 (None) takes no value")
+            self.value = None
+        else:
+            self.value = opt.coerce(value)
+        self.selector = selector
+
+    @classmethod
+    def is_fixed_byte_length(cls) -> bool:
+        return False
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        if len(data) == 0:
+            raise ValueError("Union: empty data")
+        selector = data[0]
+        if selector >= len(cls.options):
+            raise ValueError(f"Union: bad selector {selector}")
+        opt = cls.options[selector]
+        if opt is None:
+            if len(data) != 1:
+                raise ValueError("Union: trailing bytes after None selector")
+            return cls(0, None)
+        return cls(selector, opt.decode_bytes(data[1:]))
+
+    @classmethod
+    def default(cls):
+        return cls(0, None if cls.options[0] is None else cls.options[0].default())
+
+    def encode_bytes(self) -> bytes:
+        body = b"" if self.value is None else self.value.encode_bytes()
+        return bytes([self.selector]) + body
+
+    def hash_tree_root(self) -> bytes:
+        root = b"\x00" * 32 if self.value is None else self.value.hash_tree_root()
+        return mix_in_selector(root, self.selector)
+
+    def __eq__(self, other):
+        if not isinstance(other, Union):
+            return NotImplemented
+        return type(self) is type(other) and self.selector == other.selector and self.value == other.value
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.selector, self.hash_tree_root()))
+
+    def __repr__(self):
+        return f"{type(self).__name__}(selector={self.selector}, value={self.value!r})"
+
+
+# ---------------------------------------------------------------------------
+# Generalized indices (ssz/merkle-proofs.md:58-189)
+# ---------------------------------------------------------------------------
+
+
+def get_generalized_index(typ: type, *path) -> int:
+    """Navigate `path` (field names / indices / '__len__') from `typ`'s root."""
+    root = 1
+    for p in path:
+        if p == "__len__":
+            if not (issubclass(typ, (List, Bitlist, ByteList))):
+                raise TypeError(f"__len__ only valid on lists, not {typ}")
+            root = root * 2 + 1
+            typ = uint64
+            continue
+        if issubclass(typ, Container):
+            fields = list(typ.fields().items())
+            names = [n for n, _ in fields]
+            idx = names.index(p)
+            base = next_pow2(len(fields))
+            root = root * base + idx
+            typ = fields[idx][1]
+        elif issubclass(typ, (List, Bitlist, ByteList)):
+            root *= 2  # mix_in_length: data subtree is the left child
+            if issubclass(typ, List):
+                limit = typ._chunk_limit(typ.limit)
+                elem = typ.element_type
+            elif issubclass(typ, Bitlist):
+                limit = (typ.limit + 255) // 256
+                elem = None
+            else:
+                limit = (typ.limit + 31) // 32
+                elem = None
+            base = next_pow2(limit)
+            if elem is not None and not _is_basic(elem):
+                root = root * base + int(p)
+                typ = elem
+            else:
+                per_chunk = 32 // elem.type_byte_length() if elem is not None else 256 if issubclass(typ, Bitlist) else 32
+                root = root * base + int(p) // per_chunk
+                typ = Bytes32
+        elif issubclass(typ, (Vector, Bitvector, ByteVector)):
+            if issubclass(typ, Vector):
+                limit = typ._chunk_limit(typ.length)
+                elem = typ.element_type
+            elif issubclass(typ, Bitvector):
+                limit = (typ.length + 255) // 256
+                elem = None
+            else:
+                limit = (typ.length + 31) // 32
+                elem = None
+            base = next_pow2(limit)
+            if elem is not None and not _is_basic(elem):
+                root = root * base + int(p)
+                typ = elem
+            else:
+                per_chunk = 32 // elem.type_byte_length() if elem is not None else 256 if issubclass(typ, Bitvector) else 32
+                root = root * base + int(p) // per_chunk
+                typ = Bytes32
+        else:
+            raise TypeError(f"cannot navigate into {typ}")
+    return root
+
+
+def get_generalized_index_length(index: int) -> int:
+    """Depth of a generalized index (merkle-proofs.md:46)."""
+    return index.bit_length() - 1

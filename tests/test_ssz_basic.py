@@ -1,0 +1,297 @@
+"""SSZ serialization/Merkleization unit tests.
+
+Expectations are computed with an independent, naive in-test merkleizer
+(plain hashlib over fully-materialized padded trees) — mirroring the
+reference's hand-built ssz_generic vectors (tests/generators/ssz_generic)."""
+import hashlib
+
+import pytest
+
+from consensus_specs_tpu import ssz
+from consensus_specs_tpu.ssz import (
+    Bitlist,
+    Bitvector,
+    ByteList,
+    Bytes32,
+    Bytes48,
+    Container,
+    List,
+    Union,
+    Vector,
+    boolean,
+    uint8,
+    uint16,
+    uint32,
+    uint64,
+    uint256,
+)
+
+
+def h(x: bytes) -> bytes:
+    return hashlib.sha256(x).digest()
+
+
+def naive_merkleize(chunks, limit=None):
+    chunks = list(chunks)
+    count = len(chunks)
+    if limit is None:
+        limit = max(count, 1)
+    size = 1
+    while size < limit:
+        size *= 2
+    chunks = chunks + [b"\x00" * 32] * (size - count)
+    while len(chunks) > 1:
+        chunks = [h(chunks[i] + chunks[i + 1]) for i in range(0, len(chunks), 2)]
+    return chunks[0]
+
+
+def mix_len(root, n):
+    return h(root + n.to_bytes(32, "little"))
+
+
+# --- basic types ---
+
+def test_uint_serialization():
+    assert ssz.serialize(uint64(0x0123456789ABCDEF)) == bytes.fromhex("efcdab8967452301")
+    assert ssz.serialize(uint8(5)) == b"\x05"
+    assert ssz.serialize(uint16(0xABCD)) == b"\xcd\xab"
+    assert uint64.decode_bytes(bytes.fromhex("efcdab8967452301")) == 0x0123456789ABCDEF
+    assert ssz.serialize(uint256(1)) == b"\x01" + b"\x00" * 31
+
+
+def test_uint_bounds():
+    with pytest.raises(ValueError):
+        uint8(256)
+    with pytest.raises(ValueError):
+        uint64(-1)
+    with pytest.raises(ValueError):
+        boolean(2)
+
+
+def test_uint_root():
+    assert ssz.hash_tree_root(uint64(17)) == (17).to_bytes(8, "little") + b"\x00" * 24
+    assert ssz.hash_tree_root(boolean(True)) == b"\x01" + b"\x00" * 31
+
+
+def test_bytes32():
+    v = Bytes32(b"\x11" * 32)
+    assert ssz.serialize(v) == b"\x11" * 32
+    assert ssz.hash_tree_root(v) == b"\x11" * 32
+    assert Bytes32() == b"\x00" * 32
+    with pytest.raises(ValueError):
+        Bytes32(b"\x00" * 31)
+    # Bytes48 spans two chunks
+    b48 = Bytes48(b"\x22" * 48)
+    assert ssz.hash_tree_root(b48) == h(b"\x22" * 48 + b"\x00" * 16)
+
+
+def test_bytelist():
+    t = ByteList[64]
+    v = t(b"abc")
+    assert ssz.serialize(v) == b"abc"
+    expected = mix_len(naive_merkleize([b"abc" + b"\x00" * 29], limit=2), 3)
+    assert ssz.hash_tree_root(v) == expected
+    assert ssz.hash_tree_root(t()) == mix_len(naive_merkleize([], limit=2), 0)
+
+
+# --- bitfields (simple-serialize.md bit packing) ---
+
+def test_bitvector():
+    v = Bitvector[10]([1, 0, 1, 0, 1, 0, 1, 0, 1, 1])
+    assert ssz.serialize(v) == bytes([0b01010101, 0b00000011])
+    rt = Bitvector[10].decode_bytes(ssz.serialize(v))
+    assert rt == v
+    chunk = bytes([0b01010101, 0b00000011]) + b"\x00" * 30
+    assert ssz.hash_tree_root(v) == chunk
+    with pytest.raises(ValueError):
+        Bitvector[10].decode_bytes(bytes([0xFF, 0xFF]))  # nonzero padding
+
+
+def test_bitlist():
+    v = Bitlist[8]([1, 0, 1])
+    assert ssz.serialize(v) == bytes([0b1101])
+    assert Bitlist[8].decode_bytes(bytes([0b1101])) == v
+    chunk = bytes([0b101]) + b"\x00" * 31
+    assert ssz.hash_tree_root(v) == mix_len(chunk, 3)
+    # empty bitlist serializes to the lone delimiter byte
+    assert ssz.serialize(Bitlist[8]([])) == b"\x01"
+    with pytest.raises(ValueError):
+        Bitlist[8].decode_bytes(b"")
+    with pytest.raises(ValueError):
+        Bitlist[8].decode_bytes(b"\x00")
+    with pytest.raises(ValueError):
+        Bitlist[4].decode_bytes(bytes([0b100000]))  # 5 bits > limit 4
+
+
+def test_bitlist_mutation():
+    v = Bitlist[16]([0] * 9)
+    v[3] = True
+    assert ssz.serialize(v) == bytes([0b00001000, 0b10])
+
+
+# --- vectors / lists ---
+
+def test_vector_basic():
+    v = Vector[uint64, 4]([1, 2, 3, 4])
+    assert ssz.serialize(v) == b"".join(i.to_bytes(8, "little") for i in [1, 2, 3, 4])
+    assert ssz.hash_tree_root(v) == b"".join(i.to_bytes(8, "little") for i in [1, 2, 3, 4])
+    v5 = Vector[uint64, 5]([1, 2, 3, 4, 5])
+    packed = b"".join(i.to_bytes(8, "little") for i in [1, 2, 3, 4, 5]) + b"\x00" * 24
+    assert ssz.hash_tree_root(v5) == naive_merkleize([packed[:32], packed[32:]], limit=2)
+
+
+def test_list_basic():
+    t = List[uint64, 1024]
+    v = t([7, 8, 9])
+    assert ssz.serialize(v) == b"".join(i.to_bytes(8, "little") for i in [7, 8, 9])
+    packed = b"".join(i.to_bytes(8, "little") for i in [7, 8, 9]) + b"\x00" * 8
+    # limit 1024 uint64s = 256 chunks
+    assert ssz.hash_tree_root(v) == mix_len(naive_merkleize([packed], limit=256), 3)
+    assert len(t.decode_bytes(ssz.serialize(v))) == 3
+    v.append(10)
+    assert len(v) == 4
+    with pytest.raises(ValueError):
+        List[uint8, 2]([1, 2, 3])
+
+
+def test_huge_limit_list():
+    # 2**40 limit must not materialize chunks (virtual zero padding)
+    t = List[uint64, 2**40]
+    root = ssz.hash_tree_root(t([1]))
+    assert len(root) == 32
+
+
+# --- containers ---
+
+class Small(Container):
+    a: uint64
+    b: uint64
+
+
+class WithVariable(Container):
+    fixed: uint16
+    var: List[uint8, 32]
+    tail: uint16
+
+
+def test_container_fixed():
+    s = Small(a=1, b=2)
+    assert ssz.serialize(s) == (1).to_bytes(8, "little") + (2).to_bytes(8, "little")
+    expected = naive_merkleize(
+        [(1).to_bytes(8, "little") + b"\x00" * 24, (2).to_bytes(8, "little") + b"\x00" * 24]
+    )
+    assert ssz.hash_tree_root(s) == expected
+    assert Small.decode_bytes(ssz.serialize(s)) == s
+    assert Small.is_fixed_byte_length()
+    assert Small.type_byte_length() == 16
+
+
+def test_container_variable():
+    c = WithVariable(fixed=0x1234, var=List[uint8, 32]([1, 2, 3]), tail=0x5678)
+    enc = ssz.serialize(c)
+    # fixed(2) + offset(4) + tail(2) = 8, then var bytes
+    assert enc == bytes.fromhex("3412") + (8).to_bytes(4, "little") + bytes.fromhex("7856") + bytes([1, 2, 3])
+    assert WithVariable.decode_bytes(enc) == c
+    assert not WithVariable.is_fixed_byte_length()
+
+
+def test_container_decode_errors():
+    with pytest.raises(ValueError):
+        WithVariable.decode_bytes(b"\x00\x00" + (7).to_bytes(4, "little") + b"\x00\x00")  # bad first offset
+    with pytest.raises(ValueError):
+        Small.decode_bytes(b"\x00" * 15)
+
+
+def test_container_mutation_and_copy():
+    s = Small(a=1, b=2)
+    s.a = 42
+    assert s.a == 42
+    with pytest.raises(AttributeError):
+        s.c = 1
+    c = s.copy()
+    c.b = 99
+    assert s.b == 2
+
+    class Outer(Container):
+        inner: Small
+
+    o = Outer(inner=Small(a=5, b=6))
+    o2 = o.copy()
+    o2.inner.a = 50
+    assert o.inner.a == 5  # deep copy
+
+
+def test_container_defaults():
+    s = Small()
+    assert s.a == 0 and s.b == 0
+    w = WithVariable()
+    assert len(w.var) == 0
+
+
+def test_nested_roundtrip():
+    class Deep(Container):
+        items: List[Small, 4]
+        name: ByteList[16]
+        flags: Bitlist[12]
+
+    d = Deep(items=List[Small, 4]([Small(a=1, b=2), Small(a=3, b=4)]),
+             name=ByteList[16](b"hello"),
+             flags=Bitlist[12]([1, 1, 0, 1]))
+    assert Deep.decode_bytes(ssz.serialize(d)) == d
+    assert len(ssz.hash_tree_root(d)) == 32
+
+
+# --- union ---
+
+def test_union():
+    U = Union[None, uint16, uint32]
+    u = U(1, 0xAABB)
+    assert ssz.serialize(u) == b"\x01\xbb\xaa"
+    assert U.decode_bytes(b"\x01\xbb\xaa") == u
+    assert ssz.hash_tree_root(u) == h((0xAABB).to_bytes(2, "little") + b"\x00" * 30 + (1).to_bytes(32, "little"))
+    n = U(0, None)
+    assert ssz.serialize(n) == b"\x00"
+    assert U.decode_bytes(b"\x00") == n
+
+
+# --- generalized indices ---
+
+def test_generalized_index_container():
+    gi = ssz.get_generalized_index
+    # Small has 2 fields -> depth 1: a=2, b=3
+    assert gi(Small, "a") == 2
+    assert gi(Small, "b") == 3
+
+    class Four(Container):
+        w: uint64
+        x: uint64
+        y: Small
+        z: uint64
+
+    assert gi(Four, "w") == 4
+    assert gi(Four, "y", "b") == 6 * 2 + 1
+
+
+def test_generalized_index_list():
+    t = List[Small, 8]
+    gi = ssz.get_generalized_index
+    # mix_in_length: data at 2, len at 3; 8 leaves under data
+    assert gi(t, "__len__") == 3
+    assert gi(t, 0) == 2 * 8 + 0
+    assert gi(t, 5) == 2 * 8 + 5
+    assert gi(t, 5, "a") == (2 * 8 + 5) * 2
+
+
+def test_merkle_proof_helpers():
+    leaves = [bytes([i]) * 32 for i in range(5)]
+    tree = ssz.calc_merkle_tree_from_leaves(leaves, 3)
+    root = tree[-1][0]
+    assert root == naive_merkleize(leaves, limit=8)
+    proof = ssz.get_merkle_proof(tree, 2, 3)
+    assert ssz.compute_merkle_proof_root(leaves[2], proof, 2**3 + 2) == root
+
+
+def test_zero_hashes():
+    assert ssz.ZERO_HASHES[0] == b"\x00" * 32
+    assert ssz.ZERO_HASHES[1] == h(b"\x00" * 64)
+    assert ssz.ZERO_HASHES[2] == h(ssz.ZERO_HASHES[1] * 2)

@@ -1,0 +1,1 @@
+"""Device kernels and batched primitives (SHA-256, Merkle reduce, shuffle, BLS)."""

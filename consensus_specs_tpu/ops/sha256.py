@@ -1,0 +1,189 @@
+"""Batched SHA-256 for Merkle hashing, in pure JAX (runs on TPU and CPU).
+
+Replaces the reference's pycryptodome-backed `hash()` shim
+(eth2spec/utils/hash_function.py:8) for the Merkleization hot path: each
+Merkle level is one batched compression over all (left||right) 64-byte
+blocks. Merkle inputs are always exactly 64 bytes, so the digest is
+compress(compress(IV, data_block), PAD_BLOCK) with a constant padding
+block whose message schedule is precomputed at trace time.
+
+All words are big-endian uint32 lanes; jnp uint32 arithmetic wraps mod 2^32,
+which is exactly SHA-256's arithmetic. The 64 rounds are unrolled at trace
+time — static control flow, XLA fuses the whole pipeline.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_K = np.array(
+    [
+        0x428A2F98, 0x71374491, 0xB5C0FBCF, 0xE9B5DBA5, 0x3956C25B, 0x59F111F1, 0x923F82A4, 0xAB1C5ED5,
+        0xD807AA98, 0x12835B01, 0x243185BE, 0x550C7DC3, 0x72BE5D74, 0x80DEB1FE, 0x9BDC06A7, 0xC19BF174,
+        0xE49B69C1, 0xEFBE4786, 0x0FC19DC6, 0x240CA1CC, 0x2DE92C6F, 0x4A7484AA, 0x5CB0A9DC, 0x76F988DA,
+        0x983E5152, 0xA831C66D, 0xB00327C8, 0xBF597FC7, 0xC6E00BF3, 0xD5A79147, 0x06CA6351, 0x14292967,
+        0x27B70A85, 0x2E1B2138, 0x4D2C6DFC, 0x53380D13, 0x650A7354, 0x766A0ABB, 0x81C2C92E, 0x92722C85,
+        0xA2BFE8A1, 0xA81A664B, 0xC24B8B70, 0xC76C51A3, 0xD192E819, 0xD6990624, 0xF40E3585, 0x106AA070,
+        0x19A4C116, 0x1E376C08, 0x2748774C, 0x34B0BCB5, 0x391C0CB3, 0x4ED8AA4A, 0x5B9CCA4F, 0x682E6FF3,
+        0x748F82EE, 0x78A5636F, 0x84C87814, 0x8CC70208, 0x90BEFFFA, 0xA4506CEB, 0xBEF9A3F7, 0xC67178F2,
+    ],
+    dtype=np.uint32,
+)
+
+_IV = np.array(
+    [0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A, 0x510E527F, 0x9B05688C, 0x1F83D9AB, 0x5BE0CD19],
+    dtype=np.uint32,
+)
+
+
+def _pad_block_schedule() -> np.ndarray:
+    """Message schedule of the constant second block for a 64-byte message:
+    0x80, zeros, 64-bit bit-length (512)."""
+    w = np.zeros(64, dtype=np.uint64)
+    w[0] = 0x80000000
+    w[15] = 512
+
+    def rotr(x, n):
+        return ((x >> n) | (x << (32 - n))) & 0xFFFFFFFF
+
+    for t in range(16, 64):
+        s0 = rotr(w[t - 15], 7) ^ rotr(w[t - 15], 18) ^ (w[t - 15] >> 3)
+        s1 = rotr(w[t - 2], 17) ^ rotr(w[t - 2], 19) ^ (w[t - 2] >> 10)
+        w[t] = (w[t - 16] + s0 + w[t - 7] + s1) & 0xFFFFFFFF
+    return w.astype(np.uint32)
+
+
+_PAD_W = _pad_block_schedule()
+
+
+def _rotr(x: jnp.ndarray, n: int) -> jnp.ndarray:
+    return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
+
+
+def _schedule(block: jnp.ndarray) -> jnp.ndarray:
+    """Expand (..., 16) message words to the (64, ...) round schedule.
+
+    lax.scan over a rolling 16-word window keeps the traced graph tiny
+    (compile time matters: an unrolled 64-round graph takes minutes to
+    compile; the scan compiles in seconds and XLA unrolls as it sees fit).
+    """
+    w0 = jnp.moveaxis(block, -1, 0)  # (16, ...)
+
+    def step(window, _):
+        s0 = _rotr(window[1], 7) ^ _rotr(window[1], 18) ^ (window[1] >> np.uint32(3))
+        s1 = _rotr(window[14], 17) ^ _rotr(window[14], 19) ^ (window[14] >> np.uint32(10))
+        wt = window[0] + s0 + window[9] + s1
+        return jnp.concatenate([window[1:], wt[None]], axis=0), wt
+
+    _, ws = jax.lax.scan(step, w0, None, length=48)
+    return jnp.concatenate([w0, ws], axis=0)
+
+
+def _compress(state: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """One SHA-256 compression. state: (..., 8) uint32; w: (64, ...) schedule."""
+    kw = w + jnp.asarray(_K).reshape((64,) + (1,) * (w.ndim - 1))
+
+    def round_fn(carry, kwt):
+        a, b, c, d, e, f, g, hh = carry
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = hh + s1 + ch + kwt
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        return (t1 + s0 + maj, a, b, c, d + t1, e, f, g), None
+
+    carry0 = tuple(state[..., i] for i in range(8))
+    carry, _ = jax.lax.scan(round_fn, carry0, kw)
+    return state + jnp.stack(carry, axis=-1)
+
+
+def sha256_of_block(blocks: jnp.ndarray) -> jnp.ndarray:
+    """SHA-256 digests of (..., 16)-word (64-byte) messages -> (..., 8) words.
+
+    Includes the constant padding-block compression (messages are exactly
+    one block long — the Merkle node case)."""
+    iv = jnp.broadcast_to(jnp.asarray(_IV), blocks.shape[:-1] + (8,))
+    mid = _compress(iv, _schedule(blocks))
+    pad_w = jnp.broadcast_to(
+        jnp.asarray(_PAD_W).reshape((64,) + (1,) * (blocks.ndim - 1)), (64,) + blocks.shape[:-1]
+    )
+    return _compress(mid, pad_w)
+
+
+@jax.jit
+def sha256_blocks_jit(blocks: jnp.ndarray) -> jnp.ndarray:
+    return sha256_of_block(blocks)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def merkle_reduce_jit(chunks: jnp.ndarray, levels: int) -> jnp.ndarray:
+    """Reduce (N, 8)-word chunks to the root, entirely on device.
+
+    N must be 2**levels. One batched compression per level; no host
+    round-trips between levels (the whole loop is one XLA program)."""
+    for _ in range(levels):
+        chunks = sha256_of_block(chunks.reshape(chunks.shape[0] // 2, 16))
+    return chunks[0]
+
+
+# --- host-facing byte APIs -------------------------------------------------
+
+
+def _bytes_to_words(data: bytes, words_per_row: int) -> np.ndarray:
+    arr = np.frombuffer(data, dtype=">u4").astype(np.uint32)
+    return arr.reshape(-1, words_per_row)
+
+
+def _words_to_bytes(words: np.ndarray) -> bytes:
+    return np.asarray(words).astype(">u4").tobytes()
+
+
+def hash_many_device(data: bytes) -> bytes:
+    """`ssz.hashing` backend: SHA-256 of each 64-byte block of `data`.
+
+    Batches are zero-padded to the next power of two so XLA compiles one
+    program per size bucket instead of one per distinct batch size."""
+    n = len(data) // 64
+    size = 1 << (n - 1).bit_length() if n > 1 else 1
+    blocks = np.zeros((size, 16), dtype=np.uint32)
+    blocks[:n] = _bytes_to_words(data, 16)
+    out = np.asarray(sha256_blocks_jit(jnp.asarray(blocks)))[:n]
+    return _words_to_bytes(out)
+
+
+def merkle_root_device(chunks: bytes, limit: int) -> bytes:
+    """Root of zero-padded Merkle tree over packed 32-byte chunks, on device."""
+    from ..ssz.merkle import ZERO_HASHES, ceil_log2, next_pow2
+
+    n = len(chunks) // 32
+    depth = ceil_log2(max(limit, 1))
+    if n == 0:
+        return ZERO_HASHES[depth]
+    size = next_pow2(n)
+    padded = chunks + b"\x00" * ((size - n) * 32)
+    words = jnp.asarray(_bytes_to_words(padded, 8))
+    root = np.asarray(merkle_reduce_jit(words, ceil_log2(size)))
+    root_bytes = _words_to_bytes(root)
+    level = ceil_log2(size)
+    from ..ssz import hashing
+
+    while level < depth:
+        root_bytes = hashing.hash_many(root_bytes + ZERO_HASHES[level])
+        level += 1
+    return root_bytes
+
+
+def use_device_hasher() -> None:
+    """Install the JAX batched hasher as the SSZ merkleization backend."""
+    from ..ssz import hashing
+
+    hashing.set_backend(hash_many_device, name="jax")
+
+
+def use_host_hasher() -> None:
+    from ..ssz import hashing
+
+    hashing.set_backend(None)

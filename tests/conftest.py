@@ -1,14 +1,23 @@
 """Test session config.
 
-Multi-device sharding tests run on a virtual 8-device CPU mesh
-(XLA_FLAGS=--xla_force_host_platform_device_count=8); set before JAX import.
+Tests run on a virtual 8-device CPU mesh so multi-chip sharding is
+exercised without TPU hardware (task spec: xla_force_host_platform_device_count).
+
+Platform selection note: this image's axon sitecustomize registers the TPU
+tunnel as a JAX plugin and force-sets jax_platforms='axon,cpu' via
+jax.config — the JAX_PLATFORMS *env var* is therefore ignored. The config
+update below (before any backend initialization) is what actually pins
+tests to CPU.
 """
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,44 @@
+"""JAX batched SHA-256 vs hashlib, and backend swap equivalence."""
+import hashlib
+import os
+import random
+
+import pytest
+
+from consensus_specs_tpu.ops import sha256 as dev
+from consensus_specs_tpu.ssz import hashing, merkleize_chunks
+
+
+def test_single_block():
+    data = bytes(range(64))
+    assert dev.hash_many_device(data) == hashlib.sha256(data).digest()
+
+
+def test_batch_blocks():
+    rng = random.Random(1234)
+    blocks = [bytes(rng.randrange(256) for _ in range(64)) for _ in range(37)]
+    got = dev.hash_many_device(b"".join(blocks))
+    want = b"".join(hashlib.sha256(b).digest() for b in blocks)
+    assert got == want
+
+
+def test_merkle_root_device_matches_host():
+    rng = random.Random(7)
+    for n, limit in [(1, 1), (3, 8), (8, 8), (5, 2**32), (0, 16)]:
+        chunks = [bytes(rng.randrange(256) for _ in range(32)) for _ in range(n)]
+        host = merkleize_chunks(chunks, limit=limit)
+        devr = dev.merkle_root_device(b"".join(chunks), limit=limit)
+        assert devr == host, (n, limit)
+
+
+def test_backend_swap():
+    rng = random.Random(99)
+    chunks = [bytes(rng.randrange(256) for _ in range(32)) for _ in range(11)]
+    host_root = merkleize_chunks(chunks, limit=16)
+    dev.use_device_hasher()
+    try:
+        assert hashing.backend_name() == "jax"
+        assert merkleize_chunks(chunks, limit=16) == host_root
+    finally:
+        dev.use_host_hasher()
+    assert hashing.backend_name() == "hashlib"

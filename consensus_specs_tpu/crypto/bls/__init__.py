@@ -1,0 +1,114 @@
+"""BLS facade with switchable backends (ref: eth2spec/utils/bls.py:6-44).
+
+Backends:
+  - "reference": pure-Python host implementation (this package) — the
+    correctness oracle, like the reference's py_ecc default.
+  - "jax": batched TPU/JAX backend (ops.bls_jax) — the milagro-analog
+    fast path; falls back to reference for single ops it doesn't cover.
+
+`bls_active` kill-switch + `only_with_bls` decorator mirror the
+reference's test-speed escape hatch (utils/bls.py:33-44): signature
+checks are skipped wholesale when off.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from . import ciphersuite as _reference
+
+G2_POINT_AT_INFINITY = _reference.G2_POINT_AT_INFINITY
+
+bls_active = True
+_backend = _reference
+_backend_name = "reference"
+
+
+def use_backend(name: str) -> None:
+    global _backend, _backend_name
+    if name == "reference":
+        _backend = _reference
+    elif name == "jax":
+        from ...ops import bls_jax
+
+        _backend = bls_jax
+    else:
+        raise ValueError(f"unknown BLS backend {name!r}")
+    _backend_name = name
+
+
+def use_reference() -> None:
+    use_backend("reference")
+
+
+def use_jax() -> None:
+    use_backend("jax")
+
+
+def backend_name() -> str:
+    return _backend_name
+
+
+def only_with_bls(alt_return=None):
+    """Decorator: skip the wrapped check (returning `alt_return`) when
+    bls_active is False (utils/bls.py:37-44)."""
+
+    def decorator(fn):
+        def wrapper(*args, **kwargs):
+            if not bls_active:
+                return alt_return
+            return fn(*args, **kwargs)
+
+        wrapper.__name__ = fn.__name__
+        return wrapper
+
+    return decorator
+
+
+@only_with_bls(alt_return=True)
+def Verify(pubkey: bytes, message: bytes, signature: bytes) -> bool:
+    try:
+        return _backend.Verify(pubkey, message, signature)
+    except Exception:
+        return False
+
+
+@only_with_bls(alt_return=True)
+def AggregateVerify(pubkeys: Sequence[bytes], messages: Sequence[bytes], signature: bytes) -> bool:
+    try:
+        return _backend.AggregateVerify(pubkeys, messages, signature)
+    except Exception:
+        return False
+
+
+@only_with_bls(alt_return=True)
+def FastAggregateVerify(pubkeys: Sequence[bytes], message: bytes, signature: bytes) -> bool:
+    try:
+        return _backend.FastAggregateVerify(pubkeys, message, signature)
+    except Exception:
+        return False
+
+
+@only_with_bls(alt_return=G2_POINT_AT_INFINITY)
+def Aggregate(signatures: Sequence[bytes]) -> bytes:
+    return _backend.Aggregate(signatures)
+
+
+@only_with_bls(alt_return=b"\x00" * 96)
+def Sign(privkey, message: bytes) -> bytes:
+    return _backend.Sign(privkey, message)
+
+
+def AggregatePKs(pubkeys: Sequence[bytes]) -> bytes:
+    return _backend.AggregatePKs(pubkeys)
+
+
+def SkToPk(privkey) -> bytes:
+    return _backend.SkToPk(privkey)
+
+
+def KeyValidate(pubkey: bytes) -> bool:
+    return _backend.KeyValidate(pubkey)
+
+
+def signature_to_G2(signature: bytes):
+    return _reference.signature_to_G2(signature)

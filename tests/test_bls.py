@@ -1,0 +1,147 @@
+"""BLS12-381 tests: field/curve laws, pairing bilinearity, hash-to-curve
+consistency, and the sign/verify/aggregate API edge cases the reference's
+bls generator covers (tests/generators/bls/main.py:40-60)."""
+import random
+
+import pytest
+
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.crypto.bls import ciphersuite as cs
+from consensus_specs_tpu.crypto.bls import hash_to_curve as h2c
+from consensus_specs_tpu.crypto.bls.curve import (
+    B2,
+    g1_from_bytes,
+    g1_generator,
+    g1_to_bytes,
+    g2_from_bytes,
+    g2_generator,
+    g2_to_bytes,
+)
+from consensus_specs_tpu.crypto.bls.fields import Fq2, P, R
+from consensus_specs_tpu.crypto.bls.pairing import pairing
+
+pytestmark = pytest.mark.bls
+
+
+def test_generators_valid():
+    g1, g2 = g1_generator(), g2_generator()
+    assert g1.on_curve() and g2.on_curve()
+    assert g1.in_subgroup() and g2.in_subgroup()
+    assert g1.mul(R).is_infinity and g2.mul(R).is_infinity
+
+
+def test_point_serialization_roundtrip():
+    rng = random.Random(5)
+    for _ in range(4):
+        k = rng.randrange(1, R)
+        p1 = g1_generator().mul(k)
+        assert g1_from_bytes(g1_to_bytes(p1)) == p1
+        p2 = g2_generator().mul(k)
+        assert g2_from_bytes(g2_to_bytes(p2)) == p2
+    # known anchor: pubkey for sk=1 is the compressed G1 generator
+    assert cs.SkToPk(1).hex().startswith("97f1d3a73197d7942695638c4fa9ac0fc3688c4f9774b905a14e3a3f171bac58")
+
+
+def test_infinity_serialization():
+    inf1 = g1_generator().infinity()
+    assert g1_to_bytes(inf1)[0] == 0xC0
+    assert g1_from_bytes(g1_to_bytes(inf1)).is_infinity
+    assert cs.G2_POINT_AT_INFINITY == g2_to_bytes(g2_generator().infinity())
+    assert g2_from_bytes(cs.G2_POINT_AT_INFINITY).is_infinity
+
+
+def test_pairing_bilinearity():
+    e = pairing(g1_generator(), g2_generator())
+    assert not e.is_one()
+    assert e.pow(R).is_one()
+    assert pairing(g1_generator().mul(3), g2_generator().mul(4)) == e.pow(12)
+
+
+def test_sswu_and_iso_on_curve():
+    rng = random.Random(42)
+    for _ in range(3):
+        u = Fq2(rng.randrange(P), rng.randrange(P))
+        x, y = h2c.map_to_curve_simple_swu(u)
+        assert y.square() == x * x.square() + h2c._A * x + h2c._B
+        xo, yo = h2c.iso_map_g2(x, y)
+        assert yo.square() == xo * xo.square() + B2
+
+
+def test_hash_to_g2_subgroup_and_determinism():
+    p1 = h2c.hash_to_g2(b"test message")
+    p2 = h2c.hash_to_g2(b"test message")
+    p3 = h2c.hash_to_g2(b"other message")
+    assert p1 == p2 and p1 != p3
+    assert p1.on_curve() and p1.in_subgroup()
+
+
+def test_expand_message_xmd_shapes():
+    out = h2c.expand_message_xmd(b"msg", b"DST", 96)
+    assert len(out) == 96
+    assert h2c.expand_message_xmd(b"msg", b"DST", 96) == out
+    assert h2c.expand_message_xmd(b"msg2", b"DST", 96) != out
+
+
+def test_sign_verify():
+    sk, msg = 12345, b"hello consensus"
+    pk = cs.SkToPk(sk)
+    sig = cs.Sign(sk, msg)
+    assert cs.Verify(pk, msg, sig)
+    assert not cs.Verify(pk, b"wrong message", sig)
+    assert not cs.Verify(cs.SkToPk(54321), msg, sig)
+    # tampered signature
+    bad = bytearray(sig)
+    bad[-1] ^= 1
+    assert not cs.Verify(pk, msg, bytes(bad))
+
+
+def test_aggregate_same_message():
+    msg = b"attestation data root"
+    sks = [101, 202, 303]
+    pks = [cs.SkToPk(sk) for sk in sks]
+    sigs = [cs.Sign(sk, msg) for sk in sks]
+    agg = cs.Aggregate(sigs)
+    assert cs.FastAggregateVerify(pks, msg, agg)
+    assert not cs.FastAggregateVerify(pks[:2], msg, agg)
+    assert not cs.FastAggregateVerify(pks, b"other", agg)
+    # aggregated pubkey verifies as a plain key
+    assert cs.Verify(cs.AggregatePKs(pks), msg, agg)
+
+
+def test_aggregate_distinct_messages():
+    pairs = [(7, b"m1"), (8, b"m2"), (9, b"m3")]
+    pks = [cs.SkToPk(sk) for sk, _ in pairs]
+    msgs = [m for _, m in pairs]
+    agg = cs.Aggregate([cs.Sign(sk, m) for sk, m in pairs])
+    assert cs.AggregateVerify(pks, msgs, agg)
+    assert not cs.AggregateVerify(pks, [b"m1", b"m2", b"mX"], agg)
+    assert not cs.AggregateVerify(list(reversed(pks)), msgs, agg)
+
+
+def test_edge_cases():
+    # empty-input rules (bls generator edge vectors, generators/bls/main.py:56-60)
+    with pytest.raises(Exception):
+        cs.Aggregate([])
+    assert not cs.FastAggregateVerify([], b"msg", cs.G2_POINT_AT_INFINITY)
+    assert not cs.AggregateVerify([], [], cs.G2_POINT_AT_INFINITY)
+    # infinity pubkey fails KeyValidate and Verify
+    inf_pk = g1_to_bytes(g1_generator().infinity())
+    assert not cs.KeyValidate(inf_pk)
+    assert not cs.Verify(inf_pk, b"msg", cs.G2_POINT_AT_INFINITY)
+    assert cs.KeyValidate(cs.SkToPk(1))
+    with pytest.raises(ValueError):
+        cs.Sign(0, b"msg")
+    with pytest.raises(ValueError):
+        cs.Sign(R, b"msg")
+
+
+def test_facade_switch():
+    sk, msg = 42, b"facade"
+    pk, sig = bls.SkToPk(sk), bls.Sign(sk, msg)
+    assert bls.Verify(pk, msg, sig)
+    assert not bls.Verify(pk, msg, b"\x00" * 96)  # exception-swallowing path
+    bls.bls_active = False
+    try:
+        assert bls.Verify(pk, b"anything", b"junk")  # skipped -> True
+    finally:
+        bls.bls_active = True

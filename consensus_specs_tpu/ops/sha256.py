@@ -118,6 +118,14 @@ def sha256_blocks_jit(blocks: jnp.ndarray) -> jnp.ndarray:
     return sha256_of_block(blocks)
 
 
+@jax.jit
+def sha256_raw_blocks_jit(blocks: jnp.ndarray) -> jnp.ndarray:
+    """Single compression from IV over ALREADY-PADDED (..., 16)-word blocks
+    (for <=55-byte messages whose padding was laid out on host)."""
+    iv = jnp.broadcast_to(jnp.asarray(_IV), blocks.shape[:-1] + (8,))
+    return _compress(iv, _schedule(blocks))
+
+
 @functools.partial(jax.jit, static_argnums=(1,))
 def merkle_reduce_jit(chunks: jnp.ndarray, levels: int) -> jnp.ndarray:
     """Reduce (N, 8)-word chunks to the root, entirely on device.
@@ -176,14 +184,36 @@ def merkle_root_device(chunks: bytes, limit: int) -> bytes:
     return root_bytes
 
 
+def hash_small_device(messages) -> list:
+    """Batched SHA-256 of <=55-byte messages: pad each into one 64-byte
+    block on host, one raw-compression kernel call for the whole batch."""
+    m = len(messages)
+    size = 1 << (m - 1).bit_length() if m > 1 else 1
+    buf = bytearray(size * 64)
+    for i, msg in enumerate(messages):
+        n = len(msg)
+        if n > 55:
+            raise ValueError(f"hash_small_device: message too long ({n} > 55)")
+        off = i * 64
+        buf[off : off + n] = msg
+        buf[off + n] = 0x80
+        buf[off + 56 : off + 64] = (8 * n).to_bytes(8, "big")
+    words = jnp.asarray(_bytes_to_words(bytes(buf), 16))
+    out = np.asarray(sha256_raw_blocks_jit(words))[:m]
+    raw = _words_to_bytes(out)
+    return [raw[32 * i : 32 * i + 32] for i in range(m)]
+
+
 def use_device_hasher() -> None:
     """Install the JAX batched hasher as the SSZ merkleization backend."""
     from ..ssz import hashing
 
     hashing.set_backend(hash_many_device, name="jax")
+    hashing.set_small_backend(hash_small_device)
 
 
 def use_host_hasher() -> None:
     from ..ssz import hashing
 
     hashing.set_backend(None)
+    hashing.set_small_backend(None)

@@ -1,0 +1,14 @@
+"""Fork spec sources (deltas) + builder.
+
+Each ``<fork>.py`` file in this package is a *spec source*: a Python
+delta over its parent fork, written against names that the builder
+injects (preset constants, the ``config`` object, and every definition
+of the parent forks). They are executed by ``build.build_spec`` into
+flat per-(fork, preset) modules — the same architecture as the
+reference's markdown→`eth2spec.<fork>.<preset>` compiler (setup.py:
+168-264, 580-678), with Python files as the source of truth instead of
+markdown. Do not import the source files directly.
+"""
+from .build import build_spec, spec_targets, FORK_ORDER
+
+__all__ = ["build_spec", "spec_targets", "FORK_ORDER"]

@@ -54,6 +54,29 @@ def hash_many(data: bytes) -> bytes:
     return _backend(data)
 
 
+_small_backend: Optional[Callable] = None
+
+
+def set_small_backend(fn: Optional[Callable]) -> None:
+    """Install a batched short-message hasher: ``fn(messages) -> [digest]``
+    for messages of <=55 bytes (one compression block after padding)."""
+    global _small_backend
+    _small_backend = fn
+
+
+def sha256_many_small(messages) -> list:
+    """Batched SHA-256 of many short (<=55 byte) messages. Each fits a
+    single compression block after standard padding, so a device backend
+    (ops.sha256.hash_small_device) does the whole batch in one raw-block
+    kernel call. Used by the shuffle's per-round source hashes
+    (beacon-chain.md:760-785) and proposer sampling; host default loops
+    hashlib."""
+    if _small_backend is not None:
+        return _small_backend(messages)
+    sha = hashlib.sha256
+    return [sha(m).digest() for m in messages]
+
+
 def sha256(data: bytes) -> bytes:
     """Plain one-shot SHA-256 (arbitrary length) — always on host.
 

@@ -328,11 +328,23 @@ class _BitsBase(SSZType):
         return self._bits[i]
 
     def __setitem__(self, i, v):
-        self._bits[i] = bool(v)
+        if isinstance(i, slice):
+            # Validate the post-assignment length BEFORE committing so a
+            # failed check can't leave the value corrupted.
+            new_bits = list(self._bits)
+            new_bits[i] = [bool(x) for x in v]
+            self._check_len(len(new_bits))
+            self._bits = new_bits
+        else:
+            self._bits[i] = bool(v)
 
     def __eq__(self, other):
         if isinstance(other, _BitsBase):
-            return type(self) is type(other) and self._bits == other._bits
+            # Same kind (Bitvector vs Bitlist) + equal bits; cross-module
+            # parameterized classes compare by value (see _SequenceBase).
+            if isinstance(self, Bitvector) is not isinstance(other, Bitvector):
+                return NotImplemented
+            return self._bits == other._bits
         if isinstance(other, (list, tuple)):
             return self._bits == [bool(b) for b in other]
         return NotImplemented
@@ -549,12 +561,20 @@ class _SequenceBase(SSZType):
     def index(self, v):
         return self._items.index(v)
 
+    def count(self, v):
+        return self._items.count(v)
+
     def __contains__(self, v):
         return v in self._items
 
     def __eq__(self, other):
         if isinstance(other, _SequenceBase):
-            return type(self) is type(other) and self._items == other._items
+            # Same kind (Vector vs List) + equal items; exact class identity
+            # is not required so values from differently-built spec modules
+            # (whose parameterized classes are distinct) compare equal.
+            if isinstance(self, Vector) is not isinstance(other, Vector):
+                return NotImplemented
+            return self._items == other._items
         if isinstance(other, (list, tuple)):
             return self._items == list(other)
         return NotImplemented
@@ -724,9 +744,31 @@ class Container(SSZType):
             raise AttributeError(f"{type(self).__name__} has no SSZ field {name!r}")
         object.__setattr__(self, name, typ.coerce(value))
 
+    @classmethod
+    def coerce(cls, value):
+        if isinstance(value, cls):
+            return value
+        if isinstance(value, Container):
+            # Cross-class coercion (e.g. the same container type from another
+            # (fork, preset) spec module, or a fork upgrade reusing unchanged
+            # sub-containers): copy field-wise, coercing recursively.
+            if set(cls._fields) != set(value._fields):
+                raise TypeError(
+                    f"cannot coerce {type(value).__name__} to {cls.__name__}: field mismatch"
+                )
+            return cls(**{n: getattr(value, n) for n in cls._fields})
+        if isinstance(value, dict):
+            return cls(**value)
+        return cls(value)
+
     def __eq__(self, other):
-        if not isinstance(other, Container) or type(self) is not type(other):
+        # Same field names + equal field values; class *identity* is not
+        # required so values from differently-built spec modules compare equal.
+        if not isinstance(other, Container):
             return NotImplemented
+        if type(self) is not type(other):
+            if type(self).__name__ != type(other).__name__ or set(self._fields) != set(other._fields):
+                return NotImplemented
         return all(getattr(self, n) == getattr(other, n) for n in self._fields)
 
     def __hash__(self):

@@ -1,0 +1,60 @@
+"""Block transition runners (ref: test/helpers/state.py:60-120 and
+helpers/block.py signing flow)."""
+from __future__ import annotations
+
+from .block import sign_block
+from .context import expect_assertion_error
+
+
+def transition_unsigned_block(spec, state, block):
+    """process_slots + process_block, without signature/state-root checks."""
+    assert state.slot < block.slot
+    spec.process_slots(state, block.slot)
+    spec.process_block(state, block)
+    return block
+
+
+def state_transition_and_sign_block(spec, state, block, expect_fail=False):
+    """Apply the block to ``state``, fill in its state root, and return the
+    signed block (ref state.py:60-90). With ``expect_fail`` the transition
+    must raise and state is left at the pre-block slot."""
+    if expect_fail:
+        expect_assertion_error(lambda: transition_unsigned_block(spec, state.copy(), block))
+        return None
+    transition_unsigned_block(spec, state, block)
+    block.state_root = spec.hash_tree_root(state)
+    return sign_block(spec, state, block)
+
+
+def run_block_processing_to(spec, state, block, process_name: str):
+    """Advance state through the per-block sub-transitions *before*
+    ``process_name``, then return — so a test can run exactly one
+    sub-transition against a correctly-staged state
+    (ref helpers/block_processing.py)."""
+    if state.slot < block.slot:
+        spec.process_slots(state, block.slot)
+
+    ordered = [
+        "process_block_header",
+        "process_randao",
+        "process_eth1_data",
+        "process_operations",
+    ]
+    if hasattr(spec, "process_withdrawals"):
+        ordered.insert(1, "process_withdrawals")
+    if hasattr(spec, "process_execution_payload") and "process_withdrawals" not in ordered:
+        pass
+
+    for name in ordered:
+        if name == process_name:
+            break
+        fn = getattr(spec, name, None)
+        if fn is None:
+            continue
+        if name == "process_block_header":
+            fn(state, block)
+        elif name == "process_withdrawals":
+            fn(state, block.body.execution_payload)
+        else:
+            fn(state, block.body)
+    return state

@@ -1,0 +1,44 @@
+"""Deterministic test keypairs: privkey(i) = i + 1 (ref: test/helpers/
+keys.py:1-6). Pubkeys are derived lazily and cached for the session —
+the reference precomputes 8192 eagerly with native BLS; with the pure-host
+scalar-mul here, laziness keeps import instant."""
+from __future__ import annotations
+
+from typing import Dict
+
+from consensus_specs_tpu.crypto.bls import ciphersuite
+
+
+class _LazyPubkeys:
+    """Sequence-like view: pubkeys[i] == SkToPk(i + 1)."""
+
+    def __init__(self):
+        self._cache: Dict[int, bytes] = {}
+
+    def __getitem__(self, i: int) -> bytes:
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(1 << 14))]
+        if i < 0:
+            i += 1 << 14
+        pk = self._cache.get(i)
+        if pk is None:
+            pk = ciphersuite.SkToPk(i + 1)
+            self._cache[i] = pk
+            pubkey_to_privkey[pk] = i + 1
+        return pk
+
+
+def privkey(index: int) -> int:
+    return index + 1
+
+
+class _Privkeys:
+    def __getitem__(self, i: int) -> int:
+        if i < 0:
+            i += 1 << 14
+        return i + 1
+
+
+privkeys = _Privkeys()
+pubkeys = _LazyPubkeys()
+pubkey_to_privkey: Dict[bytes, int] = {}

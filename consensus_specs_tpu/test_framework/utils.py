@@ -1,0 +1,66 @@
+"""pytest ↔ generator dual-mode adapter (ref: test/utils/utils.py)."""
+from __future__ import annotations
+
+from functools import wraps
+
+from consensus_specs_tpu.ssz.types import SSZType
+
+
+def vector_test():
+    """Wrap a yielding test so that:
+    - generator mode returns [(name, kind, value), ...] parts with kinds
+      inferred (SSZ view → "ssz", bytes → "ssz", else "data"; explicit
+      3-tuples pass through) — ref utils.py:29-55;
+    - pytest mode drains and discards the generator — ref utils.py:63-69.
+    """
+
+    def runner(fn):
+        @wraps(fn)
+        def entry(*args, **kw):
+            def generator_mode():
+                out = fn(*args, **kw)
+                if out is None:
+                    return
+                for part in out:
+                    if len(part) == 2:
+                        (key, value) = part
+                        if isinstance(value, (SSZType, bytes, bytearray)):
+                            yield key, "ssz", value
+                        else:
+                            yield key, "data", value
+                    else:
+                        yield part
+
+            if kw.pop("generator_mode", False):
+                return list(generator_mode())
+            # pytest mode: drain
+            out = fn(*args, **kw)
+            if out is not None:
+                for _ in out:
+                    continue
+            return None
+
+        return entry
+
+    return runner
+
+
+def with_meta_tags(tags: dict):
+    """Append meta key/values to the test's output parts (ref utils.py:76)."""
+
+    def runner(fn):
+        @wraps(fn)
+        def entry(*args, **kw):
+            yielded_any = False
+            out = fn(*args, **kw)
+            if out is not None:
+                for part in out:
+                    yielded_any = True
+                    yield part
+            if yielded_any:
+                for k, v in tags.items():
+                    yield k, "meta", v
+
+        return entry
+
+    return runner

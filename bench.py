@@ -1,0 +1,70 @@
+"""Benchmark: device hash_tree_root Merkleization throughput vs the host
+(hashlib ~= the reference's pycryptodome path, utils/hash_function.py:8).
+
+Measures the device-resident path — chunk data already in HBM, only the
+32-byte root fetched — which is the framework's design point (BeaconState
+leaves stay on device between transitions). Fetching the root forces
+completion (block_until_ready is unreliable through the axon tunnel).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+BASELINE.md configs #2/#5 (ssz_static hash_tree_root throughput) — the
+north-star until the device BLS backend lands (#1/#3/#4).
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from consensus_specs_tpu.ops.sha256 import merkle_reduce_jit, _words_to_bytes
+    from consensus_specs_tpu.ssz import merkle
+
+    levels = 20
+    n_chunks = 1 << levels  # 32 MiB of chunk data — mainnet-registry scale
+    mib = n_chunks * 32 / (1 << 20)
+    rng = np.random.default_rng(42)
+    words_np = rng.integers(0, 2**32, size=(n_chunks, 8), dtype=np.uint32)
+    words = jax.device_put(jnp.asarray(words_np))
+
+    # Warm-up (compile + first run), then timed reps with forced root fetch
+    np.asarray(merkle_reduce_jit(words, levels))
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        root_dev_words = np.asarray(merkle_reduce_jit(words, levels))
+        times.append(time.perf_counter() - t0)
+    dev_mbs = mib / min(times)
+    root_dev = _words_to_bytes(root_dev_words)
+
+    # Host baseline (single run; it is the slow side)
+    chunk_bytes = words_np.astype(">u4").tobytes()
+    chunk_list = [chunk_bytes[i : i + 32] for i in range(0, len(chunk_bytes), 32)]
+    t0 = time.perf_counter()
+    root_host = merkle.merkleize_chunks(chunk_list, limit=n_chunks)
+    host_mbs = mib / (time.perf_counter() - t0)
+
+    if root_dev != root_host:
+        print(json.dumps({"metric": "hash_tree_root_throughput", "value": 0.0,
+                          "unit": "MiB/s", "vs_baseline": 0.0,
+                          "error": "device root mismatch"}))
+        sys.exit(1)
+
+    print(json.dumps({
+        "metric": "hash_tree_root_throughput",
+        "value": round(dev_mbs, 2),
+        "unit": "MiB/s",
+        "vs_baseline": round(dev_mbs / host_mbs, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -29,6 +29,12 @@ PREVIOUS_FORK = {
 _SOURCE_DIR = Path(__file__).resolve().parent
 _cache: Dict[Tuple[str, str], types.ModuleType] = {}
 _code_cache: Dict[str, Any] = {}
+_override_seq = 0
+
+
+def available_forks():
+    """Forks whose spec source exists on disk, in dependency order."""
+    return [f for f in FORK_ORDER if (_SOURCE_DIR / f"{f}.py").exists()]
 
 
 def _fork_chain(fork: str):
@@ -69,7 +75,14 @@ def build_spec(
         return _cache[cache_key]
 
     chain = _fork_chain(fork)
-    suffix = "" if config_overrides is None else f"_o{id(config_overrides):x}"
+    if config_overrides is None:
+        suffix = ""
+    else:
+        # Monotonic counter: names must stay unique for the lifetime of the
+        # process (id() can be recycled; sys.modules + state caches key on it)
+        global _override_seq
+        _override_seq += 1
+        suffix = f"_o{_override_seq}"
     mod = types.ModuleType(f"consensus_specs_tpu.specs.{fork}_{preset_name}{suffix}")
     mod.__file__ = str(_SOURCE_DIR / f"{fork}.py")
     ns = mod.__dict__

@@ -1135,6 +1135,18 @@ def process_block(state: "BeaconState", block: BeaconBlock) -> None:
     process_operations(state, block.body)
 
 
+def block_process_steps():
+    """Ordered (name, apply) sub-transition table for this fork's
+    process_block — test infrastructure uses it to stage a state up to a
+    given sub-transition. Later forks override with their own order."""
+    return [
+        ("process_block_header", lambda state, block: process_block_header(state, block)),
+        ("process_randao", lambda state, block: process_randao(state, block.body)),
+        ("process_eth1_data", lambda state, block: process_eth1_data(state, block.body)),
+        ("process_operations", lambda state, block: process_operations(state, block.body)),
+    ]
+
+
 def process_block_header(state: "BeaconState", block: BeaconBlock) -> None:
     # Slot/proposer/parent consistency
     assert block.slot == state.slot

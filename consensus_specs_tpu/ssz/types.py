@@ -338,11 +338,16 @@ class _BitsBase(SSZType):
         else:
             self._bits[i] = bool(v)
 
+    def _type_key(self):
+        bound = self.length if isinstance(self, Bitvector) else self.limit
+        return (isinstance(self, Bitvector), int(bound))
+
     def __eq__(self, other):
         if isinstance(other, _BitsBase):
-            # Same kind (Bitvector vs Bitlist) + equal bits; cross-module
-            # parameterized classes compare by value (see _SequenceBase).
-            if isinstance(self, Bitvector) is not isinstance(other, Bitvector):
+            # Same kind + same bound + equal bits; cross-module parameterized
+            # classes compare by value (see _SequenceBase), and __hash__ uses
+            # the same key so the eq/hash contract holds.
+            if self._type_key() != other._type_key():
                 return NotImplemented
             return self._bits == other._bits
         if isinstance(other, (list, tuple)):
@@ -350,7 +355,7 @@ class _BitsBase(SSZType):
         return NotImplemented
 
     def __hash__(self):
-        return hash((type(self), tuple(self._bits)))
+        return hash((self._type_key(), tuple(self._bits)))
 
     def __repr__(self):
         return f"{type(self).__name__}({''.join('1' if b else '0' for b in self._bits)})"
@@ -567,12 +572,17 @@ class _SequenceBase(SSZType):
     def __contains__(self, v):
         return v in self._items
 
+    def _type_key(self):
+        # (kind, bound, element-type name): what must match for two
+        # parameterized classes from different spec modules to be "the same
+        # type" — keeps __eq__ consistent with __hash__ (which hashes the
+        # limit-padded tree root).
+        bound = self.length if isinstance(self, Vector) else self.limit
+        return (isinstance(self, Vector), int(bound), self.element_type.__name__)
+
     def __eq__(self, other):
         if isinstance(other, _SequenceBase):
-            # Same kind (Vector vs List) + equal items; exact class identity
-            # is not required so values from differently-built spec modules
-            # (whose parameterized classes are distinct) compare equal.
-            if isinstance(self, Vector) is not isinstance(other, Vector):
+            if self._type_key() != other._type_key():
                 return NotImplemented
             return self._items == other._items
         if isinstance(other, (list, tuple)):

@@ -29,32 +29,17 @@ def state_transition_and_sign_block(spec, state, block, expect_fail=False):
 def run_block_processing_to(spec, state, block, process_name: str):
     """Advance state through the per-block sub-transitions *before*
     ``process_name``, then return — so a test can run exactly one
-    sub-transition against a correctly-staged state
+    sub-transition against a correctly-staged state. The order comes from
+    the spec's own block_process_steps() table, so fork deltas that insert
+    steps (execution payload, withdrawals, sync aggregate) stage correctly
     (ref helpers/block_processing.py)."""
     if state.slot < block.slot:
         spec.process_slots(state, block.slot)
 
-    ordered = [
-        "process_block_header",
-        "process_randao",
-        "process_eth1_data",
-        "process_operations",
-    ]
-    if hasattr(spec, "process_withdrawals"):
-        ordered.insert(1, "process_withdrawals")
-    if hasattr(spec, "process_execution_payload") and "process_withdrawals" not in ordered:
-        pass
-
-    for name in ordered:
+    names = [name for name, _ in spec.block_process_steps()]
+    assert process_name in names, f"{process_name} not in {names}"
+    for name, apply in spec.block_process_steps():
         if name == process_name:
             break
-        fn = getattr(spec, name, None)
-        if fn is None:
-            continue
-        if name == "process_block_header":
-            fn(state, block)
-        elif name == "process_withdrawals":
-            fn(state, block.body.execution_payload)
-        else:
-            fn(state, block.body)
+        apply(state, block)
     return state

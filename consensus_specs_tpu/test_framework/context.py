@@ -216,19 +216,22 @@ def with_phases(phases: Sequence[str], other_phases: Optional[Sequence[str]] = N
     def deco(fn):
         @wraps(fn)
         def entry(*args, **kw):
-            run_phases = phases
+            from consensus_specs_tpu.specs.build import available_forks
+
+            have = set(available_forks())
+            run_phases = [p for p in phases if p in have]
             phase = kw.pop("phase", None)
             if phase is not None:
-                if phase not in phases:
+                if phase not in phases or phase not in have:
                     return None
                 run_phases = [phase]
             preset = kw.pop("preset", DEFAULT_PRESET)
+            targets = {
+                f: get_spec(f, preset)
+                for f in set(run_phases + [p for p in (other_phases or []) if p in have])
+            }
             ret = None
             for p in run_phases:
-                targets = {
-                    f: get_spec(f, preset)
-                    for f in set(list(run_phases) + list(other_phases or []))
-                }
                 ret = fn(*args, spec=targets[p], phases=targets, **kw)
             return ret
 

@@ -42,10 +42,10 @@ def next_epoch(spec, state):
         spec.process_slots(state, slot)
 
 
-def next_epoch_via_block(spec, state, insert_state_root=False):
+def next_epoch_via_block(spec, state):
     """Advance one epoch with a block at the boundary slot."""
     from .block_processing import state_transition_and_sign_block
-    from .block import build_empty_block_for_next_slot, build_empty_block
+    from .block import build_empty_block
 
     slot = state.slot + spec.SLOTS_PER_EPOCH - (state.slot % spec.SLOTS_PER_EPOCH)
     block = build_empty_block(spec, state, slot)

@@ -27,9 +27,8 @@ PREVIOUS_FORK = {
 }
 
 _SOURCE_DIR = Path(__file__).resolve().parent
-_cache: Dict[Tuple[str, str], types.ModuleType] = {}
+_cache: Dict[Tuple, types.ModuleType] = {}
 _code_cache: Dict[str, Any] = {}
-_override_seq = 0
 
 
 def available_forks():
@@ -70,19 +69,20 @@ def build_spec(
     ``config`` has the overrides applied — the with_config_overrides
     mechanism (ref: test/context.py:492-534) without re-importing files.
     """
-    cache_key = (fork, preset_name)
-    if config_overrides is None and cache_key in _cache:
+    if config_overrides is None:
+        cache_key = (fork, preset_name)
+        suffix = ""
+    else:
+        # Value-keyed cache: identical overrides share one module, so
+        # repeated override-tests neither rebuild the chain nor leak
+        # sys.modules entries / genesis-state cache slots.
+        items = tuple(sorted(config_overrides.items()))
+        cache_key = (fork, preset_name, items)
+        suffix = f"_o{abs(hash(items)):x}"
+    if cache_key in _cache:
         return _cache[cache_key]
 
     chain = _fork_chain(fork)
-    if config_overrides is None:
-        suffix = ""
-    else:
-        # Monotonic counter: names must stay unique for the lifetime of the
-        # process (id() can be recycled; sys.modules + state caches key on it)
-        global _override_seq
-        _override_seq += 1
-        suffix = f"_o{_override_seq}"
     mod = types.ModuleType(f"consensus_specs_tpu.specs.{fork}_{preset_name}{suffix}")
     mod.__file__ = str(_SOURCE_DIR / f"{fork}.py")
     ns = mod.__dict__
@@ -101,8 +101,7 @@ def build_spec(
     ns["fork"] = fork
     ns["preset_base"] = preset_name
 
-    if config_overrides is None:
-        _cache[cache_key] = mod
+    _cache[cache_key] = mod
     return mod
 
 

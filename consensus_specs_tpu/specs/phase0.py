@@ -800,17 +800,27 @@ def process_slot(state: "BeaconState") -> None:
 # Epoch processing (beacon-chain.md:1289-1684)
 # ---------------------------------------------------------------------------
 
+def epoch_process_steps():
+    """Canonical per-epoch sub-transition order (beacon-chain.md:1289).
+    Resolved from module globals at call time so fork overrides of both
+    the list and the individual steps late-bind; test staging walks it."""
+    return [
+        process_justification_and_finalization,
+        process_rewards_and_penalties,
+        process_registry_updates,
+        process_slashings,
+        process_eth1_data_reset,
+        process_effective_balance_updates,
+        process_slashings_reset,
+        process_randao_mixes_reset,
+        process_historical_roots_update,
+        process_participation_record_updates,
+    ]
+
+
 def process_epoch(state: "BeaconState") -> None:
-    process_justification_and_finalization(state)
-    process_rewards_and_penalties(state)
-    process_registry_updates(state)
-    process_slashings(state)
-    process_eth1_data_reset(state)
-    process_effective_balance_updates(state)
-    process_slashings_reset(state)
-    process_randao_mixes_reset(state)
-    process_historical_roots_update(state)
-    process_participation_record_updates(state)
+    for step in epoch_process_steps():
+        step(state)
 
 
 def get_matching_source_attestations(state: "BeaconState", epoch: Epoch) -> Sequence[PendingAttestation]:

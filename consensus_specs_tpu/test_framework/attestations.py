@@ -160,7 +160,7 @@ def state_transition_with_full_block(spec, state, fill_cur_epoch, fill_prev_epoc
         if slot_to_attest >= spec.compute_start_slot_at_epoch(spec.get_current_epoch(state)):
             for attestation in get_valid_attestation_at_slot(state, spec, slot_to_attest, participation_fn):
                 block.body.attestations.append(attestation)
-    if fill_prev_epoch:
+    if fill_prev_epoch and state.slot >= spec.SLOTS_PER_EPOCH:
         slot_to_attest = state.slot - spec.SLOTS_PER_EPOCH + 1
         for attestation in get_valid_attestation_at_slot(state, spec, slot_to_attest, participation_fn):
             block.body.attestations.append(attestation)

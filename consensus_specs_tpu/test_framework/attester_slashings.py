@@ -1,0 +1,94 @@
+"""Attester slashing builders + runner (ref: test/helpers/
+attester_slashings.py)."""
+from __future__ import annotations
+
+from .attestations import get_valid_attestation, sign_attestation, sign_indexed_attestation
+from .context import expect_assertion_error
+from .proposer_slashings import get_min_slashing_penalty_quotient
+from .state import get_balance
+
+
+def get_valid_attester_slashing(spec, state, slot=None, signed_1=False, signed_2=False,
+                                filter_participant_set=None):
+    attestation_1 = get_valid_attestation(
+        spec, state, slot=slot, signed=signed_1, filter_participant_set=filter_participant_set
+    )
+
+    attestation_2 = attestation_1.copy()
+    attestation_2.data.target.root = b"\x01" * 32
+    if signed_2:
+        sign_attestation(spec, state, attestation_2)
+
+    return spec.AttesterSlashing(
+        attestation_1=spec.get_indexed_attestation(state, attestation_1),
+        attestation_2=spec.get_indexed_attestation(state, attestation_2),
+    )
+
+
+def get_valid_attester_slashing_by_indices(spec, state, indices, slot=None,
+                                           signed_1=False, signed_2=False):
+    """Slashing whose attestations carry exactly ``indices``."""
+    slashing = get_valid_attester_slashing(
+        spec, state, slot=slot,
+        filter_participant_set=lambda comm: comm & set(indices),
+    )
+    slashing.attestation_1.attesting_indices = sorted(indices)
+    slashing.attestation_2.attesting_indices = sorted(indices)
+    if signed_1:
+        sign_indexed_attestation(spec, state, slashing.attestation_1)
+    if signed_2:
+        sign_indexed_attestation(spec, state, slashing.attestation_2)
+    return slashing
+
+
+def get_indexed_attestation_participants(spec, indexed_att):
+    return list(indexed_att.attesting_indices)
+
+
+def get_attestation_2_data(spec, att_slashing):
+    return att_slashing.attestation_2.data
+
+
+def run_attester_slashing_processing(spec, state, attester_slashing, valid=True):
+    """Yield pre/operation/post around process_attester_slashing
+    (ref attester_slashings.py runner)."""
+    pre_state = state.copy()
+
+    yield "pre", state
+    yield "attester_slashing", attester_slashing
+
+    if not valid:
+        expect_assertion_error(lambda: spec.process_attester_slashing(state, attester_slashing))
+        yield "post", None
+        return
+
+    slashed_indices = set(attester_slashing.attestation_1.attesting_indices).intersection(
+        attester_slashing.attestation_2.attesting_indices
+    )
+
+    proposer_index = spec.get_beacon_proposer_index(state)
+    pre_proposer_balance = get_balance(state, proposer_index)
+    pre_slashed_balances = {i: get_balance(state, i) for i in slashed_indices}
+
+    total_proposer_rewards = sum(
+        int(state.validators[i].effective_balance) // spec.WHISTLEBLOWER_REWARD_QUOTIENT
+        for i in slashed_indices
+    )
+
+    spec.process_attester_slashing(state, attester_slashing)
+
+    for slashed_index in slashed_indices:
+        slashed_validator = state.validators[slashed_index]
+        assert slashed_validator.slashed
+        assert slashed_validator.exit_epoch < spec.FAR_FUTURE_EPOCH
+        assert slashed_validator.withdrawable_epoch < spec.FAR_FUTURE_EPOCH
+        if slashed_index != proposer_index:
+            penalty = (
+                int(slashed_validator.effective_balance) // get_min_slashing_penalty_quotient(spec)
+            )
+            assert get_balance(state, slashed_index) == pre_slashed_balances[slashed_index] - penalty
+
+    if proposer_index not in slashed_indices:
+        assert get_balance(state, proposer_index) == pre_proposer_balance + total_proposer_rewards
+
+    yield "post", state

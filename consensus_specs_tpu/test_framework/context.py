@@ -8,13 +8,14 @@ facade's kill-switch around each run (ref context.py:236-334).
 """
 from __future__ import annotations
 
-from functools import wraps
+import random
 from typing import Any, Dict, Optional, Sequence
 
 from consensus_specs_tpu.crypto import bls
 from consensus_specs_tpu.specs import build_spec
 from .constants import ALL_PHASES, MINIMAL, PHASE0, ALTAIR, BELLATRIX, CAPELLA  # noqa: F401
 from .genesis import create_genesis_state
+from .meta import copy_meta
 from .utils import vector_test, with_meta_tags
 
 # Set by tests/conftest.py from CLI flags (ref conftest.py:30-93)
@@ -62,7 +63,7 @@ def low_balances(spec):
 def misc_balances(spec):
     num_validators = spec.SLOTS_PER_EPOCH * 8
     balances = [spec.MAX_EFFECTIVE_BALANCE * 2 * i // num_validators for i in range(num_validators)]
-    rng = __import__("random").Random(3456)
+    rng = random.Random(3456)
     rng.shuffle(balances)
     return balances
 
@@ -73,7 +74,7 @@ def misc_balances_in_default_range_with_many_validators(spec):
     balances = [
         max(spec.MAX_EFFECTIVE_BALANCE * 2 * i // num_validators, floor) for i in range(num_validators)
     ]
-    rng = __import__("random").Random(1234)
+    rng = random.Random(1234)
     rng.shuffle(balances)
     return balances
 
@@ -102,12 +103,11 @@ def _prepare_state(balances_fn, threshold_fn, spec):
 
 def with_custom_state(balances_fn, threshold_fn):
     def deco(fn):
-        @wraps(fn)
         def entry(*args, spec, phases=None, **kw):
             state = _prepare_state(balances_fn, threshold_fn, spec)
             return fn(*args, spec=spec, state=state, **kw)
 
-        return entry
+        return copy_meta(entry, fn)
 
     return deco
 
@@ -124,7 +124,6 @@ def _bls_wrap(fn, force: Optional[bool]):
     # Generator wrapper: the toggle must span the *iteration* of the wrapped
     # test (tests are generators evaluated lazily), not just its creation —
     # same shape as ref context.py:294-306.
-    @wraps(fn)
     def entry(*args, **kw):
         setting = kw.pop("bls_active", None)
         active = force if force is not None else (
@@ -139,7 +138,7 @@ def _bls_wrap(fn, force: Optional[bool]):
         finally:
             bls.bls_active = old
 
-    return entry
+    return copy_meta(entry, fn)
 
 
 def always_bls(fn):
@@ -164,12 +163,11 @@ def single_phase(fn):
     """Drop the `phases` kwarg for tests that only need one fork
     (ref context.py:246-255)."""
 
-    @wraps(fn)
     def entry(*args, **kw):
         kw.pop("phases", None)
         return fn(*args, **kw)
 
-    return entry
+    return copy_meta(entry, fn)
 
 
 def spec_test(fn):
@@ -210,11 +208,10 @@ def expect_assertion_error(fn):
 
 def with_phases(phases: Sequence[str], other_phases: Optional[Sequence[str]] = None):
     """Expand the test over the given forks. In pytest mode all selected
-    forks run in sequence; generator mode pins one via the `phase` kwarg
-    (ref context.py:355-456)."""
+    (and implemented) forks run in sequence; generator mode pins one via
+    the `phase` kwarg (ref context.py:355-456)."""
 
     def deco(fn):
-        @wraps(fn)
         def entry(*args, **kw):
             from consensus_specs_tpu.specs.build import available_forks
 
@@ -225,6 +222,12 @@ def with_phases(phases: Sequence[str], other_phases: Optional[Sequence[str]] = N
                 if phase not in phases or phase not in have:
                     return None
                 run_phases = [phase]
+            elif not run_phases:
+                # pytest mode with no implemented fork: skip loudly rather
+                # than report a vacuous pass
+                import pytest
+
+                pytest.skip(f"no implemented fork among {list(phases)}")
             preset = kw.pop("preset", DEFAULT_PRESET)
             targets = {
                 f: get_spec(f, preset)
@@ -236,7 +239,7 @@ def with_phases(phases: Sequence[str], other_phases: Optional[Sequence[str]] = N
             return ret
 
         entry.fork_matrix = list(phases)
-        return entry
+        return copy_meta(entry, fn)
 
     return deco
 
@@ -268,7 +271,6 @@ def with_presets(preset_names: Sequence[str], reason: Optional[str] = None):
     """Skip unless the active preset is in the set (ref context.py:459)."""
 
     def deco(fn):
-        @wraps(fn)
         def entry(*args, **kw):
             preset = kw.get("preset", DEFAULT_PRESET)
             if preset not in preset_names:
@@ -277,7 +279,7 @@ def with_presets(preset_names: Sequence[str], reason: Optional[str] = None):
                 pytest.skip(reason or f"preset {preset} not supported")
             return fn(*args, **kw)
 
-        return entry
+        return copy_meta(entry, fn)
 
     return deco
 
@@ -288,21 +290,17 @@ def with_config_overrides(conf_overrides: Dict[str, Any]):
     (ref context.py:492-534)."""
 
     def deco(fn):
-        @wraps(fn)
         def entry(*args, spec, **kw):
             spec = build_spec(spec.fork, spec.preset_base, conf_overrides)
-            if kw.get("generator_mode"):
-                pass  # config emission handled by the generator runner
             return fn(*args, spec=spec, **kw)
 
-        return entry
+        return copy_meta(entry, fn)
 
     return deco
 
 
 def only_generator(reason):
     def deco(fn):
-        @wraps(fn)
         def entry(*args, **kw):
             if not kw.get("generator_mode", False):
                 import pytest
@@ -310,7 +308,7 @@ def only_generator(reason):
                 pytest.skip(reason)
             return fn(*args, **kw)
 
-        return entry
+        return copy_meta(entry, fn)
 
     return deco
 

@@ -1,0 +1,137 @@
+"""Deposit builders + processing runner (ref: test/helpers/deposits.py)."""
+from __future__ import annotations
+
+from consensus_specs_tpu.ssz.merkle import calc_merkle_tree_from_leaves, get_merkle_proof
+
+from .context import expect_assertion_error
+from .keys import privkeys, pubkeys
+
+
+def mock_deposit(spec, state, index):
+    """Mock validator at ``index`` as not-yet-activated (ref deposits.py)."""
+    assert spec.is_active_validator(state.validators[index], spec.get_current_epoch(state))
+    state.validators[index].activation_eligibility_epoch = spec.FAR_FUTURE_EPOCH
+    state.validators[index].activation_epoch = spec.FAR_FUTURE_EPOCH
+    state.validators[index].effective_balance = spec.MAX_EFFECTIVE_BALANCE
+    assert not spec.is_active_validator(state.validators[index], spec.get_current_epoch(state))
+
+
+def build_deposit_data(spec, pubkey, privkey, amount, withdrawal_credentials, signed=False):
+    deposit_data = spec.DepositData(
+        pubkey=pubkey,
+        withdrawal_credentials=withdrawal_credentials,
+        amount=amount,
+    )
+    if signed:
+        sign_deposit_data(spec, deposit_data, privkey)
+    return deposit_data
+
+
+def sign_deposit_data(spec, deposit_data, privkey):
+    deposit_message = spec.DepositMessage(
+        pubkey=deposit_data.pubkey,
+        withdrawal_credentials=deposit_data.withdrawal_credentials,
+        amount=deposit_data.amount,
+    )
+    domain = spec.compute_domain(spec.DOMAIN_DEPOSIT)
+    signing_root = spec.compute_signing_root(deposit_message, domain)
+    deposit_data.signature = spec.bls.Sign(privkey, signing_root)
+
+
+def build_deposit(spec, deposit_data_list, pubkey, privkey, amount,
+                  withdrawal_credentials, signed):
+    deposit_data = build_deposit_data(
+        spec, pubkey, privkey, amount, withdrawal_credentials, signed=signed
+    )
+    index = len(deposit_data_list)
+    deposit_data_list.append(deposit_data)
+    return deposit_from_context(spec, deposit_data_list, index)
+
+
+def deposit_from_context(spec, deposit_data_list, index):
+    """Deposit + root for the deposit at ``index`` given the full list:
+    32-level branch + the length mix-in chunk as the 33rd proof node
+    (beacon-chain.md:742,1854)."""
+    deposit_data = deposit_data_list[index]
+    root = spec.hash_tree_root(
+        spec.List[spec.DepositData, 2**spec.DEPOSIT_CONTRACT_TREE_DEPTH](deposit_data_list)
+    )
+    tree = calc_merkle_tree_from_leaves(
+        [spec.hash_tree_root(d) for d in deposit_data_list],
+        layer_count=int(spec.DEPOSIT_CONTRACT_TREE_DEPTH),
+    )
+    length_chunk = len(deposit_data_list).to_bytes(32, "little")
+    proof = list(get_merkle_proof(tree, item_index=index)) + [length_chunk]
+    leaf = spec.hash_tree_root(deposit_data)
+    assert spec.is_valid_merkle_branch(
+        leaf, proof, spec.DEPOSIT_CONTRACT_TREE_DEPTH + 1, index, root
+    )
+    deposit = spec.Deposit(proof=proof, data=deposit_data)
+    return deposit, root, deposit_data_list
+
+
+def prepare_state_and_deposit(spec, state, validator_index, amount,
+                              withdrawal_credentials=None, signed=False):
+    """Build a deposit for ``validator_index`` and point the state's
+    eth1_data at its tree (ref deposits.py:120-160)."""
+    deposit_data_list = []
+    pubkey = pubkeys[validator_index]
+    privkey = privkeys[validator_index]
+
+    # insecurely embedded default: hash of pubkey with BLS prefix
+    if withdrawal_credentials is None:
+        withdrawal_credentials = (
+            bytes(spec.BLS_WITHDRAWAL_PREFIX) + spec.hash(pubkey)[1:]
+        )
+
+    deposit, root, deposit_data_list = build_deposit(
+        spec, deposit_data_list, pubkey, privkey, amount, withdrawal_credentials, signed
+    )
+
+    state.eth1_deposit_index = 0
+    state.eth1_data.deposit_root = root
+    state.eth1_data.deposit_count = len(deposit_data_list)
+    return deposit
+
+
+def run_deposit_processing(spec, state, deposit, validator_index, valid=True, effective=True):
+    """Yield pre/operation/post around process_deposit
+    (ref deposits.py:170-230)."""
+    pre_validator_count = len(state.validators)
+    pre_balance = 0
+    is_top_up = validator_index < pre_validator_count
+    if is_top_up:
+        pre_balance = int(state.balances[validator_index])
+        pre_effective_balance = int(state.validators[validator_index].effective_balance)
+
+    yield "pre", state
+    yield "deposit", deposit
+
+    if not valid:
+        expect_assertion_error(lambda: spec.process_deposit(state, deposit))
+        yield "post", None
+        return
+
+    spec.process_deposit(state, deposit)
+    yield "post", state
+
+    if not effective or not spec.bls.KeyValidate(deposit.data.pubkey):
+        assert len(state.validators) == pre_validator_count
+        assert len(state.balances) == pre_validator_count
+        if is_top_up:
+            assert state.balances[validator_index] == pre_balance
+    else:
+        if is_top_up:
+            # Top-ups don't add validators
+            assert len(state.validators) == pre_validator_count
+            assert len(state.balances) == pre_validator_count
+            # Top-ups do not change effective balance
+            assert state.validators[validator_index].effective_balance == pre_effective_balance
+        else:
+            assert len(state.validators) == pre_validator_count + 1
+            assert len(state.balances) == pre_validator_count + 1
+            effective_balance = min(spec.MAX_EFFECTIVE_BALANCE, int(deposit.data.amount))
+            effective_balance -= effective_balance % spec.EFFECTIVE_BALANCE_INCREMENT
+            assert state.validators[validator_index].effective_balance == effective_balance
+        assert state.balances[validator_index] == pre_balance + deposit.data.amount
+    assert state.eth1_deposit_index == state.eth1_data.deposit_count

@@ -1,0 +1,30 @@
+"""Epoch sub-transition staging/runner (ref: test/helpers/
+epoch_processing.py:36-67)."""
+from __future__ import annotations
+
+
+def get_process_calls(spec):
+    return [fn.__name__ for fn in spec.epoch_process_steps()]
+
+
+def run_epoch_processing_to(spec, state, process_name: str):
+    """Advance to the last slot of the epoch, then run every sub-transition
+    strictly before ``process_name``."""
+    slot = state.slot + (spec.SLOTS_PER_EPOCH - state.slot % spec.SLOTS_PER_EPOCH)
+    if state.slot < slot - 1:
+        spec.process_slots(state, slot - 1)
+
+    names = get_process_calls(spec)
+    assert process_name in names, f"{process_name} not in {names}"
+    for fn in spec.epoch_process_steps():
+        if fn.__name__ == process_name:
+            break
+        fn(state)
+
+
+def run_epoch_processing_with(spec, state, process_name: str):
+    """Stage, then yield pre/post around exactly one sub-transition."""
+    run_epoch_processing_to(spec, state, process_name)
+    yield "pre", state
+    getattr(spec, process_name)(state)
+    yield "post", state

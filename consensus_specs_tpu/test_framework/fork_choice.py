@@ -1,0 +1,180 @@
+"""Fork-choice test helpers: every on_tick/on_block/on_attestation is also
+recorded as a replayable step for the fork_choice vector format
+(ref: test/helpers/fork_choice.py and tests/formats/fork_choice/README.md).
+"""
+from __future__ import annotations
+
+from .context import expect_assertion_error
+
+
+def get_anchor_root(spec, state):
+    anchor_block_header = state.latest_block_header.copy()
+    if anchor_block_header.state_root == spec.Bytes32():
+        anchor_block_header.state_root = spec.hash_tree_root(state)
+    return spec.hash_tree_root(anchor_block_header)
+
+
+def get_genesis_forkchoice_store(spec, genesis_state):
+    store, _ = get_genesis_forkchoice_store_and_block(spec, genesis_state)
+    return store
+
+
+def get_genesis_forkchoice_store_and_block(spec, genesis_state):
+    assert genesis_state.slot == spec.GENESIS_SLOT
+    genesis_block = spec.BeaconBlock(state_root=spec.hash_tree_root(genesis_state))
+    return spec.get_forkchoice_store(genesis_state, genesis_block), genesis_block
+
+
+def tick_and_add_block(spec, store, signed_block, test_steps, valid=True,
+                       merge_block=False, block_not_found=False, is_optimistic=False):
+    pre_state = store.block_states[signed_block.message.parent_root]
+    block_time = pre_state.genesis_time + signed_block.message.slot * spec.config.SECONDS_PER_SLOT
+    if merge_block:
+        assert spec.is_merge_transition_block(pre_state, signed_block.message.body)
+
+    if store.time < block_time:
+        on_tick_and_append_step(spec, store, block_time, test_steps)
+
+    post_state = yield from add_block(
+        spec, store, signed_block, test_steps, valid=valid, block_not_found=block_not_found
+    )
+    return post_state
+
+
+def on_tick_and_append_step(spec, store, time, test_steps):
+    spec.on_tick(store, time)
+    test_steps.append({"tick": int(time)})
+
+
+def run_on_block(spec, store, signed_block, valid=True):
+    if not valid:
+        expect_assertion_error(lambda: spec.on_block(store, signed_block))
+        return
+    spec.on_block(store, signed_block)
+    assert store.blocks[spec.hash_tree_root(signed_block.message)] == signed_block.message
+
+
+def add_block(spec, store, signed_block, test_steps, valid=True, block_not_found=False):
+    """Run on_block and related state_transition; record the block as a step."""
+    yield get_block_file_name(signed_block), signed_block
+
+    if not valid:
+        try:
+            run_on_block(spec, store, signed_block, valid=True)
+        except (AssertionError, KeyError, IndexError, ValueError):
+            test_steps.append({
+                "block": get_block_file_name(signed_block),
+                "valid": False,
+            })
+            return None
+        else:
+            raise AssertionError("block with invalid signature was not rejected")
+
+    run_on_block(spec, store, signed_block, valid=True)
+    test_steps.append({"block": get_block_file_name(signed_block)})
+
+    # An on_block step implies receiving block's attestations
+    for attestation in signed_block.message.body.attestations:
+        spec.on_attestation(store, attestation, is_from_block=True)
+    # ...and attester slashings
+    for attester_slashing in signed_block.message.body.attester_slashings:
+        spec.on_attester_slashing(store, attester_slashing)
+
+    block_root = spec.hash_tree_root(signed_block.message)
+    assert store.blocks[block_root] == signed_block.message
+    assert store.block_states[block_root].hash_tree_root() == signed_block.message.state_root
+    test_steps.append({
+        "checks": {
+            "time": int(store.time),
+            "head": get_formatted_head_output(spec, store),
+            "justified_checkpoint": checkpoint_dict(store.justified_checkpoint),
+            "finalized_checkpoint": checkpoint_dict(store.finalized_checkpoint),
+            "best_justified_checkpoint": checkpoint_dict(store.best_justified_checkpoint),
+            "proposer_boost_root": "0x" + bytes(store.proposer_boost_root).hex(),
+        }
+    })
+
+    return store.block_states[block_root]
+
+
+def add_attestation(spec, store, attestation, test_steps, is_from_block=False):
+    spec.on_attestation(store, attestation, is_from_block=is_from_block)
+    yield get_attestation_file_name(attestation), attestation
+    test_steps.append({"attestation": get_attestation_file_name(attestation)})
+
+
+def add_attestations(spec, store, attestations, test_steps, is_from_block=False):
+    for attestation in attestations:
+        yield from add_attestation(spec, store, attestation, test_steps, is_from_block=is_from_block)
+
+
+def add_attester_slashing(spec, store, attester_slashing, test_steps, valid=True):
+    slashing_file_name = get_attester_slashing_file_name(attester_slashing)
+    yield slashing_file_name, attester_slashing
+
+    if not valid:
+        expect_assertion_error(lambda: spec.on_attester_slashing(store, attester_slashing))
+        test_steps.append({"attester_slashing": slashing_file_name, "valid": False})
+        return
+
+    spec.on_attester_slashing(store, attester_slashing)
+    test_steps.append({"attester_slashing": slashing_file_name})
+
+
+def get_block_file_name(signed_block):
+    return f"block_{bytes(signed_block.message.hash_tree_root()).hex()[:16]}"
+
+
+def get_attestation_file_name(attestation):
+    return f"attestation_{bytes(attestation.hash_tree_root()).hex()[:16]}"
+
+
+def get_attester_slashing_file_name(attester_slashing):
+    return f"attester_slashing_{bytes(attester_slashing.hash_tree_root()).hex()[:16]}"
+
+
+def get_formatted_head_output(spec, store):
+    head = spec.get_head(store)
+    slot = store.blocks[head].slot
+    return {"slot": int(slot), "root": "0x" + bytes(head).hex()}
+
+
+def checkpoint_dict(checkpoint):
+    return {"epoch": int(checkpoint.epoch), "root": "0x" + bytes(checkpoint.root).hex()}
+
+
+def apply_next_epoch_with_attestations(spec, state, store, fill_cur_epoch, fill_prev_epoch,
+                                       participation_fn=None, test_steps=None):
+    from .attestations import next_epoch_with_attestations
+
+    if test_steps is None:
+        test_steps = []
+
+    _, new_signed_blocks, post_state = next_epoch_with_attestations(
+        spec, state, fill_cur_epoch, fill_prev_epoch, participation_fn
+    )
+    for signed_block in new_signed_blocks:
+        block = signed_block.message
+        yield from tick_and_add_block(spec, store, signed_block, test_steps)
+        block_root = spec.hash_tree_root(block)
+        assert store.blocks[block_root] == block
+    last_signed_block = new_signed_blocks[-1]
+
+    assert store.block_states[spec.hash_tree_root(last_signed_block.message)].slot == post_state.slot
+    return post_state, store, last_signed_block
+
+
+def apply_next_slots_with_attestations(spec, state, store, slots, fill_cur_epoch,
+                                       fill_prev_epoch, test_steps, participation_fn=None):
+    from .attestations import next_slots_with_attestations
+
+    _, new_signed_blocks, post_state = next_slots_with_attestations(
+        spec, state, slots, fill_cur_epoch, fill_prev_epoch, participation_fn
+    )
+    for signed_block in new_signed_blocks:
+        block = signed_block.message
+        yield from tick_and_add_block(spec, store, signed_block, test_steps)
+        block_root = spec.hash_tree_root(block)
+        assert store.blocks[block_root] == block
+
+    return post_state, store, new_signed_blocks[-1]

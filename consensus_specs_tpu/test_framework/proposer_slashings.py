@@ -1,0 +1,92 @@
+"""Proposer slashing builders + runner (ref: test/helpers/
+proposer_slashings.py)."""
+from __future__ import annotations
+
+from .block import sign_block  # noqa: F401  (commonly used together)
+from .context import expect_assertion_error
+from .keys import privkeys
+from .state import get_balance
+
+
+def get_min_slashing_penalty_quotient(spec):
+    if hasattr(spec, "MIN_SLASHING_PENALTY_QUOTIENT_BELLATRIX") and spec.fork in ("bellatrix", "capella"):
+        return spec.MIN_SLASHING_PENALTY_QUOTIENT_BELLATRIX
+    if hasattr(spec, "MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR") and spec.fork != "phase0":
+        return spec.MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR
+    return spec.MIN_SLASHING_PENALTY_QUOTIENT
+
+
+def check_proposer_slashing_effect(spec, pre_state, state, slashed_index, block=None):
+    slashed_validator = state.validators[slashed_index]
+    assert slashed_validator.slashed
+    assert slashed_validator.exit_epoch < spec.FAR_FUTURE_EPOCH
+    assert slashed_validator.withdrawable_epoch < spec.FAR_FUTURE_EPOCH
+
+    proposer_index = spec.get_beacon_proposer_index(state)
+    slash_penalty = state.validators[slashed_index].effective_balance // get_min_slashing_penalty_quotient(spec)
+    whistleblower_reward = (
+        state.validators[slashed_index].effective_balance // spec.WHISTLEBLOWER_REWARD_QUOTIENT
+    )
+    if proposer_index != slashed_index:
+        # Slashed validator lost initial slash penalty
+        assert get_balance(state, slashed_index) == get_balance(pre_state, slashed_index) - slash_penalty
+    else:
+        # Slashed proposer itself: net change is reward - penalty
+        assert get_balance(state, slashed_index) == (
+            get_balance(pre_state, slashed_index) - slash_penalty + whistleblower_reward
+        )
+
+
+def sign_header(spec, state, header, privkey):
+    domain = spec.get_domain(state, spec.DOMAIN_BEACON_PROPOSER, spec.compute_epoch_at_slot(header.slot))
+    signing_root = spec.compute_signing_root(header, domain)
+    return spec.bls.Sign(privkey, signing_root)
+
+
+def get_valid_proposer_slashing(spec, state, random_root=b"\x99" * 32,
+                                slashed_index=None, slot=None, signed_1=False, signed_2=False):
+    if slashed_index is None:
+        current_epoch = spec.get_current_epoch(state)
+        slashed_index = spec.get_active_validator_indices(state, current_epoch)[-1]
+    if slot is None:
+        slot = state.slot
+    privkey = privkeys[slashed_index]
+
+    header_1 = spec.BeaconBlockHeader(
+        slot=slot,
+        proposer_index=slashed_index,
+        parent_root=b"\x33" * 32,
+        state_root=b"\x44" * 32,
+        body_root=b"\x55" * 32,
+    )
+    header_2 = header_1.copy()
+    header_2.parent_root = random_root
+
+    signed_header_1 = spec.SignedBeaconBlockHeader(message=header_1)
+    if signed_1:
+        signed_header_1.signature = sign_header(spec, state, header_1, privkey)
+    signed_header_2 = spec.SignedBeaconBlockHeader(message=header_2)
+    if signed_2:
+        signed_header_2.signature = sign_header(spec, state, header_2, privkey)
+
+    return spec.ProposerSlashing(signed_header_1=signed_header_1, signed_header_2=signed_header_2)
+
+
+def run_proposer_slashing_processing(spec, state, proposer_slashing, valid=True):
+    """Yield pre/operation/post around process_proposer_slashing
+    (ref proposer_slashings.py runner)."""
+    pre_state = state.copy()
+
+    yield "pre", state
+    yield "proposer_slashing", proposer_slashing
+
+    if not valid:
+        expect_assertion_error(lambda: spec.process_proposer_slashing(state, proposer_slashing))
+        yield "post", None
+        return
+
+    proposer_index = proposer_slashing.signed_header_1.message.proposer_index
+    spec.process_proposer_slashing(state, proposer_slashing)
+    yield "post", state
+
+    check_proposer_slashing_effect(spec, pre_state, state, proposer_index)

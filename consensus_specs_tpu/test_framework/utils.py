@@ -1,9 +1,9 @@
 """pytest ↔ generator dual-mode adapter (ref: test/utils/utils.py)."""
 from __future__ import annotations
 
-from functools import wraps
-
 from consensus_specs_tpu.ssz.types import SSZType
+
+from .meta import copy_meta
 
 
 def vector_test():
@@ -15,7 +15,6 @@ def vector_test():
     """
 
     def runner(fn):
-        @wraps(fn)
         def entry(*args, **kw):
             def generator_mode():
                 out = fn(*args, **kw)
@@ -40,7 +39,7 @@ def vector_test():
                     continue
             return None
 
-        return entry
+        return copy_meta(entry, fn)
 
     return runner
 
@@ -49,7 +48,6 @@ def with_meta_tags(tags: dict):
     """Append meta key/values to the test's output parts (ref utils.py:76)."""
 
     def runner(fn):
-        @wraps(fn)
         def entry(*args, **kw):
             yielded_any = False
             out = fn(*args, **kw)
@@ -61,6 +59,6 @@ def with_meta_tags(tags: dict):
                 for k, v in tags.items():
                     yield k, "meta", v
 
-        return entry
+        return copy_meta(entry, fn)
 
     return runner

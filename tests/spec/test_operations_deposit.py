@@ -1,0 +1,229 @@
+"""process_deposit tests
+(ref: test/phase0/block_processing/test_process_deposit.py)."""
+from consensus_specs_tpu.test_framework.context import (
+    always_bls,
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.test_framework.deposits import (
+    build_deposit,
+    prepare_state_and_deposit,
+    run_deposit_processing,
+    sign_deposit_data,
+)
+from consensus_specs_tpu.test_framework.keys import privkeys, pubkeys
+from consensus_specs_tpu.test_framework.state import next_epoch_via_block
+
+
+@with_all_phases
+@spec_state_test
+def test_new_deposit_under_max(spec, state):
+    # fresh deposit = next validator index = validator appended to registry
+    validator_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE - 1
+    deposit = prepare_state_and_deposit(spec, state, validator_index, amount, signed=True)
+    yield from run_deposit_processing(spec, state, deposit, validator_index)
+
+
+@with_all_phases
+@spec_state_test
+def test_new_deposit_max(spec, state):
+    validator_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE
+    deposit = prepare_state_and_deposit(spec, state, validator_index, amount, signed=True)
+    yield from run_deposit_processing(spec, state, deposit, validator_index)
+
+
+@with_all_phases
+@spec_state_test
+def test_new_deposit_over_max(spec, state):
+    validator_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE + 1
+    deposit = prepare_state_and_deposit(spec, state, validator_index, amount, signed=True)
+    yield from run_deposit_processing(spec, state, deposit, validator_index)
+
+
+@with_all_phases
+@spec_state_test
+def test_new_deposit_eth1_withdrawal_credentials(spec, state):
+    validator_index = len(state.validators)
+    withdrawal_credentials = (
+        bytes(spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX)
+        + b"\x00" * 11  # specified 0s
+        + b"\x59" * 20  # a 20-byte eth1 address
+    )
+    amount = spec.MAX_EFFECTIVE_BALANCE
+    deposit = prepare_state_and_deposit(
+        spec, state, validator_index, amount,
+        withdrawal_credentials=withdrawal_credentials, signed=True,
+    )
+    yield from run_deposit_processing(spec, state, deposit, validator_index)
+
+
+@with_all_phases
+@spec_state_test
+def test_new_deposit_non_versioned_withdrawal_credentials(spec, state):
+    validator_index = len(state.validators)
+    withdrawal_credentials = b"\xff" * 32  # Non specified withdrawal credentials version
+    amount = spec.MAX_EFFECTIVE_BALANCE
+    deposit = prepare_state_and_deposit(
+        spec, state, validator_index, amount,
+        withdrawal_credentials=withdrawal_credentials, signed=True,
+    )
+    yield from run_deposit_processing(spec, state, deposit, validator_index)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_correct_sig_but_forked_state(spec, state):
+    validator_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE
+    # deposits will always be valid, regardless of the current fork
+    state.fork.current_version = spec.Version(b"\x13\x37\x00\x00")
+    deposit = prepare_state_and_deposit(spec, state, validator_index, amount, signed=True)
+    yield from run_deposit_processing(spec, state, deposit, validator_index)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_incorrect_sig_new_deposit(spec, state):
+    # fresh deposit = next validator index = validator appended to registry
+    validator_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE
+    deposit = prepare_state_and_deposit(spec, state, validator_index, amount)
+    yield from run_deposit_processing(spec, state, deposit, validator_index, effective=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_top_up__max_effective_balance(spec, state):
+    validator_index = 0
+    amount = spec.MAX_EFFECTIVE_BALANCE // 4
+    deposit = prepare_state_and_deposit(spec, state, validator_index, amount, signed=True)
+
+    state.balances[validator_index] = spec.MAX_EFFECTIVE_BALANCE
+    state.validators[validator_index].effective_balance = spec.MAX_EFFECTIVE_BALANCE
+
+    yield from run_deposit_processing(spec, state, deposit, validator_index)
+
+    assert state.balances[validator_index] == spec.MAX_EFFECTIVE_BALANCE + amount
+    assert state.validators[validator_index].effective_balance == spec.MAX_EFFECTIVE_BALANCE
+
+
+@with_all_phases
+@spec_state_test
+def test_top_up__less_effective_balance(spec, state):
+    validator_index = 0
+    amount = spec.MAX_EFFECTIVE_BALANCE // 4
+    deposit = prepare_state_and_deposit(spec, state, validator_index, amount, signed=True)
+
+    initial_balance = spec.MAX_EFFECTIVE_BALANCE - 1000
+    initial_effective_balance = spec.MAX_EFFECTIVE_BALANCE - spec.EFFECTIVE_BALANCE_INCREMENT
+    state.balances[validator_index] = initial_balance
+    state.validators[validator_index].effective_balance = initial_effective_balance
+
+    yield from run_deposit_processing(spec, state, deposit, validator_index)
+
+    assert state.balances[validator_index] == initial_balance + amount
+    # unchanged effective balance
+    assert state.validators[validator_index].effective_balance == initial_effective_balance
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_incorrect_sig_top_up(spec, state):
+    validator_index = 0
+    amount = spec.MAX_EFFECTIVE_BALANCE // 4
+    deposit = prepare_state_and_deposit(spec, state, validator_index, amount)
+    # invalid signatures, in top-ups, are allowed!
+    yield from run_deposit_processing(spec, state, deposit, validator_index)
+
+
+@with_all_phases
+@spec_state_test
+def test_incorrect_withdrawal_credentials_top_up(spec, state):
+    validator_index = 0
+    amount = spec.MAX_EFFECTIVE_BALANCE // 4
+    withdrawal_credentials = bytes(spec.BLS_WITHDRAWAL_PREFIX) + spec.hash(b"junk")[1:]
+    deposit = prepare_state_and_deposit(
+        spec, state, validator_index, amount,
+        withdrawal_credentials=withdrawal_credentials, signed=True,
+    )
+    # inconsistent withdrawal credentials, in top-ups, are allowed!
+    yield from run_deposit_processing(spec, state, deposit, validator_index)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_wrong_deposit_for_deposit_count(spec, state):
+    deposit_data_list = []
+
+    # build root for deposit_1
+    index_1 = len(deposit_data_list)
+    pubkey_1 = pubkeys[index_1]
+    privkey_1 = privkeys[index_1]
+    _, _, deposit_data_list = build_deposit(
+        spec, deposit_data_list, pubkey_1, privkey_1, spec.MAX_EFFECTIVE_BALANCE,
+        withdrawal_credentials=b"\x00" * 32, signed=True,
+    )
+    deposit_count_1 = len(deposit_data_list)
+
+    # build root for deposit_2
+    index_2 = len(deposit_data_list)
+    pubkey_2 = pubkeys[index_2 + 10]
+    privkey_2 = privkeys[index_2 + 10]
+    deposit_2, root_2, deposit_data_list = build_deposit(
+        spec, deposit_data_list, pubkey_2, privkey_2, spec.MAX_EFFECTIVE_BALANCE,
+        withdrawal_credentials=b"\x00" * 32, signed=True,
+    )
+
+    # state has root for deposit_2 but is at deposit_count for deposit_1
+    state.eth1_data.deposit_root = root_2
+    state.eth1_data.deposit_count = deposit_count_1
+    state.eth1_deposit_index = 0
+
+    yield from run_deposit_processing(spec, state, deposit_2, index_2, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_bad_merkle_proof(spec, state):
+    validator_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE
+    deposit = prepare_state_and_deposit(spec, state, validator_index, amount)
+
+    # mess up merkle branch
+    deposit.proof[5] = spec.Bytes32()
+
+    sign_deposit_data(spec, deposit.data, privkeys[validator_index])
+
+    yield from run_deposit_processing(spec, state, deposit, validator_index, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_key_validate_invalid_subgroup(spec, state):
+    validator_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE
+
+    # All-zero pubkey is not a valid G1 point
+    pubkey = b"\x00" * 48
+    deposit = prepare_state_and_deposit(spec, state, validator_index, amount, signed=True)
+    deposit.data.pubkey = pubkey
+    # proof no longer matches; rebuild the deposit entirely with the bad key
+    from consensus_specs_tpu.test_framework.deposits import build_deposit_data, build_deposit as _bd
+
+    deposit_data_list = []
+    deposit, root, deposit_data_list = _bd(
+        spec, deposit_data_list, pubkey, privkeys[validator_index], amount,
+        withdrawal_credentials=b"\x00" * 32, signed=False,
+    )
+    state.eth1_deposit_index = 0
+    state.eth1_data.deposit_root = root
+    state.eth1_data.deposit_count = len(deposit_data_list)
+
+    yield from run_deposit_processing(spec, state, deposit, validator_index, effective=False)

@@ -1,0 +1,147 @@
+"""process_proposer_slashing tests
+(ref: test/phase0/block_processing/test_process_proposer_slashing.py)."""
+from consensus_specs_tpu.test_framework.context import (
+    always_bls,
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.test_framework.proposer_slashings import (
+    get_valid_proposer_slashing,
+    run_proposer_slashing_processing,
+    sign_header,
+)
+from consensus_specs_tpu.test_framework.keys import privkeys
+from consensus_specs_tpu.test_framework.state import next_epoch
+
+
+@with_all_phases
+@spec_state_test
+def test_success(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=True)
+    yield from run_proposer_slashing_processing(spec, state, proposer_slashing)
+
+
+@with_all_phases
+@spec_state_test
+def test_slashed_and_proposer_index_the_same(spec, state):
+    # use the proposer of the current slot as the slashed target
+    proposer_index = spec.get_beacon_proposer_index(state)
+    proposer_slashing = get_valid_proposer_slashing(
+        spec, state, slashed_index=proposer_index, signed_1=True, signed_2=True
+    )
+    yield from run_proposer_slashing_processing(spec, state, proposer_slashing)
+
+
+@with_all_phases
+@spec_state_test
+def test_block_header_from_future(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(
+        spec, state, slot=state.slot + 5, signed_1=True, signed_2=True
+    )
+    yield from run_proposer_slashing_processing(spec, state, proposer_slashing)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_sig_1(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(spec, state, signed_1=False, signed_2=True)
+    yield from run_proposer_slashing_processing(spec, state, proposer_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_sig_2(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=False)
+    yield from run_proposer_slashing_processing(spec, state, proposer_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_sig_1_and_2_swap(spec, state):
+    # Get valid signatures, but attach to the other header
+    proposer_slashing = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=True)
+    signature_1 = proposer_slashing.signed_header_1.signature
+    proposer_slashing.signed_header_1.signature = proposer_slashing.signed_header_2.signature
+    proposer_slashing.signed_header_2.signature = signature_1
+    yield from run_proposer_slashing_processing(spec, state, proposer_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_proposer_index(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=True)
+    # Index just out of range
+    proposer_slashing.signed_header_1.message.proposer_index = len(state.validators)
+    proposer_slashing.signed_header_2.message.proposer_index = len(state.validators)
+    yield from run_proposer_slashing_processing(spec, state, proposer_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_different_proposer_indices(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=True)
+    # set different index and re-sign the second header
+    header_2 = proposer_slashing.signed_header_2.message
+    active_indices = spec.get_active_validator_indices(state, spec.get_current_epoch(state))
+    active_indices = [i for i in active_indices if i != header_2.proposer_index]
+    header_2.proposer_index = active_indices[0]
+    proposer_slashing.signed_header_2.signature = sign_header(
+        spec, state, header_2, privkeys[header_2.proposer_index]
+    )
+    yield from run_proposer_slashing_processing(spec, state, proposer_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_slots_of_different_epochs(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=False)
+    # set slot of header_2 to a different epoch and re-sign
+    header_2 = proposer_slashing.signed_header_2.message
+    header_2.slot += spec.SLOTS_PER_EPOCH
+    proposer_slashing.signed_header_2.signature = sign_header(
+        spec, state, header_2, privkeys[header_2.proposer_index]
+    )
+    yield from run_proposer_slashing_processing(spec, state, proposer_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_headers_are_same_sigs_are_same(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=False)
+    proposer_slashing.signed_header_2 = proposer_slashing.signed_header_1.copy()
+    yield from run_proposer_slashing_processing(spec, state, proposer_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_proposer_is_not_activated(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=True)
+    # set proposer to not-yet-activated
+    proposer_index = proposer_slashing.signed_header_1.message.proposer_index
+    state.validators[proposer_index].activation_epoch = spec.get_current_epoch(state) + 1
+    yield from run_proposer_slashing_processing(spec, state, proposer_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_proposer_is_slashed(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=True)
+    # set proposer to already slashed
+    proposer_index = proposer_slashing.signed_header_1.message.proposer_index
+    state.validators[proposer_index].slashed = True
+    yield from run_proposer_slashing_processing(spec, state, proposer_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_proposer_is_withdrawn(spec, state):
+    # move 1 epoch into future to allow for past withdrawable epoch
+    next_epoch(spec, state)
+    # set proposer withdrawable epoch in past
+    proposer_slashing = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=True)
+    proposer_index = proposer_slashing.signed_header_1.message.proposer_index
+    state.validators[proposer_index].withdrawable_epoch = spec.get_current_epoch(state) - 1
+    yield from run_proposer_slashing_processing(spec, state, proposer_slashing, valid=False)

@@ -1,0 +1,160 @@
+"""process_voluntary_exit tests
+(ref: test/phase0/block_processing/test_process_voluntary_exit.py)."""
+from consensus_specs_tpu.test_framework.context import (
+    always_bls,
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.test_framework.keys import privkeys
+from consensus_specs_tpu.test_framework.state import next_epoch, next_slots
+from consensus_specs_tpu.test_framework.voluntary_exits import (
+    run_voluntary_exit_processing,
+    sign_voluntary_exit,
+)
+
+
+def _activate_and_age(spec, state):
+    # move state forward SHARD_COMMITTEE_PERIOD epochs to allow exit
+    next_slots(spec, state, spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH)
+
+
+@with_all_phases
+@spec_state_test
+def test_success_exit(spec, state):
+    _activate_and_age(spec, state)
+    current_epoch = spec.get_current_epoch(state)
+    validator_index = spec.get_active_validator_indices(state, current_epoch)[0]
+
+    signed_voluntary_exit = sign_voluntary_exit(
+        spec, state,
+        spec.VoluntaryExit(epoch=current_epoch, validator_index=validator_index),
+        privkeys[validator_index],
+    )
+    yield from run_voluntary_exit_processing(spec, state, signed_voluntary_exit)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_incorrect_signature(spec, state):
+    _activate_and_age(spec, state)
+    current_epoch = spec.get_current_epoch(state)
+    validator_index = spec.get_active_validator_indices(state, current_epoch)[0]
+
+    voluntary_exit = spec.VoluntaryExit(epoch=current_epoch, validator_index=validator_index)
+    signed_voluntary_exit = sign_voluntary_exit(spec, state, voluntary_exit, privkeys[validator_index + 1])
+    yield from run_voluntary_exit_processing(spec, state, signed_voluntary_exit, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_success_exit_queue__min_churn(spec, state):
+    _activate_and_age(spec, state)
+    current_epoch = spec.get_current_epoch(state)
+
+    # exit `MAX_EXITS_PER_EPOCH` (churn limit)
+    initial_indices = spec.get_active_validator_indices(state, current_epoch)[
+        : spec.get_validator_churn_limit(state)
+    ]
+
+    # Prepare a bunch of exits, based on the current state
+    exit_queue = []
+    for index in initial_indices:
+        signed_voluntary_exit = sign_voluntary_exit(
+            spec, state,
+            spec.VoluntaryExit(epoch=current_epoch, validator_index=index),
+            privkeys[index],
+        )
+        exit_queue.append(signed_voluntary_exit)
+
+    # Now run all the exits
+    for voluntary_exit in exit_queue:
+        # the function yields data, but we are just interested in running it here, ignore yields.
+        for _ in run_voluntary_exit_processing(spec, state, voluntary_exit):
+            continue
+
+    # exit an additional validator
+    validator_index = spec.get_active_validator_indices(state, current_epoch)[-1]
+    signed_voluntary_exit = sign_voluntary_exit(
+        spec, state,
+        spec.VoluntaryExit(epoch=current_epoch, validator_index=validator_index),
+        privkeys[validator_index],
+    )
+
+    # This is the interesting part of the test: on a pre-state with full exit queue,
+    # when processing an additional exit, it results in an exit in a later epoch
+    yield from run_voluntary_exit_processing(spec, state, signed_voluntary_exit)
+
+    for index in initial_indices:
+        assert (
+            state.validators[validator_index].exit_epoch
+            == state.validators[index].exit_epoch + 1
+        )
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_validator_exit_in_future(spec, state):
+    _activate_and_age(spec, state)
+    current_epoch = spec.get_current_epoch(state)
+    validator_index = spec.get_active_validator_indices(state, current_epoch)[0]
+
+    voluntary_exit = spec.VoluntaryExit(epoch=current_epoch + 1, validator_index=validator_index)
+    signed_voluntary_exit = sign_voluntary_exit(spec, state, voluntary_exit, privkeys[validator_index])
+    yield from run_voluntary_exit_processing(spec, state, signed_voluntary_exit, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_validator_incorrect_validator_index(spec, state):
+    _activate_and_age(spec, state)
+    current_epoch = spec.get_current_epoch(state)
+    validator_index = spec.get_active_validator_indices(state, current_epoch)[0]
+
+    voluntary_exit = spec.VoluntaryExit(epoch=current_epoch, validator_index=len(state.validators))
+    signed_voluntary_exit = sign_voluntary_exit(spec, state, voluntary_exit, privkeys[validator_index])
+    yield from run_voluntary_exit_processing(spec, state, signed_voluntary_exit, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_validator_not_active(spec, state):
+    current_epoch = spec.get_current_epoch(state)
+    validator_index = spec.get_active_validator_indices(state, current_epoch)[0]
+
+    state.validators[validator_index].activation_epoch = spec.FAR_FUTURE_EPOCH
+
+    voluntary_exit = spec.VoluntaryExit(epoch=current_epoch, validator_index=validator_index)
+    signed_voluntary_exit = sign_voluntary_exit(spec, state, voluntary_exit, privkeys[validator_index])
+    yield from run_voluntary_exit_processing(spec, state, signed_voluntary_exit, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_validator_already_exited(spec, state):
+    _activate_and_age(spec, state)
+    current_epoch = spec.get_current_epoch(state)
+    validator_index = spec.get_active_validator_indices(state, current_epoch)[0]
+
+    # but validator already has exited
+    state.validators[validator_index].exit_epoch = current_epoch + 2
+
+    voluntary_exit = spec.VoluntaryExit(epoch=current_epoch, validator_index=validator_index)
+    signed_voluntary_exit = sign_voluntary_exit(spec, state, voluntary_exit, privkeys[validator_index])
+    yield from run_voluntary_exit_processing(spec, state, signed_voluntary_exit, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_validator_not_active_long_enough(spec, state):
+    current_epoch = spec.get_current_epoch(state)
+    validator_index = spec.get_active_validator_indices(state, current_epoch)[0]
+
+    voluntary_exit = spec.VoluntaryExit(epoch=current_epoch, validator_index=validator_index)
+    signed_voluntary_exit = sign_voluntary_exit(spec, state, voluntary_exit, privkeys[validator_index])
+
+    assert (
+        current_epoch - state.validators[validator_index].activation_epoch
+        < spec.config.SHARD_COMMITTEE_PERIOD
+    )
+    yield from run_voluntary_exit_processing(spec, state, signed_voluntary_exit, valid=False)

@@ -45,6 +45,7 @@ from consensus_specs_tpu.ssz import (
     uint256,
 )
 from consensus_specs_tpu.ssz import hash_tree_root, serialize, copy  # noqa: F401
+from consensus_specs_tpu.ssz import get_generalized_index, get_generalized_index_length  # noqa: F401
 from consensus_specs_tpu.ssz.hashing import sha256 as _sha256, sha256_many_small
 
 
@@ -1264,7 +1265,7 @@ def process_attestation(state: "BeaconState", attestation: Attestation) -> None:
     assert is_valid_indexed_attestation(state, get_indexed_attestation(state, attestation))
 
 
-def get_validator_from_deposit(state: "BeaconState", deposit: Deposit) -> Validator:
+def get_validator_from_deposit(deposit: Deposit) -> Validator:
     amount = deposit.data.amount
     effective_balance = min(amount - amount % EFFECTIVE_BALANCE_INCREMENT, MAX_EFFECTIVE_BALANCE)  # noqa: F821
     return Validator(
@@ -1304,7 +1305,7 @@ def process_deposit(state: "BeaconState", deposit: Deposit) -> None:
         signing_root = compute_signing_root(deposit_message, domain)
         if not bls.Verify(pubkey, signing_root, deposit.data.signature):
             return
-        state.validators.append(get_validator_from_deposit(state, deposit))
+        state.validators.append(get_validator_from_deposit(deposit))
         state.balances.append(amount)
     else:
         index = ValidatorIndex(validator_pubkeys.index(pubkey))

@@ -105,7 +105,9 @@ def with_custom_state(balances_fn, threshold_fn):
     def deco(fn):
         def entry(*args, spec, phases=None, **kw):
             state = _prepare_state(balances_fn, threshold_fn, spec)
-            return fn(*args, spec=spec, state=state, **kw)
+            # forward `phases` unconditionally; single_phase pops it for
+            # single-fork tests (ref context.py:246-255)
+            return fn(*args, spec=spec, state=state, phases=phases, **kw)
 
         return copy_meta(entry, fn)
 

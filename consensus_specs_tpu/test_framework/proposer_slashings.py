@@ -3,6 +3,7 @@ proposer_slashings.py)."""
 from __future__ import annotations
 
 from .block import sign_block  # noqa: F401  (commonly used together)
+from .constants import is_post_altair
 from .context import expect_assertion_error
 from .keys import privkeys
 from .state import get_balance
@@ -27,13 +28,47 @@ def check_proposer_slashing_effect(spec, pre_state, state, slashed_index, block=
     whistleblower_reward = (
         state.validators[slashed_index].effective_balance // spec.WHISTLEBLOWER_REWARD_QUOTIENT
     )
+
+    # Altair+: blocks also carry sync-committee reward/penalty effects
+    sc_reward_for_slashed = sc_penalty_for_slashed = 0
+    sc_reward_for_proposer = sc_penalty_for_proposer = 0
+    if is_post_altair(spec) and block is not None:
+        from .sync_committee import (
+            compute_committee_indices,
+            compute_sync_committee_participant_reward_and_penalty,
+        )
+
+        committee_indices = compute_committee_indices(spec, state, state.current_sync_committee)
+        committee_bits = block.body.sync_aggregate.sync_committee_bits
+        sc_reward_for_slashed, sc_penalty_for_slashed = (
+            compute_sync_committee_participant_reward_and_penalty(
+                spec, pre_state, slashed_index, committee_indices, committee_bits
+            )
+        )
+        sc_reward_for_proposer, sc_penalty_for_proposer = (
+            compute_sync_committee_participant_reward_and_penalty(
+                spec, pre_state, proposer_index, committee_indices, committee_bits
+            )
+        )
+
     if proposer_index != slashed_index:
-        # Slashed validator lost initial slash penalty
-        assert get_balance(state, slashed_index) == get_balance(pre_state, slashed_index) - slash_penalty
-    else:
-        # Slashed proposer itself: net change is reward - penalty
+        # Slashed validator lost initial slash penalty (+- sync effects)
         assert get_balance(state, slashed_index) == (
+            get_balance(pre_state, slashed_index) - slash_penalty
+            + sc_reward_for_slashed - sc_penalty_for_slashed
+        )
+        # Proposer gained whistleblower reward (>=: may have reported more,
+        # and earns sync-aggregate proposer rewards)
+        assert get_balance(state, proposer_index) >= (
+            get_balance(pre_state, proposer_index) + whistleblower_reward
+            + sc_reward_for_proposer - sc_penalty_for_proposer
+        )
+    else:
+        # Slashed proposer itself: whistleblower reward net of penalty (>=:
+        # sync-aggregate proposer rewards come on top)
+        assert get_balance(state, slashed_index) >= (
             get_balance(pre_state, slashed_index) - slash_penalty + whistleblower_reward
+            + sc_reward_for_slashed - sc_penalty_for_slashed
         )
 
 

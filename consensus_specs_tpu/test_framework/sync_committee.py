@@ -1,0 +1,128 @@
+"""Sync-committee test helpers: signatures, rewards math, runner
+(ref: test/helpers/sync_committee.py)."""
+from __future__ import annotations
+
+from .block_processing import run_block_processing_to
+from .context import expect_assertion_error
+from .keys import privkeys, pubkey_to_privkey, pubkeys
+
+
+def compute_committee_indices(spec, state, committee=None):
+    """Validator indices of the sync committee members (with duplicates)."""
+    if committee is None:
+        committee = state.current_sync_committee
+    all_pubkeys = [v.pubkey for v in state.validators]
+    return [all_pubkeys.index(pubkey) for pubkey in committee.pubkeys]
+
+
+def compute_sync_committee_signature(spec, state, slot, privkey, block_root=None, domain_type=None):
+    domain = spec.get_domain(
+        state, domain_type or spec.DOMAIN_SYNC_COMMITTEE, spec.compute_epoch_at_slot(slot)
+    )
+    if block_root is None:
+        if slot == state.slot:
+            block_root = build_empty_block_root(spec, state)
+        else:
+            block_root = spec.get_block_root_at_slot(state, slot)
+    signing_root = spec.compute_signing_root(spec.Root(block_root), domain)
+    return spec.bls.Sign(privkey, signing_root)
+
+
+def build_empty_block_root(spec, state):
+    from .block import build_empty_block_for_next_slot
+
+    return build_empty_block_for_next_slot(spec, state).parent_root
+
+
+def compute_aggregate_sync_committee_signature(spec, state, slot, participants, block_root=None,
+                                               domain_type=None):
+    if len(participants) == 0:
+        return spec.G2_POINT_AT_INFINITY
+
+    signatures = [
+        compute_sync_committee_signature(
+            spec, state, slot, privkeys[validator_index], block_root=block_root, domain_type=domain_type
+        )
+        for validator_index in participants
+    ]
+    return spec.bls.Aggregate(signatures)
+
+
+def compute_sync_committee_inclusion_reward(spec, state):
+    total_active_increments = spec.get_total_active_balance(state) // spec.EFFECTIVE_BALANCE_INCREMENT
+    total_base_rewards = spec.get_base_reward_per_increment(state) * total_active_increments
+    max_participant_rewards = (
+        total_base_rewards * spec.SYNC_REWARD_WEIGHT // spec.WEIGHT_DENOMINATOR // spec.SLOTS_PER_EPOCH
+    )
+    return spec.Gwei(max_participant_rewards // spec.SYNC_COMMITTEE_SIZE)
+
+
+def compute_sync_committee_participant_reward_and_penalty(spec, state, participant_index,
+                                                          committee_indices, committee_bits):
+    """(reward, penalty) a member accrues from one sync aggregate, counting
+    multiplicity (members can appear several times)."""
+    inclusion_reward = compute_sync_committee_inclusion_reward(spec, state)
+
+    included_multiplicities = sum(
+        1 for index, bit in zip(committee_indices, committee_bits)
+        if index == participant_index and bit
+    )
+    excluded_multiplicities = sum(
+        1 for index, bit in zip(committee_indices, committee_bits)
+        if index == participant_index and not bit
+    )
+    return (
+        spec.Gwei(inclusion_reward * included_multiplicities),
+        spec.Gwei(inclusion_reward * excluded_multiplicities),
+    )
+
+
+def compute_sync_committee_proposer_reward(spec, state, committee_indices, committee_bits):
+    inclusion_reward = compute_sync_committee_inclusion_reward(spec, state)
+    participant_number = sum(1 for bit in committee_bits if bit)
+    participant_reward = inclusion_reward * spec.PROPOSER_WEIGHT // (
+        spec.WEIGHT_DENOMINATOR - spec.PROPOSER_WEIGHT
+    )
+    return spec.Gwei(participant_reward * participant_number)
+
+
+def validate_sync_committee_rewards(spec, pre_state, post_state, committee_indices,
+                                    committee_bits, proposer_index):
+    for index in range(len(post_state.validators)):
+        reward, penalty = compute_sync_committee_participant_reward_and_penalty(
+            spec, pre_state, index, committee_indices, committee_bits
+        )
+        if proposer_index == index:
+            reward += compute_sync_committee_proposer_reward(
+                spec, pre_state, committee_indices, committee_bits
+            )
+        balance = pre_state.balances[index] + reward
+        assert post_state.balances[index] == (0 if balance < penalty else balance - penalty)
+
+
+def run_sync_committee_processing(spec, state, block, expect_exception=False):
+    """Stage block processing up to the sync-aggregate step, then run
+    process_sync_aggregate in isolation and yield pre/operation/post
+    (ref sync_committee.py:113-146)."""
+    pre_state = state.copy()
+    # stage everything before process_sync_aggregate (slots, header, ops)
+    run_block_processing_to(spec, state, block, "process_sync_aggregate")
+    yield "pre", state
+    yield "sync_aggregate", block.body.sync_aggregate
+    if expect_exception:
+        expect_assertion_error(
+            lambda: spec.process_sync_aggregate(state, block.body.sync_aggregate)
+        )
+        yield "post", None
+        assert pre_state.balances == state.balances
+        return
+
+    spec.process_sync_aggregate(state, block.body.sync_aggregate)
+    yield "post", state
+
+    committee_indices = compute_committee_indices(spec, state, state.current_sync_committee)
+    committee_bits = block.body.sync_aggregate.sync_committee_bits
+    validate_sync_committee_rewards(
+        spec, pre_state, state, committee_indices, committee_bits,
+        spec.get_beacon_proposer_index(state),
+    )

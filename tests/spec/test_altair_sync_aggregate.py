@@ -1,0 +1,157 @@
+"""process_sync_aggregate tests
+(ref: test/altair/block_processing/sync_aggregate/)."""
+import random
+
+from consensus_specs_tpu.test_framework.block import build_empty_block_for_next_slot
+from consensus_specs_tpu.test_framework.context import (
+    always_bls,
+    spec_state_test,
+    with_altair_and_later,
+)
+from consensus_specs_tpu.test_framework.state import next_slots, transition_to
+from consensus_specs_tpu.test_framework.sync_committee import (
+    compute_aggregate_sync_committee_signature,
+    compute_committee_indices,
+    run_sync_committee_processing,
+)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_sync_committee_rewards_everyone_participates(spec, state):
+    committee_indices = compute_committee_indices(spec, state)
+    committee_size = len(committee_indices)
+
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=[True] * committee_size,
+        sync_committee_signature=compute_aggregate_sync_committee_signature(
+            spec, state, block.slot - 1, committee_indices
+        ),
+    )
+    yield from run_sync_committee_processing(spec, state, block)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_sync_committee_rewards_nonduplicate_half_participation(spec, state):
+    committee_indices = compute_committee_indices(spec, state)
+    committee_size = len(committee_indices)
+    rng = random.Random(1010)
+    participating = rng.sample(range(committee_size), committee_size // 2)
+    committee_bits = [i in participating for i in range(committee_size)]
+
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=committee_bits,
+        sync_committee_signature=compute_aggregate_sync_committee_signature(
+            spec, state, block.slot - 1,
+            [index for index, bit in zip(committee_indices, committee_bits) if bit],
+        ),
+    )
+    yield from run_sync_committee_processing(spec, state, block)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_sync_committee_rewards_empty_participants(spec, state):
+    committee_indices = compute_committee_indices(spec, state)
+    committee_size = len(committee_indices)
+
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=[False] * committee_size,
+        sync_committee_signature=spec.G2_POINT_AT_INFINITY,
+    )
+    yield from run_sync_committee_processing(spec, state, block)
+
+
+@with_altair_and_later
+@spec_state_test
+@always_bls
+def test_invalid_signature_bad_domain(spec, state):
+    committee_indices = compute_committee_indices(spec, state)
+
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=[True] * len(committee_indices),
+        sync_committee_signature=compute_aggregate_sync_committee_signature(
+            spec, state, block.slot - 1, committee_indices,
+            domain_type=spec.DOMAIN_BEACON_ATTESTER,  # wrong domain
+        ),
+    )
+    yield from run_sync_committee_processing(spec, state, block, expect_exception=True)
+
+
+@with_altair_and_later
+@spec_state_test
+@always_bls
+def test_invalid_signature_missing_participant(spec, state):
+    committee_indices = compute_committee_indices(spec, state)
+    rng = random.Random(2020)
+    random_participant = rng.choice(committee_indices)
+
+    block = build_empty_block_for_next_slot(spec, state)
+    # Exclude one participant whose signature was included.
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=[index != random_participant for index in committee_indices],
+        sync_committee_signature=compute_aggregate_sync_committee_signature(
+            spec, state, block.slot - 1, committee_indices,  # full committee signs
+        ),
+    )
+    yield from run_sync_committee_processing(spec, state, block, expect_exception=True)
+
+
+@with_altair_and_later
+@spec_state_test
+@always_bls
+def test_invalid_signature_extra_participant(spec, state):
+    committee_indices = compute_committee_indices(spec, state)
+    rng = random.Random(3030)
+    random_participant = rng.choice(committee_indices)
+
+    block = build_empty_block_for_next_slot(spec, state)
+    # Exclude one signature even though the block claims the participant contributed.
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=[True] * len(committee_indices),
+        sync_committee_signature=compute_aggregate_sync_committee_signature(
+            spec, state, block.slot - 1,
+            [index for index in committee_indices if index != random_participant],
+        ),
+    )
+    yield from run_sync_committee_processing(spec, state, block, expect_exception=True)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_proposer_in_committee_without_participation(spec, state):
+    # move forward to ensure a proposer is likely in the committee sometimes;
+    # regardless, rewards math must hold with proposer excluded from bits
+    committee_indices = compute_committee_indices(spec, state)
+    block = build_empty_block_for_next_slot(spec, state)
+    proposer_index = block.proposer_index
+    bits = [index != proposer_index for index in committee_indices]
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=bits,
+        sync_committee_signature=compute_aggregate_sync_committee_signature(
+            spec, state, block.slot - 1,
+            [index for index, bit in zip(committee_indices, bits) if bit],
+        ),
+    )
+    yield from run_sync_committee_processing(spec, state, block)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_sync_committee_updates_at_period_boundary(spec, state):
+    # Advance to one slot before the sync committee period boundary
+    current_period = spec.get_current_epoch(state) // spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+    boundary_epoch = (current_period + 1) * spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+    transition_to(spec, state, boundary_epoch * spec.SLOTS_PER_EPOCH - 1)
+
+    pre_next = state.next_sync_committee.copy()
+    yield "pre", state
+    spec.process_sync_committee_updates(state)
+    yield "post", state
+
+    assert state.current_sync_committee == pre_next

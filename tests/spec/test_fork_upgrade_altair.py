@@ -1,0 +1,110 @@
+"""phase0 → altair fork upgrade tests
+(ref: test/altair/fork/test_altair_fork_basic.py + transition/)."""
+from consensus_specs_tpu.test_framework.attestations import next_epoch_with_attestations
+from consensus_specs_tpu.test_framework.context import (
+    ALTAIR,
+    PHASE0,
+    spec_test,
+    single_phase,
+    with_phases,
+    with_custom_state,
+    default_balances,
+    default_activation_threshold,
+    misc_balances,
+    low_balances,
+    zero_activation_threshold,
+)
+from consensus_specs_tpu.test_framework.state import next_epoch, next_epoch_via_block
+
+
+def run_fork_test(post_spec, pre_state):
+    yield "pre", pre_state
+
+    post_state = post_spec.upgrade_to_altair(pre_state)
+
+    # Stable fields
+    stable_fields = [
+        "genesis_time", "genesis_validators_root", "slot",
+        "latest_block_header", "block_roots", "state_roots", "historical_roots",
+        "eth1_data", "eth1_data_votes", "eth1_deposit_index",
+        "validators", "balances",
+        "randao_mixes", "slashings",
+        "justification_bits", "previous_justified_checkpoint",
+        "current_justified_checkpoint", "finalized_checkpoint",
+    ]
+    for field in stable_fields:
+        assert getattr(pre_state, field) == getattr(post_state, field), field
+
+    # Modified fields
+    assert post_state.fork.previous_version == pre_state.fork.current_version
+    assert bytes(post_state.fork.current_version) == bytes(post_spec.config.ALTAIR_FORK_VERSION)
+
+    # New fields
+    assert len(post_state.previous_epoch_participation) == len(pre_state.validators)
+    assert len(post_state.current_epoch_participation) == len(pre_state.validators)
+    assert all(int(s) == 0 for s in post_state.inactivity_scores)
+    assert len(post_state.current_sync_committee.pubkeys) == post_spec.SYNC_COMMITTEE_SIZE
+
+    yield "post", post_state
+
+
+@with_phases([PHASE0], other_phases=[ALTAIR])
+@spec_test
+@with_custom_state(default_balances, default_activation_threshold)
+def test_fork_base_state(spec, state, phases):
+    yield from run_fork_test(phases[ALTAIR], state)
+
+
+@with_phases([PHASE0], other_phases=[ALTAIR])
+@spec_test
+@with_custom_state(default_balances, default_activation_threshold)
+def test_fork_next_epoch(spec, state, phases):
+    next_epoch(spec, state)
+    yield from run_fork_test(phases[ALTAIR], state)
+
+
+@with_phases([PHASE0], other_phases=[ALTAIR])
+@spec_test
+@with_custom_state(default_balances, default_activation_threshold)
+def test_fork_next_epoch_with_block(spec, state, phases):
+    next_epoch_via_block(spec, state)
+    yield from run_fork_test(phases[ALTAIR], state)
+
+
+@with_phases([PHASE0], other_phases=[ALTAIR])
+@spec_test
+@with_custom_state(misc_balances, default_activation_threshold)
+def test_fork_misc_balances(spec, state, phases):
+    yield from run_fork_test(phases[ALTAIR], state)
+
+
+@with_phases([PHASE0], other_phases=[ALTAIR])
+@spec_test
+@with_custom_state(low_balances, zero_activation_threshold)
+def test_fork_low_balances(spec, state, phases):
+    yield from run_fork_test(phases[ALTAIR], state)
+
+
+@with_phases([PHASE0], other_phases=[ALTAIR])
+@spec_test
+@with_custom_state(default_balances, default_activation_threshold)
+def test_transition_with_attestations_translation(spec, state, phases):
+    """Full epochs of phase0 attestations must translate into altair
+    participation flags, preserving justification progress."""
+    next_epoch(spec, state)
+    _, _, state = next_epoch_with_attestations(spec, state, True, False)
+    _, _, state = next_epoch_with_attestations(spec, state, True, True)
+    assert state.current_justified_checkpoint.epoch > 0
+
+    yield "pre", state
+    post_state = phases[ALTAIR].upgrade_to_altair(state)
+    yield "post", post_state
+
+    # Previous-epoch attestations became participation flags
+    participation = [int(f) for f in post_state.previous_epoch_participation]
+    assert sum(1 for f in participation if f) > 0
+    # Justification is preserved and continues under altair
+    assert post_state.current_justified_checkpoint == state.current_justified_checkpoint
+    altair_spec = phases[ALTAIR]
+    _, _, cont = next_epoch_with_attestations(altair_spec, post_state, True, True)
+    assert cont.finalized_checkpoint.epoch >= state.finalized_checkpoint.epoch

@@ -155,7 +155,7 @@ def test_proposer_slashing(spec, state):
     yield "blocks", [signed_block]
     yield "post", state
 
-    check_proposer_slashing_effect(spec, pre_state, state, slashed_index)
+    check_proposer_slashing_effect(spec, pre_state, state, slashed_index, block=signed_block.message)
 
 
 @with_all_phases
@@ -246,7 +246,25 @@ def test_deposit_top_up(spec, state):
 
     assert len(state.validators) == initial_registry_len
     assert len(state.balances) == initial_balances_len
-    assert get_balance(state, validator_index) == validator_pre_balance + amount
+
+    # Altair+: account for the sync-committee effects carried by the block
+    from consensus_specs_tpu.test_framework.constants import is_post_altair
+
+    sc_reward = sc_penalty = 0
+    if is_post_altair(spec):
+        from consensus_specs_tpu.test_framework.sync_committee import (
+            compute_committee_indices,
+            compute_sync_committee_participant_reward_and_penalty,
+        )
+
+        committee_indices = compute_committee_indices(spec, state, state.current_sync_committee)
+        committee_bits = block.body.sync_aggregate.sync_committee_bits
+        sc_reward, sc_penalty = compute_sync_committee_participant_reward_and_penalty(
+            spec, state, validator_index, committee_indices, committee_bits
+        )
+    assert get_balance(state, validator_index) == (
+        validator_pre_balance + amount + sc_reward - sc_penalty
+    )
 
 
 @with_all_phases

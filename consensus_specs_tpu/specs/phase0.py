@@ -30,18 +30,26 @@ from consensus_specs_tpu.crypto import bls
 from consensus_specs_tpu.ssz import (
     Bitlist,
     Bitvector,
+    ByteList,
+    ByteVector,
     Bytes1,
     Bytes4,
+    Bytes8,
+    Bytes20,
     Bytes32,
     Bytes48,
     Bytes96,
     Container,
     List,
+    Union,
     Vector,
     boolean,
+    byte,
     uint8,
+    uint16,
     uint32,
     uint64,
+    uint128,
     uint256,
 )
 from consensus_specs_tpu.ssz import hash_tree_root, serialize, copy  # noqa: F401

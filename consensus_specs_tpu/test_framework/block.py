@@ -75,7 +75,7 @@ def build_empty_block(spec, state, slot=None):
 
     if is_post_altair(spec):
         empty_block.body.sync_aggregate.sync_committee_signature = spec.G2_POINT_AT_INFINITY
-    if is_post_bellatrix(spec):
+    if is_post_bellatrix(spec) and spec.is_execution_enabled(state, empty_block.body):
         from .execution_payload import build_empty_execution_payload
 
         empty_block.body.execution_payload = build_empty_execution_payload(spec, state)

@@ -1,0 +1,4 @@
+"""Test-vector generation (ref: tests/core/pyspec/eth2spec/gen_helpers/ and
+tests/generators/): run the dual-mode tests in generator mode and write
+conformance vectors in the canonical
+``preset/fork/runner/handler/suite/case`` layout."""

@@ -1,0 +1,136 @@
+"""Vector-writing runner: CLI, case directories, INCOMPLETE sentinel
+lifecycle, resume, error log (ref: gen_helpers/gen_base/gen_runner.py).
+"""
+from __future__ import annotations
+
+import argparse
+import shutil
+import time
+import traceback
+from pathlib import Path
+from typing import Iterable
+
+import yaml
+
+from consensus_specs_tpu.exceptions import SkippedTest
+from consensus_specs_tpu.ssz.types import SSZType
+from consensus_specs_tpu.utils import snappy
+
+from .gen_typing import TestCase, TestProvider
+
+TIME_THRESHOLD_TO_PRINT = 1.0  # seconds
+
+
+def validate_output_dir(path_str: str) -> Path:
+    path = Path(path_str)
+    if path.exists() and not path.is_dir():
+        raise argparse.ArgumentTypeError(f"Output path must be a directory: {path}")
+    return path
+
+
+def run_generator(generator_name: str, test_providers: Iterable[TestProvider], args=None) -> None:
+    """Write all providers' cases under ``<output>/<case dir>`` with the
+    INCOMPLETE sentinel marking in-progress cases and skip-if-exists resume
+    (ref gen_runner.py:41-218)."""
+    parser = argparse.ArgumentParser(
+        prog=f"gen-{generator_name}",
+        description=f"Generate YAML/SSZ test-vector suites for {generator_name}",
+    )
+    parser.add_argument("-o", "--output-dir", dest="output_dir", required=True,
+                        type=validate_output_dir, help="directory to write vectors into")
+    parser.add_argument("-f", "--force", action="store_true", default=False,
+                        help="overwrite existing test cases")
+    parser.add_argument("-l", "--preset-list", dest="preset_list", nargs="*", default=None,
+                        help="only generate the given presets")
+    parser.add_argument("-c", "--collect-only", action="store_true", default=False,
+                        help="list the test cases without generating")
+
+    ns = parser.parse_args(args=args)
+
+    output_dir: Path = ns.output_dir
+    log_file = output_dir / "testgen_error_log.txt"
+
+    generated = skipped = failed = 0
+    collected = 0
+
+    for provider in test_providers:
+        provider.prepare()
+
+        for test_case in provider.make_cases():
+            if ns.preset_list is not None and test_case.preset_name not in ns.preset_list:
+                continue
+            collected += 1
+            if ns.collect_only:
+                print(test_case.dir_path())
+                continue
+
+            case_dir = output_dir / test_case.dir_path()
+            incomplete_tag_file = case_dir / "INCOMPLETE"
+
+            if case_dir.exists():
+                if not ns.force and not incomplete_tag_file.exists():
+                    skipped += 1
+                    continue
+                shutil.rmtree(case_dir)
+
+            print(f"generating: {case_dir}")
+            written_parts = 0
+            try:
+                case_dir.mkdir(parents=True, exist_ok=True)
+                start = time.time()
+                # sentinel first: a crash leaves the case marked incomplete
+                incomplete_tag_file.touch()
+
+                meta = {}
+                for (name, kind, data) in test_case.case_fn():
+                    if kind == "meta":
+                        meta[name] = data
+                        continue
+                    written_parts += 1
+                    if kind == "ssz":
+                        raw = bytes(data.encode_bytes()) if isinstance(data, SSZType) else bytes(data)
+                        (case_dir / f"{name}.ssz_snappy").write_bytes(snappy.compress(raw))
+                    elif kind == "data":
+                        from consensus_specs_tpu.debug.encode import encode
+
+                        out_data = encode(data) if isinstance(data, SSZType) else data
+                        with open(case_dir / f"{name}.yaml", "w") as f:
+                            yaml.safe_dump(out_data, f, default_flow_style=None)
+                    else:
+                        raise ValueError(f"unknown part kind {kind!r}")
+
+                if len(meta) != 0:
+                    written_parts += 1
+                    with open(case_dir / "meta.yaml", "w") as f:
+                        yaml.safe_dump(meta, f, default_flow_style=None)
+
+                if written_parts == 0:
+                    print(f"test case {case_dir} did not produce any parts, removing")
+                    shutil.rmtree(case_dir)
+                    continue
+
+                incomplete_tag_file.unlink()
+                generated += 1
+                elapsed = time.time() - start
+                if elapsed >= TIME_THRESHOLD_TO_PRINT:
+                    print(f"  done in {elapsed:.2f}s")
+            except SkippedTest as e:
+                print(f"skipped: {e}")
+                skipped += 1
+                if case_dir.exists():
+                    shutil.rmtree(case_dir)
+            except Exception:
+                failed += 1
+                err = traceback.format_exc()
+                print(f"ERROR in {case_dir}:\n{err}")
+                output_dir.mkdir(parents=True, exist_ok=True)
+                with open(log_file, "a") as f:
+                    f.write(f"\n--- {case_dir} ---\n{err}\n")
+
+    if ns.collect_only:
+        print(f"collected {collected} test cases")
+    else:
+        summary = f"completed generation of {generator_name}: {generated} generated, {skipped} skipped, {failed} failed"
+        print(summary)
+        if failed:
+            raise SystemExit(1)

@@ -1,0 +1,32 @@
+"""Generator datatypes (ref: gen_helpers/gen_base/gen_typing.py)."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, List, Tuple
+
+# (name, kind, data) where kind in {"meta", "data", "ssz"}
+TestCasePart = Tuple[str, str, Any]
+
+
+@dataclass
+class TestCase:
+    fork_name: str
+    preset_name: str
+    runner_name: str
+    handler_name: str
+    suite_name: str
+    case_name: str
+    case_fn: Callable[[], Iterable[TestCasePart]]
+
+    def dir_path(self) -> str:
+        return (
+            f"{self.preset_name}/{self.fork_name}/{self.runner_name}/"
+            f"{self.handler_name}/{self.suite_name}/{self.case_name}"
+        )
+
+
+@dataclass
+class TestProvider:
+    # run once before making the cases (e.g. select a BLS backend)
+    prepare: Callable[[], None]
+    make_cases: Callable[[], Iterable[TestCase]]

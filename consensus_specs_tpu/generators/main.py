@@ -1,0 +1,54 @@
+"""Master generator CLI: run one or all vector runners
+(the `make generate_tests` / `make gen_<name>` equivalent, ref Makefile:89,167-197).
+
+Usage:
+  python -m consensus_specs_tpu.generators.main -o out/          # all runners
+  python -m consensus_specs_tpu.generators.main -o out/ --runners bls shuffling
+  ... plus any gen_runner flags (-f force, -l preset filter, -c collect)
+"""
+from __future__ import annotations
+
+import argparse
+import importlib
+import sys
+
+RUNNERS = [
+    "operations",
+    "sanity",
+    "finality",
+    "epoch_processing",
+    "genesis",
+    "forks",
+    "fork_choice",
+    "shuffling",
+    "bls",
+    "ssz_static",
+]
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(prog="generate-tests")
+    parser.add_argument("--runners", nargs="*", default=None,
+                        help=f"runners to generate (default: all of {RUNNERS})")
+    ns, rest = parser.parse_known_args(argv)
+
+    names = ns.runners if ns.runners else RUNNERS
+    unknown = [n for n in names if n not in RUNNERS]
+    if unknown:
+        raise SystemExit(f"unknown runner(s) {unknown}; have {RUNNERS}")
+    failures = []
+    for name in names:
+        mod = importlib.import_module(f"consensus_specs_tpu.generators.runners.{name}")
+        print(f"\n=== runner: {name} ===")
+        try:
+            mod.run(args=rest)
+        except SystemExit as e:
+            if e.code not in (0, None):
+                failures.append(name)
+    if failures:
+        print(f"FAILED runners: {failures}")
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,168 @@
+"""`bls` runner: IETF-draft-v4 style sign/verify/aggregate vectors incl.
+edge cases (G2 infinity, zero privkey rejections)
+(ref: tests/generators/bls/main.py)."""
+from consensus_specs_tpu.crypto.bls import ciphersuite
+
+from ..gen_runner import run_generator
+from ..gen_typing import TestCase, TestProvider
+
+Z1_PUBKEY = b"\xc0" + b"\x00" * 47
+NO_SIGNATURE = b"\x00" * 96
+Z2_SIGNATURE = b"\xc0" + b"\x00" * 95
+ZERO_PRIVKEY = 0
+ZERO_PRIVKEY_BYTES = b"\x00" * 32
+
+PRIVKEYS = [
+    0x00000000000000000000000000000000263DBD792F5B1BE47ED85F8938C0F29586AF0D3AC7B977F21C278FE1462040C3,
+    0x0000000000000000000000000000000047B8192D77BF871B62E87859D653922725724A5C031AFEABC60BCEF5FF665138,
+    0x00000000000000000000000000000000328388AFF0D4A5B7DC9205ABD374E7E98F3CD9F3418EDB4EAFDA5FB16473D216,
+]
+MESSAGES = [
+    bytes(b"\x00" * 32),
+    bytes(b"\x56" * 32),
+    bytes(b"\xab" * 32),
+]
+
+
+def _hex(b: bytes) -> str:
+    return "0x" + bytes(b).hex()
+
+
+def case_sign():
+    for i, privkey in enumerate(PRIVKEYS):
+        for j, message in enumerate(MESSAGES):
+            sig = ciphersuite.Sign(privkey, message)
+            yield f"sign_case_{i}_{j}", {
+                "input": {"privkey": _hex(privkey.to_bytes(32, "big")), "message": _hex(message)},
+                "output": _hex(sig),
+            }
+    # Edge case: zero privkey must fail
+    yield "sign_case_zero_privkey", {
+        "input": {"privkey": _hex(ZERO_PRIVKEY_BYTES), "message": _hex(MESSAGES[0])},
+        "output": None,
+    }
+
+
+def case_verify():
+    for i, privkey in enumerate(PRIVKEYS):
+        for j, message in enumerate(MESSAGES):
+            sig = ciphersuite.Sign(privkey, message)
+            pubkey = ciphersuite.SkToPk(privkey)
+            yield f"verify_valid_case_{i}_{j}", {
+                "input": {"pubkey": _hex(pubkey), "message": _hex(message), "signature": _hex(sig)},
+                "output": True,
+            }
+            # tampered
+            tampered = bytes(sig[:-4]) + b"\xff\xff\xff\xff"
+            yield f"verify_tampered_case_{i}_{j}", {
+                "input": {"pubkey": _hex(pubkey), "message": _hex(message), "signature": _hex(tampered)},
+                "output": False,
+            }
+    # Infinity pubkey + infinity signature must NOT verify
+    yield "verify_infinity_pubkey_and_infinity_signature", {
+        "input": {"pubkey": _hex(Z1_PUBKEY), "message": _hex(MESSAGES[1]), "signature": _hex(Z2_SIGNATURE)},
+        "output": False,
+    }
+
+
+def case_aggregate():
+    for j, message in enumerate(MESSAGES):
+        sigs = [ciphersuite.Sign(privkey, message) for privkey in PRIVKEYS]
+        yield f"aggregate_0x{message.hex()}", {
+            "input": [_hex(s) for s in sigs],
+            "output": _hex(ciphersuite.Aggregate(sigs)),
+        }
+    # Edge: empty aggregate is invalid
+    yield "aggregate_na_signatures", {"input": [], "output": None}
+    # Edge: infinity signature aggregates to itself
+    yield "aggregate_infinity_signature", {
+        "input": [_hex(Z2_SIGNATURE)],
+        "output": _hex(Z2_SIGNATURE),
+    }
+
+
+def case_fast_aggregate_verify():
+    for i, message in enumerate(MESSAGES):
+        privkeys = PRIVKEYS[: i + 1]
+        sigs = [ciphersuite.Sign(privkey, message) for privkey in privkeys]
+        aggregate_signature = ciphersuite.Aggregate(sigs)
+        pubkeys = [ciphersuite.SkToPk(privkey) for privkey in privkeys]
+        yield f"fast_aggregate_verify_valid_{i}", {
+            "input": {"pubkeys": [_hex(pk) for pk in pubkeys], "message": _hex(message),
+                      "signature": _hex(aggregate_signature)},
+            "output": True,
+        }
+        # extra pubkey
+        pubkeys_extra = pubkeys + [ciphersuite.SkToPk(PRIVKEYS[-1])]
+        yield f"fast_aggregate_verify_extra_pubkey_{i}", {
+            "input": {"pubkeys": [_hex(pk) for pk in pubkeys_extra], "message": _hex(message),
+                      "signature": _hex(aggregate_signature)},
+            "output": False,
+        }
+    yield "fast_aggregate_verify_na_pubkeys_and_infinity_signature", {
+        "input": {"pubkeys": [], "message": _hex(MESSAGES[2]), "signature": _hex(Z2_SIGNATURE)},
+        "output": False,
+    }
+    yield "fast_aggregate_verify_na_pubkeys_and_na_signature", {
+        "input": {"pubkeys": [], "message": _hex(MESSAGES[2]), "signature": _hex(NO_SIGNATURE)},
+        "output": False,
+    }
+
+
+def case_aggregate_verify():
+    pubkeys = []
+    messages = []
+    sigs = []
+    for privkey, message in zip(PRIVKEYS, MESSAGES):
+        pubkeys.append(ciphersuite.SkToPk(privkey))
+        messages.append(message)
+        sigs.append(ciphersuite.Sign(privkey, message))
+    aggregate_signature = ciphersuite.Aggregate(sigs)
+    yield "aggregate_verify_valid", {
+        "input": {"pubkeys": [_hex(pk) for pk in pubkeys], "messages": [_hex(m) for m in messages],
+                  "signature": _hex(aggregate_signature)},
+        "output": True,
+    }
+    yield "aggregate_verify_tampered_signature", {
+        "input": {"pubkeys": [_hex(pk) for pk in pubkeys], "messages": [_hex(m) for m in messages],
+                  "signature": _hex(bytes(aggregate_signature[:4]) + b"\xff\xff\xff\xff" + bytes(aggregate_signature[8:]))},
+        "output": False,
+    }
+    yield "aggregate_verify_na_pubkeys_and_infinity_signature", {
+        "input": {"pubkeys": [], "messages": [], "signature": _hex(Z2_SIGNATURE)},
+        "output": False,
+    }
+
+
+HANDLERS = {
+    "sign": case_sign,
+    "verify": case_verify,
+    "aggregate": case_aggregate,
+    "fast_aggregate_verify": case_fast_aggregate_verify,
+    "aggregate_verify": case_aggregate_verify,
+}
+
+
+def _bls_cases():
+    for handler, gen in HANDLERS.items():
+        for case_name, case_data in gen():
+            def case_fn(case_data=case_data):
+                yield "data", "data", case_data
+
+            yield TestCase(
+                fork_name="phase0",
+                preset_name="general",
+                runner_name="bls",
+                handler_name=handler,
+                suite_name="small",
+                case_name=case_name,
+                case_fn=case_fn,
+            )
+
+
+def run(args=None):
+    run_generator("bls", [TestProvider(prepare=lambda: None, make_cases=_bls_cases)], args=args)
+
+
+if __name__ == "__main__":
+    run()

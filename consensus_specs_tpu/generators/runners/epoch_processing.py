@@ -1,0 +1,15 @@
+"""`epoch_processing` runner (ref: tests/generators/epoch_processing/main.py)."""
+from ..gen_from_tests import run_state_test_generators
+
+all_mods = {
+    fork: {"epoch_processing": "tests.spec.test_epoch_processing"}
+    for fork in ("phase0", "altair", "bellatrix", "capella")
+}
+
+
+def run(args=None):
+    run_state_test_generators(runner_name="epoch_processing", all_mods=all_mods, args=args)
+
+
+if __name__ == "__main__":
+    run()

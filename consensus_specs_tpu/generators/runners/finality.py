@@ -1,0 +1,15 @@
+"""`finality` runner (ref: tests/generators/finality/main.py)."""
+from ..gen_from_tests import run_state_test_generators
+
+all_mods = {
+    fork: {"finality": "tests.spec.test_finality"}
+    for fork in ("phase0", "altair", "bellatrix", "capella")
+}
+
+
+def run(args=None):
+    run_state_test_generators(runner_name="finality", all_mods=all_mods, args=args)
+
+
+if __name__ == "__main__":
+    run()

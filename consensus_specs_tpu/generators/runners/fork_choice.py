@@ -1,0 +1,15 @@
+"""`fork_choice` runner (ref: tests/generators/fork_choice/main.py)."""
+from ..gen_from_tests import run_state_test_generators
+
+all_mods = {
+    fork: {"get_head": "tests.spec.test_fork_choice"}
+    for fork in ("phase0", "altair", "bellatrix", "capella")
+}
+
+
+def run(args=None):
+    run_state_test_generators(runner_name="fork_choice", all_mods=all_mods, args=args)
+
+
+if __name__ == "__main__":
+    run()

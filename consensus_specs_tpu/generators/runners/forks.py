@@ -1,0 +1,38 @@
+"""`forks` runner: upgrade_to_* unit vectors. The tests run against the
+PRE-fork spec (phase=phase0) but the vectors are filed under the
+POST-fork name (ref: tests/generators/forks/main.py)."""
+import importlib
+
+from ..gen_from_tests import generate_from_tests
+from ..gen_runner import run_generator
+from ..gen_typing import TestCase, TestProvider
+
+# post-fork name -> (pre-fork phase, test module)
+FORK_TESTS = {
+    "altair": ("phase0", "tests.spec.test_fork_upgrade_altair"),
+}
+
+
+def _providers():
+    for preset in ("minimal", "mainnet"):
+        for post_fork, (pre_fork, mod_name) in FORK_TESTS.items():
+            def make_cases(post_fork=post_fork, pre_fork=pre_fork, mod_name=mod_name, preset=preset):
+                mod = importlib.import_module(mod_name)
+                yield from generate_from_tests(
+                    runner_name="forks",
+                    handler_name="fork",
+                    src=mod,
+                    fork_name=post_fork,
+                    preset_name=preset,
+                    phase=pre_fork,
+                )
+
+            yield TestProvider(prepare=lambda: None, make_cases=make_cases)
+
+
+def run(args=None):
+    run_generator("forks", list(_providers()), args=args)
+
+
+if __name__ == "__main__":
+    run()

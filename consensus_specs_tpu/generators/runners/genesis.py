@@ -1,0 +1,14 @@
+"""`genesis` runner (ref: tests/generators/genesis/main.py)."""
+from ..gen_from_tests import run_state_test_generators
+
+all_mods = {
+    "phase0": {"genesis": "tests.spec.test_genesis"},
+}
+
+
+def run(args=None):
+    run_state_test_generators(runner_name="genesis", all_mods=all_mods, args=args)
+
+
+if __name__ == "__main__":
+    run()

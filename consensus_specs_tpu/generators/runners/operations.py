@@ -1,0 +1,37 @@
+"""`operations` runner: per-fork block-operation handlers
+(ref: tests/generators/operations/main.py)."""
+from ..gen_from_tests import combine_mods, run_state_test_generators
+
+_new = "tests.spec.test_operations_"
+
+phase_0_mods = {
+    "attestation": _new + "attestation",
+    "attester_slashing": _new + "attester_slashing",
+    "block_header": _new + "block_header",
+    "deposit": _new + "deposit",
+    "proposer_slashing": _new + "proposer_slashing",
+    "voluntary_exit": _new + "voluntary_exit",
+}
+
+_altair_new = {
+    "sync_aggregate": "tests.spec.test_altair_sync_aggregate",
+}
+altair_mods = combine_mods(_altair_new, phase_0_mods)
+
+bellatrix_mods = altair_mods
+capella_mods = bellatrix_mods
+
+all_mods = {
+    "phase0": phase_0_mods,
+    "altair": altair_mods,
+    "bellatrix": bellatrix_mods,
+    "capella": capella_mods,
+}
+
+
+def run(args=None):
+    run_state_test_generators(runner_name="operations", all_mods=all_mods, args=args)
+
+
+if __name__ == "__main__":
+    run()

@@ -1,0 +1,16 @@
+"""`sanity` runner (ref: tests/generators/sanity/main.py)."""
+from ..gen_from_tests import run_state_test_generators
+
+mods = {
+    "blocks": "tests.spec.test_sanity_blocks",
+}
+
+all_mods = {fork: mods for fork in ("phase0", "altair", "bellatrix", "capella")}
+
+
+def run(args=None):
+    run_state_test_generators(runner_name="sanity", all_mods=all_mods, args=args)
+
+
+if __name__ == "__main__":
+    run()

@@ -1,0 +1,45 @@
+"""`shuffling` runner: compute_shuffled_index mapping vectors for 30 seeds
+x a range of counts (ref: tests/generators/shuffling/main.py)."""
+from ..gen_runner import run_generator
+from ..gen_typing import TestCase, TestProvider
+
+from consensus_specs_tpu.specs import build_spec
+
+
+def shuffling_case_fn(spec, seed, count):
+    def case_fn():
+        yield "mapping", "data", {
+            "seed": "0x" + seed.hex(),
+            "count": int(count),
+            "mapping": [int(spec.compute_shuffled_index(spec.uint64(i), spec.uint64(count), seed))
+                        for i in range(count)],
+        }
+
+    return case_fn
+
+
+def shuffling_test_cases(preset_name):
+    spec = build_spec("phase0", preset_name)
+    for seed in [spec.hash(spec.uint_to_bytes(spec.uint64(seed_init))) for seed_init in range(30)]:
+        for count in [0, 1, 2, 3, 5, 10, 33, 100, 1000, 9999]:
+            yield TestCase(
+                fork_name="phase0",
+                preset_name=preset_name,
+                runner_name="shuffling",
+                handler_name="core",
+                suite_name="shuffle",
+                case_name=f"shuffle_0x{seed.hex()}_{count}",
+                case_fn=shuffling_case_fn(spec, seed, count),
+            )
+
+
+def run(args=None):
+    providers = [
+        TestProvider(prepare=lambda: None, make_cases=lambda p=p: shuffling_test_cases(p))
+        for p in ("minimal", "mainnet")
+    ]
+    run_generator("shuffling", providers, args=args)
+
+
+if __name__ == "__main__":
+    run()

@@ -9,6 +9,6 @@ reference's markdown→`eth2spec.<fork>.<preset>` compiler (setup.py:
 168-264, 580-678), with Python files as the source of truth instead of
 markdown. Do not import the source files directly.
 """
-from .build import build_spec, spec_targets, FORK_ORDER
+from .build import available_forks, build_spec, spec_targets, FORK_ORDER
 
-__all__ = ["build_spec", "spec_targets", "FORK_ORDER"]
+__all__ = ["available_forks", "build_spec", "spec_targets", "FORK_ORDER"]

@@ -270,15 +270,19 @@ def with_capella_and_later(fn):
 
 
 def with_presets(preset_names: Sequence[str], reason: Optional[str] = None):
-    """Skip unless the active preset is in the set (ref context.py:459)."""
+    """Skip unless the active preset is in the set (ref context.py:459).
+    Reads the preset off the already-resolved spec (the `preset` kwarg is
+    consumed earlier by with_phases) and raises SkippedTest — pytest mode
+    converts it to a pytest.skip, generator mode counts it as skipped."""
 
     def deco(fn):
         def entry(*args, **kw):
-            preset = kw.get("preset", DEFAULT_PRESET)
+            spec = kw.get("spec")
+            preset = spec.preset_base if spec is not None else DEFAULT_PRESET
             if preset not in preset_names:
-                import pytest
+                from consensus_specs_tpu.exceptions import SkippedTest
 
-                pytest.skip(reason or f"preset {preset} not supported")
+                raise SkippedTest(reason or f"preset {preset} not supported")
             return fn(*args, **kw)
 
         return copy_meta(entry, fn)

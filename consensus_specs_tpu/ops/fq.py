@@ -1,0 +1,193 @@
+"""Batched BLS12-381 base-field arithmetic on TPU: 381-bit integers as
+32 x 12-bit limbs in int32 lanes, Montgomery multiplication.
+
+No native wide multiply exists on TPU; 12-bit limbs keep every partial
+product and accumulation within int32 (schoolbook conv of 32x32 12-bit
+limbs peaks below 2^30 — see _poly_mul/_mont_reduce bounds in comments).
+All functions broadcast over leading batch dims: shapes (..., 32).
+
+This is the device analog of the host tower (crypto/bls/fields.py) and
+the foundation for the batched pairing backend (ref: the milagro C
+binding this framework replaces, eth2spec/utils/bls.py:17-22).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+P_INT = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
+
+LIMB_BITS = 12
+N_LIMBS = 32
+LIMB_MASK = (1 << LIMB_BITS) - 1
+R_INT = 1 << (LIMB_BITS * N_LIMBS)  # Montgomery radix 2^384
+
+
+def _to_limbs_int(v: int) -> np.ndarray:
+    return np.array([(v >> (LIMB_BITS * i)) & LIMB_MASK for i in range(N_LIMBS)], dtype=np.int32)
+
+
+P_LIMBS = _to_limbs_int(P_INT)
+# -p^{-1} mod 2^12
+NPRIME = (-pow(P_INT, -1, 1 << LIMB_BITS)) % (1 << LIMB_BITS)
+R2_INT = (R_INT * R_INT) % P_INT
+R2_LIMBS = _to_limbs_int(R2_INT)
+ONE_MONT = _to_limbs_int(R_INT % P_INT)  # 1 in Montgomery form
+ZERO = np.zeros(N_LIMBS, dtype=np.int32)
+
+
+# -- host <-> device conversion ----------------------------------------------
+
+def to_limbs(values) -> np.ndarray:
+    """ints (nested lists ok) -> (..., 32) int32 limb array (plain form)."""
+    arr = np.asarray(values, dtype=object)
+    out = np.zeros(arr.shape + (N_LIMBS,), dtype=np.int32)
+    for idx in np.ndindex(arr.shape):
+        out[idx] = _to_limbs_int(int(arr[idx]))
+    return out
+
+
+def from_limbs(limbs) -> np.ndarray:
+    """(..., 32) limb array -> object array of ints."""
+    arr = np.asarray(limbs)
+    out = np.empty(arr.shape[:-1], dtype=object)
+    for idx in np.ndindex(arr.shape[:-1]):
+        v = 0
+        for i in range(N_LIMBS - 1, -1, -1):
+            v = (v << LIMB_BITS) | int(arr[idx + (i,)])
+        out[idx] = v
+    return out if out.shape else out[()]
+
+
+# -- normalized add/sub ------------------------------------------------------
+
+def _carry_norm(x):
+    """Propagate carries so limbs are 12-bit; requires limb values < 2^31
+    and non-negative. Two passes cover values up to ~2^30."""
+    for _ in range(2):
+        carry = x >> LIMB_BITS
+        x = (x & LIMB_MASK) + jnp.concatenate(
+            [jnp.zeros_like(carry[..., :1]), carry[..., :-1]], axis=-1
+        )
+    return x
+
+
+def _geq(a, b):
+    """Lexicographic a >= b over limbs (most significant first)."""
+    # scan from most significant: result = a>b at highest differing limb
+    gt = a > b
+    lt = a < b
+    res = jnp.zeros(a.shape[:-1], dtype=jnp.bool_)
+    dec = jnp.zeros(a.shape[:-1], dtype=jnp.bool_)  # decided
+    for i in range(N_LIMBS - 1, -1, -1):
+        res = jnp.where(~dec & gt[..., i], True, res)
+        dec = dec | gt[..., i] | lt[..., i]
+    return res | ~dec  # equal -> True
+
+
+def _cond_sub_p(x):
+    """x - p if x >= p else x (x has normalized 12-bit limbs)."""
+    p = jnp.asarray(P_LIMBS)
+    ge = _geq(x, jnp.broadcast_to(p, x.shape))
+    diff = x - p
+    # borrow-propagate the subtraction
+    borrow = jnp.zeros_like(diff[..., 0])
+    out = []
+    for i in range(N_LIMBS):
+        d = diff[..., i] - borrow
+        borrow = jnp.where(d < 0, 1, 0).astype(diff.dtype)
+        out.append(d + borrow * (1 << LIMB_BITS))
+    diff = jnp.stack(out, axis=-1)
+    return jnp.where(ge[..., None], diff, x)
+
+
+def add(a, b):
+    """(a + b) mod p, both < p."""
+    return _cond_sub_p(_carry_norm(a + b))
+
+
+def sub(a, b):
+    """(a - b) mod p, both < p."""
+    p = jnp.asarray(P_LIMBS)
+    x = a + p - b  # strictly positive
+    return _cond_sub_p(_carry_norm(x))
+
+
+def neg(a):
+    """(-a) mod p; maps 0 to 0."""
+    p = jnp.asarray(P_LIMBS)
+    is_zero = jnp.all(a == 0, axis=-1, keepdims=True)
+    x = _cond_sub_p(_carry_norm(p - a))
+    return jnp.where(is_zero, jnp.zeros_like(x), x)
+
+
+# -- Montgomery multiplication ----------------------------------------------
+
+def _poly_mul(a, b):
+    """Schoolbook limb convolution: (..., 32) x (..., 32) -> (..., 64).
+    Max accumulation: 32 * (2^12-1)^2 < 2^29 — int32-safe."""
+    out = jnp.zeros(a.shape[:-1] + (2 * N_LIMBS,), dtype=jnp.int32)
+    for i in range(N_LIMBS):
+        out = out.at[..., i : i + N_LIMBS].add(a[..., i : i + 1] * b)
+    return out
+
+
+def _mont_reduce(t):
+    """Montgomery reduction base 2^12: t (..., 64) -> t/R mod p (..., 32).
+    Per round: cancel limb i via m*p, then push its carry to limb i+1 so
+    the next round reads correct low bits. Peaks below 2^31."""
+    p = jnp.asarray(P_LIMBS)
+    for i in range(N_LIMBS):
+        m = (t[..., i] * NPRIME) & LIMB_MASK
+        t = t.at[..., i : i + N_LIMBS].add(m[..., None] * p)
+        carry = t[..., i] >> LIMB_BITS
+        t = t.at[..., i + 1].add(carry)
+        t = t.at[..., i].set(0)
+    hi = t[..., N_LIMBS:]
+    return _cond_sub_p(_carry_norm(hi))
+
+
+def mul(a, b):
+    """Montgomery product: a*b/R mod p (inputs/outputs in Montgomery form)."""
+    return _mont_reduce(_poly_mul(a, b))
+
+
+def square(a):
+    return mul(a, a)
+
+
+def to_mont(a):
+    """plain -> Montgomery form (a*R mod p)."""
+    return mul(a, jnp.broadcast_to(jnp.asarray(R2_LIMBS), a.shape))
+
+
+def from_mont(a):
+    """Montgomery -> plain form (a/R mod p)."""
+    wide = jnp.concatenate([a, jnp.zeros_like(a)], axis=-1)
+    return _mont_reduce(wide)
+
+
+def inv(a):
+    """a^{-1} in Montgomery form via Fermat: a^(p-2). Fixed 380-step
+    square-and-multiply (lax-friendly static loop)."""
+    e = P_INT - 2
+    bits = [(e >> i) & 1 for i in range(e.bit_length() - 1, -1, -1)]
+    result = jnp.broadcast_to(jnp.asarray(ONE_MONT), a.shape)
+    for bit in bits:
+        result = square(result)
+        if bit:
+            result = mul(result, a)
+    return result
+
+
+@functools.partial(jax.jit)
+def mul_jit(a, b):
+    return mul(a, b)
+
+
+@functools.partial(jax.jit)
+def add_jit(a, b):
+    return add(a, b)

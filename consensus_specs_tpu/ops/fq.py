@@ -17,6 +17,7 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 P_INT = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
 
@@ -51,13 +52,15 @@ def to_limbs(values) -> np.ndarray:
 
 
 def from_limbs(limbs) -> np.ndarray:
-    """(..., 32) limb array -> object array of ints."""
+    """(..., 32) limb array -> object array of ints. Accumulates with
+    addition so non-canonical (carry-bearing) limbs still read back as
+    the value they represent."""
     arr = np.asarray(limbs)
     out = np.empty(arr.shape[:-1], dtype=object)
     for idx in np.ndindex(arr.shape[:-1]):
         v = 0
-        for i in range(N_LIMBS - 1, -1, -1):
-            v = (v << LIMB_BITS) | int(arr[idx + (i,)])
+        for i in range(N_LIMBS):
+            v += int(arr[idx + (i,)]) << (LIMB_BITS * i)
         out[idx] = v
     return out if out.shape else out[()]
 
@@ -65,14 +68,25 @@ def from_limbs(limbs) -> np.ndarray:
 # -- normalized add/sub ------------------------------------------------------
 
 def _carry_norm(x):
-    """Propagate carries so limbs are 12-bit; requires limb values < 2^31
-    and non-negative. Two passes cover values up to ~2^30."""
-    for _ in range(2):
-        carry = x >> LIMB_BITS
-        x = (x & LIMB_MASK) + jnp.concatenate(
-            [jnp.zeros_like(carry[..., :1]), carry[..., :-1]], axis=-1
-        )
-    return x
+    """Exact carry propagation so limbs are canonical 12-bit; requires
+    limb values in (-2^30, 2^30) so limb + carry stays in int32. Negative
+    limbs (borrows, e.g. from `sub`'s a + p - b) propagate correctly:
+    >> is an arithmetic shift, so the carry becomes -1 and the masked
+    remainder is the mod-2^12 residue.
+
+    A fixed number of parallel passes cannot normalize a full-length
+    carry ripple (e.g. a low-limb carry through a run of 0xFFF limbs),
+    so do one exact sequential ripple with lax.scan over the 32 limbs.
+    Any carry out of the top limb is dropped — callers keep values below
+    2^384 by construction (sums of a few field elements)."""
+    xs = jnp.moveaxis(x, -1, 0)  # (32, ...)
+
+    def step(carry, xi):
+        t = xi + carry
+        return t >> LIMB_BITS, t & LIMB_MASK
+
+    _, limbs = lax.scan(step, jnp.zeros_like(xs[0]), xs)
+    return jnp.moveaxis(limbs, 0, -1)
 
 
 def _geq(a, b):
@@ -170,16 +184,25 @@ def from_mont(a):
     return _mont_reduce(wide)
 
 
+_P_MINUS_2_BITS = np.array(
+    [(P_INT - 2) >> i & 1 for i in range((P_INT - 2).bit_length() - 1, -1, -1)],
+    dtype=np.int32,
+)
+
+
 def inv(a):
-    """a^{-1} in Montgomery form via Fermat: a^(p-2). Fixed 380-step
-    square-and-multiply (lax-friendly static loop)."""
-    e = P_INT - 2
-    bits = [(e >> i) & 1 for i in range(e.bit_length() - 1, -1, -1)]
-    result = jnp.broadcast_to(jnp.asarray(ONE_MONT), a.shape)
-    for bit in bits:
+    """a^{-1} in Montgomery form via Fermat: a^(p-2). lax.scan over the
+    381 exponent bits (MSB-first) keeps the traced graph one-iteration
+    small. Maps 0 to 0 (0^(p-2) = 0), matching the host tower's fq_inv
+    domain conventions."""
+    one = jnp.broadcast_to(jnp.asarray(ONE_MONT), a.shape)
+
+    def step(result, bit):
         result = square(result)
-        if bit:
-            result = mul(result, a)
+        result = jnp.where(bit, mul(result, a), result)
+        return result, None
+
+    result, _ = lax.scan(step, one, jnp.asarray(_P_MINUS_2_BITS))
     return result
 
 

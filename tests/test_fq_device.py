@@ -1,0 +1,98 @@
+"""Randomized parity tests of the device base-field limb arithmetic
+(ops/fq.py) against Python bignum arithmetic — the advisor-mandated
+oracle check for the foundation of the batched pairing backend."""
+import numpy as np
+import pytest
+
+from consensus_specs_tpu.ops import fq
+
+
+P = fq.P_INT
+RNG = np.random.default_rng(0xB15)
+
+
+def _rand_fq(n):
+    return [int.from_bytes(RNG.bytes(48), "big") % P for _ in range(n)]
+
+
+def test_limb_roundtrip():
+    vals = _rand_fq(16) + [0, 1, P - 1]
+    back = fq.from_limbs(fq.to_limbs(vals))
+    assert [int(v) for v in back] == vals
+
+
+def test_add_parity_random():
+    a = _rand_fq(64)
+    b = _rand_fq(64)
+    got = fq.from_limbs(fq.add(fq.to_limbs(a), fq.to_limbs(b)))
+    want = [(x + y) % P for x, y in zip(a, b)]
+    assert list(got) == want
+
+
+def test_add_carry_ripple():
+    # Adversarial full-length carry ripple: low limb overflows into a run
+    # of 0xFFF limbs (the case two fixed carry passes cannot normalize —
+    # the advisor's round-1 repro class).
+    cases = [
+        (0x1000800FFF, 0x7FF800FFF),
+        ((1 << 371) - 1, 1),  # 0x7FF...FFF + 1: ripple through 30 limbs
+        (int("FFF" * 31, 16), 0xFFF),
+    ]
+    a = [x % P for x, _ in cases]
+    b = [y % P for _, y in cases]
+    got = fq.from_limbs(fq.add(fq.to_limbs(a), fq.to_limbs(b)))
+    want = [(x + y) % P for x, y in zip(a, b)]
+    assert list(got) == want
+
+
+def test_sub_neg_parity():
+    a = _rand_fq(64)
+    b = _rand_fq(64)
+    got = fq.from_limbs(fq.sub(fq.to_limbs(a), fq.to_limbs(b)))
+    want = [(x - y) % P for x, y in zip(a, b)]
+    assert list(got) == want
+    gotn = fq.from_limbs(fq.neg(fq.to_limbs(a)))
+    assert list(gotn) == [(-x) % P for x in a]
+    # 0 maps to 0, not p
+    assert int(fq.from_limbs(fq.neg(fq.to_limbs([0])))[0]) == 0
+
+
+def test_sub_borrow_ripple():
+    a = [1 << 370]
+    b = [1]
+    got = fq.from_limbs(fq.sub(fq.to_limbs(a), fq.to_limbs(b)))
+    assert int(got[0]) == a[0] - 1
+
+
+def test_mont_mul_parity():
+    a = _rand_fq(64)
+    b = _rand_fq(64)
+    am = fq.to_mont(fq.to_limbs(a))
+    bm = fq.to_mont(fq.to_limbs(b))
+    got = fq.from_limbs(fq.from_mont(fq.mul(am, bm)))
+    want = [(x * y) % P for x, y in zip(a, b)]
+    assert list(got) == want
+
+
+def test_mont_mul_edge_values():
+    edge = [0, 1, 2, P - 1, P - 2, (P - 1) // 2, (1 << 380) % P]
+    a = edge * len(edge)
+    b = [v for v in edge for _ in edge]
+    am = fq.to_mont(fq.to_limbs(a))
+    bm = fq.to_mont(fq.to_limbs(b))
+    got = fq.from_limbs(fq.from_mont(fq.mul(am, bm)))
+    want = [(x * y) % P for x, y in zip(a, b)]
+    assert list(got) == want
+
+
+def test_inv_parity():
+    a = _rand_fq(8) + [1, 2, P - 1]
+    am = fq.to_mont(fq.to_limbs(a))
+    got = fq.from_limbs(fq.from_mont(fq.inv(am)))
+    want = [pow(x, P - 2, P) for x in a]
+    assert list(got) == want
+
+
+def test_inv_of_zero_is_zero():
+    got = fq.from_limbs(fq.from_mont(fq.inv(fq.to_mont(fq.to_limbs([0])))))
+    assert int(got[0]) == 0

@@ -1,0 +1,73 @@
+"""Multi-device mesh tests on the virtual 8-device CPU mesh provisioned
+by conftest.py — validates that the sharded compute paths (GSPMD
+collectives over dp/mp axes) produce bit-identical results to the
+single-device path (SURVEY.md §2.6 design targets)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from consensus_specs_tpu.ops.sha256 import merkle_reduce_jit, sha256_of_block
+
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (virtual) devices"
+)
+
+
+def _mesh_1d():
+    return Mesh(np.array(jax.devices()[:8]), ("dp",))
+
+
+def test_sharded_hash_batch_matches_single_device():
+    rng = np.random.default_rng(11)
+    blocks = jnp.asarray(rng.integers(0, 2**32, size=(64, 16), dtype=np.uint32))
+    want = np.asarray(sha256_of_block(blocks))
+
+    mesh = _mesh_1d()
+    sharded = jax.device_put(blocks, NamedSharding(mesh, P("dp", None)))
+    got = np.asarray(jax.jit(sha256_of_block)(sharded))
+    assert np.array_equal(got, want)
+
+
+def test_sharded_merkle_root_matches_single_device():
+    rng = np.random.default_rng(12)
+    levels = 10
+    words = jnp.asarray(rng.integers(0, 2**32, size=(1 << levels, 8), dtype=np.uint32))
+    want = np.asarray(merkle_reduce_jit(words, levels))
+
+    mesh = _mesh_1d()
+    sharded = jax.device_put(words, NamedSharding(mesh, P("dp", None)))
+    got = np.asarray(merkle_reduce_jit(sharded, levels))
+    assert np.array_equal(got, want)
+
+
+def test_psum_aggregation_over_mesh():
+    # The cross-device reduction shape used for aggregate-pubkey style
+    # sums: shard a batch over dp, psum partial sums over ICI.
+    mesh = _mesh_1d()
+    x = jnp.arange(8 * 4, dtype=jnp.uint32).reshape(8, 4)
+
+    @jax.jit
+    def total(v):
+        return jax.lax.psum(v, "dp")
+
+    mapped = jax.shard_map(
+        total, mesh=mesh, in_specs=P("dp", None), out_specs=P(None)
+    )
+    got = np.asarray(mapped(jax.device_put(x, NamedSharding(mesh, P("dp", None)))))
+    want = np.broadcast_to(np.asarray(x).sum(axis=0, dtype=np.uint32), got.shape)
+    assert np.array_equal(got, want)
+
+
+def test_2d_mesh_merkle_reduce_cross_shard_levels():
+    # dp x mp mesh: the last log2(8) reduce levels combine across shards.
+    rng = np.random.default_rng(13)
+    devices = np.array(jax.devices()[:8]).reshape(4, 2)
+    mesh = Mesh(devices, ("dp", "mp"))
+    words = jnp.asarray(rng.integers(0, 2**32, size=(256, 8), dtype=np.uint32))
+    want = np.asarray(merkle_reduce_jit(words, 8))
+    sharded = jax.device_put(words, NamedSharding(mesh, P("dp", "mp")))
+    got = np.asarray(merkle_reduce_jit(sharded, 8))
+    assert np.array_equal(got, want)

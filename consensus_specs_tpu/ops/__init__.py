@@ -1,1 +1,4 @@
-"""Device kernels and batched primitives (SHA-256, Merkle reduce, shuffle, BLS)."""
+"""Device kernels and batched primitives: SHA-256 compression + fused
+Merkle reduce (sha256.py), BLS12-381 limb arithmetic (fq.py), extension
+tower (tower.py), batched ate pairing (pairing_jax.py), and the device
+BLS signature backend (bls_jax.py)."""

@@ -1,0 +1,271 @@
+"""Batched device BLS backend — the fast path the BLS facade selects via
+``bls.use_backend("jax")`` (the milagro-analog switch; ref
+eth2spec/utils/bls.py:17-30 and gen_from_tests/gen.py:75-77).
+
+Split of labor (the boundary BASELINE.json draws):
+- Host: wire-format decode (48/96-byte compressed points), subgroup
+  checks, hash-to-curve — Python-object domain, LRU-cached by input
+  bytes (eth2 workloads reuse validator pubkeys and repeat messages
+  heavily; the reference gets the same effect from remerkleable/LRU
+  caches, setup.py:358-428).
+- Device: ALL pairing work — batched Miller loops + shared final
+  exponentiation per check (ops/pairing_jax.py) over (B, K) pair
+  arrays, B padded to pow2 buckets to bound jit recompiles.
+
+Scalar API (Verify/AggregateVerify/FastAggregateVerify/...) matches the
+host ciphersuite exactly (crypto/bls/ciphersuite.py) so the facade can
+swap backends transparently; the *_batch functions are the TPU-native
+entry points that verify whole blocks' worth of signatures per dispatch.
+"""
+from __future__ import annotations
+
+import functools
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..crypto.bls import ciphersuite as _host
+from ..crypto.bls.curve import (
+    DeserializationError,
+    g1_from_bytes,
+    g1_generator,
+    g1_infinity,
+    g2_from_bytes,
+)
+from ..crypto.bls.hash_to_curve import hash_to_g2
+from . import fq, tower
+
+try:  # persistent compile cache: the pairing graphs are expensive to build
+    import jax
+
+    if jax.config.jax_compilation_cache_dir is None:  # respect host app config
+        _cache_dir = os.environ.get(
+            "CONSENSUS_SPECS_TPU_JAX_CACHE",
+            os.path.expanduser("~/.cache/jax_consensus"),
+        )
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+except Exception:  # pragma: no cover - cache is best-effort
+    pass
+
+from . import pairing_jax  # noqa: E402  (after cache config)
+
+G2_POINT_AT_INFINITY = _host.G2_POINT_AT_INFINITY
+
+_MIN_BUCKET = 8
+
+
+def _bucket(n: int, minimum: int = _MIN_BUCKET) -> int:
+    b = minimum
+    while b < n:
+        b <<= 1
+    return b
+
+
+# -- host-side cached decode/prep --------------------------------------------
+
+@functools.lru_cache(maxsize=1)
+def _neg_g1_limbs() -> Tuple[np.ndarray, np.ndarray]:
+    x, y = g1_generator().neg().affine()
+    return tower.fq_to_limbs_mont(int(x)), tower.fq_to_limbs_mont(int(y))
+
+
+@functools.lru_cache(maxsize=65536)
+def _pk_g1_point(pubkey: bytes):
+    """Compressed G1 pubkey -> validated curve Point, or None if the
+    encoding is invalid / infinity / outside the subgroup (the cases
+    _pubkey_point rejects, crypto/bls/ciphersuite.py:64-68). The
+    subgroup check is the expensive host step — cached by key bytes."""
+    try:
+        pt = g1_from_bytes(pubkey)
+    except DeserializationError:
+        return None
+    if pt.is_infinity or not pt.in_subgroup():
+        return None
+    return pt
+
+
+@functools.lru_cache(maxsize=65536)
+def _pk_affine(pubkey: bytes) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    pt = _pk_g1_point(pubkey)
+    if pt is None:
+        return None
+    x, y = pt.affine()
+    return tower.fq_to_limbs_mont(int(x)), tower.fq_to_limbs_mont(int(y))
+
+
+@functools.lru_cache(maxsize=16384)
+def _sig_affine(signature: bytes):
+    """Compressed G2 signature -> ("inf" | (qx, qy) limb affine | None).
+    Infinity is a legal signature point (pairs with it contribute 1);
+    None = malformed or out-of-subgroup (rejected like
+    crypto/bls/ciphersuite.py:71-75)."""
+    try:
+        pt = g2_from_bytes(signature)
+    except DeserializationError:
+        return None
+    if pt.is_infinity:
+        return "inf"
+    if not pt.in_subgroup():
+        return None
+    x, y = pt.affine()
+    return tower.fq2_to_limbs_mont(x), tower.fq2_to_limbs_mont(y)
+
+
+@functools.lru_cache(maxsize=16384)
+def _msg_g2_affine(message: bytes) -> Tuple[np.ndarray, np.ndarray]:
+    x, y = hash_to_g2(message).affine()
+    return tower.fq2_to_limbs_mont(x), tower.fq2_to_limbs_mont(y)
+
+
+def clear_caches() -> None:
+    _pk_g1_point.cache_clear()
+    _pk_affine.cache_clear()
+    _sig_affine.cache_clear()
+    _msg_g2_affine.cache_clear()
+
+
+# -- batched pairing-check dispatch ------------------------------------------
+
+# A "check" is a list of pairs [(g1_limbs | None, g2_limbs | "inf")]
+# whose pairing product must equal 1. None in a pair's G1 slot means the
+# negated generator. A check of None means "statically False" (malformed
+# input — never reaches the device).
+_Pair = Tuple[Optional[Tuple[np.ndarray, np.ndarray]], object]
+
+
+def _run_checks(checks: Sequence[Optional[List[_Pair]]]) -> np.ndarray:
+    n = len(checks)
+    out = np.zeros(n, dtype=bool)
+    live = [i for i, c in enumerate(checks) if c is not None and len(c) > 0]
+    if not live:
+        return out
+    b = _bucket(len(live))
+    k = _bucket(max(len(checks[i]) for i in live), minimum=2)
+    gx, gy = _neg_g1_limbs()
+    px = np.tile(gx, (b, k, 1))
+    py = np.tile(gy, (b, k, 1))
+    qx = np.zeros((b, k, 2, fq.N_LIMBS), dtype=np.int32)
+    qy = np.zeros((b, k, 2, fq.N_LIMBS), dtype=np.int32)
+    active = np.zeros((b, k), dtype=bool)
+    for row, i in enumerate(live):
+        for col, (p, q) in enumerate(checks[i]):
+            if p is not None:
+                px[row, col] = p[0]
+                py[row, col] = p[1]
+            if q == "inf":
+                continue  # pair contributes 1: leave inactive
+            qx[row, col] = q[0]
+            qy[row, col] = q[1]
+            active[row, col] = True
+    ok = np.asarray(pairing_jax.pairing_check_jit(px, py, qx, qy, active))
+    for row, i in enumerate(live):
+        out[i] = bool(ok[row])
+    return out
+
+
+# -- check builders (exact ciphersuite semantics) ----------------------------
+
+def _verify_check(pubkey: bytes, message: bytes, signature: bytes):
+    pk = _pk_affine(bytes(pubkey))
+    if pk is None:
+        return None
+    sig = _sig_affine(bytes(signature))
+    if sig is None:
+        return None
+    return [(None, sig), (pk, _msg_g2_affine(bytes(message)))]
+
+
+def _aggregate_verify_check(pubkeys, messages, signature):
+    if len(pubkeys) == 0 or len(pubkeys) != len(messages):
+        return None
+    sig = _sig_affine(bytes(signature))
+    if sig is None:
+        return None
+    check: List[_Pair] = [(None, sig)]
+    for pk_bytes, msg in zip(pubkeys, messages):
+        pk = _pk_affine(bytes(pk_bytes))
+        if pk is None:
+            return None
+        check.append((pk, _msg_g2_affine(bytes(msg))))
+    return check
+
+
+def _fast_aggregate_verify_check(pubkeys, message: bytes, signature: bytes):
+    if len(pubkeys) == 0:
+        return None
+    sig = _sig_affine(bytes(signature))
+    if sig is None:
+        return None
+    acc = g1_infinity()
+    for pk_bytes in pubkeys:
+        pt = _pk_g1_point(bytes(pk_bytes))
+        if pt is None:
+            return None
+        acc = acc.add(pt)
+    if acc.is_infinity:
+        # aggregate degenerated to infinity: its pair contributes 1, so
+        # the check reduces to e(-g1, sig) == 1  <=>  sig == infinity
+        return [(None, sig)]
+    x, y = acc.affine()
+    agg = (tower.fq_to_limbs_mont(int(x)), tower.fq_to_limbs_mont(int(y)))
+    return [(None, sig), (agg, _msg_g2_affine(bytes(message)))]
+
+
+# -- scalar API (facade-compatible, crypto/bls/ciphersuite.py parity) --------
+
+def Verify(pubkey: bytes, message: bytes, signature: bytes) -> bool:
+    return bool(_run_checks([_verify_check(pubkey, message, signature)])[0])
+
+
+def AggregateVerify(pubkeys, messages, signature: bytes) -> bool:
+    return bool(
+        _run_checks([_aggregate_verify_check(pubkeys, messages, signature)])[0]
+    )
+
+
+def FastAggregateVerify(pubkeys, message: bytes, signature: bytes) -> bool:
+    return bool(
+        _run_checks([_fast_aggregate_verify_check(pubkeys, message, signature)])[0]
+    )
+
+
+# scalar/host-domain primitives: same implementation as the oracle
+Aggregate = _host.Aggregate
+AggregatePKs = _host.AggregatePKs
+Sign = _host.Sign
+SkToPk = _host.SkToPk
+KeyValidate = _host.KeyValidate
+signature_to_G2 = _host.signature_to_G2
+
+
+# -- batched API (the TPU-native entry points) -------------------------------
+
+def verify_batch(pubkeys, messages, signatures) -> np.ndarray:
+    """Element-wise Verify over equal-length sequences, one device
+    dispatch. Returns (N,) bool."""
+    return _run_checks(
+        [_verify_check(p, m, s) for p, m, s in zip(pubkeys, messages, signatures)]
+    )
+
+
+def fast_aggregate_verify_batch(pubkey_lists, messages, signatures) -> np.ndarray:
+    """Element-wise FastAggregateVerify (one pubkey list per message),
+    one device dispatch — the 128-attestation block shape
+    (BASELINE.md config #3)."""
+    return _run_checks(
+        [
+            _fast_aggregate_verify_check(pks, m, s)
+            for pks, m, s in zip(pubkey_lists, messages, signatures)
+        ]
+    )
+
+
+def aggregate_verify_batch(pubkey_lists, message_lists, signatures) -> np.ndarray:
+    return _run_checks(
+        [
+            _aggregate_verify_check(pks, ms, s)
+            for pks, ms, s in zip(pubkey_lists, message_lists, signatures)
+        ]
+    )

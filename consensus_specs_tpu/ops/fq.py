@@ -90,32 +90,26 @@ def _carry_norm(x):
 
 
 def _geq(a, b):
-    """Lexicographic a >= b over limbs (most significant first)."""
-    # scan from most significant: result = a>b at highest differing limb
+    """Lexicographic a >= b over canonical limbs, vectorized: a >= b iff
+    a > b at the most significant differing limb (or all equal). The
+    "all higher limbs equal" prefix is a reversed cumulative product."""
+    eq = a == b
     gt = a > b
-    lt = a < b
-    res = jnp.zeros(a.shape[:-1], dtype=jnp.bool_)
-    dec = jnp.zeros(a.shape[:-1], dtype=jnp.bool_)  # decided
-    for i in range(N_LIMBS - 1, -1, -1):
-        res = jnp.where(~dec & gt[..., i], True, res)
-        dec = dec | gt[..., i] | lt[..., i]
-    return res | ~dec  # equal -> True
+    # higher_eq[i] = all(eq[i+1:]) — cumprod over the reversed limb axis
+    he = jnp.flip(jnp.cumprod(jnp.flip(eq, axis=-1), axis=-1), axis=-1)
+    higher_eq = jnp.concatenate(
+        [he[..., 1:], jnp.ones_like(he[..., :1])], axis=-1
+    )
+    return jnp.any(gt & higher_eq, axis=-1) | jnp.all(eq, axis=-1)
 
 
 def _cond_sub_p(x):
-    """x - p if x >= p else x (x has normalized 12-bit limbs)."""
+    """x - p if x >= p else x (x has canonical 12-bit limbs). The limbwise
+    difference may go negative; _carry_norm's arithmetic-shift borrow
+    propagation renormalizes it."""
     p = jnp.asarray(P_LIMBS)
     ge = _geq(x, jnp.broadcast_to(p, x.shape))
-    diff = x - p
-    # borrow-propagate the subtraction
-    borrow = jnp.zeros_like(diff[..., 0])
-    out = []
-    for i in range(N_LIMBS):
-        d = diff[..., i] - borrow
-        borrow = jnp.where(d < 0, 1, 0).astype(diff.dtype)
-        out.append(d + borrow * (1 << LIMB_BITS))
-    diff = jnp.stack(out, axis=-1)
-    return jnp.where(ge[..., None], diff, x)
+    return jnp.where(ge[..., None], _carry_norm(x - p), x)
 
 
 def add(a, b):
@@ -131,11 +125,10 @@ def sub(a, b):
 
 
 def neg(a):
-    """(-a) mod p; maps 0 to 0."""
+    """(-a) mod p; maps 0 to 0 (p - 0 = p, which _cond_sub_p folds back
+    to 0 since _geq(p, p) holds)."""
     p = jnp.asarray(P_LIMBS)
-    is_zero = jnp.all(a == 0, axis=-1, keepdims=True)
-    x = _cond_sub_p(_carry_norm(p - a))
-    return jnp.where(is_zero, jnp.zeros_like(x), x)
+    return _cond_sub_p(_carry_norm(p - a))
 
 
 # -- Montgomery multiplication ----------------------------------------------
@@ -149,18 +142,33 @@ def _poly_mul(a, b):
     return out
 
 
+_P_PAD = np.zeros(2 * N_LIMBS, dtype=np.int32)
+_P_PAD[:N_LIMBS] = P_LIMBS
+
+
 def _mont_reduce(t):
     """Montgomery reduction base 2^12: t (..., 64) -> t/R mod p (..., 32).
-    Per round: cancel limb i via m*p, then push its carry to limb i+1 so
-    the next round reads correct low bits. Peaks below 2^31."""
-    p = jnp.asarray(P_LIMBS)
-    for i in range(N_LIMBS):
-        m = (t[..., i] * NPRIME) & LIMB_MASK
-        t = t.at[..., i : i + N_LIMBS].add(m[..., None] * p)
-        carry = t[..., i] >> LIMB_BITS
-        t = t.at[..., i + 1].add(carry)
-        t = t.at[..., i].set(0)
-    hi = t[..., N_LIMBS:]
+
+    lax.scan over 32 rounds with a sliding window: each round cancels the
+    current lowest limb via m*p, pushes its carry into the next limb, and
+    shifts the window down one limb — so all indexing is static and the
+    traced body stays ~10 ops (the pairing stack embeds hundreds of these
+    inside its own scans; a small body keeps compiles fast). Accumulation
+    peaks below 2^30 + 2^18 — int32-safe."""
+    p_pad = jnp.asarray(_P_PAD)
+
+    def round_(acc, _):
+        m = (acc[..., 0] * NPRIME) & LIMB_MASK
+        acc = acc + m[..., None] * p_pad
+        carry = acc[..., 0] >> LIMB_BITS
+        acc = acc.at[..., 1].add(carry)
+        acc = jnp.concatenate(
+            [acc[..., 1:], jnp.zeros_like(acc[..., :1])], axis=-1
+        )
+        return acc, None
+
+    t, _ = lax.scan(round_, t, None, length=N_LIMBS)
+    hi = t[..., :N_LIMBS]
     return _cond_sub_p(_carry_norm(hi))
 
 

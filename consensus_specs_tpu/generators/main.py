@@ -17,12 +17,17 @@ RUNNERS = [
     "sanity",
     "finality",
     "epoch_processing",
+    "rewards",
+    "random",
     "genesis",
     "forks",
+    "transition",
     "fork_choice",
     "shuffling",
     "bls",
     "ssz_static",
+    "ssz_generic",
+    "merkle",
 ]
 
 

@@ -2,7 +2,11 @@
 from ..gen_from_tests import run_state_test_generators
 
 all_mods = {
-    fork: {"get_head": "tests.spec.test_fork_choice"}
+    fork: {
+        "get_head": "tests.spec.test_fork_choice",
+        "ex_ante": "tests.spec.test_fork_choice_ex_ante",
+        "on_block": "tests.spec.test_fork_choice_on_block",
+    }
     for fork in ("phase0", "altair", "bellatrix", "capella")
 }
 

@@ -10,6 +10,8 @@ from ..gen_typing import TestCase, TestProvider
 # post-fork name -> (pre-fork phase, test module)
 FORK_TESTS = {
     "altair": ("phase0", "tests.spec.test_fork_upgrade_altair"),
+    "bellatrix": ("altair", "tests.spec.test_fork_upgrade_bellatrix"),
+    "capella": ("bellatrix", "tests.spec.test_fork_upgrade_capella"),
 }
 
 

@@ -1,0 +1,81 @@
+"""`merkle` runner: single Merkle proof vectors against a BeaconState
+(ref: tests/generators/merkle/main.py + tests/formats/merkle/README.md —
+state.ssz_snappy + proof.yaml {leaf, leaf_index, branch}, verified with
+is_valid_merkle_branch)."""
+from __future__ import annotations
+
+from consensus_specs_tpu.specs import build_spec
+from consensus_specs_tpu.ssz.proof import compute_merkle_proof
+from consensus_specs_tpu.test_framework.context import (
+    _prepare_state,
+    default_activation_threshold,
+    default_balances,
+)
+
+from ..gen_runner import run_generator
+from ..gen_typing import TestCase, TestProvider
+
+
+def _proof_cases(fork: str, preset: str):
+    spec = build_spec(fork, preset)
+    state = _prepare_state(default_balances, default_activation_threshold, spec)
+
+    # name -> (gindex, leaf root of the addressed subtree)
+    targets = {
+        "finalized_root": (
+            int(spec.FINALIZED_ROOT_INDEX),
+            spec.hash_tree_root(state.finalized_checkpoint.root),
+        ),
+        "next_sync_committee": (
+            int(spec.NEXT_SYNC_COMMITTEE_INDEX),
+            spec.hash_tree_root(state.next_sync_committee),
+        ),
+        "current_sync_committee": (
+            int(spec.get_generalized_index(spec.BeaconState, "current_sync_committee")),
+            spec.hash_tree_root(state.current_sync_committee),
+        ),
+    }
+
+    for name, (gindex, leaf) in targets.items():
+        branch = compute_merkle_proof(state, gindex)
+        # self-check before emitting: the branch must verify
+        assert spec.is_valid_merkle_branch(
+            leaf=leaf,
+            branch=branch,
+            depth=spec.floorlog2(gindex),
+            index=spec.get_subtree_index(gindex),
+            root=spec.hash_tree_root(state),
+        )
+
+        def case_fn(state=state, gindex=gindex, branch=branch, leaf=leaf):
+            yield "state", "ssz", state
+            yield "proof", "data", {
+                "leaf": "0x" + bytes(leaf).hex(),
+                "leaf_index": gindex,
+                "branch": ["0x" + bytes(b).hex() for b in branch],
+            }
+
+        yield TestCase(
+            fork_name=fork,
+            preset_name=preset,
+            runner_name="merkle",
+            handler_name="single_proof",
+            suite_name="pyspec_tests",
+            case_name=f"single_proof_{name}",
+            case_fn=case_fn,
+        )
+
+
+def _cases():
+    for preset in ("minimal", "mainnet"):
+        yield from _proof_cases("altair", preset)
+
+
+def run(args=None):
+    run_generator(
+        "merkle", [TestProvider(prepare=lambda: None, make_cases=_cases)], args=args
+    )
+
+
+if __name__ == "__main__":
+    run()

@@ -18,8 +18,16 @@ _altair_new = {
 }
 altair_mods = combine_mods(_altair_new, phase_0_mods)
 
-bellatrix_mods = altair_mods
-capella_mods = bellatrix_mods
+_bellatrix_new = {
+    "execution_payload": _new + "execution_payload",
+}
+bellatrix_mods = combine_mods(_bellatrix_new, altair_mods)
+
+_capella_new = {
+    "withdrawals": _new + "withdrawals",
+    "bls_to_execution_change": _new + "bls_to_execution_change",
+}
+capella_mods = combine_mods(_capella_new, bellatrix_mods)
 
 all_mods = {
     "phase0": phase_0_mods,

@@ -1,0 +1,15 @@
+"""`random` runner (ref: tests/generators/random/main.py)."""
+from ..gen_from_tests import run_state_test_generators
+
+all_mods = {
+    fork: {"random": "tests.spec.test_random"}
+    for fork in ("phase0", "altair", "bellatrix", "capella")
+}
+
+
+def run(args=None):
+    run_state_test_generators(runner_name="random", all_mods=all_mods, args=args)
+
+
+if __name__ == "__main__":
+    run()

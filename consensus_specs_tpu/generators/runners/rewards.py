@@ -1,0 +1,19 @@
+"""`rewards` runner (ref: tests/generators/rewards/main.py)."""
+from ..gen_from_tests import run_state_test_generators
+
+all_mods = {
+    fork: {
+        "basic": "tests.spec.test_rewards_basic",
+        "leak": "tests.spec.test_rewards_leak",
+        "random": "tests.spec.test_rewards_random",
+    }
+    for fork in ("phase0", "altair", "bellatrix", "capella")
+}
+
+
+def run(args=None):
+    run_state_test_generators(runner_name="rewards", all_mods=all_mods, args=args)
+
+
+if __name__ == "__main__":
+    run()

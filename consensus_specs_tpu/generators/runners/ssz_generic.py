@@ -1,0 +1,239 @@
+"""`ssz_generic` runner: hand-built valid + invalid vectors for the SSZ
+wire format itself (ref: tests/generators/ssz_generic/main.py and
+tests/formats/ssz_generic/README.md — the deserialization robustness
+contract). Handlers: uints, boolean, basic_vector, bitvector, bitlist,
+containers. Valid cases carry serialized+value+root; invalid cases carry
+only the malformed serialization, which clients MUST reject."""
+from __future__ import annotations
+
+from random import Random
+
+from consensus_specs_tpu.debug.encode import encode
+from consensus_specs_tpu.ssz import (
+    Bitlist,
+    Bitvector,
+    ByteList,
+    Container,
+    List,
+    Vector,
+    boolean,
+    hash_tree_root,
+    serialize,
+    uint8,
+    uint16,
+    uint32,
+    uint64,
+    uint128,
+    uint256,
+)
+
+from ..gen_runner import run_generator
+from ..gen_typing import TestCase, TestProvider
+
+
+# -- canonical test containers (names are part of the vector contract) -------
+
+def _container(name, fields):
+    return type(name, (Container,), {"__annotations__": fields})
+
+
+SingleFieldTestStruct = _container("SingleFieldTestStruct", {"A": uint8})
+SmallTestStruct = _container("SmallTestStruct", {"A": uint16, "B": uint16})
+FixedTestStruct = _container("FixedTestStruct", {"A": uint8, "B": uint64, "C": uint32})
+VarTestStruct = _container(
+    "VarTestStruct", {"A": uint16, "B": List[uint16, 1024], "C": uint8}
+)
+ComplexTestStruct = _container(
+    "ComplexTestStruct",
+    {
+        "A": uint16,
+        "B": List[uint16, 128],
+        "C": uint8,
+        "D": ByteList[256],
+        "E": VarTestStruct,
+        "F": Vector[FixedTestStruct, 4],
+        "G": Vector[VarTestStruct, 2],
+    },
+)
+BitsStruct = _container(
+    "BitsStruct",
+    {
+        "A": Bitlist[5],
+        "B": Bitvector[2],
+        "C": Bitvector[1],
+        "D": Bitlist[6],
+        "E": Bitvector[8],
+    },
+)
+
+CONTAINER_TYPES = [
+    SingleFieldTestStruct,
+    SmallTestStruct,
+    FixedTestStruct,
+    VarTestStruct,
+    ComplexTestStruct,
+    BitsStruct,
+]
+
+UINT_TYPES = [uint8, uint16, uint32, uint64, uint128, uint256]
+
+
+def _random_value(rng: Random, typ):
+    from consensus_specs_tpu.debug.random_value import RandomizationMode, get_random_ssz_object
+
+    return get_random_ssz_object(
+        rng, typ, max_bytes_length=2048, max_list_length=8,
+        mode=RandomizationMode.mode_random, chaos=False,
+    )
+
+
+def _valid(obj):
+    def case_fn(obj=obj):
+        yield "serialized", "ssz", serialize(obj)
+        yield "value", "data", encode(obj)
+        yield "root", "meta", "0x" + bytes(hash_tree_root(obj)).hex()
+
+    return case_fn
+
+
+def _invalid(data: bytes):
+    def case_fn(data=data):
+        yield "serialized", "ssz", data
+
+    return case_fn
+
+
+# -- case builders ------------------------------------------------------------
+
+def cases_uints():
+    rng = Random(2001)
+    for typ in UINT_TYPES:
+        n = typ.type_byte_length()
+        for label, value in [
+            ("zero", 0),
+            ("max", (1 << (8 * n)) - 1),
+            ("random_0", rng.randrange(1 << (8 * n))),
+            ("random_1", rng.randrange(1 << (8 * n))),
+        ]:
+            yield "valid", f"uint_{8 * n}_{label}", _valid(typ(value))
+        yield "invalid", f"uint_{8 * n}_one_byte_short", _invalid(b"\x01" * (n - 1))
+        yield "invalid", f"uint_{8 * n}_one_byte_long", _invalid(b"\x01" * (n + 1))
+        yield "invalid", f"uint_{8 * n}_empty", _invalid(b"")
+
+
+def cases_boolean():
+    yield "valid", "true", _valid(boolean(True))
+    yield "valid", "false", _valid(boolean(False))
+    yield "invalid", "byte_2", _invalid(b"\x02")
+    yield "invalid", "byte_ff", _invalid(b"\xff")
+    yield "invalid", "empty", _invalid(b"")
+    yield "invalid", "two_bytes", _invalid(b"\x01\x00")
+
+
+def cases_basic_vector():
+    rng = Random(2002)
+    for elem, length in [(uint8, 5), (uint16, 8), (uint64, 4), (uint64, 1)]:
+        typ = Vector[elem, length]
+        obj = _random_value(rng, typ)
+        name = f"vec_{elem.__name__}_{length}"
+        yield "valid", f"{name}_random", _valid(obj)
+        good = serialize(obj)
+        yield "invalid", f"{name}_one_byte_short", _invalid(good[:-1])
+        yield "invalid", f"{name}_one_byte_long", _invalid(good + b"\x00")
+        yield "invalid", f"{name}_empty", _invalid(b"")
+
+
+def cases_bitvector():
+    rng = Random(2003)
+    for length in [1, 2, 7, 8, 9, 16, 31, 512]:
+        typ = Bitvector[length]
+        obj = _random_value(rng, typ)
+        yield "valid", f"bitvec_{length}_random", _valid(obj)
+        good = serialize(obj)
+        yield "invalid", f"bitvec_{length}_extra_byte", _invalid(good + b"\x00")
+        if length % 8:
+            # a bit set above the declared length in the last byte
+            bad = bytearray(good)
+            bad[-1] |= 1 << (length % 8)
+            yield "invalid", f"bitvec_{length}_padding_bit_set", _invalid(bytes(bad))
+        if len(good) > 1:
+            yield "invalid", f"bitvec_{length}_short", _invalid(good[:-1])
+
+
+def cases_bitlist():
+    rng = Random(2004)
+    for limit in [1, 2, 8, 9, 31, 512]:
+        typ = Bitlist[limit]
+        obj = _random_value(rng, typ)
+        yield "valid", f"bitlist_{limit}_random", _valid(obj)
+        yield "valid", f"bitlist_{limit}_empty", _valid(typ())
+        # no delimiter bit at all
+        yield "invalid", f"bitlist_{limit}_no_delimiter_zero_byte", _invalid(b"\x00")
+        yield "invalid", f"bitlist_{limit}_no_delimiter_empty", _invalid(b"")
+        # delimiter implies more bits than the limit allows
+        full_bytes = bytearray((limit + 8) // 8 + 1)
+        full_bytes[-1] = 0x01
+        yield "invalid", f"bitlist_{limit}_over_limit", _invalid(bytes(full_bytes))
+
+
+def cases_containers():
+    rng = Random(2005)
+    for typ in CONTAINER_TYPES:
+        for i in range(2):
+            obj = _random_value(rng, typ)
+            yield "valid", f"{typ.__name__}_random_{i}", _valid(obj)
+        good = serialize(_random_value(rng, typ))
+        yield "invalid", f"{typ.__name__}_one_byte_short", _invalid(good[:-1] if good else b"")
+        yield "invalid", f"{typ.__name__}_extra_byte", _invalid(good + b"\x00")
+    # var-size container offset corruption
+    var = VarTestStruct(A=1, B=List[uint16, 1024](1, 2, 3), C=2)
+    good = bytearray(serialize(var))
+    # fixed part: A(2) + offset(4) + C(1) = 7; corrupt the offset
+    bad_low = bytearray(good)
+    bad_low[2:6] = (3).to_bytes(4, "little")  # points inside the fixed part
+    yield "invalid", "VarTestStruct_offset_into_fixed_part", _invalid(bytes(bad_low))
+    bad_high = bytearray(good)
+    bad_high[2:6] = (len(good) + 4).to_bytes(4, "little")  # past the end
+    yield "invalid", "VarTestStruct_offset_past_end", _invalid(bytes(bad_high))
+    bad_skew = bytearray(good)
+    bad_skew[2:6] = (8).to_bytes(4, "little")  # != fixed size (7)
+    yield "invalid", "VarTestStruct_first_offset_skewed", _invalid(bytes(bad_skew))
+
+
+HANDLERS = {
+    "uints": cases_uints,
+    "boolean": cases_boolean,
+    "basic_vector": cases_basic_vector,
+    "bitvector": cases_bitvector,
+    "bitlist": cases_bitlist,
+    "containers": cases_containers,
+}
+
+# exported for the pytest-side robustness check (tests/test_ssz_generic.py)
+def iter_cases():
+    for handler, gen in HANDLERS.items():
+        for suite, case_name, case_fn in gen():
+            yield handler, suite, case_name, case_fn
+
+
+def _cases():
+    for handler, suite, case_name, case_fn in iter_cases():
+        yield TestCase(
+            fork_name="phase0",
+            preset_name="general",
+            runner_name="ssz_generic",
+            handler_name=handler,
+            suite_name=suite,
+            case_name=case_name,
+            case_fn=case_fn,
+        )
+
+
+def run(args=None):
+    run_generator(
+        "ssz_generic", [TestProvider(prepare=lambda: None, make_cases=_cases)], args=args
+    )
+
+
+if __name__ == "__main__":
+    run()

@@ -1,0 +1,17 @@
+"""`transition` runner (ref: tests/generators/transition/main.py)."""
+from ..gen_from_tests import run_state_test_generators
+
+# Transition tests declare their own pre-fork via with_phases; register
+# them under every pre-fork that has a successor.
+all_mods = {
+    fork: {"core": "tests.spec.test_transition"}
+    for fork in ("phase0", "altair", "bellatrix")
+}
+
+
+def run(args=None):
+    run_state_test_generators(runner_name="transition", all_mods=all_mods, args=args)
+
+
+if __name__ == "__main__":
+    run()

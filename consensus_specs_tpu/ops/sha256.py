@@ -205,11 +205,14 @@ def hash_small_device(messages) -> list:
 
 
 def use_device_hasher() -> None:
-    """Install the JAX batched hasher as the SSZ merkleization backend."""
+    """Install the JAX batched hasher as the SSZ merkleization backend,
+    including the fused whole-tree root path (one dispatch per large
+    tree instead of one per level)."""
     from ..ssz import hashing
 
     hashing.set_backend(hash_many_device, name="jax")
     hashing.set_small_backend(hash_small_device)
+    hashing.set_fused_root_backend(merkle_root_device)
 
 
 def use_host_hasher() -> None:
@@ -217,3 +220,4 @@ def use_host_hasher() -> None:
 
     hashing.set_backend(None)
     hashing.set_small_backend(None)
+    hashing.set_fused_root_backend(None)

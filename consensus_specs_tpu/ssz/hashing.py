@@ -54,6 +54,27 @@ def hash_many(data: bytes) -> bytes:
     return _backend(data)
 
 
+_fused_root_backend: Optional[Callable] = None
+FUSED_ROOT_MIN_CHUNKS = 256  # below this, dispatch overhead beats the device
+
+
+def set_fused_root_backend(fn: Optional[Callable]) -> None:
+    """Install a whole-tree root backend: ``fn(chunks: bytes, limit: int)
+    -> bytes`` computes the Merkle root of packed 32-byte chunks with
+    zero-padding to ``limit`` leaves in ONE device dispatch (no per-level
+    host round-trips — see ops.sha256.merkle_root_device)."""
+    global _fused_root_backend
+    _fused_root_backend = fn
+
+
+def fused_root(chunks: bytes, limit: int) -> Optional[bytes]:
+    """The fused whole-tree root, or None when no backend is installed or
+    the tree is too small to be worth a device dispatch."""
+    if _fused_root_backend is None or len(chunks) < 32 * FUSED_ROOT_MIN_CHUNKS:
+        return None
+    return _fused_root_backend(chunks, limit)
+
+
 _small_backend: Optional[Callable] = None
 
 

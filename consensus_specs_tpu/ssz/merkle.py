@@ -10,7 +10,7 @@ from __future__ import annotations
 
 from typing import List, Optional, Sequence
 
-from .hashing import hash_many
+from .hashing import fused_root, hash_many
 
 ZERO_CHUNK = b"\x00" * 32
 
@@ -49,6 +49,12 @@ def merkleize_chunks(chunks: Sequence[bytes], limit: Optional[int] = None) -> by
     depth = ceil_log2(limit)
     if count == 0:
         return ZERO_HASHES[depth]
+    if count >= 2:
+        # large trees: whole-tree device reduce in one dispatch (chunk
+        # data crosses to HBM once; only the 32-byte root returns)
+        root = fused_root(b"".join(chunks), limit)
+        if root is not None:
+            return root
     nodes = list(chunks)
     level = 0
     while len(nodes) > 1:

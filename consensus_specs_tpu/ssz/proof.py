@@ -1,0 +1,72 @@
+"""Single Merkle proofs against SSZ objects by generalized index
+(ref: ssz/merkle-proofs.md:58-249 — the proof-construction side the
+light-client sync protocol consumes, sync-protocol.md:159-231).
+
+`compute_merkle_proof(obj, gindex)` returns the branch ordered leaf-level
+first, matching `is_valid_merkle_branch` / `compute_merkle_proof_root`
+fold order. Descent across Container boundaries is supported (the
+light-client gindices FINALIZED_ROOT_INDEX / NEXT_SYNC_COMMITTEE_INDEX
+never descend through a List's length mix-in).
+"""
+from __future__ import annotations
+
+from typing import List as PyList
+
+from .merkle import ZERO_HASHES, ceil_log2, next_pow2
+from .hashing import hash_many
+from .types import Container
+
+
+def _container_chunk_levels(obj: Container) -> PyList[PyList[bytes]]:
+    """Bottom-up levels of the container's field-root tree, padded to the
+    pow2 leaf count with zero hashes."""
+    fields = list(obj.fields())
+    chunks = [bytes(getattr(obj, name).hash_tree_root()) for name in fields]
+    size = next_pow2(max(len(chunks), 1))
+    depth = ceil_log2(size)
+    level = chunks + [ZERO_HASHES[0]] * (size - len(chunks))
+    levels = [level]
+    for d in range(depth):
+        nxt = [
+            hash_many(level[2 * i] + level[2 * i + 1])
+            for i in range(len(level) // 2)
+        ]
+        levels.append(nxt)
+        level = nxt
+    return levels
+
+
+def compute_merkle_proof(obj, gindex: int) -> PyList[bytes]:
+    """Branch proving the subtree at `gindex` inside `obj`'s hash tree."""
+    gindex = int(gindex)
+    assert gindex >= 1
+    bits = bin(gindex)[3:]  # descent path from the root, MSB first
+    return _proof(obj, bits)
+
+
+def _proof(obj, bits: str) -> PyList[bytes]:
+    if not bits:
+        return []
+    if not isinstance(obj, Container):
+        raise NotImplementedError(
+            f"proof descent through {type(obj).__name__} not supported "
+            "(only Container paths needed by the light-client gindices)"
+        )
+    fields = list(obj.fields())
+    levels = _container_chunk_levels(obj)
+    depth = len(levels) - 1
+    tree_bits, rest = bits[:depth], bits[depth:]
+    assert len(tree_bits) == depth, "generalized index path ends inside padding"
+    idx = int(tree_bits, 2) if tree_bits else 0
+
+    siblings = []
+    pos = idx
+    for level in range(depth):  # leaf-level sibling first
+        siblings.append(levels[level][pos ^ 1])
+        pos //= 2
+
+    if not rest:
+        return siblings
+    assert idx < len(fields), "path descends into zero padding"
+    deeper = _proof(getattr(obj, fields[idx]), rest)
+    return deeper + siblings

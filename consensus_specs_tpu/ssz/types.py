@@ -5,13 +5,17 @@ Feature parity with the reference's remerkleable-based typing surface
 uintN, boolean, Container, Vector, List, ByteVector, ByteList, Bitvector,
 Bitlist, Union, plus generalized indices (ssz/merkle-proofs.md:58-189).
 
-Design difference from remerkleable: objects are plain Python values (ints,
+Design differences from remerkleable: objects are plain Python values (ints,
 bytes, lists) rather than persistent binary trees. Roots are computed on
 demand by flattening to chunk lists and reducing level-by-level through the
-batched hasher (`hashing.hash_many`) — the shape a TPU kernel wants. A
-root memo (`_cached_root`) on containers, invalidated on any mutation in the
-owning tree, recovers remerkleable's incremental-rehash win for the common
-"mutate a little, re-root" spec pattern.
+batched hasher (`hashing.hash_many`) — the shape a TPU kernel wants.
+
+Assignment semantics caveat: composite values (Containers, sequences) are
+coerced BY REFERENCE when the type already matches, so two parents can
+alias one child — unlike remerkleable, whose views share only immutable
+nodes. Spec code is safe (it copies states explicitly, per the spec text);
+test helpers that move containers between a state and a block/payload must
+`.copy()` at the boundary (see execution_payload.build_empty_execution_payload).
 """
 from __future__ import annotations
 

@@ -217,10 +217,15 @@ def prepare_state_with_attestations(spec, state, participation_fn=None):
                 spec.process_attestation(state, attestation)
                 attestations.remove(attestation)
 
-    # Every slot of the (now previous) epoch must be attested
-    attested_slots = {int(a.data.slot) for a in state.previous_epoch_attestations}
-    expected = {
-        int(spec.compute_start_slot_at_epoch(start_epoch) + i) for i in range(spec.SLOTS_PER_EPOCH)
-    }
-    assert attested_slots == expected, (sorted(attested_slots), sorted(expected))
+    if hasattr(state, "previous_epoch_attestations"):
+        # phase0: every slot of the (now previous) epoch must be attested
+        attested_slots = {int(a.data.slot) for a in state.previous_epoch_attestations}
+        expected = {
+            int(spec.compute_start_slot_at_epoch(start_epoch) + i)
+            for i in range(spec.SLOTS_PER_EPOCH)
+        }
+        assert attested_slots == expected, (sorted(attested_slots), sorted(expected))
+    else:
+        # altair+: participation flags landed for the previous epoch
+        assert any(int(f) != 0 for f in state.previous_epoch_participation)
     return state

@@ -1,0 +1,60 @@
+"""BLSToExecutionChange builders (ref: test/helpers/bls_to_execution_changes.py
+shape in later reference versions; capella/beacon-chain.md:408)."""
+from __future__ import annotations
+
+from consensus_specs_tpu.crypto import bls
+
+from .context import expect_assertion_error
+from .keys import privkeys, pubkeys
+
+
+def get_signed_address_change(
+    spec,
+    state,
+    validator_index=None,
+    withdrawal_pubkey=None,
+    to_execution_address=None,
+    privkey=None,
+):
+    if validator_index is None:
+        validator_index = 0
+    if withdrawal_pubkey is None:
+        withdrawal_pubkey = pubkeys[validator_index]
+        if privkey is None:
+            privkey = privkeys[validator_index]
+    if to_execution_address is None:
+        to_execution_address = b"\x42" * 20
+
+    address_change = spec.BLSToExecutionChange(
+        validator_index=validator_index,
+        from_bls_pubkey=withdrawal_pubkey,
+        to_execution_address=to_execution_address,
+    )
+    domain = spec.get_domain(state, spec.DOMAIN_BLS_TO_EXECUTION_CHANGE)
+    signing_root = spec.compute_signing_root(address_change, domain)
+    signature = (
+        bls.Sign(privkey, signing_root) if privkey is not None else b"\x00" * 96
+    )
+    return spec.SignedBLSToExecutionChange(message=address_change, signature=signature)
+
+
+def run_bls_to_execution_change_processing(spec, state, signed_address_change, valid=True):
+    """Yield pre/operation/post around process_bls_to_execution_change."""
+    yield "pre", state
+    yield "address_change", signed_address_change
+
+    if not valid:
+        expect_assertion_error(
+            lambda: spec.process_bls_to_execution_change(state, signed_address_change)
+        )
+        yield "post", None
+        return
+
+    spec.process_bls_to_execution_change(state, signed_address_change)
+    yield "post", state
+
+    validator = state.validators[signed_address_change.message.validator_index]
+    creds = bytes(validator.withdrawal_credentials)
+    assert creds[:1] == bytes(spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX)
+    assert creds[1:12] == b"\x00" * 11
+    assert creds[12:] == bytes(signed_address_change.message.to_execution_address)

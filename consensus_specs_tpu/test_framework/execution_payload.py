@@ -27,7 +27,11 @@ def build_empty_execution_payload(spec, state, randao_mix=None):
         transactions=empty_txs,
     )
     if hasattr(spec, "get_expected_withdrawals"):  # capella+
-        payload.withdrawals = spec.get_expected_withdrawals(state)
+        # copy each withdrawal: this SSZ library assigns composites by
+        # reference, and the payload must be independent of the state's
+        # withdrawals_queue (a tampered payload test would otherwise
+        # tamper the queue too)
+        payload.withdrawals = [wd.copy() for wd in spec.get_expected_withdrawals(state)]
     payload.block_hash = compute_el_block_hash(spec, payload)
     return payload
 

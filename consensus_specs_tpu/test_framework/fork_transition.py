@@ -1,0 +1,67 @@
+"""Cross-fork transition test machinery (ref: test/helpers/fork_transition.py,
+354 LoC; emits the transition vector format: meta post_fork/fork_epoch/
+fork_block + pre (old fork), blocks (mixed forks), post (new fork))."""
+from __future__ import annotations
+
+from .block import build_empty_block, build_empty_block_for_next_slot, sign_block
+from .state import state_transition_and_sign_block
+
+
+UPGRADE_FNS = {
+    "altair": "upgrade_to_altair",
+    "bellatrix": "upgrade_to_bellatrix",
+    "capella": "upgrade_to_capella",
+}
+
+
+def run_fork_transition(
+    spec_pre,
+    spec_post,
+    state,
+    fork_epoch,
+    blocks_before=True,
+    blocks_after=2,
+):
+    """Drive a chain of blocks across the fork boundary at fork_epoch.
+
+    The last pre-fork slot gets a pre-fork block (when blocks_before),
+    epoch processing rolls into fork_epoch, the state is upgraded, and
+    the first post-fork block lands at the fork-epoch start slot —
+    matching the reference's transition semantics
+    (test/altair/transition/test_transition.py)."""
+    yield "post_fork", "meta", spec_post.fork
+    yield "fork_epoch", "meta", int(fork_epoch)
+    yield "pre", state
+
+    blocks = []
+    fork_slot = int(fork_epoch) * int(spec_pre.SLOTS_PER_EPOCH)
+    assert state.slot < fork_slot
+
+    if blocks_before:
+        while int(state.slot) + 1 < fork_slot:
+            block = build_empty_block_for_next_slot(spec_pre, state)
+            blocks.append(state_transition_and_sign_block(spec_pre, state, block))
+    if blocks:
+        yield "fork_block", "meta", len(blocks) - 1  # index of last pre-fork block
+
+    # roll through the epoch boundary into the fork epoch, then upgrade
+    spec_pre.process_slots(state, fork_slot)
+    upgrade = getattr(spec_post, UPGRADE_FNS[spec_post.fork])
+    state = upgrade(state)
+    assert bytes(state.fork.current_version) == bytes(
+        getattr(spec_post.config, f"{spec_post.fork.upper()}_FORK_VERSION")
+    )
+
+    # first post-fork block at the fork-epoch start slot: the state is
+    # already at that slot, so apply process_block directly (the
+    # reference's _state_transition_and_sign_block_at_slot shape)
+    block = build_empty_block(spec_post, state, slot=state.slot)
+    spec_post.process_block(state, block)
+    block.state_root = spec_post.hash_tree_root(state)
+    blocks.append(sign_block(spec_post, state, block))
+    for _ in range(int(blocks_after)):
+        block = build_empty_block_for_next_slot(spec_post, state)
+        blocks.append(state_transition_and_sign_block(spec_post, state, block))
+
+    yield "blocks", blocks
+    yield "post", state

@@ -11,7 +11,7 @@ def mock_withdrawal_credentials(spec, validator_index: int) -> bytes:
 
 
 def build_mock_validator(spec, i: int, balance: int):
-    return spec.Validator(
+    validator = spec.Validator(
         pubkey=pubkeys[i],
         withdrawal_credentials=mock_withdrawal_credentials(spec, i),
         activation_eligibility_epoch=spec.FAR_FUTURE_EPOCH,
@@ -22,6 +22,9 @@ def build_mock_validator(spec, i: int, balance: int):
             balance - balance % spec.EFFECTIVE_BALANCE_INCREMENT, spec.MAX_EFFECTIVE_BALANCE
         ),
     )
+    if hasattr(validator, "fully_withdrawn_epoch"):  # capella+
+        validator.fully_withdrawn_epoch = spec.FAR_FUTURE_EPOCH
+    return validator
 
 
 def _fork_versions(spec):

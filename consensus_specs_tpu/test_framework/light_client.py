@@ -1,0 +1,64 @@
+"""Light-client sync-protocol test helpers
+(ref: test/helpers/light_client.py shape; altair/sync-protocol.md)."""
+from __future__ import annotations
+
+from consensus_specs_tpu.ssz.proof import compute_merkle_proof
+
+from .sync_committee import (
+    compute_aggregate_sync_committee_signature,
+    compute_committee_indices,
+)
+
+
+def initialize_light_client_store(spec, state):
+    return spec.LightClientStore(
+        finalized_header=spec.BeaconBlockHeader(),
+        current_sync_committee=state.current_sync_committee,
+        next_sync_committee=state.next_sync_committee,
+        best_valid_update=None,
+        optimistic_header=spec.BeaconBlockHeader(),
+        previous_max_active_participants=0,
+        current_max_active_participants=0,
+    )
+
+
+def signed_block_header(spec, block):
+    return spec.BeaconBlockHeader(
+        slot=block.slot,
+        proposer_index=block.proposer_index,
+        parent_root=block.parent_root,
+        state_root=block.state_root,
+        body_root=spec.hash_tree_root(block.body),
+    )
+
+
+def get_sync_aggregate_over_header(spec, state, header, participation=None):
+    """SyncAggregate of the CURRENT sync committee signing `header` as the
+    attested header. compute_signing_root(header, d) equals
+    compute_signing_root(Root(htr(header)), d), so the sync-committee
+    message signer applies directly (sync-protocol.md:159-231)."""
+    committee = compute_committee_indices(spec, state)
+    size = int(spec.SYNC_COMMITTEE_SIZE)
+    if participation is None:
+        bits = [True] * size
+    else:
+        bits = [i < int(size * participation) for i in range(size)]
+    participants = [committee[i] for i in range(size) if bits[i]]
+    signature = compute_aggregate_sync_committee_signature(
+        spec, state, header.slot, participants, block_root=spec.hash_tree_root(header)
+    )
+    return spec.SyncAggregate(
+        sync_committee_bits=bits, sync_committee_signature=signature
+    ), participants
+
+
+def empty_finality_branch(spec):
+    return [spec.Bytes32() for _ in range(spec.floorlog2(spec.FINALIZED_ROOT_INDEX))]
+
+
+def empty_next_sync_committee_branch(spec):
+    return [spec.Bytes32() for _ in range(spec.floorlog2(spec.NEXT_SYNC_COMMITTEE_INDEX))]
+
+
+def build_finality_branch(spec, attested_state):
+    return compute_merkle_proof(attested_state, int(spec.FINALIZED_ROOT_INDEX))

@@ -1,0 +1,51 @@
+"""PoW-chain stubs for merge-transition tests (ref: test/helpers/pow_block.py).
+
+`patch_pow_chain` swaps the spec module's `get_pow_block` stub
+(specs/bellatrix.py:395-398) for a dict-backed chain view — the same
+monkeypatch pattern the reference uses. Always a context manager: spec
+modules are cached per (fork, preset), so a leaked patch would bleed
+into other tests.
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+
+def prepare_pow_block(spec, block_hash, parent_hash=b"\x00" * 32, total_difficulty=0):
+    return spec.PowBlock(
+        block_hash=block_hash,
+        parent_hash=parent_hash,
+        total_difficulty=total_difficulty,
+    )
+
+
+def prepare_terminal_pow_chain(spec, parent_hash):
+    """A two-block chain whose tip is a valid terminal PoW block for the
+    given execution parent_hash."""
+    ttd = int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
+    grandparent = prepare_pow_block(
+        spec, block_hash=b"\x11" * 32, total_difficulty=max(ttd - 1, 0)
+    )
+    tip = prepare_pow_block(
+        spec,
+        block_hash=parent_hash,
+        parent_hash=grandparent.block_hash,
+        total_difficulty=ttd,
+    )
+    return [grandparent, tip]
+
+
+@contextmanager
+def patch_pow_chain(spec, pow_chain):
+    """Temporarily back spec.get_pow_block with the given blocks."""
+    by_hash = {bytes(b.block_hash): b for b in pow_chain}
+    original = spec.get_pow_block
+
+    def get_pow_block(block_hash):
+        return by_hash.get(bytes(block_hash))
+
+    spec.get_pow_block = get_pow_block
+    try:
+        yield
+    finally:
+        spec.get_pow_block = original

@@ -1,0 +1,155 @@
+"""Randomized-scenario building blocks + scenario expander
+(ref: test/utils/randomized_block_tests.py, 377 LoC + the code-generating
+tests/generators/random/generate.py — redesigned as a data-driven
+scenario table instead of generated source files).
+
+A scenario is a list of steps; each step is either a state transition
+("next_slot", "next_epoch", "random_slots") or a block ("block" —
+random-op block applied via the full state transition). Scenarios emit
+sanity/blocks-format vectors (pre, blocks, post) so clients replay them
+with their production block pipeline.
+"""
+from __future__ import annotations
+
+from random import Random
+
+from consensus_specs_tpu.crypto import bls
+
+from .attestations import get_valid_attestation
+from .attester_slashings import get_valid_attester_slashing_by_indices
+from .block import build_empty_block_for_next_slot
+from .constants import is_post_altair
+from .state import next_epoch, next_slot, next_slots, state_transition_and_sign_block
+
+
+# -- state randomizers --------------------------------------------------------
+
+def randomize_inactivity_scores(spec, state, rng):
+    if is_post_altair(spec):
+        state.inactivity_scores = [
+            spec.uint64(rng.randrange(0, 2 * int(spec.config.INACTIVITY_SCORE_BIAS) + 3))
+            for _ in range(len(state.validators))
+        ]
+
+
+def randomize_balances(spec, state, rng):
+    """Jitter balances around spec norms without zeroing anyone."""
+    for index in range(len(state.balances)):
+        jitter = rng.randrange(0, int(spec.EFFECTIVE_BALANCE_INCREMENT))
+        state.balances[index] = spec.Gwei(int(state.balances[index]) + jitter)
+
+
+def randomize_state(spec, state, rng):
+    """Light-touch registry/balances/scores randomization that keeps the
+    state transitionable (ref randomized_block_tests.py randomize_state)."""
+    from .rewards import exit_random_validators, slash_random_validators_clean
+
+    randomize_balances(spec, state, rng)
+    randomize_inactivity_scores(spec, state, rng)
+    exit_random_validators(spec, state, rng, fraction=0.1)
+    slash_random_validators_clean(spec, state, rng, fraction=0.1)
+
+
+# -- random block builder -----------------------------------------------------
+
+def _random_attestations(spec, state, rng, max_count=2):
+    """Valid attestations for the previous slot's committees."""
+    atts = []
+    if state.slot < spec.MIN_ATTESTATION_INCLUSION_DELAY:
+        return atts
+    slot = state.slot - spec.MIN_ATTESTATION_INCLUSION_DELAY
+    committees = spec.get_committee_count_per_slot(state, spec.compute_epoch_at_slot(slot))
+    for index in rng.sample(range(committees), min(max_count, committees)):
+        atts.append(
+            get_valid_attestation(spec, state, slot=slot, index=index, signed=True)
+        )
+    return atts
+
+
+def _maybe_attester_slashing(spec, state, rng, slashed: set):
+    """Occasionally double-vote-slash a not-yet-slashed validator."""
+    if rng.random() > 0.2:
+        return None
+    candidates = [
+        i
+        for i in spec.get_active_validator_indices(state, spec.get_current_epoch(state))
+        if i not in slashed and not state.validators[i].slashed
+    ]
+    if not candidates:
+        return None
+    victim = rng.choice(candidates)
+    slashing = get_valid_attester_slashing_by_indices(
+        spec, state, [victim], signed_1=True, signed_2=True
+    )
+    slashed.add(victim)
+    return slashing
+
+
+def _advance_past_slashed_proposers(spec, state):
+    """Randomization may slash the upcoming proposer; a slashed proposer
+    can't produce a valid block, so skip those slots."""
+    from .block import get_proposer_index_maybe
+
+    for _ in range(int(spec.SLOTS_PER_EPOCH) * 2):
+        proposer = get_proposer_index_maybe(spec, state, state.slot + 1)
+        if not state.validators[proposer].slashed:
+            return
+        next_slot(spec, state)
+    raise AssertionError("no unslashed proposer found in two epochs")
+
+
+def build_random_block(spec, state, rng, slashed: set):
+    """A valid block with a random operation mix."""
+    _advance_past_slashed_proposers(spec, state)
+    block = build_empty_block_for_next_slot(spec, state)
+    for att in _random_attestations(spec, state, rng):
+        block.body.attestations.append(att)
+    slashing = _maybe_attester_slashing(spec, state, rng, slashed)
+    if slashing is not None:
+        block.body.attester_slashings.append(slashing)
+    return block
+
+
+# -- scenario expander --------------------------------------------------------
+
+SCENARIOS = {
+    # name -> list of steps; counts kept small: each block is a full
+    # state_transition and suites run across 4 forks x presets
+    "random_0": ["block", "next_slot", "block", "next_epoch", "block"],
+    "random_1": ["next_epoch", "block", "block", "block"],
+    "random_2": ["random_slots", "block", "next_epoch", "block", "block"],
+    "random_3": ["block", "random_slots", "block", "random_slots", "block"],
+    "leak_0": ["leak", "block", "next_epoch", "block"],
+    "leak_1": ["leak", "random_slots", "block", "block"],
+}
+
+
+def run_random_scenario(spec, state, scenario_name, seed):
+    rng = Random(seed)
+    randomize_state(spec, state, rng)
+
+    yield "pre", state
+
+    blocks = []
+    slashed: set = set()
+    for step in SCENARIOS[scenario_name]:
+        if step == "next_slot":
+            next_slot(spec, state)
+        elif step == "next_epoch":
+            next_epoch(spec, state)
+        elif step == "random_slots":
+            next_slots(spec, state, rng.randrange(1, int(spec.SLOTS_PER_EPOCH)))
+        elif step == "leak":
+            # no attestations for > MIN_EPOCHS_TO_INACTIVITY_PENALTY epochs
+            for _ in range(int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 3):
+                next_epoch(spec, state)
+            assert spec.is_in_inactivity_leak(state)
+        elif step == "block":
+            block = build_random_block(spec, state, rng, slashed)
+            signed = state_transition_and_sign_block(spec, state, block)
+            blocks.append(signed)
+        else:  # pragma: no cover
+            raise ValueError(f"unknown step {step}")
+
+    yield "blocks", blocks
+    yield "post", state

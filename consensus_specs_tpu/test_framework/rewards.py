@@ -1,0 +1,357 @@
+"""Rewards test machinery: per-component delta runners + scenario
+builders (ref: test/helpers/rewards.py, 520 LoC — redesigned around two
+oracles: per-component participation properties, and an end-to-end
+cross-check that the emitted deltas compose to exactly the balance
+changes process_rewards_and_penalties applies)."""
+from __future__ import annotations
+
+from random import Random
+
+from .attestations import prepare_state_with_attestations
+from .constants import is_post_altair, is_post_bellatrix
+from .state import next_epoch
+
+
+_DELTAS_CLASSES = {}
+
+
+def _deltas_class(spec):
+    """SSZ container type for a (rewards, penalties) pair — the vector
+    part format (ref rewards.py:19-21). Built via type() with real-type
+    annotations (this module's `from __future__ import annotations`
+    would stringify inline class-body annotations, hiding the fields
+    from the Container metaclass)."""
+    from consensus_specs_tpu.ssz import List, uint64
+
+    limit = int(spec.VALIDATOR_REGISTRY_LIMIT)
+    cls = _DELTAS_CLASSES.get(limit)
+    if cls is None:
+        elem = List[uint64, limit]
+        cls = type(
+            "Deltas",
+            (spec.Container,),
+            {"__annotations__": {"rewards": elem, "penalties": elem}},
+        )
+        _DELTAS_CLASSES[limit] = cls
+    return cls
+
+
+def Deltas(spec, rewards, penalties):
+    return _deltas_class(spec)(rewards=rewards, penalties=penalties)
+
+
+def get_inactivity_penalty_quotient(spec):
+    if is_post_bellatrix(spec):
+        return spec.INACTIVITY_PENALTY_QUOTIENT_BELLATRIX
+    if is_post_altair(spec):
+        return spec.INACTIVITY_PENALTY_QUOTIENT_ALTAIR
+    return spec.INACTIVITY_PENALTY_QUOTIENT
+
+
+def has_enough_for_reward(spec, state, index):
+    """Positive effective balance can still round to a zero base reward
+    (ref rewards.py:33-43)."""
+    if is_post_altair(spec):
+        increments = state.validators[index].effective_balance // spec.EFFECTIVE_BALANCE_INCREMENT
+        return increments * spec.get_base_reward_per_increment(state) > 0
+    return (
+        state.validators[index].effective_balance * spec.BASE_REWARD_FACTOR
+        > spec.integer_squareroot(spec.get_total_active_balance(state))
+        // spec.BASE_REWARDS_PER_EPOCH
+    )
+
+
+def _eligible_indices(spec, state):
+    previous_epoch = spec.get_previous_epoch(state)
+    return [
+        i
+        for i, v in enumerate(state.validators)
+        if spec.is_active_validator(v, previous_epoch)
+        or (v.slashed and previous_epoch + 1 < v.withdrawable_epoch)
+    ]
+
+
+def _phase0_component_participants(spec, state, component):
+    previous_epoch = spec.get_previous_epoch(state)
+    matching = {
+        "source": spec.get_matching_source_attestations,
+        "target": spec.get_matching_target_attestations,
+        "head": spec.get_matching_head_attestations,
+    }[component](state, previous_epoch)
+    return spec.get_unslashed_attesting_indices(state, matching)
+
+
+def _altair_component_participants(spec, state, component):
+    flag_index = {
+        "source": spec.TIMELY_SOURCE_FLAG_INDEX,
+        "target": spec.TIMELY_TARGET_FLAG_INDEX,
+        "head": spec.TIMELY_HEAD_FLAG_INDEX,
+    }[component]
+    return spec.get_unslashed_participating_indices(
+        state, flag_index, spec.get_previous_epoch(state)
+    )
+
+
+def _validate_component_deltas(spec, state, component, rewards, penalties):
+    """Property oracle per component (ref rewards.py validate logic):
+    participants earn (exactly, in phase0, when the base reward rounds
+    positive; in altair the per-flag reward can round to zero so only a
+    collective check applies), eligible non-participants are penalized
+    (except altair's head flag, which carries no penalty), and everyone
+    else is untouched."""
+    eligible = set(_eligible_indices(spec, state))
+    in_leak = spec.is_in_inactivity_leak(state)
+    post_altair = is_post_altair(spec)
+    if post_altair:
+        participants = _altair_component_participants(spec, state, component)
+        penalizing = component in ("source", "target")
+    else:
+        participants = _phase0_component_participants(spec, state, component)
+        penalizing = True
+
+    for index in range(len(state.validators)):
+        if index not in eligible:
+            assert rewards[index] == 0 and penalties[index] == 0
+            continue
+        if index in participants:
+            assert penalties[index] == 0
+            if in_leak and post_altair:
+                # altair suppresses flag rewards during a leak
+                assert rewards[index] == 0
+            elif in_leak:
+                # phase0 pays the full base reward (cancelled by the
+                # inactivity deltas) — nonzero when it rounds positive
+                if has_enough_for_reward(spec, state, index):
+                    assert rewards[index] > 0
+            elif not post_altair and has_enough_for_reward(spec, state, index):
+                assert rewards[index] > 0
+        else:
+            assert rewards[index] == 0
+            if penalizing and has_enough_for_reward(spec, state, index):
+                assert penalties[index] > 0
+
+    if post_altair and not in_leak:
+        rewardable = [i for i in participants if has_enough_for_reward(spec, state, i)]
+        if rewardable:
+            assert any(rewards[i] > 0 for i in rewardable)
+
+
+def run_deltas(spec, state):
+    """Yield pre + every reward component's deltas, each validated by the
+    property oracle, then cross-check composition against
+    process_rewards_and_penalties (ref rewards.py:66-120)."""
+    yield "pre", state
+
+    components = []  # (rewards, penalties) per emitted part
+
+    if is_post_altair(spec):
+        flags = [
+            ("source_deltas", spec.TIMELY_SOURCE_FLAG_INDEX, "source"),
+            ("target_deltas", spec.TIMELY_TARGET_FLAG_INDEX, "target"),
+            ("head_deltas", spec.TIMELY_HEAD_FLAG_INDEX, "head"),
+        ]
+        for name, flag_index, component in flags:
+            rewards, penalties = spec.get_flag_index_deltas(state, flag_index)
+            _validate_component_deltas(spec, state, component, rewards, penalties)
+            components.append((rewards, penalties))
+            yield name, Deltas(spec, rewards, penalties)
+    else:
+        for name, component in [
+            ("source_deltas", "source"),
+            ("target_deltas", "target"),
+            ("head_deltas", "head"),
+        ]:
+            rewards, penalties = {
+                "source": spec.get_source_deltas,
+                "target": spec.get_target_deltas,
+                "head": spec.get_head_deltas,
+            }[component](state)
+            _validate_component_deltas(spec, state, component, rewards, penalties)
+            components.append((rewards, penalties))
+            yield name, Deltas(spec, rewards, penalties)
+
+        rewards, penalties = spec.get_inclusion_delay_deltas(state)
+        # inclusion delay only rewards; recipients are source
+        # participants (attester share) and block proposers (inclusion
+        # share), so no per-index zero check beyond penalties
+        assert all(p == 0 for p in penalties)
+        components.append((rewards, penalties))
+        yield "inclusion_delay_deltas", Deltas(spec, rewards, penalties)
+
+    rewards, penalties = spec.get_inactivity_penalty_deltas(state)
+    assert all(r == 0 for r in rewards)
+    components.append((rewards, penalties))
+    yield "inactivity_penalty_deltas", Deltas(spec, rewards, penalties)
+
+    _cross_check_total(spec, state, components)
+
+
+def _cross_check_total(spec, state, components):
+    """The emitted components must compose (with the spec's saturating
+    application order) to exactly what process_rewards_and_penalties
+    does to balances."""
+    if spec.get_current_epoch(state) == spec.GENESIS_EPOCH:
+        return  # process_rewards_and_penalties is a no-op at genesis
+    applied = state.copy()
+    spec.process_rewards_and_penalties(applied)
+    n = len(state.validators)
+    totals_r = [0] * n
+    totals_p = [0] * n
+    for rewards, penalties in components:
+        for i in range(n):
+            totals_r[i] += int(rewards[i])
+            totals_p[i] += int(penalties[i])
+    for i in range(n):
+        expected = int(state.balances[i]) + totals_r[i]
+        expected = max(expected - totals_p[i], 0)
+        assert int(applied.balances[i]) == expected, f"validator {i}"
+
+
+# -- scenario builders (ref rewards.py run_test_* family) --------------------
+
+def run_test_empty(spec, state):
+    next_epoch(spec, state)
+    next_epoch(spec, state)  # previous epoch exists, zero participation
+    yield from run_deltas(spec, state)
+
+
+def run_test_full_all_correct(spec, state):
+    prepare_state_with_attestations(spec, state)
+    yield from run_deltas(spec, state)
+
+
+def run_test_full_but_partial_participation(spec, state, rng=None):
+    rng = rng or Random(1010)
+    prepare_state_with_attestations(spec, state)
+    if is_post_altair(spec):
+        for index in range(len(state.validators)):
+            if rng.choice([True, False]):
+                state.previous_epoch_participation[index] = spec.ParticipationFlags(0)
+    else:
+        atts = list(state.previous_epoch_attestations)
+        state.previous_epoch_attestations = [a for a in atts if rng.choice([True, False])]
+    yield from run_deltas(spec, state)
+
+
+def run_test_partial_participation(spec, state, fraction):
+    """Keep ~fraction of each committee attesting."""
+
+    def participation_fn(epoch, slot, index, comm):
+        comm = sorted(comm)
+        return set(comm[: max(int(len(comm) * fraction), 1)])
+
+    prepare_state_with_attestations(spec, state, participation_fn=participation_fn)
+    yield from run_deltas(spec, state)
+
+
+def run_test_with_not_yet_activated_validators(spec, state, rng=None):
+    rng = rng or Random(5555)
+    set_some_activations_far_future(spec, state, rng)
+    prepare_state_with_attestations(spec, state)
+    yield from run_deltas(spec, state)
+
+
+def run_test_with_exited_validators(spec, state, rng=None):
+    # exits must precede attestation prep: a retroactive exit would
+    # change the historical committee shuffle the aggregation bits
+    # were built against
+    rng = rng or Random(1337)
+    exit_random_validators(spec, state, rng)
+    prepare_state_with_attestations(spec, state)
+    yield from run_deltas(spec, state)
+
+
+def run_test_with_slashed_validators(spec, state, rng=None):
+    rng = rng or Random(3322)
+    prepare_state_with_attestations(spec, state)
+    slash_random_validators_clean(spec, state, rng)
+    yield from run_deltas(spec, state)
+
+
+def run_test_some_very_low_effective_balances_that_attested(spec, state):
+    prepare_state_with_attestations(spec, state)
+    for i in range(3):
+        state.validators[i].effective_balance = spec.EFFECTIVE_BALANCE_INCREMENT
+    yield from run_deltas(spec, state)
+
+
+def transition_to_leaking(spec, state):
+    """Advance past MIN_EPOCHS_TO_INACTIVITY_PENALTY without finality so
+    is_in_inactivity_leak flips on."""
+    target = spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY + 2
+    for _ in range(int(target) + 1):
+        next_epoch(spec, state)
+    assert spec.is_in_inactivity_leak(state)
+
+
+def _seed_inactivity_scores(spec, state, rng):
+    if is_post_altair(spec):
+        state.inactivity_scores = [
+            spec.uint64(rng.randrange(0, 2 * int(spec.config.INACTIVITY_SCORE_BIAS) + 5))
+            for _ in range(len(state.validators))
+        ]
+
+
+def run_test_full_leak(spec, state):
+    transition_to_leaking(spec, state)
+    _seed_inactivity_scores(spec, state, Random(77))
+    prepare_state_with_attestations(spec, state)
+    yield from run_deltas(spec, state)
+
+
+def run_test_empty_leak(spec, state):
+    transition_to_leaking(spec, state)
+    _seed_inactivity_scores(spec, state, Random(78))
+    next_epoch(spec, state)
+    yield from run_deltas(spec, state)
+
+
+def run_test_random_leak(spec, state, rng=None):
+    rng = rng or Random(9009)
+    transition_to_leaking(spec, state)
+    _seed_inactivity_scores(spec, state, rng)
+    prepare_state_with_attestations(spec, state)
+    if is_post_altair(spec):
+        for index in range(len(state.validators)):
+            if rng.random() < 0.4:
+                state.previous_epoch_participation[index] = spec.ParticipationFlags(0)
+    else:
+        atts = list(state.previous_epoch_attestations)
+        state.previous_epoch_attestations = [a for a in atts if rng.random() < 0.6]
+    yield from run_deltas(spec, state)
+
+
+# -- registry mutators (shared with the random suites) -----------------------
+
+def set_some_activations_far_future(spec, state, rng, fraction=0.25):
+    current_epoch = spec.get_current_epoch(state)
+    for index in range(len(state.validators)):
+        if rng.random() < fraction and index > 0:
+            v = state.validators[index]
+            v.activation_eligibility_epoch = spec.FAR_FUTURE_EPOCH
+            v.activation_epoch = spec.FAR_FUTURE_EPOCH
+            assert not spec.is_active_validator(v, current_epoch)
+
+
+def exit_random_validators(spec, state, rng, fraction=0.25):
+    current_epoch = spec.get_current_epoch(state)
+    for index in range(len(state.validators)):
+        if rng.random() < fraction:
+            v = state.validators[index]
+            v.exit_epoch = rng.choice(
+                [max(current_epoch - 1, 0), current_epoch, current_epoch + 1]
+            )
+            v.withdrawable_epoch = v.exit_epoch + spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+
+
+def slash_random_validators_clean(spec, state, rng, fraction=0.25):
+    """Mark slashed without the full slash_validator side effects — the
+    deltas only read the flags (ref random.py slash_random_validators)."""
+    current_epoch = spec.get_current_epoch(state)
+    for index in range(len(state.validators)):
+        if rng.random() < fraction:
+            v = state.validators[index]
+            v.slashed = True
+            v.withdrawable_epoch = max(
+                v.withdrawable_epoch, current_epoch + spec.EPOCHS_PER_SLASHINGS_VECTOR
+            )

@@ -1,0 +1,234 @@
+"""Ex-ante reorg attack scenarios: proposer boost defense
+(ref: test/phase0/fork_choice/test_ex_ante.py, 421 LoC — the key attack
+shapes; every action is emitted as a replayable fork_choice step)."""
+from consensus_specs_tpu.test_framework.attestations import (
+    get_valid_attestation,
+)
+from consensus_specs_tpu.test_framework.block import (
+    build_empty_block,
+    build_empty_block_for_next_slot,
+)
+from consensus_specs_tpu.test_framework.context import (
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.test_framework.fork_choice import (
+    add_attestation,
+    add_block,
+    get_genesis_forkchoice_store_and_block,
+    on_tick_and_append_step,
+    tick_and_add_block,
+)
+from consensus_specs_tpu.test_framework.state import state_transition_and_sign_block
+
+
+def _boost_weight(spec, state):
+    committee_weight = spec.get_total_active_balance(state) // spec.SLOTS_PER_EPOCH
+    return committee_weight * spec.config.PROPOSER_SCORE_BOOST // 100
+
+
+def _single_attester(comm):
+    return {sorted(comm)[0]}
+
+
+def _setup_A(spec, state, store, test_steps):
+    """Common base: block A at slot 1 on the anchor."""
+    on_tick_and_append_step(spec, store, store.genesis_time, test_steps)
+    state_a = state.copy()
+    block_a = build_empty_block_for_next_slot(spec, state_a)
+    signed_a = state_transition_and_sign_block(spec, state_a, block_a)
+    yield from tick_and_add_block(spec, store, signed_a, test_steps)
+    return state_a, signed_a
+
+
+@with_all_phases
+@spec_state_test
+def test_ex_ante_vanilla(spec, state):
+    """Attacker withholds B (slot n+1) + one attestation for B, releasing
+    both just before the honest timely proposal C (slot n+2, parent A).
+    Proposer boost on C must outweigh the single ex-ante vote."""
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+
+    state_a, signed_a = yield from _setup_A(spec, state, store, test_steps)
+
+    # attacker's private block B at slot 2 on A
+    state_b = state_a.copy()
+    block_b = build_empty_block_for_next_slot(spec, state_b)
+    signed_b = state_transition_and_sign_block(spec, state_b, block_b)
+
+    # attacker's attestation voting B (1 participant)
+    att_b = get_valid_attestation(
+        spec, state_b, slot=block_b.slot, index=0, signed=True,
+        filter_participant_set=_single_attester,
+    )
+
+    # honest block C at slot 3 on A
+    state_c = state_a.copy()
+    block_c = build_empty_block(spec, state_c, slot=state_a.slot + 2)
+    signed_c = state_transition_and_sign_block(spec, state_c, block_c)
+
+    # tick to the exact start of slot 3 (timely window)
+    time = int(state.genesis_time + block_c.slot * spec.config.SECONDS_PER_SLOT)
+    on_tick_and_append_step(spec, store, time, test_steps)
+
+    # attacker releases B (late -> no boost), then the vote for B
+    yield from add_block(spec, store, signed_b, test_steps)
+    yield from add_attestation(spec, store, att_b, test_steps)
+
+    # honest C arrives timely -> boosted -> head
+    yield from add_block(spec, store, signed_c, test_steps)
+    assert store.proposer_boost_root == spec.hash_tree_root(block_c)
+    assert spec.get_head(store) == spec.hash_tree_root(block_c)
+
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_ex_ante_attestations_beat_boost(spec, state):
+    """With enough withheld attestations (weight > proposer boost), the
+    ex-ante attack succeeds — documents the boost's limit."""
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+
+    state_a, signed_a = yield from _setup_A(spec, state, store, test_steps)
+
+    state_b = state_a.copy()
+    block_b = build_empty_block_for_next_slot(spec, state_b)
+    signed_b = state_transition_and_sign_block(spec, state_b, block_b)
+
+    # full committees voting B: weight must exceed the boost
+    atts_b = []
+    committees = spec.get_committee_count_per_slot(
+        state_b, spec.compute_epoch_at_slot(block_b.slot)
+    )
+    for index in range(committees):
+        atts_b.append(
+            get_valid_attestation(spec, state_b, slot=block_b.slot, index=index, signed=True)
+        )
+    attesters = sum(sum(a.aggregation_bits) for a in atts_b)
+    attack_weight = sum(
+        state_b.validators[i].effective_balance
+        for a in atts_b
+        for i in spec.get_attesting_indices(state_b, a.data, a.aggregation_bits)
+    )
+    assert attack_weight > _boost_weight(spec, state_b), (attesters, "need > boost")
+
+    state_c = state_a.copy()
+    block_c = build_empty_block(spec, state_c, slot=state_a.slot + 2)
+    signed_c = state_transition_and_sign_block(spec, state_c, block_c)
+
+    time = int(state.genesis_time + block_c.slot * spec.config.SECONDS_PER_SLOT)
+    on_tick_and_append_step(spec, store, time, test_steps)
+
+    yield from add_block(spec, store, signed_b, test_steps)
+    for att in atts_b:
+        yield from add_attestation(spec, store, att, test_steps)
+    yield from add_block(spec, store, signed_c, test_steps)
+
+    assert store.proposer_boost_root == spec.hash_tree_root(block_c)
+    assert spec.get_head(store) == spec.hash_tree_root(block_b)
+
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_ex_ante_sandwich_without_attestations(spec, state):
+    """Boost-powered sandwich: C (timely, on A) takes the head from B,
+    then D (timely, on B) takes it back — no attestations involved."""
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+
+    state_a, signed_a = yield from _setup_A(spec, state, store, test_steps)
+
+    state_b = state_a.copy()
+    block_b = build_empty_block_for_next_slot(spec, state_b)
+    signed_b = state_transition_and_sign_block(spec, state_b, block_b)
+
+    state_c = state_a.copy()
+    block_c = build_empty_block(spec, state_c, slot=state_a.slot + 2)
+    signed_c = state_transition_and_sign_block(spec, state_c, block_c)
+
+    # D at slot 4, parent B — the sandwich closer
+    state_d = state_b.copy()
+    block_d = build_empty_block(spec, state_d, slot=state_b.slot + 2)
+    signed_d = state_transition_and_sign_block(spec, state_d, block_d)
+
+    time = int(state.genesis_time + block_c.slot * spec.config.SECONDS_PER_SLOT)
+    on_tick_and_append_step(spec, store, time, test_steps)
+    yield from add_block(spec, store, signed_b, test_steps)
+    yield from add_block(spec, store, signed_c, test_steps)
+    assert spec.get_head(store) == spec.hash_tree_root(block_c)
+
+    time = int(state.genesis_time + block_d.slot * spec.config.SECONDS_PER_SLOT)
+    on_tick_and_append_step(spec, store, time, test_steps)
+    yield from add_block(spec, store, signed_d, test_steps)
+    assert store.proposer_boost_root == spec.hash_tree_root(block_d)
+    assert spec.get_head(store) == spec.hash_tree_root(block_d)
+
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_ex_ante_sandwich_with_honest_attestations_sticks(spec, state):
+    """When honest attesters vote C with weight above the boost, the
+    sandwich closer D cannot reorg C out."""
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+
+    state_a, signed_a = yield from _setup_A(spec, state, store, test_steps)
+
+    state_b = state_a.copy()
+    block_b = build_empty_block_for_next_slot(spec, state_b)
+    signed_b = state_transition_and_sign_block(spec, state_b, block_b)
+
+    state_c = state_a.copy()
+    block_c = build_empty_block(spec, state_c, slot=state_a.slot + 2)
+    signed_c = state_transition_and_sign_block(spec, state_c, block_c)
+
+    # honest full-committee votes for C at its own slot
+    atts_c = []
+    committees = spec.get_committee_count_per_slot(
+        state_c, spec.compute_epoch_at_slot(block_c.slot)
+    )
+    for index in range(committees):
+        atts_c.append(
+            get_valid_attestation(spec, state_c, slot=block_c.slot, index=index, signed=True)
+        )
+    honest_weight = sum(
+        state_c.validators[i].effective_balance
+        for a in atts_c
+        for i in spec.get_attesting_indices(state_c, a.data, a.aggregation_bits)
+    )
+    assert honest_weight > _boost_weight(spec, state_c)
+
+    state_d = state_b.copy()
+    block_d = build_empty_block(spec, state_d, slot=state_b.slot + 2)
+    signed_d = state_transition_and_sign_block(spec, state_d, block_d)
+
+    time = int(state.genesis_time + block_c.slot * spec.config.SECONDS_PER_SLOT)
+    on_tick_and_append_step(spec, store, time, test_steps)
+    yield from add_block(spec, store, signed_b, test_steps)
+    yield from add_block(spec, store, signed_c, test_steps)
+
+    time = int(state.genesis_time + block_d.slot * spec.config.SECONDS_PER_SLOT)
+    on_tick_and_append_step(spec, store, time, test_steps)
+    for att in atts_c:
+        yield from add_attestation(spec, store, att, test_steps)
+    yield from add_block(spec, store, signed_d, test_steps)
+
+    assert store.proposer_boost_root == spec.hash_tree_root(block_d)
+    assert spec.get_head(store) == spec.hash_tree_root(block_c)
+
+    yield "steps", test_steps

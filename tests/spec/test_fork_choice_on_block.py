@@ -1,0 +1,145 @@
+"""on_block edge cases + proposer boost mechanics
+(ref: test/phase0/fork_choice/test_on_block.py, 799 LoC — key cases)."""
+from consensus_specs_tpu.test_framework.block import (
+    build_empty_block,
+    build_empty_block_for_next_slot,
+)
+from consensus_specs_tpu.test_framework.context import (
+    expect_assertion_error,
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.test_framework.fork_choice import (
+    add_block,
+    apply_next_epoch_with_attestations,
+    get_genesis_forkchoice_store_and_block,
+    on_tick_and_append_step,
+    tick_and_add_block,
+)
+from consensus_specs_tpu.test_framework.state import (
+    next_epoch,
+    state_transition_and_sign_block,
+)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_on_block_future_block(spec, state):
+    """A block from a slot the store has not ticked into is rejected."""
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+    on_tick_and_append_step(spec, store, store.genesis_time, test_steps)
+
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    # no tick to the block's slot
+    yield from add_block(spec, store, signed_block, test_steps, valid=False)
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_on_block_bad_parent_root(spec, state):
+    """Unknown parent root -> rejected (lookup failure)."""
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+    time = store.genesis_time + spec.config.SECONDS_PER_SLOT
+    on_tick_and_append_step(spec, store, time, test_steps)
+
+    block = build_empty_block_for_next_slot(spec, state)
+    transitioned = state.copy()
+    spec.process_slots(transitioned, block.slot)
+    block.parent_root = b"\x77" * 32
+    block.state_root = spec.hash_tree_root(transitioned)
+    from consensus_specs_tpu.test_framework.block import sign_block
+
+    signed_block = sign_block(spec, transitioned, block)
+    yield from add_block(
+        spec, store, signed_block, test_steps, valid=False, block_not_found=True
+    )
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_on_block_before_finalized(spec, state):
+    """A block whose slot is not beyond the finalized slot is rejected."""
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+    on_tick_and_append_step(spec, store, store.genesis_time, test_steps)
+
+    # A fork from genesis, withheld while the canonical chain finalizes
+    fork_state = state.copy()
+    fork_block = build_empty_block_for_next_slot(spec, fork_state)
+    signed_fork_block = state_transition_and_sign_block(spec, fork_state, fork_block)
+
+    next_epoch(spec, state)
+    on_tick_and_append_step(
+        spec, store,
+        store.genesis_time + state.slot * spec.config.SECONDS_PER_SLOT,
+        test_steps,
+    )
+    for _ in range(4):
+        state, store, _ = yield from apply_next_epoch_with_attestations(
+            spec, state, store, True, True, test_steps=test_steps
+        )
+    assert store.finalized_checkpoint.epoch > 0
+
+    # The withheld genesis-fork block is now behind finality
+    yield from add_block(spec, store, signed_fork_block, test_steps, valid=False)
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_boost_timely_block(spec, state):
+    """A block arriving inside the first interval of its slot earns the
+    boost; the boost clears at the next slot tick."""
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield from tick_and_add_block(spec, store, signed_block, test_steps)
+    assert store.proposer_boost_root == spec.hash_tree_root(block)
+    assert spec.get_head(store) == spec.hash_tree_root(block)
+
+    # boost resets on the next slot's tick
+    time = int(store.genesis_time + (block.slot + 1) * spec.config.SECONDS_PER_SLOT)
+    on_tick_and_append_step(spec, store, time, test_steps)
+    assert store.proposer_boost_root == spec.Root()
+
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_boost_untimely_block(spec, state):
+    """A block arriving after the attestation-due interval gets no boost."""
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+
+    late = int(
+        store.genesis_time
+        + block.slot * spec.config.SECONDS_PER_SLOT
+        + spec.config.SECONDS_PER_SLOT // spec.INTERVALS_PER_SLOT
+    )
+    on_tick_and_append_step(spec, store, late, test_steps)
+    yield from add_block(spec, store, signed_block, test_steps)
+    assert store.proposer_boost_root == spec.Root()
+    assert spec.get_head(store) == spec.hash_tree_root(block)
+
+    yield "steps", test_steps

@@ -1,0 +1,82 @@
+"""altair → bellatrix fork upgrade tests
+(ref: test/bellatrix/fork/test_bellatrix_fork_basic.py)."""
+from consensus_specs_tpu.test_framework.context import (
+    ALTAIR,
+    BELLATRIX,
+    default_activation_threshold,
+    default_balances,
+    low_balances,
+    misc_balances,
+    spec_test,
+    with_custom_state,
+    with_phases,
+    zero_activation_threshold,
+)
+from consensus_specs_tpu.test_framework.state import next_epoch, next_epoch_via_block
+
+
+def run_fork_test(post_spec, pre_state):
+    yield "pre", pre_state
+
+    post_state = post_spec.upgrade_to_bellatrix(pre_state)
+
+    stable_fields = [
+        "genesis_time", "genesis_validators_root", "slot",
+        "latest_block_header", "block_roots", "state_roots", "historical_roots",
+        "eth1_data", "eth1_data_votes", "eth1_deposit_index",
+        "validators", "balances",
+        "randao_mixes", "slashings",
+        "previous_epoch_participation", "current_epoch_participation",
+        "justification_bits", "previous_justified_checkpoint",
+        "current_justified_checkpoint", "finalized_checkpoint",
+        "inactivity_scores", "current_sync_committee", "next_sync_committee",
+    ]
+    for field in stable_fields:
+        assert getattr(pre_state, field) == getattr(post_state, field), field
+
+    assert post_state.fork.previous_version == pre_state.fork.current_version
+    assert bytes(post_state.fork.current_version) == bytes(
+        post_spec.config.BELLATRIX_FORK_VERSION
+    )
+    # The pre-merge payload header is empty
+    assert post_state.latest_execution_payload_header == post_spec.ExecutionPayloadHeader()
+    assert not post_spec.is_merge_transition_complete(post_state)
+
+    yield "post", post_state
+
+
+@with_phases([ALTAIR], other_phases=[BELLATRIX])
+@spec_test
+@with_custom_state(default_balances, default_activation_threshold)
+def test_fork_base_state(spec, state, phases):
+    yield from run_fork_test(phases[BELLATRIX], state)
+
+
+@with_phases([ALTAIR], other_phases=[BELLATRIX])
+@spec_test
+@with_custom_state(default_balances, default_activation_threshold)
+def test_fork_next_epoch(spec, state, phases):
+    next_epoch(spec, state)
+    yield from run_fork_test(phases[BELLATRIX], state)
+
+
+@with_phases([ALTAIR], other_phases=[BELLATRIX])
+@spec_test
+@with_custom_state(default_balances, default_activation_threshold)
+def test_fork_next_epoch_with_block(spec, state, phases):
+    next_epoch_via_block(spec, state)
+    yield from run_fork_test(phases[BELLATRIX], state)
+
+
+@with_phases([ALTAIR], other_phases=[BELLATRIX])
+@spec_test
+@with_custom_state(misc_balances, default_activation_threshold)
+def test_fork_misc_balances(spec, state, phases):
+    yield from run_fork_test(phases[BELLATRIX], state)
+
+
+@with_phases([ALTAIR], other_phases=[BELLATRIX])
+@spec_test
+@with_custom_state(low_balances, zero_activation_threshold)
+def test_fork_low_balances(spec, state, phases):
+    yield from run_fork_test(phases[BELLATRIX], state)

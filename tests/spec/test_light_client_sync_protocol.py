@@ -1,0 +1,239 @@
+"""Light-client sync-protocol tests — drives validate/process_
+light_client_update and the forced-timeout path
+(ref: test/altair/unittests/test_sync_protocol.py; altair/sync-protocol.md)."""
+from consensus_specs_tpu.test_framework.attestations import (
+    next_epoch_with_attestations,
+)
+from consensus_specs_tpu.test_framework.block import build_empty_block_for_next_slot
+from consensus_specs_tpu.test_framework.context import (
+    always_bls,
+    expect_assertion_error,
+    spec_state_test,
+    with_altair_and_later,
+)
+from consensus_specs_tpu.test_framework.light_client import (
+    build_finality_branch,
+    empty_finality_branch,
+    empty_next_sync_committee_branch,
+    get_sync_aggregate_over_header,
+    initialize_light_client_store,
+    signed_block_header,
+)
+from consensus_specs_tpu.test_framework.state import (
+    next_slots,
+    state_transition_and_sign_block,
+)
+
+
+def _attested_block_header(spec, state):
+    """One block on top of `state`; returns (header, post_state)."""
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    return signed_block_header(spec, signed.message), state
+
+
+def _basic_update(spec, state, store, participation=None):
+    header, state = _attested_block_header(spec, state)
+    aggregate, _ = get_sync_aggregate_over_header(
+        spec, state, header, participation=participation
+    )
+    update = spec.LightClientUpdate(
+        attested_header=header,
+        next_sync_committee=spec.SyncCommittee(),
+        next_sync_committee_branch=empty_next_sync_committee_branch(spec),
+        finalized_header=spec.BeaconBlockHeader(),
+        finality_branch=empty_finality_branch(spec),
+        sync_aggregate=aggregate,
+        fork_version=state.fork.current_version,
+    )
+    return update, state
+
+
+@with_altair_and_later
+@spec_state_test
+def test_process_update_not_timeout(spec, state):
+    store = initialize_light_client_store(spec, state)
+    update, state = _basic_update(spec, state, store)
+
+    pre_finalized = store.finalized_header.copy()
+    spec.process_light_client_update(
+        store, update, state.slot, state.genesis_validators_root
+    )
+
+    # optimistic header advances; finalized does not (no finality proof)
+    assert store.optimistic_header == update.attested_header
+    assert store.finalized_header == pre_finalized
+    assert store.best_valid_update == update
+    assert store.current_max_active_participants == spec.SYNC_COMMITTEE_SIZE
+    yield "pre", state
+    yield "post", state
+
+
+@with_altair_and_later
+@spec_state_test
+def test_process_update_timeout_force_applies_best(spec, state):
+    store = initialize_light_client_store(spec, state)
+    update, state = _basic_update(spec, state, store)
+    spec.process_light_client_update(
+        store, update, state.slot, state.genesis_validators_root
+    )
+    assert store.best_valid_update == update
+
+    # past the update timeout, the stored best update is force-applied
+    timeout_slot = store.finalized_header.slot + spec.UPDATE_TIMEOUT + 1
+    spec.process_slot_for_light_client_store(store, timeout_slot)
+    assert store.finalized_header == update.attested_header
+    assert store.best_valid_update is None
+    yield "pre", state
+    yield "post", state
+
+
+@with_altair_and_later
+@spec_state_test
+def test_process_update_finality_applied(spec, state):
+    store = initialize_light_client_store(spec, state)
+
+    # build a finalizing chain, tracking blocks for the finalized header
+    all_blocks = []
+    for _ in range(4):
+        _, blocks, state = next_epoch_with_attestations(spec, state, True, True)
+        all_blocks.extend(blocks)
+    assert state.finalized_checkpoint.epoch > 0
+
+    # attested block on the tip; its state carries the finalized checkpoint
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    attested_header = signed_block_header(spec, signed.message)
+
+    finalized_root = state.finalized_checkpoint.root
+    finalized_block = next(
+        b.message for b in all_blocks
+        if spec.hash_tree_root(b.message) == finalized_root
+    )
+    finalized_header = signed_block_header(spec, finalized_block)
+    assert spec.hash_tree_root(finalized_header) == finalized_root
+
+    aggregate, _ = get_sync_aggregate_over_header(spec, state, attested_header)
+    update = spec.LightClientUpdate(
+        attested_header=attested_header,
+        next_sync_committee=spec.SyncCommittee(),
+        next_sync_committee_branch=empty_next_sync_committee_branch(spec),
+        finalized_header=finalized_header,
+        finality_branch=build_finality_branch(spec, state),
+        sync_aggregate=aggregate,
+        fork_version=state.fork.current_version,
+    )
+
+    spec.process_light_client_update(
+        store, update, state.slot, state.genesis_validators_root
+    )
+    assert store.finalized_header == finalized_header
+    assert store.best_valid_update is None
+    yield "pre", state
+    yield "post", state
+
+
+@with_altair_and_later
+@spec_state_test
+@always_bls
+def test_invalid_update_bad_signature(spec, state):
+    store = initialize_light_client_store(spec, state)
+    update, state = _basic_update(spec, state, store)
+    tampered = update.copy()
+    tampered.attested_header.proposer_index += 1  # signature no longer covers
+    expect_assertion_error(
+        lambda: spec.validate_light_client_update(
+            store, tampered, state.slot, state.genesis_validators_root
+        )
+    )
+    yield "pre", state
+    yield "post", None
+
+
+@with_altair_and_later
+@spec_state_test
+def test_invalid_update_no_participants(spec, state):
+    store = initialize_light_client_store(spec, state)
+    update, state = _basic_update(spec, state, store, participation=0.0)
+    assert sum(update.sync_aggregate.sync_committee_bits) == 0
+    expect_assertion_error(
+        lambda: spec.validate_light_client_update(
+            store, update, state.slot, state.genesis_validators_root
+        )
+    )
+    yield "pre", state
+    yield "post", None
+
+
+@with_altair_and_later
+@spec_state_test
+def test_invalid_update_future_header(spec, state):
+    store = initialize_light_client_store(spec, state)
+    update, state = _basic_update(spec, state, store)
+    # current slot behind the attested header
+    expect_assertion_error(
+        lambda: spec.validate_light_client_update(
+            store, update, update.attested_header.slot - 1, state.genesis_validators_root
+        )
+    )
+    yield "pre", state
+    yield "post", None
+
+
+@with_altair_and_later
+@spec_state_test
+def test_invalid_update_bad_finality_branch(spec, state):
+    store = initialize_light_client_store(spec, state)
+    for _ in range(4):
+        _, blocks, state = next_epoch_with_attestations(spec, state, True, True)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    attested_header = signed_block_header(spec, signed.message)
+    aggregate, _ = get_sync_aggregate_over_header(spec, state, attested_header)
+
+    update = spec.LightClientUpdate(
+        attested_header=attested_header,
+        next_sync_committee=spec.SyncCommittee(),
+        next_sync_committee_branch=empty_next_sync_committee_branch(spec),
+        finalized_header=spec.BeaconBlockHeader(slot=8),  # wrong header
+        finality_branch=build_finality_branch(spec, state),
+        sync_aggregate=aggregate,
+        fork_version=state.fork.current_version,
+    )
+    expect_assertion_error(
+        lambda: spec.validate_light_client_update(
+            store, update, state.slot, state.genesis_validators_root
+        )
+    )
+    yield "pre", state
+    yield "post", None
+
+
+@with_altair_and_later
+@spec_state_test
+def test_merkle_proof_helpers_match_gindices(spec, state):
+    """compute_merkle_proof output verifies against is_valid_merkle_branch
+    for both hardcoded light-client gindices."""
+    from consensus_specs_tpu.ssz.proof import compute_merkle_proof
+
+    root = spec.hash_tree_root(state)
+
+    branch = compute_merkle_proof(state, int(spec.FINALIZED_ROOT_INDEX))
+    assert spec.is_valid_merkle_branch(
+        leaf=spec.hash_tree_root(state.finalized_checkpoint.root),
+        branch=branch,
+        depth=spec.floorlog2(spec.FINALIZED_ROOT_INDEX),
+        index=spec.get_subtree_index(spec.FINALIZED_ROOT_INDEX),
+        root=root,
+    )
+
+    branch = compute_merkle_proof(state, int(spec.NEXT_SYNC_COMMITTEE_INDEX))
+    assert spec.is_valid_merkle_branch(
+        leaf=spec.hash_tree_root(state.next_sync_committee),
+        branch=branch,
+        depth=spec.floorlog2(spec.NEXT_SYNC_COMMITTEE_INDEX),
+        index=spec.get_subtree_index(spec.NEXT_SYNC_COMMITTEE_INDEX),
+        root=root,
+    )
+    yield "pre", state
+    yield "post", state

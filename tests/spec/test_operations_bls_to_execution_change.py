@@ -1,0 +1,107 @@
+"""process_bls_to_execution_change tests — capella
+(ref: test/capella/block_processing/test_process_bls_to_execution_change.py)."""
+from consensus_specs_tpu.test_framework.bls_to_execution_changes import (
+    get_signed_address_change,
+    run_bls_to_execution_change_processing,
+)
+from consensus_specs_tpu.test_framework.context import (
+    always_bls,
+    spec_state_test,
+    with_capella_and_later,
+)
+from consensus_specs_tpu.test_framework.keys import privkeys, pubkeys
+
+
+@with_capella_and_later
+@spec_state_test
+def test_success(spec, state):
+    signed_address_change = get_signed_address_change(spec, state)
+    yield from run_bls_to_execution_change_processing(spec, state, signed_address_change)
+
+
+@with_capella_and_later
+@spec_state_test
+def test_success_not_activated(spec, state):
+    validator_index = 3
+    validator = state.validators[validator_index]
+    validator.activation_eligibility_epoch += 4
+    validator.activation_epoch = spec.FAR_FUTURE_EPOCH
+    assert not spec.is_active_validator(validator, spec.get_current_epoch(state))
+    signed_address_change = get_signed_address_change(
+        spec, state, validator_index=validator_index
+    )
+    yield from run_bls_to_execution_change_processing(spec, state, signed_address_change)
+
+
+@with_capella_and_later
+@spec_state_test
+def test_success_exited(spec, state):
+    validator_index = 4
+    state.validators[validator_index].exit_epoch = spec.get_current_epoch(state)
+    signed_address_change = get_signed_address_change(
+        spec, state, validator_index=validator_index
+    )
+    yield from run_bls_to_execution_change_processing(spec, state, signed_address_change)
+
+
+@with_capella_and_later
+@spec_state_test
+def test_invalid_out_of_range_validator_index(spec, state):
+    signed_address_change = get_signed_address_change(
+        spec, state, validator_index=len(state.validators)
+    )
+    yield from run_bls_to_execution_change_processing(
+        spec, state, signed_address_change, valid=False
+    )
+
+
+@with_capella_and_later
+@spec_state_test
+def test_invalid_already_eth1_credentials(spec, state):
+    validator_index = 0
+    state.validators[validator_index].withdrawal_credentials = (
+        bytes(spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX) + b"\x00" * 11 + b"\x11" * 20
+    )
+    signed_address_change = get_signed_address_change(
+        spec, state, validator_index=validator_index
+    )
+    yield from run_bls_to_execution_change_processing(
+        spec, state, signed_address_change, valid=False
+    )
+
+
+@with_capella_and_later
+@spec_state_test
+def test_invalid_wrong_from_bls_pubkey(spec, state):
+    # credentials hash-commit to pubkeys[0]; claim pubkeys[1] instead
+    signed_address_change = get_signed_address_change(
+        spec,
+        state,
+        validator_index=0,
+        withdrawal_pubkey=pubkeys[1],
+        privkey=privkeys[1],
+    )
+    yield from run_bls_to_execution_change_processing(
+        spec, state, signed_address_change, valid=False
+    )
+
+
+@with_capella_and_later
+@spec_state_test
+@always_bls
+def test_invalid_bad_signature(spec, state):
+    signed_address_change = get_signed_address_change(spec, state)
+    signed_address_change.signature = spec.BLSSignature(b"\x42" * 96)
+    yield from run_bls_to_execution_change_processing(
+        spec, state, signed_address_change, valid=False
+    )
+
+
+@with_capella_and_later
+@spec_state_test
+@always_bls
+def test_invalid_signed_with_wrong_key(spec, state):
+    signed_address_change = get_signed_address_change(spec, state, privkey=privkeys[7])
+    yield from run_bls_to_execution_change_processing(
+        spec, state, signed_address_change, valid=False
+    )

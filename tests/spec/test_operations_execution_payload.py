@@ -1,0 +1,166 @@
+"""process_execution_payload tests — bellatrix+capella
+(ref: test/bellatrix/block_processing/test_process_execution_payload.py)."""
+from consensus_specs_tpu.test_framework.context import (
+    spec_state_test,
+    with_bellatrix_and_later,
+)
+from consensus_specs_tpu.test_framework.execution_payload import (
+    build_empty_execution_payload,
+    build_state_with_complete_transition,
+    build_state_with_incomplete_transition,
+    compute_el_block_hash,
+    run_execution_payload_processing,
+)
+from consensus_specs_tpu.test_framework.state import next_slot
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_success_first_payload(spec, state):
+    state = build_state_with_incomplete_transition(spec, state)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    yield from run_execution_payload_processing(spec, state, payload)
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_success_regular_payload(spec, state):
+    state = build_state_with_complete_transition(spec, state)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    yield from run_execution_payload_processing(spec, state, payload)
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_success_first_payload_with_gap_slot(spec, state):
+    state = build_state_with_incomplete_transition(spec, state)
+    next_slot(spec, state)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    yield from run_execution_payload_processing(spec, state, payload)
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_success_regular_payload_with_gap_slot(spec, state):
+    state = build_state_with_complete_transition(spec, state)
+    next_slot(spec, state)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    yield from run_execution_payload_processing(spec, state, payload)
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_invalid_bad_execution_first_payload(spec, state):
+    # the execution engine rejects the payload
+    state = build_state_with_incomplete_transition(spec, state)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    yield from run_execution_payload_processing(
+        spec, state, payload, valid=False, execution_valid=False
+    )
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_invalid_bad_execution_regular_payload(spec, state):
+    state = build_state_with_complete_transition(spec, state)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    yield from run_execution_payload_processing(
+        spec, state, payload, valid=False, execution_valid=False
+    )
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_invalid_bad_parent_hash_regular_payload(spec, state):
+    state = build_state_with_complete_transition(spec, state)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.parent_hash = spec.Hash32(b"\x55" * 32)
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    yield from run_execution_payload_processing(spec, state, payload, valid=False)
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_bad_parent_hash_first_payload(spec, state):
+    # before the merge transition completes, parent_hash is unchecked
+    state = build_state_with_incomplete_transition(spec, state)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.parent_hash = spec.Hash32(b"\x55" * 32)
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    yield from run_execution_payload_processing(spec, state, payload)
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_invalid_bad_prev_randao_first_payload(spec, state):
+    state = build_state_with_incomplete_transition(spec, state)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.prev_randao = b"\x42" * 32
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    yield from run_execution_payload_processing(spec, state, payload, valid=False)
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_invalid_bad_prev_randao_regular_payload(spec, state):
+    state = build_state_with_complete_transition(spec, state)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.prev_randao = b"\x42" * 32
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    yield from run_execution_payload_processing(spec, state, payload, valid=False)
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_invalid_future_timestamp_regular_payload(spec, state):
+    state = build_state_with_complete_transition(spec, state)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.timestamp = payload.timestamp + 1
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    yield from run_execution_payload_processing(spec, state, payload, valid=False)
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_invalid_past_timestamp_first_payload(spec, state):
+    state = build_state_with_incomplete_transition(spec, state)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.timestamp = payload.timestamp - 1  # state is past genesis: > 0
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    yield from run_execution_payload_processing(spec, state, payload, valid=False)
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_non_empty_extra_data_regular_payload(spec, state):
+    state = build_state_with_complete_transition(spec, state)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.extra_data = spec.ByteList[spec.MAX_EXTRA_DATA_BYTES](b"\x45" * 12)
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    yield from run_execution_payload_processing(spec, state, payload)
+    assert state.latest_execution_payload_header.extra_data == payload.extra_data
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_nonzero_gas_used_regular_payload(spec, state):
+    state = build_state_with_complete_transition(spec, state)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.gas_used = 3_000_000
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    yield from run_execution_payload_processing(spec, state, payload)
+    assert state.latest_execution_payload_header.gas_used == payload.gas_used

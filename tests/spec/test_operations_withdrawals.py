@@ -1,0 +1,149 @@
+"""process_withdrawals + process_full_withdrawals tests — capella
+(ref: test/capella/block_processing/test_process_withdrawals.py,
+.../epoch_processing full-withdrawal coverage; spec v1.1.10 capella uses
+the withdrawals_queue model, capella/beacon-chain.md:337)."""
+from consensus_specs_tpu.test_framework.context import (
+    expect_assertion_error,
+    spec_state_test,
+    with_capella_and_later,
+)
+from consensus_specs_tpu.test_framework.execution_payload import (
+    build_empty_execution_payload,
+)
+from consensus_specs_tpu.test_framework.state import next_slot
+
+
+def _queue_withdrawal(spec, state, index, amount=None):
+    """Stage a withdrawal in the state queue the way the spec does."""
+    if amount is None:
+        amount = state.balances[index]
+    spec.withdraw_balance(state, index, amount)
+
+
+def run_withdrawals_processing(spec, state, payload, valid=True):
+    yield "pre", state
+    yield "execution_payload", payload
+    if not valid:
+        expect_assertion_error(lambda: spec.process_withdrawals(state, payload))
+        yield "post", None
+        return
+    pre_queue = list(state.withdrawals_queue)
+    spec.process_withdrawals(state, payload)
+    yield "post", state
+    consumed = len(payload.withdrawals)
+    assert list(state.withdrawals_queue) == pre_queue[consumed:]
+
+
+@with_capella_and_later
+@spec_state_test
+def test_success_empty_queue(spec, state):
+    assert len(state.withdrawals_queue) == 0
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    yield from run_withdrawals_processing(spec, state, payload)
+
+
+@with_capella_and_later
+@spec_state_test
+def test_success_one_withdrawal(spec, state):
+    _queue_withdrawal(spec, state, 0, 1_000_000)
+    assert len(state.withdrawals_queue) == 1
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    assert len(payload.withdrawals) == 1
+    yield from run_withdrawals_processing(spec, state, payload)
+    assert state.withdrawal_index == 1
+
+
+@with_capella_and_later
+@spec_state_test
+def test_success_max_per_payload(spec, state):
+    for i in range(spec.MAX_WITHDRAWALS_PER_PAYLOAD + 2):
+        _queue_withdrawal(spec, state, i, 1_000_000)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    assert len(payload.withdrawals) == spec.MAX_WITHDRAWALS_PER_PAYLOAD
+    yield from run_withdrawals_processing(spec, state, payload)
+    assert len(state.withdrawals_queue) == 2
+
+
+@with_capella_and_later
+@spec_state_test
+def test_invalid_withdrawal_count_mismatch(spec, state):
+    _queue_withdrawal(spec, state, 0, 1_000_000)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.withdrawals = payload.withdrawals[:-1]  # drop the expected one
+    yield from run_withdrawals_processing(spec, state, payload, valid=False)
+
+
+@with_capella_and_later
+@spec_state_test
+def test_invalid_withdrawal_amount_mismatch(spec, state):
+    _queue_withdrawal(spec, state, 0, 1_000_000)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    wd = payload.withdrawals[0]
+    wd.amount += 1
+    payload.withdrawals[0] = wd
+    yield from run_withdrawals_processing(spec, state, payload, valid=False)
+
+
+@with_capella_and_later
+@spec_state_test
+def test_invalid_withdrawal_index_mismatch(spec, state):
+    _queue_withdrawal(spec, state, 0, 1_000_000)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    wd = payload.withdrawals[0]
+    wd.index += 1
+    payload.withdrawals[0] = wd
+    yield from run_withdrawals_processing(spec, state, payload, valid=False)
+
+
+@with_capella_and_later
+@spec_state_test
+def test_invalid_withdrawal_address_mismatch(spec, state):
+    _queue_withdrawal(spec, state, 0, 1_000_000)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    wd = payload.withdrawals[0]
+    wd.address = spec.ExecutionAddress(b"\x99" * 20)
+    payload.withdrawals[0] = wd
+    yield from run_withdrawals_processing(spec, state, payload, valid=False)
+
+
+@with_capella_and_later
+@spec_state_test
+def test_full_withdrawals_at_epoch_boundary(spec, state):
+    # make validator 0 fully withdrawable with eth1 credentials
+    index = 0
+    state.validators[index].withdrawal_credentials = (
+        bytes(spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX) + b"\x00" * 11 + b"\x11" * 20
+    )
+    state.validators[index].withdrawable_epoch = spec.get_current_epoch(state)
+    pre_balance = state.balances[index]
+    assert pre_balance > 0
+
+    yield "pre", state
+    spec.process_full_withdrawals(state)
+    yield "post", state
+
+    assert state.balances[index] == 0
+    assert len(state.withdrawals_queue) == 1
+    wd = state.withdrawals_queue[0]
+    assert wd.amount == pre_balance
+    assert bytes(wd.address) == b"\x11" * 20
+    assert state.validators[index].fully_withdrawn_epoch == spec.get_current_epoch(state)
+
+
+@with_capella_and_later
+@spec_state_test
+def test_full_withdrawals_skips_bls_credentials(spec, state):
+    # default mock credentials are BLS-prefixed: nothing is withdrawable
+    state.validators[0].withdrawable_epoch = spec.get_current_epoch(state)
+    yield "pre", state
+    spec.process_full_withdrawals(state)
+    yield "post", state
+    assert len(state.withdrawals_queue) == 0
+    assert state.balances[0] > 0

@@ -1,0 +1,44 @@
+"""Randomized block-sequence tests, all forks
+(ref: test/phase0/random/test_random.py — generated files in the
+reference; data-driven scenario table here)."""
+from consensus_specs_tpu.test_framework.context import (
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.test_framework.random_block_tests import run_random_scenario
+
+
+@with_all_phases
+@spec_state_test
+def test_randomized_0(spec, state):
+    yield from run_random_scenario(spec, state, "random_0", seed=440)
+
+
+@with_all_phases
+@spec_state_test
+def test_randomized_1(spec, state):
+    yield from run_random_scenario(spec, state, "random_1", seed=441)
+
+
+@with_all_phases
+@spec_state_test
+def test_randomized_2(spec, state):
+    yield from run_random_scenario(spec, state, "random_2", seed=442)
+
+
+@with_all_phases
+@spec_state_test
+def test_randomized_3(spec, state):
+    yield from run_random_scenario(spec, state, "random_3", seed=443)
+
+
+@with_all_phases
+@spec_state_test
+def test_randomized_leak_0(spec, state):
+    yield from run_random_scenario(spec, state, "leak_0", seed=444)
+
+
+@with_all_phases
+@spec_state_test
+def test_randomized_leak_1(spec, state):
+    yield from run_random_scenario(spec, state, "leak_1", seed=445)

@@ -1,0 +1,61 @@
+"""Rewards component-delta tests — basic scenarios
+(ref: test/phase0/rewards/test_basic.py + altair rewards via fork matrix)."""
+from consensus_specs_tpu.test_framework.context import (
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.test_framework import rewards
+
+
+@with_all_phases
+@spec_state_test
+def test_empty(spec, state):
+    yield from rewards.run_test_empty(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_full_all_correct(spec, state):
+    yield from rewards.run_test_full_all_correct(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_full_but_partial_participation(spec, state):
+    yield from rewards.run_test_full_but_partial_participation(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_half_full(spec, state):
+    yield from rewards.run_test_partial_participation(spec, state, 0.5)
+
+
+@with_all_phases
+@spec_state_test
+def test_quarter_full(spec, state):
+    yield from rewards.run_test_partial_participation(spec, state, 0.25)
+
+
+@with_all_phases
+@spec_state_test
+def test_with_not_yet_activated_validators(spec, state):
+    yield from rewards.run_test_with_not_yet_activated_validators(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_with_exited_validators(spec, state):
+    yield from rewards.run_test_with_exited_validators(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_with_slashed_validators(spec, state):
+    yield from rewards.run_test_with_slashed_validators(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_some_very_low_effective_balances_that_attested(spec, state):
+    yield from rewards.run_test_some_very_low_effective_balances_that_attested(spec, state)

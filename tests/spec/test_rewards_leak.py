@@ -1,0 +1,25 @@
+"""Rewards component-delta tests — inactivity-leak scenarios
+(ref: test/phase0/rewards/test_leak.py)."""
+from consensus_specs_tpu.test_framework.context import (
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.test_framework import rewards
+
+
+@with_all_phases
+@spec_state_test
+def test_full_leak(spec, state):
+    yield from rewards.run_test_full_leak(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_empty_leak(spec, state):
+    yield from rewards.run_test_empty_leak(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_random_leak(spec, state):
+    yield from rewards.run_test_random_leak(spec, state)

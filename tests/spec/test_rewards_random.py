@@ -1,0 +1,44 @@
+"""Rewards component-delta tests — seeded random scenarios
+(ref: test/phase0/rewards/test_random.py)."""
+from random import Random
+
+from consensus_specs_tpu.test_framework.context import (
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.test_framework import rewards
+
+
+def _run_random(spec, state, seed):
+    rng = Random(seed)
+    rewards.exit_random_validators(spec, state, rng, fraction=0.15)
+    rewards.slash_random_validators_clean(spec, state, rng, fraction=0.15)
+    rewards.prepare_state_with_attestations(spec, state)
+    from consensus_specs_tpu.test_framework.constants import is_post_altair
+
+    if is_post_altair(spec):
+        for index in range(len(state.validators)):
+            if rng.random() < 0.3:
+                state.previous_epoch_participation[index] = spec.ParticipationFlags(0)
+    else:
+        atts = list(state.previous_epoch_attestations)
+        state.previous_epoch_attestations = [a for a in atts if rng.random() < 0.7]
+    yield from rewards.run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_full_random_0(spec, state):
+    yield from _run_random(spec, state, 1010)
+
+
+@with_all_phases
+@spec_state_test
+def test_full_random_1(spec, state):
+    yield from _run_random(spec, state, 2020)
+
+
+@with_all_phases
+@spec_state_test
+def test_full_random_2(spec, state):
+    yield from _run_random(spec, state, 3030)

@@ -1,0 +1,66 @@
+"""Cross-fork transition tests: blocks driven across each fork boundary
+(ref: test/altair/transition/test_transition.py, 364 LoC + the
+transition generator, tests/generators/transition/)."""
+from consensus_specs_tpu.test_framework.context import (
+    ALTAIR,
+    BELLATRIX,
+    CAPELLA,
+    PHASE0,
+    default_activation_threshold,
+    default_balances,
+    spec_test,
+    with_custom_state,
+    with_phases,
+)
+from consensus_specs_tpu.test_framework.fork_transition import run_fork_transition
+
+
+def _make_tests(pre, post):
+    """Parameterize the three scenario shapes for one fork pair."""
+
+    @with_phases([pre], other_phases=[post])
+    @spec_test
+    @with_custom_state(default_balances, default_activation_threshold)
+    def test_normal_transition(spec, state, phases):
+        yield from run_fork_transition(spec, phases[post], state, fork_epoch=2)
+
+    @with_phases([pre], other_phases=[post])
+    @spec_test
+    @with_custom_state(default_balances, default_activation_threshold)
+    def test_transition_missing_first_post_block(spec, state, phases):
+        yield from run_fork_transition(
+            spec, phases[post], state, fork_epoch=2, blocks_after=1
+        )
+
+    @with_phases([pre], other_phases=[post])
+    @spec_test
+    @with_custom_state(default_balances, default_activation_threshold)
+    def test_transition_only_blocks_post_fork(spec, state, phases):
+        yield from run_fork_transition(
+            spec, phases[post], state, fork_epoch=1, blocks_before=False
+        )
+
+    return (
+        test_normal_transition,
+        test_transition_missing_first_post_block,
+        test_transition_only_blocks_post_fork,
+    )
+
+
+(
+    test_transition_to_altair,
+    test_transition_to_altair_short,
+    test_transition_to_altair_no_pre_blocks,
+) = _make_tests(PHASE0, ALTAIR)
+
+(
+    test_transition_to_bellatrix,
+    test_transition_to_bellatrix_short,
+    test_transition_to_bellatrix_no_pre_blocks,
+) = _make_tests(ALTAIR, BELLATRIX)
+
+(
+    test_transition_to_capella,
+    test_transition_to_capella_short,
+    test_transition_to_capella_no_pre_blocks,
+) = _make_tests(BELLATRIX, CAPELLA)

@@ -1,0 +1,113 @@
+"""validate_merge_block unit tests — bellatrix
+(ref: test/bellatrix/fork_choice/test_validate_merge_block.py;
+bellatrix/fork-choice.md:125)."""
+from consensus_specs_tpu.test_framework.block import build_empty_block_for_next_slot
+from consensus_specs_tpu.test_framework.context import (
+    expect_assertion_error,
+    spec_state_test,
+    with_bellatrix_and_later,
+    with_config_overrides,
+    with_phases,
+)
+from consensus_specs_tpu.test_framework.constants import BELLATRIX, CAPELLA
+from consensus_specs_tpu.test_framework.pow_block import (
+    patch_pow_chain,
+    prepare_pow_block,
+    prepare_terminal_pow_chain,
+)
+
+
+PARENT_HASH = b"\xaa" * 32
+
+
+def _merge_block(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.execution_payload.parent_hash = PARENT_HASH
+    return block
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_validate_merge_block_success(spec, state):
+    chain = prepare_terminal_pow_chain(spec, PARENT_HASH)
+    block = _merge_block(spec, state)
+    with patch_pow_chain(spec, chain):
+        spec.validate_merge_block(block)
+    yield "pre", state
+    yield "post", state
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_invalid_pow_block_lookup_fails(spec, state):
+    block = _merge_block(spec, state)
+    with patch_pow_chain(spec, []):
+        expect_assertion_error(lambda: spec.validate_merge_block(block))
+    yield "pre", state
+    yield "post", None
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_invalid_pow_parent_lookup_fails(spec, state):
+    chain = prepare_terminal_pow_chain(spec, PARENT_HASH)[1:]  # drop grandparent
+    block = _merge_block(spec, state)
+    with patch_pow_chain(spec, chain):
+        expect_assertion_error(lambda: spec.validate_merge_block(block))
+    yield "pre", state
+    yield "post", None
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_invalid_terminal_difficulty_not_reached(spec, state):
+    chain = prepare_terminal_pow_chain(spec, PARENT_HASH)
+    chain[1].total_difficulty = int(spec.config.TERMINAL_TOTAL_DIFFICULTY) - 1
+    block = _merge_block(spec, state)
+    with patch_pow_chain(spec, chain):
+        expect_assertion_error(lambda: spec.validate_merge_block(block))
+    yield "pre", state
+    yield "post", None
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_invalid_parent_already_terminal(spec, state):
+    chain = prepare_terminal_pow_chain(spec, PARENT_HASH)
+    chain[0].total_difficulty = int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
+    block = _merge_block(spec, state)
+    with patch_pow_chain(spec, chain):
+        expect_assertion_error(lambda: spec.validate_merge_block(block))
+    yield "pre", state
+    yield "post", None
+
+
+@with_phases([BELLATRIX, CAPELLA])
+@with_config_overrides(
+    {
+        "TERMINAL_BLOCK_HASH": b"\xcd" * 32,
+        "TERMINAL_BLOCK_HASH_ACTIVATION_EPOCH": 0,
+    }
+)
+@spec_state_test
+def test_terminal_block_hash_override_success(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.execution_payload.parent_hash = b"\xcd" * 32
+    spec.validate_merge_block(block)  # no PoW lookups in override mode
+    yield "pre", state
+    yield "post", state
+
+
+@with_phases([BELLATRIX, CAPELLA])
+@with_config_overrides(
+    {
+        "TERMINAL_BLOCK_HASH": b"\xcd" * 32,
+        "TERMINAL_BLOCK_HASH_ACTIVATION_EPOCH": 0,
+    }
+)
+@spec_state_test
+def test_invalid_terminal_block_hash_override_mismatch(spec, state):
+    block = _merge_block(spec, state)  # parent_hash != TERMINAL_BLOCK_HASH
+    expect_assertion_error(lambda: spec.validate_merge_block(block))
+    yield "pre", state
+    yield "post", None

@@ -1,0 +1,83 @@
+"""Native (C) host-side kernels, compiled on first import and bound via
+ctypes (no pybind11 in the image; the CPython-free ctypes ABI keeps the
+build a single `gcc -shared` call).
+
+The reference's host-native components arrive as pip deps (pycryptodome C
+SHA-256, milagro C BLS — SURVEY §2.5); here they are built in-tree. A
+failed build degrades gracefully: callers fall back to hashlib.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "sha256_batch.c")
+_SO = os.path.join(_DIR, f"_sha256_batch_{sys.platform}.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _cpu_has_sha_ni() -> bool:
+    try:
+        with open("/proc/cpuinfo") as f:
+            return "sha_ni" in f.read()
+    except OSError:
+        return False
+
+
+def _build() -> Optional[str]:
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return _SO
+    flags = ["-O3", "-fPIC", "-shared"]
+    if _cpu_has_sha_ni():
+        flags += ["-msha", "-mssse3", "-msse4.1"]
+    cmd = ["gcc", *flags, _SRC, "-o", _SO]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return _SO
+    except (subprocess.SubprocessError, OSError):
+        return None
+
+
+def load_sha256() -> Optional[ctypes.CDLL]:
+    """The compiled batch-SHA256 library, or None when unavailable."""
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    so = _build()
+    if so is None:
+        return None
+    try:
+        lib = ctypes.CDLL(so)
+        for name in ("sha256_pairs", "sha256_raw"):
+            fn = getattr(lib, name)
+            fn.restype = None
+            fn.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p]
+        _lib = lib
+    except OSError:
+        _lib = None
+    return _lib
+
+
+def sha256_pairs(data: bytes) -> bytes:
+    """SHA-256 of each 64-byte block of `data`, concatenated (C loop)."""
+    lib = load_sha256()
+    n = len(data) // 64
+    out = ctypes.create_string_buffer(32 * n)
+    lib.sha256_pairs(data, n, out)
+    return out.raw
+
+
+def sha256_raw_blocks(data: bytes) -> bytes:
+    """Single-compression digests of already-padded 64-byte blocks."""
+    lib = load_sha256()
+    n = len(data) // 64
+    out = ctypes.create_string_buffer(32 * n)
+    lib.sha256_raw(data, n, out)
+    return out.raw

@@ -14,6 +14,7 @@ time — static control flow, XLA fuses the whole pipeline.
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -137,6 +138,33 @@ def merkle_reduce_jit(chunks: jnp.ndarray, levels: int) -> jnp.ndarray:
     return chunks[0]
 
 
+@functools.partial(jax.jit, static_argnums=(1,))
+def merkle_levels_jit(chunks: jnp.ndarray, levels: int):
+    """All interior Merkle levels of (N, 8)-word chunks in ONE dispatch.
+
+    Returns a list of (N/2^k, 8) arrays, k = 1..levels. One upload, one
+    download, no per-level round trips — the shape ChunkTree._full_build
+    wants when materializing interior nodes for incremental updates."""
+    out = []
+    for _ in range(levels):
+        chunks = sha256_of_block(chunks.reshape(chunks.shape[0] // 2, 16))
+        out.append(chunks)
+    return out
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def item_roots_jit(chunks: jnp.ndarray, levels: int) -> jnp.ndarray:
+    """Per-item roots of N independent 2**levels-chunk subtrees.
+
+    chunks: (N * 2**levels, 8) words laid out item-major, so a flat
+    pairwise reduce never crosses item boundaries. Returns (N, 8) roots —
+    the download is 1/2**levels of the upload (the batched-registry
+    leaf-root case: one dispatch for a million Validator roots)."""
+    for _ in range(levels):
+        chunks = sha256_of_block(chunks.reshape(chunks.shape[0] // 2, 16))
+    return chunks
+
+
 # --- host-facing byte APIs -------------------------------------------------
 
 
@@ -204,15 +232,104 @@ def hash_small_device(messages) -> list:
     return [raw[32 * i : 32 * i + 32] for i in range(m)]
 
 
-def use_device_hasher() -> None:
-    """Install the JAX batched hasher as the SSZ merkleization backend,
-    including the fused whole-tree root path (one dispatch per large
-    tree instead of one per level)."""
+def tree_levels_device(leaves: bytes) -> list:
+    """All interior levels of a pow2-padded chunk tree in ONE dispatch
+    (`hashing` tree backend). Returns packed level bytes, height 1 up."""
+    from ..ssz.merkle import ceil_log2, next_pow2
+
+    n = len(leaves) // 32
+    size = next_pow2(n)
+    padded = leaves + b"\x00" * ((size - n) * 32)
+    words = jnp.asarray(_bytes_to_words(bytes(padded), 8))
+    levels = merkle_levels_jit(words, ceil_log2(size))
+    return [_words_to_bytes(np.asarray(lv)) for lv in levels]
+
+
+def item_roots_device(packed: bytes, chunks_per_item: int) -> bytes:
+    """Roots of N independent `chunks_per_item`(=2^k)-chunk subtrees laid
+    out item-major in `packed` — one dispatch, download is N*32 bytes."""
+    from ..ssz.merkle import ceil_log2
+
+    words = jnp.asarray(_bytes_to_words(packed, 8))
+    roots = np.asarray(item_roots_jit(words, ceil_log2(chunks_per_item)))
+    return _words_to_bytes(roots)
+
+
+def calibrate_thresholds() -> dict:
+    """Measure dispatch floor + transfer slope vs host hashlib and set the
+    `hashing` size thresholds so the device only gets batches it wins.
+
+    Matters because the device may sit behind a high-latency tunnel
+    (dispatch floor ~70ms observed) or be a local chip (~100µs): a fixed
+    threshold is wrong for one of them."""
+    import time
+
+    from ..ssz import hashing
+
+    # host rate: MB/s of hashlib over 1 MiB of 64-byte blocks
+    data = b"\x5a" * (1 << 20)
+    t0 = time.perf_counter()
+    hashing._host_hash_many(data)
+    host_bps = len(data) / (time.perf_counter() - t0)
+
+    # device: floor (tiny fused call) + slope (4 MiB fused call)
+    small = jnp.zeros((64, 8), dtype=jnp.uint32)
+    np.asarray(merkle_reduce_jit(small, 6))  # compile
+    t0 = time.perf_counter()
+    np.asarray(merkle_reduce_jit(small, 6))
+    floor_s = time.perf_counter() - t0
+    big_n = 1 << 17  # 4 MiB of chunks
+    bigw = np.zeros((big_n, 8), dtype=np.uint32)
+    np.asarray(merkle_reduce_jit(jnp.asarray(bigw), 17))  # compile
+    t0 = time.perf_counter()
+    np.asarray(merkle_reduce_jit(jnp.asarray(bigw), 17))
+    big_s = time.perf_counter() - t0
+    slope = max((big_s - floor_s) / (big_n * 32), 1e-12)  # s/byte incl. upload
+
+    # fused-root break-even: host bytes/s vs floor + slope*bytes
+    host_sbp = 1.0 / host_bps
+    if host_sbp > slope:
+        be_bytes = floor_s / (host_sbp - slope)
+        fused_min = max(128, int(be_bytes // 32))
+    else:
+        fused_min = 1 << 62  # device never wins: effectively disable
+    hashing.FUSED_ROOT_MIN_CHUNKS = fused_min
+    # hash_many round-trips half the data back: add download slope ~= upload
+    hm_slope = slope * 1.5
+    if host_sbp > hm_slope:
+        hashing.DEVICE_MIN_BLOCKS = max(64, int(floor_s / (host_sbp - hm_slope) // 64))
+    else:
+        hashing.DEVICE_MIN_BLOCKS = 1 << 62
+    return {
+        "host_mibs": host_bps / (1 << 20),
+        "floor_ms": floor_s * 1e3,
+        "slope_ns_per_byte": slope * 1e9,
+        "fused_min_chunks": hashing.FUSED_ROOT_MIN_CHUNKS,
+        "device_min_blocks": hashing.DEVICE_MIN_BLOCKS,
+    }
+
+
+def use_device_hasher(calibrate: bool = True) -> Optional[dict]:
+    """Install the JAX batched hasher as the SSZ merkleization backend:
+    per-level batches, fused whole-tree roots, fused interior-level builds,
+    and fused per-item subtree roots — each a single dispatch.
+
+    With ``calibrate`` (default), measures the device's dispatch floor and
+    transfer slope against host hashing and sets routing thresholds — which
+    can conclude the device NEVER wins (e.g. a tunneled remote chip vs a
+    SHA-NI host) and route everything to host. Returns the calibration
+    report so callers can see (and log) what was decided; pass
+    ``calibrate=False`` to force device routing at the default thresholds."""
     from ..ssz import hashing
 
     hashing.set_backend(hash_many_device, name="jax")
     hashing.set_small_backend(hash_small_device)
     hashing.set_fused_root_backend(merkle_root_device)
+    hashing.set_tree_backend(tree_levels_device)
+    hashing.set_item_roots_backend(item_roots_device)
+    if calibrate:
+        return calibrate_thresholds()
+    return None
 
 
 def use_host_hasher() -> None:
@@ -221,3 +338,5 @@ def use_host_hasher() -> None:
     hashing.set_backend(None)
     hashing.set_small_backend(None)
     hashing.set_fused_root_backend(None)
+    hashing.set_tree_backend(None)
+    hashing.set_item_roots_backend(None)

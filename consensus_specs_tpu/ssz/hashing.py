@@ -19,7 +19,7 @@ from typing import Callable, Optional
 HashManyFn = Callable[[bytes], bytes]
 
 
-def _host_hash_many(data: bytes) -> bytes:
+def _hashlib_hash_many(data: bytes) -> bytes:
     n = len(data) // 64
     out = bytearray(32 * n)
     sha = hashlib.sha256
@@ -28,15 +28,54 @@ def _host_hash_many(data: bytes) -> bytes:
     return bytes(out)
 
 
+_native = None
+_native_tried = False
+
+
+def _get_native():
+    """The in-tree C batch hasher (SHA-NI when the host has it) — the
+    analog of the reference's pycryptodome C backend. Lazily built on
+    first hash (not at import: the build shells out to gcc). None if the
+    toolchain is unavailable; callers fall back to hashlib."""
+    global _native, _native_tried
+    if _native_tried:
+        return _native
+    _native_tried = True
+    try:
+        from ..native import load_sha256, sha256_pairs, sha256_raw_blocks
+
+        if load_sha256() is not None:
+            _native = (sha256_pairs, sha256_raw_blocks)
+    except Exception as e:  # degraded but functional: record why for debugging
+        global _native_error
+        _native_error = e
+    return _native
+
+
+_native_error: Optional[Exception] = None
+
+
+def _host_hash_many(data: bytes) -> bytes:
+    native = _get_native()
+    if native is not None:
+        return native[0](data)
+    return _hashlib_hash_many(data)
+
+
 _backend: HashManyFn = _host_hash_many
 _backend_name: str = "hashlib"
+
+_DEFAULT_DEVICE_MIN_BLOCKS = 64
+_DEFAULT_FUSED_ROOT_MIN_CHUNKS = 128
 
 
 def set_backend(fn: Optional[HashManyFn], name: str = "custom") -> None:
     """Install a batched hasher; ``None`` restores the hashlib host backend."""
-    global _backend, _backend_name
+    global _backend, _backend_name, DEVICE_MIN_BLOCKS, FUSED_ROOT_MIN_CHUNKS
     if fn is None:
         _backend, _backend_name = _host_hash_many, "hashlib"
+        DEVICE_MIN_BLOCKS = _DEFAULT_DEVICE_MIN_BLOCKS
+        FUSED_ROOT_MIN_CHUNKS = _DEFAULT_FUSED_ROOT_MIN_CHUNKS
     else:
         _backend, _backend_name = fn, name
 
@@ -45,17 +84,27 @@ def backend_name() -> str:
     return _backend_name
 
 
+DEVICE_MIN_BLOCKS = 64  # below this, host hashlib beats the dispatch overhead
+
+
 def hash_many(data: bytes) -> bytes:
-    """SHA-256 of each consecutive 64-byte block of ``data``, concatenated."""
+    """SHA-256 of each consecutive 64-byte block of ``data``, concatenated.
+
+    Small batches always run on host even when a device backend is
+    installed: a device dispatch costs ~100µs while hashlib does a 64-byte
+    block in ~1µs, so sub-``DEVICE_MIN_BLOCKS`` batches never win on device.
+    """
     if len(data) % 64:
         raise ValueError(f"hash_many input must be a multiple of 64 bytes, got {len(data)}")
     if not data:
         return b""
+    if _backend is not _host_hash_many and len(data) < 64 * DEVICE_MIN_BLOCKS:
+        return _host_hash_many(data)
     return _backend(data)
 
 
 _fused_root_backend: Optional[Callable] = None
-FUSED_ROOT_MIN_CHUNKS = 256  # below this, dispatch overhead beats the device
+FUSED_ROOT_MIN_CHUNKS = 128  # below this, dispatch overhead beats the device
 
 
 def set_fused_root_backend(fn: Optional[Callable]) -> None:
@@ -73,6 +122,51 @@ def fused_root(chunks: bytes, limit: int) -> Optional[bytes]:
     if _fused_root_backend is None or len(chunks) < 32 * FUSED_ROOT_MIN_CHUNKS:
         return None
     return _fused_root_backend(chunks, limit)
+
+
+_tree_backend: Optional[Callable] = None
+TREE_DEVICE_MIN_CHUNKS = 1 << 15
+
+
+def set_tree_backend(fn: Optional[Callable]) -> None:
+    """Install a whole-tree interior-level builder: ``fn(leaves: bytes) ->
+    [level_bytes]`` returns every interior Merkle level (height 1 upward,
+    pow2-padded) in ONE device dispatch — used by ChunkTree when
+    materializing levels for incremental updates."""
+    global _tree_backend
+    _tree_backend = fn
+
+
+def tree_levels(leaves: bytes) -> Optional[list]:
+    """Fused interior-level build, or None when no backend is installed or
+    the tree is too small for a dispatch to win."""
+    if _tree_backend is None or len(leaves) < 32 * TREE_DEVICE_MIN_CHUNKS:
+        return None
+    return _tree_backend(leaves)
+
+
+_item_roots_backend: Optional[Callable] = None
+ITEM_ROOTS_MIN_ITEMS = 1 << 14
+
+
+def set_item_roots_backend(fn: Optional[Callable]) -> None:
+    """Install a per-item subtree-root kernel: ``fn(packed: bytes,
+    chunks_per_item: int) -> bytes`` reduces N independent pow2-chunk
+    subtrees (item-major layout) to N 32-byte roots in one dispatch."""
+    global _item_roots_backend
+    _item_roots_backend = fn
+
+
+def item_roots(packed: bytes, chunks_per_item: int) -> bytes:
+    """Batched independent-subtree roots; host fallback reduces level by
+    level through `hash_many` (item-major layout keeps items disjoint)."""
+    n_items = len(packed) // (32 * chunks_per_item)
+    if _item_roots_backend is not None and n_items >= ITEM_ROOTS_MIN_ITEMS:
+        return _item_roots_backend(packed, chunks_per_item)
+    nodes = packed
+    while len(nodes) > 32 * n_items:
+        nodes = hash_many(nodes)
+    return nodes
 
 
 _small_backend: Optional[Callable] = None
@@ -94,6 +188,18 @@ def sha256_many_small(messages) -> list:
     hashlib."""
     if _small_backend is not None:
         return _small_backend(messages)
+    native = _get_native()
+    if native is not None and len(messages) >= 16 and all(len(m) <= 55 for m in messages):
+        # pad each message into one raw block on host, hash the batch in C
+        # (>55 bytes would need a second compression block — hashlib path)
+        buf = bytearray(64 * len(messages))
+        for i, m in enumerate(messages):
+            off = 64 * i
+            buf[off : off + len(m)] = m
+            buf[off + len(m)] = 0x80
+            buf[off + 56 : off + 64] = (8 * len(m)).to_bytes(8, "big")
+        raw = native[1](bytes(buf))
+        return [raw[32 * i : 32 * i + 32] for i in range(len(messages))]
     sha = hashlib.sha256
     return [sha(m).digest() for m in messages]
 

@@ -35,13 +35,19 @@ def _reduce_level(nodes: List[bytes], zero: bytes) -> List[bytes]:
     return [digests[32 * i : 32 * i + 32] for i in range(len(nodes) // 2)]
 
 
-def merkleize_chunks(chunks: Sequence[bytes], limit: Optional[int] = None) -> bytes:
+def merkleize_chunks(chunks, limit: Optional[int] = None) -> bytes:
     """Root of the Merkle tree over `chunks`, zero-padded to `limit` leaves.
 
-    `limit=None` pads to next_pow2(len(chunks)) (simple-serialize.md merkleize
-    with no limit). Matches merkle_minimal.merkleize_chunks:47-89 semantics.
+    `chunks` is either packed bytes (length a multiple of 32 — the fast,
+    contiguous path) or a sequence of 32-byte chunk objects. `limit=None`
+    pads to next_pow2(count) (simple-serialize.md merkleize with no limit).
+    Matches merkle_minimal.merkleize_chunks:47-89 semantics.
     """
-    count = len(chunks)
+    if isinstance(chunks, (bytes, bytearray, memoryview)):
+        data = bytes(chunks)
+    else:
+        data = b"".join(chunks)
+    count = len(data) // 32
     if limit is None:
         limit = max(count, 1)
     if count > limit:
@@ -52,15 +58,17 @@ def merkleize_chunks(chunks: Sequence[bytes], limit: Optional[int] = None) -> by
     if count >= 2:
         # large trees: whole-tree device reduce in one dispatch (chunk
         # data crosses to HBM once; only the 32-byte root returns)
-        root = fused_root(b"".join(chunks), limit)
+        root = fused_root(data, limit)
         if root is not None:
             return root
-    nodes = list(chunks)
+    nodes = data
     level = 0
-    while len(nodes) > 1:
-        nodes = _reduce_level(nodes, ZERO_HASHES[level])
+    while len(nodes) > 32:
+        if (len(nodes) // 32) % 2:
+            nodes = nodes + ZERO_HASHES[level]
+        nodes = hash_many(nodes)
         level += 1
-    root = nodes[0]
+    root = nodes
     while level < depth:
         root = hash_many(root + ZERO_HASHES[level])
         level += 1

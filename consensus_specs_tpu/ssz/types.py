@@ -19,8 +19,13 @@ test helpers that move containers between a state and a block/payload must
 """
 from __future__ import annotations
 
+import sys
+import weakref
+from array import array
 from typing import Any, Dict, Iterable, Optional, Sequence, Tuple, Type
 
+from . import hashing
+from .backing import ChunkTree
 from .merkle import (
     ceil_log2,
     merkleize_chunks,
@@ -33,10 +38,84 @@ BYTES_PER_CHUNK = 32
 OFFSET_BYTE_LENGTH = 4
 
 
-def _pack_bytes_to_chunks(data: bytes) -> list:
+def _pad_to_chunks(data: bytes) -> bytes:
     if len(data) % BYTES_PER_CHUNK:
-        data = data + b"\x00" * (BYTES_PER_CHUNK - len(data) % BYTES_PER_CHUNK)
-    return [data[i : i + BYTES_PER_CHUNK] for i in range(0, len(data), BYTES_PER_CHUNK)]
+        return data + b"\x00" * (BYTES_PER_CHUNK - len(data) % BYTES_PER_CHUNK)
+    return data
+
+
+class _Cached:
+    """Incremental-root machinery shared by all mutable composites.
+
+    The remerkleable capability (ssz_impl.py:11-13 — per-node root caching
+    with structural sharing) rebuilt for a value-backed object model:
+
+    - every composite caches its `hash_tree_root` (`_ht_cache`);
+    - children keep weakrefs to their parents + the slot they occupy, so an
+      in-place mutation anywhere invalidates exactly the ancestor chain
+      (O(depth), not O(state));
+    - sequences additionally record WHICH slots went dirty, so a root
+      recompute re-hashes only dirty subtrees (see `ChunkTree`).
+
+    Invariant: whenever a composite's `_ht_cache` is None, every parent has
+    already been notified (its cache is cleared and, for sequences, the
+    child's slot is in its dirty set). Established at mutation time by
+    `_mark_self_dirty`/`_receive_dirty` and at link time because linking
+    happens during root computation (which fills the cache).
+    """
+
+    _ht_cache: Optional[bytes] = None
+    _parents: Optional[list] = None
+
+    def _set_cache(self, v: Optional[bytes]) -> None:
+        object.__setattr__(self, "_ht_cache", v)
+
+    def _link_child(self, child, slot) -> None:
+        """Record that `child` occupies `slot` of self (idempotent)."""
+        if not isinstance(child, _Cached):
+            return
+        ps = child._parents
+        if ps is None:
+            ps = []
+            object.__setattr__(child, "_parents", ps)
+        for r, s in ps:
+            if s == slot and r() is self:
+                return
+        ps.append((weakref.ref(self), slot))
+
+    def _receive_dirty(self, slot) -> bool:
+        """A child at `slot` changed. Returns True if this node was clean
+        (so its own parents need notifying in turn)."""
+        if self._ht_cache is None:
+            return False
+        self._set_cache(None)
+        return True
+
+    def _bubble(self) -> None:
+        """Propagate invalidation to all (live) ancestors."""
+        stack: list = [self]
+        while stack:
+            obj = stack.pop()
+            ps = obj._parents
+            if not ps:
+                continue
+            dead = False
+            for ref, slot in ps:
+                p = ref()
+                if p is None:
+                    dead = True
+                    continue
+                if p._receive_dirty(slot):
+                    stack.append(p)
+            if dead:
+                object.__setattr__(obj, "_parents", [(r, s) for r, s in ps if r() is not None])
+
+    def _mark_self_dirty(self) -> None:
+        """Call after any in-place mutation of this value."""
+        if self._ht_cache is not None:
+            self._set_cache(None)
+            self._bubble()
+        # cache already None ⇒ ancestors were notified when it was cleared
 
 
 class SSZType:
@@ -230,7 +309,13 @@ class ByteVector(bytes, SSZType):
         return bytes(self)
 
     def hash_tree_root(self) -> bytes:
-        return merkleize_chunks(_pack_bytes_to_chunks(bytes(self)), limit=(self.length + 31) // 32)
+        # immutable: root cached per instance, computed lazily
+        try:
+            return self._htr
+        except AttributeError:
+            root = merkleize_chunks(_pad_to_chunks(bytes(self)), limit=(self.length + 31) // 32)
+            self._htr = root
+            return root
 
     def copy(self):
         return self
@@ -285,8 +370,15 @@ class ByteList(bytes, SSZType):
         return bytes(self)
 
     def hash_tree_root(self) -> bytes:
-        root = merkleize_chunks(_pack_bytes_to_chunks(bytes(self)), limit=(self.limit + 31) // 32)
-        return mix_in_length(root, len(self))
+        try:
+            return self._htr
+        except AttributeError:
+            root = mix_in_length(
+                merkleize_chunks(_pad_to_chunks(bytes(self)), limit=(self.limit + 31) // 32),
+                len(self),
+            )
+            self._htr = root
+            return root
 
     def copy(self):
         return self
@@ -308,7 +400,7 @@ def _bits_to_bytes(bits: Sequence[bool]) -> bytes:
     return bytes(out)
 
 
-class _BitsBase(SSZType):
+class _BitsBase(_Cached, SSZType):
     def __init__(self, *args):
         if len(args) == 1 and isinstance(args[0], (list, tuple, _BitsBase)):
             bits = [bool(b) for b in args[0]]
@@ -341,6 +433,7 @@ class _BitsBase(SSZType):
             self._bits = new_bits
         else:
             self._bits[i] = bool(v)
+        self._mark_self_dirty()
 
     def _type_key(self):
         bound = self.length if isinstance(self, Bitvector) else self.limit
@@ -365,7 +458,9 @@ class _BitsBase(SSZType):
         return f"{type(self).__name__}({''.join('1' if b else '0' for b in self._bits)})"
 
     def copy(self):
-        return type(self)(self._bits)
+        new = type(self)(self._bits)
+        new._set_cache(self._ht_cache)
+        return new
 
 
 class Bitvector(_BitsBase):
@@ -410,9 +505,13 @@ class Bitvector(_BitsBase):
         return _bits_to_bytes(self._bits)
 
     def hash_tree_root(self) -> bytes:
-        return merkleize_chunks(
-            _pack_bytes_to_chunks(self.encode_bytes()), limit=(self.length + 255) // 256
-        )
+        c = self._ht_cache
+        if c is None:
+            c = merkleize_chunks(
+                _pad_to_chunks(self.encode_bytes()), limit=(self.length + 255) // 256
+            )
+            self._set_cache(c)
+        return c
 
 
 class Bitlist(_BitsBase):
@@ -455,10 +554,14 @@ class Bitlist(_BitsBase):
         return bytes(out)
 
     def hash_tree_root(self) -> bytes:
-        root = merkleize_chunks(
-            _pack_bytes_to_chunks(_bits_to_bytes(self._bits)), limit=(self.limit + 255) // 256
-        )
-        return mix_in_length(root, len(self._bits))
+        c = self._ht_cache
+        if c is None:
+            root = merkleize_chunks(
+                _pad_to_chunks(_bits_to_bytes(self._bits)), limit=(self.limit + 255) // 256
+            )
+            c = mix_in_length(root, len(self._bits))
+            self._set_cache(c)
+        return c
 
 
 # ---------------------------------------------------------------------------
@@ -539,7 +642,86 @@ def _is_basic(t: type) -> bool:
     return issubclass(t, uint)
 
 
-class _SequenceBase(SSZType):
+_ARRAY_CODES = {1: "B", 2: "H", 4: "I", 8: "Q"}
+
+
+def _pack_basic_items(items, elem_type) -> bytearray:
+    """Pack basic elements into chunk-padded contiguous bytes. uintN with a
+    native array code takes the C fast path (little-endian platforms)."""
+    size = elem_type.type_byte_length()
+    code = _ARRAY_CODES.get(size)
+    if code is not None and sys.byteorder == "little":
+        buf = bytearray(array(code, items).tobytes())
+    else:
+        buf = bytearray(b"".join(v.encode_bytes() for v in items))
+    if len(buf) % BYTES_PER_CHUNK:
+        buf += b"\x00" * (BYTES_PER_CHUNK - len(buf) % BYTES_PER_CHUNK)
+    return buf
+
+
+def _container_flat_plan(cls) -> Optional[list]:
+    """For fixed-size containers whose fields are all immutable scalars
+    (uintN / boolean / ByteVector<=64), the per-field root recipe enabling
+    batched whole-sequence leaf computation (the Validator case — the hot
+    leaf type of the registry). Entries are (name, kind, nbytes). None when
+    the container has mutable or large fields (falls back to per-item roots)."""
+    plan = cls.__dict__.get("_flat_plan", False)
+    if plan is not False:
+        return plan
+    plan = []
+    for name, t in cls._fields.items():
+        if issubclass(t, uint):
+            plan.append((name, "uint", t.byte_len))
+        elif issubclass(t, ByteVector):
+            if t.length <= 32:
+                plan.append((name, "bytes", t.length))
+            elif t.length <= 64:
+                plan.append((name, "hash2", t.length))
+            else:
+                plan = None
+                break
+        else:
+            plan = None
+            break
+    cls._flat_plan = plan
+    return plan
+
+
+def _batched_container_roots(items, plan) -> bytes:
+    """Roots of N same-type flat containers, column-at-a-time: each field's
+    values are gathered once (numpy scatter into the (N, F'·32) chunk
+    matrix), >32-byte fields get ONE batched hash over all items, then one
+    hash_many per tree level reduces every item's root simultaneously
+    (field counts pad to the same power of two, so a flat level-reduce
+    never mixes chunks across items)."""
+    import numpy as np
+    from operator import attrgetter
+
+    n = len(items)
+    fp = next_pow2(len(plan))
+    buf = np.zeros((n, fp * 32), dtype=np.uint8)
+    for j, (name, kind, nbytes) in enumerate(plan):
+        get = attrgetter(name)
+        col = buf[:, 32 * j : 32 * j + 32]
+        if kind == "uint" and nbytes in (1, 2, 4, 8):
+            arr = np.fromiter(map(get, items), dtype=f"<u{nbytes}", count=n)
+            col[:, :nbytes] = arr.view(np.uint8).reshape(n, nbytes)
+        elif kind == "uint":
+            raw = b"".join(v.encode_bytes() for v in map(get, items))
+            col[:, :nbytes] = np.frombuffer(raw, dtype=np.uint8).reshape(n, nbytes)
+        elif kind == "bytes":
+            raw = b"".join(map(get, items))
+            col[:, :nbytes] = np.frombuffer(raw, dtype=np.uint8).reshape(n, nbytes)
+        else:  # hash2: two chunks -> one batched hash per field
+            raw = b"".join(map(get, items))
+            padded = np.zeros((n, 64), dtype=np.uint8)
+            padded[:, :nbytes] = np.frombuffer(raw, dtype=np.uint8).reshape(n, nbytes)
+            digests = hashing.hash_many(padded.tobytes())
+            col[:] = np.frombuffer(digests, dtype=np.uint8).reshape(n, 32)
+    return hashing.item_roots(buf.tobytes(), fp)
+
+
+class _SequenceBase(_Cached, SSZType):
     element_type: type = None  # type: ignore
 
     def __init__(self, *args):
@@ -551,6 +733,8 @@ class _SequenceBase(SSZType):
             raw = list(args)
         self._items = [self.element_type.coerce(v) for v in raw]
         self._check_len(len(self._items))
+        self._tree: Optional[ChunkTree] = None
+        self._dirty: set = set()
 
     def _check_len(self, n: int) -> None:
         raise NotImplementedError
@@ -565,7 +749,111 @@ class _SequenceBase(SSZType):
         return self._items[i]
 
     def __setitem__(self, i, v):
-        self._items[i] = self.element_type.coerce(v)
+        if isinstance(i, slice):
+            raise TypeError("slice assignment not supported on SSZ sequences")
+        n = len(self._items)
+        if i < 0:
+            i += n
+        if not 0 <= i < n:
+            raise IndexError(f"{type(self).__name__}: index {i} out of range")
+        val = self.element_type.coerce(v)
+        self._items[i] = val
+        self._link_child(val, i)
+        self._mark_item_dirty(i)
+
+    def _receive_dirty(self, slot) -> bool:
+        self._dirty.add(slot)
+        if self._ht_cache is None:
+            return False
+        self._set_cache(None)
+        return True
+
+    def _mark_item_dirty(self, i: int) -> None:
+        self._dirty.add(i)
+        self._mark_self_dirty()
+
+    # -- incremental Merkleization (ChunkTree backing) -----------------------
+
+    def _bound(self) -> int:
+        raise NotImplementedError
+
+    def _build_leaves(self) -> bytearray:
+        items = self._items
+        et = self.element_type
+        if _is_basic(et):
+            return _pack_basic_items(items, et)
+        plan = _container_flat_plan(et) if issubclass(et, Container) else None
+        # bulk-link: one shared weakref + direct __dict__ writes (the
+        # per-item _link_child call costs more than the leaf hash at scale)
+        ref = weakref.ref(self)
+        if plan and len(items) >= 64:
+            packed = _batched_container_roots(items, plan)
+            for i, it in enumerate(items):
+                d = it.__dict__
+                ps = d.get("_parents")
+                if ps is None:
+                    d["_parents"] = [(ref, i)]
+                else:
+                    ps.append((ref, i))
+                # plan admits only immutable fields, so caching the batched
+                # root needs no child links inside the item
+                d["_ht_cache"] = packed[32 * i : 32 * i + 32]
+            return bytearray(packed)
+        leaves = bytearray()
+        for i, it in enumerate(items):
+            self._link_child(it, i)
+            leaves += it.hash_tree_root()
+        return leaves
+
+    def _pack_chunk(self, ci: int) -> bytes:
+        """Re-pack the 32-byte chunk `ci` from current basic items."""
+        et = self.element_type
+        per = BYTES_PER_CHUNK // et.type_byte_length()
+        start = ci * per
+        end = min(len(self._items), start + per)
+        b = b"".join(self._items[j].encode_bytes() for j in range(start, end))
+        return b.ljust(BYTES_PER_CHUNK, b"\x00")
+
+    def _sync_tree(self) -> ChunkTree:
+        items = self._items
+        et = self.element_type
+        basic = _is_basic(et)
+        if self._tree is None:
+            self._tree = ChunkTree(self._build_leaves(), self._chunk_limit(self._bound()))
+            self._dirty.clear()
+            return self._tree
+        tree = self._tree
+        if basic:
+            per = BYTES_PER_CHUNK // et.type_byte_length()
+            need = (len(items) + per - 1) // per
+        else:
+            need = len(items)
+        if tree.count > need:
+            tree.truncate(need)
+        if self._dirty:
+            if basic:
+                for ci in sorted({i // per for i in self._dirty}):
+                    if ci < need:
+                        tree.set_leaf(ci, self._pack_chunk(ci))
+            else:
+                for i in sorted(self._dirty):
+                    if i < need:
+                        it = items[i]
+                        self._link_child(it, i)
+                        tree.set_leaf(i, it.hash_tree_root())
+            self._dirty.clear()
+        return tree
+
+    def copy(self):
+        cls = type(self)
+        new = cls.__new__(cls)
+        new._items = [v.copy() for v in self._items]
+        for i, v in enumerate(new._items):
+            new._link_child(v, i)
+        new._tree = self._tree.copy() if self._tree is not None else None
+        new._dirty = set(self._dirty)
+        new._set_cache(self._ht_cache)
+        return new
 
     def index(self, v):
         return self._items.index(v)
@@ -598,11 +886,6 @@ class _SequenceBase(SSZType):
 
     def __repr__(self):
         return f"{type(self).__name__}({self._items!r})"
-
-    def _element_chunks(self) -> list:
-        if _is_basic(self.element_type):
-            return _pack_bytes_to_chunks(b"".join(v.encode_bytes() for v in self._items))
-        return [v.hash_tree_root() for v in self._items]
 
     @classmethod
     def _chunk_limit(cls, bound: int) -> int:
@@ -656,8 +939,15 @@ class Vector(_SequenceBase):
             return b"".join(v.encode_bytes() for v in self._items)
         return _serialize_parts(self._items)
 
+    def _bound(self) -> int:
+        return self.length
+
     def hash_tree_root(self) -> bytes:
-        return merkleize_chunks(self._element_chunks(), limit=self._chunk_limit(self.length))
+        c = self._ht_cache
+        if c is None:
+            c = self._sync_tree().root()
+            self._set_cache(c)
+        return c
 
 
 class List(_SequenceBase):
@@ -677,10 +967,18 @@ class List(_SequenceBase):
     def append(self, v):
         if len(self._items) + 1 > self.limit:
             raise ValueError(f"{type(self).__name__}: append exceeds limit {self.limit}")
-        self._items.append(self.element_type.coerce(v))
+        val = self.element_type.coerce(v)
+        self._items.append(val)
+        n = len(self._items) - 1
+        self._link_child(val, n)
+        self._mark_item_dirty(n)
 
     def pop(self):
-        return self._items.pop()
+        v = self._items.pop()
+        # mark the vacated index: a shared trailing chunk gets re-packed at
+        # sync time; fully-removed leaves are handled by ChunkTree.truncate
+        self._mark_item_dirty(len(self._items))
+        return v
 
     @classmethod
     def is_fixed_byte_length(cls) -> bool:
@@ -716,9 +1014,15 @@ class List(_SequenceBase):
             return b"".join(v.encode_bytes() for v in self._items)
         return _serialize_parts(self._items)
 
+    def _bound(self) -> int:
+        return self.limit
+
     def hash_tree_root(self) -> bytes:
-        root = merkleize_chunks(self._element_chunks(), limit=self._chunk_limit(self.limit))
-        return mix_in_length(root, len(self._items))
+        c = self._ht_cache
+        if c is None:
+            c = mix_in_length(self._sync_tree().root(), len(self._items))
+            self._set_cache(c)
+        return c
 
 
 # ---------------------------------------------------------------------------
@@ -726,7 +1030,7 @@ class List(_SequenceBase):
 # ---------------------------------------------------------------------------
 
 
-class Container(SSZType):
+class Container(_Cached, SSZType):
     _fields: Dict[str, type] = {}
 
     def __init_subclass__(cls, **kwargs):
@@ -753,10 +1057,16 @@ class Container(SSZType):
         return cls._fields
 
     def __setattr__(self, name, value):
+        if name.startswith("_"):
+            object.__setattr__(self, name, value)
+            return
         typ = self._fields.get(name)
         if typ is None:
             raise AttributeError(f"{type(self).__name__} has no SSZ field {name!r}")
-        object.__setattr__(self, name, typ.coerce(value))
+        v = typ.coerce(value)
+        object.__setattr__(self, name, v)
+        self._link_child(v, name)
+        self._mark_self_dirty()
 
     @classmethod
     def coerce(cls, value):
@@ -813,7 +1123,27 @@ class Container(SSZType):
         return _serialize_parts([getattr(self, n) for n in self._fields])
 
     def hash_tree_root(self) -> bytes:
-        return merkleize_chunks([getattr(self, n).hash_tree_root() for n in self._fields])
+        c = self._ht_cache
+        if c is not None:
+            return c
+        roots = []
+        for n in self._fields:
+            v = getattr(self, n)
+            self._link_child(v, n)  # links established here keep the cache honest
+            roots.append(v.hash_tree_root())
+        c = merkleize_chunks(b"".join(roots))
+        self._set_cache(c)
+        return c
+
+    def copy(self):
+        cls = type(self)
+        new = cls.__new__(cls)
+        for n in self._fields:
+            cv = getattr(self, n).copy()
+            object.__setattr__(new, n, cv)
+            new._link_child(cv, n)
+        new._set_cache(self._ht_cache)
+        return new
 
 
 # ---------------------------------------------------------------------------
@@ -821,7 +1151,7 @@ class Container(SSZType):
 # ---------------------------------------------------------------------------
 
 
-class Union(SSZType):
+class Union(_Cached, SSZType):
     options: Tuple[Optional[type], ...] = ()
 
     def __class_getitem__(cls, params) -> type:
@@ -841,6 +1171,13 @@ class Union(SSZType):
         else:
             self.value = opt.coerce(value)
         self.selector = selector
+
+    def __setattr__(self, name, value):
+        object.__setattr__(self, name, value)
+        if name in ("value", "selector"):
+            if name == "value":
+                self._link_child(value, 0)
+            self._mark_self_dirty()
 
     @classmethod
     def is_fixed_byte_length(cls) -> bool:
@@ -869,8 +1206,12 @@ class Union(SSZType):
         return bytes([self.selector]) + body
 
     def hash_tree_root(self) -> bytes:
-        root = b"\x00" * 32 if self.value is None else self.value.hash_tree_root()
-        return mix_in_selector(root, self.selector)
+        c = self._ht_cache
+        if c is None:
+            root = b"\x00" * 32 if self.value is None else self.value.hash_tree_root()
+            c = mix_in_selector(root, self.selector)
+            self._set_cache(c)
+        return c
 
     def __eq__(self, other):
         if not isinstance(other, Union):

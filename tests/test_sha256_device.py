@@ -35,10 +35,57 @@ def test_backend_swap():
     rng = random.Random(99)
     chunks = [bytes(rng.randrange(256) for _ in range(32)) for _ in range(11)]
     host_root = merkleize_chunks(chunks, limit=16)
-    dev.use_device_hasher()
+    dev.use_device_hasher(calibrate=False)
     try:
         assert hashing.backend_name() == "jax"
+        # force the device path even for tiny batches so the equivalence
+        # assertion actually exercises the jax backend
+        hashing.DEVICE_MIN_BLOCKS = 0
+        hashing.FUSED_ROOT_MIN_CHUNKS = 2
         assert merkleize_chunks(chunks, limit=16) == host_root
     finally:
         dev.use_host_hasher()
     assert hashing.backend_name() == "hashlib"
+
+
+def test_backend_swap_large_batch():
+    """A >=DEVICE_MIN_BLOCKS batch goes through the device hash_many path
+    with default thresholds."""
+    rng = random.Random(5)
+    chunks = [bytes(rng.randrange(256) for _ in range(32)) for _ in range(512)]
+    host_root = merkleize_chunks(chunks, limit=512)
+    dev.use_device_hasher(calibrate=False)
+    try:
+        assert merkleize_chunks(chunks, limit=512) == host_root
+    finally:
+        dev.use_host_hasher()
+
+
+def test_tree_levels_and_item_roots_device():
+    rng = random.Random(31)
+    leaves = bytes(rng.randrange(256) for _ in range(32 * 24))
+    got = dev.tree_levels_device(leaves)
+    # oracle: host level-by-level with pow2 zero-padding
+    from consensus_specs_tpu.ssz.merkle import next_pow2
+
+    size = next_pow2(24)
+    padded = leaves + b"\x00" * ((size - 24) * 32)
+    want = []
+    nodes = padded
+    while len(nodes) > 32:
+        nodes = b"".join(
+            hashlib.sha256(nodes[64 * i : 64 * i + 64]).digest() for i in range(len(nodes) // 64)
+        )
+        want.append(nodes)
+    assert got == want
+
+    packed = bytes(rng.randrange(256) for _ in range(32 * 8 * 10))  # 10 items, 8 chunks
+    roots = dev.item_roots_device(packed, 8)
+    for i in range(10):
+        item = packed[32 * 8 * i : 32 * 8 * (i + 1)]
+        nodes = item
+        while len(nodes) > 32:
+            nodes = b"".join(
+                hashlib.sha256(nodes[64 * j : 64 * j + 64]).digest() for j in range(len(nodes) // 64)
+            )
+        assert roots[32 * i : 32 * i + 32] == nodes, i

@@ -85,7 +85,7 @@ _MAINNET_BELLATRIX = dict(
 _MAINNET_CAPELLA = dict(
     MAX_BLS_TO_EXECUTION_CHANGES=2**4,
     MAX_WITHDRAWALS_PER_PAYLOAD=2**4,
-    WITHDRAWAL_QUEUE_LIMIT=2**40,
+    WITHDRAWALS_QUEUE_LIMIT=2**40,
 )
 
 _MAINNET_CUSTODY = dict(

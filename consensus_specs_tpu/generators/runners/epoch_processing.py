@@ -1,9 +1,46 @@
-"""`epoch_processing` runner (ref: tests/generators/epoch_processing/main.py)."""
-from ..gen_from_tests import run_state_test_generators
+"""`epoch_processing` runner: one handler per epoch sub-transition, matching
+the reference's client-facing layout (ref: tests/generators/epoch_processing/
+main.py:6-23 — vectors land under
+`<preset>/<fork>/epoch_processing/<sub_transition>/`)."""
+from ..gen_from_tests import combine_mods, run_state_test_generators
+
+_EP = "tests.spec.epoch_processing.test_process_"
+
+phase0_mods = {
+    key: _EP + key
+    for key in [
+        "justification_and_finalization",
+        "rewards_and_penalties",
+        "registry_updates",
+        "slashings",
+        "eth1_data_reset",
+        "effective_balance_updates",
+        "slashings_reset",
+        "randao_mixes_reset",
+        "historical_roots_update",
+        "participation_record_updates",
+    ]
+}
+
+_new_altair_mods = {
+    key: _EP + key
+    for key in [
+        "inactivity_updates",
+        "participation_flag_updates",
+        "sync_committee_updates",
+    ]
+}
+altair_mods = combine_mods(_new_altair_mods, phase0_mods)
+
+# no new epoch sub-transitions in bellatrix; capella adds the withdrawal sweep
+bellatrix_mods = altair_mods
+capella_mods = combine_mods({"full_withdrawals": _EP + "full_withdrawals"}, altair_mods)
 
 all_mods = {
-    fork: {"epoch_processing": "tests.spec.test_epoch_processing"}
-    for fork in ("phase0", "altair", "bellatrix", "capella")
+    "phase0": phase0_mods,
+    "altair": altair_mods,
+    "bellatrix": bellatrix_mods,
+    "capella": capella_mods,
 }
 
 

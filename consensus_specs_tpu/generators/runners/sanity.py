@@ -1,8 +1,10 @@
-"""`sanity` runner (ref: tests/generators/sanity/main.py)."""
+"""`sanity` runner: `blocks` + `slots` handlers (ref:
+tests/generators/sanity/main.py)."""
 from ..gen_from_tests import run_state_test_generators
 
 mods = {
     "blocks": "tests.spec.test_sanity_blocks",
+    "slots": "tests.spec.test_sanity_slots",
 }
 
 all_mods = {fork: mods for fork in ("phase0", "altair", "bellatrix", "capella")}

@@ -145,7 +145,7 @@ class BeaconState(Container):  # noqa: F821
     latest_execution_payload_header: ExecutionPayloadHeader
     # Withdrawals [New in Capella]
     withdrawal_index: WithdrawalIndex
-    withdrawals_queue: List[Withdrawal, WITHDRAWAL_QUEUE_LIMIT]  # noqa: F821
+    withdrawals_queue: List[Withdrawal, WITHDRAWALS_QUEUE_LIMIT]  # noqa: F821
 
 
 # ---------------------------------------------------------------------------

@@ -1,72 +1,323 @@
-"""Single Merkle proofs against SSZ objects by generalized index
-(ref: ssz/merkle-proofs.md:58-249 — the proof-construction side the
-light-client sync protocol consumes, sync-protocol.md:159-231).
+"""Merkle proofs against SSZ objects by generalized index, single and
+multi (ref: ssz/merkle-proofs.md:58-357 — the proof side the light-client
+sync protocol consumes, sync-protocol.md:159-231).
 
 `compute_merkle_proof(obj, gindex)` returns the branch ordered leaf-level
-first, matching `is_valid_merkle_branch` / `compute_merkle_proof_root`
-fold order. Descent across Container boundaries is supported (the
-light-client gindices FINALIZED_ROOT_INDEX / NEXT_SYNC_COMMITTEE_INDEX
-never descend through a List's length mix-in).
+first, matching `is_valid_merkle_branch` / `calculate_merkle_root` fold
+order. Descent is supported through every composite kind — Containers,
+composite- and basic-element Vectors/Lists (including the length mix-in:
+data subtree = left child, length = right, merkle-proofs.md "merkleization
+into a single root"), Bitvector/Bitlist, ByteVector/ByteList — with
+virtual zero-subtree siblings for unmaterialized padding (a proof into a
+`List[..., 2**40]` costs 40 zero-hash lookups, not 2**40 nodes).
+
+Multiproofs (merkle-proofs.md:249-357): `get_helper_indices` computes the
+minimal witness set; `compute_merkle_multiproof` extracts those nodes from
+an object; `calculate_multi_merkle_root`/`verify_merkle_multiproof` fold
+them back. These are host-side tree walks — batches of proofs feed the
+batched hasher, not one-hash-at-a-time device calls.
 """
 from __future__ import annotations
 
-from typing import List as PyList
+from typing import Dict, List as PyList, Sequence, Tuple
 
-from .merkle import ZERO_HASHES, ceil_log2, next_pow2
 from .hashing import hash_many
-from .types import Container
+from .merkle import ZERO_HASHES, ceil_log2, next_pow2
+from .types import (
+    Bitlist,
+    Bitvector,
+    ByteList,
+    ByteVector,
+    Container,
+    List,
+    Vector,
+    _is_basic,
+    _pad_to_chunks,
+)
 
 
-def _container_chunk_levels(obj: Container) -> PyList[PyList[bytes]]:
-    """Bottom-up levels of the container's field-root tree, padded to the
-    pow2 leaf count with zero hashes."""
-    fields = list(obj.fields())
-    chunks = [bytes(getattr(obj, name).hash_tree_root()) for name in fields]
-    size = next_pow2(max(len(chunks), 1))
-    depth = ceil_log2(size)
-    level = chunks + [ZERO_HASHES[0]] * (size - len(chunks))
-    levels = [level]
+# ---------------------------------------------------------------------------
+# Generalized-index arithmetic (merkle-proofs.md:197-252)
+# ---------------------------------------------------------------------------
+
+
+def concat_generalized_indices(*indices: int) -> int:
+    """Gindex of the node addressed by following each index in turn
+    (merkle-proofs.md:197)."""
+    o = 1
+    for i in indices:
+        o = o * next_pow2(i + 1) // 2 + (i - next_pow2(i + 1) // 2)
+    return o
+
+
+def get_generalized_index_bit(index: int, position: int) -> bool:
+    """(merkle-proofs.md:221)"""
+    return (index & (1 << position)) > 0
+
+
+def generalized_index_sibling(index: int) -> int:
+    return index ^ 1
+
+
+def generalized_index_child(index: int, right_side: bool) -> int:
+    return index * 2 + int(right_side)
+
+
+def generalized_index_parent(index: int) -> int:
+    return index // 2
+
+
+# ---------------------------------------------------------------------------
+# Proof-index sets (merkle-proofs.md:265-305)
+# ---------------------------------------------------------------------------
+
+
+def get_branch_indices(tree_index: int) -> PyList[int]:
+    """Sibling chain from the node to the root (merkle-proofs.md:265)."""
+    o = []
+    while tree_index > 1:
+        o.append(tree_index ^ 1)
+        tree_index //= 2
+    return o
+
+
+def get_path_indices(tree_index: int) -> PyList[int]:
+    """The node's ancestor chain including itself, excluding the root
+    (merkle-proofs.md:277)."""
+    o = []
+    while tree_index > 1:
+        o.append(tree_index)
+        tree_index //= 2
+    return o
+
+
+def get_helper_indices(indices: Sequence[int]) -> PyList[int]:
+    """Minimal witness set for a multiproof of `indices`: all sibling-chain
+    nodes not themselves on any proven path (merkle-proofs.md:289).
+    Descending order, as the verifier folds bottom-up."""
+    all_helper: set = set()
+    all_path: set = set()
+    for index in indices:
+        all_helper.update(get_branch_indices(index))
+        all_path.update(get_path_indices(index) + [1])
+    return sorted(all_helper - all_path, reverse=True)
+
+
+# ---------------------------------------------------------------------------
+# Verification folds (merkle-proofs.md:307-357)
+# ---------------------------------------------------------------------------
+
+
+def calculate_merkle_root(leaf: bytes, proof: Sequence[bytes], index: int) -> bytes:
+    """Fold a single branch upward (merkle-proofs.md:307)."""
+    assert len(proof) == index.bit_length() - 1
+    node = leaf
+    for i, h in enumerate(proof):
+        if index & (1 << i):
+            node = hash_many(h + node)
+        else:
+            node = hash_many(node + h)
+    return node
+
+
+def verify_merkle_proof(leaf: bytes, proof: Sequence[bytes], index: int, root: bytes) -> bool:
+    return calculate_merkle_root(leaf, proof, index) == root
+
+
+def calculate_multi_merkle_root(
+    leaves: Sequence[bytes], proof: Sequence[bytes], indices: Sequence[int]
+) -> bytes:
+    """Root from several proven leaves + their helper nodes
+    (merkle-proofs.md:325)."""
+    assert len(leaves) == len(indices)
+    helper_indices = get_helper_indices(indices)
+    assert len(proof) == len(helper_indices)
+    objects: Dict[int, bytes] = {
+        **{index: node for index, node in zip(indices, leaves)},
+        **{index: node for index, node in zip(helper_indices, proof)},
+    }
+    keys = sorted(objects.keys(), reverse=True)
+    pos = 0
+    while pos < len(keys):
+        k = keys[pos]
+        if k in objects and k ^ 1 in objects and k // 2 not in objects:
+            objects[k // 2] = hash_many(objects[(k | 1) ^ 1] + objects[k | 1])
+            keys.append(k // 2)
+        pos += 1
+    return objects[1]
+
+
+def verify_merkle_multiproof(
+    leaves: Sequence[bytes], proof: Sequence[bytes], indices: Sequence[int], root: bytes
+) -> bool:
+    return calculate_multi_merkle_root(leaves, proof, indices) == root
+
+
+# ---------------------------------------------------------------------------
+# Object-tree navigation
+# ---------------------------------------------------------------------------
+
+
+def _chunk_info(obj) -> Tuple[PyList[bytes], int, object, bool]:
+    """(chunks, depth, children, has_length_mixin) for a composite value.
+
+    `chunks` are the actual subtree leaves (unpadded); `depth` the virtual
+    tree depth to the type's bound; `children` the child objects aligned
+    with chunks (None when leaves are opaque packed chunks)."""
+    if isinstance(obj, Container):
+        fields = list(obj.fields())
+        chunks = [bytes(getattr(obj, n).hash_tree_root()) for n in fields]
+        children = [getattr(obj, n) for n in fields]
+        return chunks, ceil_log2(next_pow2(len(fields))), children, False
+    if isinstance(obj, (Vector, List)):
+        is_list = isinstance(obj, List)
+        bound = obj.limit if is_list else obj.length
+        limit = type(obj)._chunk_limit(bound)
+        if _is_basic(obj.element_type):
+            packed = _pad_to_chunks(b"".join(v.encode_bytes() for v in obj))
+            chunks = [packed[i : i + 32] for i in range(0, len(packed), 32)]
+            children = None
+        else:
+            chunks = [bytes(v.hash_tree_root()) for v in obj]
+            children = list(obj)
+        return chunks, ceil_log2(limit), children, is_list
+    if isinstance(obj, (Bitvector, Bitlist)):
+        is_list = isinstance(obj, Bitlist)
+        bound = obj.limit if is_list else obj.length
+        from .types import _bits_to_bytes
+
+        packed = _pad_to_chunks(_bits_to_bytes(list(obj)))
+        chunks = [packed[i : i + 32] for i in range(0, len(packed), 32)]
+        return chunks, ceil_log2((bound + 255) // 256), None, is_list
+    if isinstance(obj, (ByteVector, ByteList)):
+        is_list = isinstance(obj, ByteList)
+        bound = obj.limit if is_list else obj.length
+        packed = _pad_to_chunks(bytes(obj))
+        chunks = [packed[i : i + 32] for i in range(0, len(packed), 32)]
+        return chunks, ceil_log2((bound + 31) // 32), None, is_list
+    raise TypeError(f"proof descent through {type(obj).__name__} not supported")
+
+
+def _levels(chunks: PyList[bytes], depth: int) -> PyList[PyList[bytes]]:
+    """Real (unpadded) interior levels; virtual zero-subtree siblings are
+    looked up from ZERO_HASHES by the callers. Each level is hashed in ONE
+    hash_many batch (the whole level's sibling pairs at once)."""
+    levels = [list(chunks)]
+    level = list(chunks)
     for d in range(depth):
-        nxt = [
-            hash_many(level[2 * i] + level[2 * i + 1])
-            for i in range(len(level) // 2)
-        ]
-        levels.append(nxt)
-        level = nxt
+        if len(level) % 2:
+            level.append(ZERO_HASHES[d])
+        digests = hash_many(b"".join(level))
+        level = [digests[32 * i : 32 * i + 32] for i in range(len(level) // 2)]
+        levels.append(level)
     return levels
+
+
+def _data_root(chunks: PyList[bytes], depth: int) -> bytes:
+    if not chunks:
+        return ZERO_HASHES[depth]
+    lv = _levels(chunks, depth)
+    return lv[depth][0] if lv[depth] else ZERO_HASHES[depth]
+
+
+def _length_chunk(obj) -> bytes:
+    return len(obj).to_bytes(32, "little")
+
+
+def _proof(obj, bits: str) -> PyList[bytes]:
+    if not bits:
+        return []
+    chunks, depth, children, mixin = _chunk_info(obj)
+    if mixin:
+        b, bits = bits[0], bits[1:]
+        if b == "1":
+            # proving the length mix-in; its sibling is the data-tree root
+            assert not bits, "cannot descend inside the length mix-in"
+            return [_data_root(chunks, depth)]
+        # proving the data root itself needs only the length chunk
+        inner = _subtree_proof(chunks, depth, children, bits) if bits else []
+        return inner + [_length_chunk(obj)]
+    return _subtree_proof(chunks, depth, children, bits)
+
+
+def _subtree_proof(chunks, depth, children, bits: str) -> PyList[bytes]:
+    tree_bits, rest = bits[:depth], bits[depth:]
+    assert len(tree_bits) == depth, "generalized index path ends at an interior node"
+    idx = int(tree_bits, 2) if tree_bits else 0
+    levels = _levels(chunks, depth)
+    siblings = []
+    pos = idx
+    for level in range(depth):  # leaf-level sibling first
+        row = levels[level]
+        sib = pos ^ 1
+        siblings.append(row[sib] if sib < len(row) else ZERO_HASHES[level])
+        pos //= 2
+    if not rest:
+        return siblings
+    assert children is not None, "cannot descend into packed basic chunks"
+    assert idx < len(children), "path descends into zero padding"
+    return _proof(children[idx], rest) + siblings
 
 
 def compute_merkle_proof(obj, gindex: int) -> PyList[bytes]:
     """Branch proving the subtree at `gindex` inside `obj`'s hash tree."""
     gindex = int(gindex)
     assert gindex >= 1
-    bits = bin(gindex)[3:]  # descent path from the root, MSB first
-    return _proof(obj, bits)
+    return _proof(obj, bin(gindex)[3:])
 
 
-def _proof(obj, bits: str) -> PyList[bytes]:
+def hash_at_gindex(obj, gindex: int, _memo: Optional[Dict] = None) -> bytes:
+    """The tree node (subtree root) at `gindex` of `obj`'s hash tree.
+
+    `_memo` (keyed by object identity) caches each visited object's chunk
+    info and interior levels so a multiproof's many lookups share one tree
+    walk instead of re-merkleizing per helper index."""
+    gindex = int(gindex)
+    assert gindex >= 1
+    return _node(obj, bin(gindex)[3:], _memo if _memo is not None else {})
+
+
+def _tree_of(obj, memo: Dict):
+    """(chunks, depth, children, mixin, levels) for `obj`, memoized."""
+    key = id(obj)
+    entry = memo.get(key)
+    if entry is None:
+        chunks, depth, children, mixin = _chunk_info(obj)
+        entry = (chunks, depth, children, mixin, _levels(chunks, depth), obj)
+        memo[key] = entry  # the obj ref in the entry keeps id(obj) stable
+    return entry
+
+
+def _node(obj, bits: str, memo: Dict) -> bytes:
     if not bits:
-        return []
-    if not isinstance(obj, Container):
-        raise NotImplementedError(
-            f"proof descent through {type(obj).__name__} not supported "
-            "(only Container paths needed by the light-client gindices)"
-        )
-    fields = list(obj.fields())
-    levels = _container_chunk_levels(obj)
-    depth = len(levels) - 1
-    tree_bits, rest = bits[:depth], bits[depth:]
-    assert len(tree_bits) == depth, "generalized index path ends inside padding"
+        return bytes(obj.hash_tree_root())
+    chunks, depth, children, mixin, levels, _ = _tree_of(obj, memo)
+    if mixin:
+        b, bits = bits[0], bits[1:]
+        if b == "1":
+            assert not bits, "cannot descend inside the length mix-in"
+            return _length_chunk(obj)
+        return _subtree_node(levels, depth, children, bits, memo)
+    return _subtree_node(levels, depth, children, bits, memo)
+
+
+def _subtree_node(levels, depth, children, bits: str, memo: Dict) -> bytes:
+    take = min(len(bits), depth)
+    tree_bits, rest = bits[:take], bits[take:]
     idx = int(tree_bits, 2) if tree_bits else 0
-
-    siblings = []
-    pos = idx
-    for level in range(depth):  # leaf-level sibling first
-        siblings.append(levels[level][pos ^ 1])
-        pos //= 2
-
+    level = depth - len(tree_bits)  # height of the addressed node
     if not rest:
-        return siblings
-    assert idx < len(fields), "path descends into zero padding"
-    deeper = _proof(getattr(obj, fields[idx]), rest)
-    return deeper + siblings
+        row = levels[level]
+        return row[idx] if idx < len(row) else ZERO_HASHES[level]
+    assert children is not None, "cannot descend into packed basic chunks"
+    if idx >= len(children):
+        raise AssertionError("path descends into zero padding")
+    return _node(children[idx], rest, memo)
+
+
+def compute_merkle_multiproof(obj, gindices: Sequence[int]) -> PyList[bytes]:
+    """Helper nodes (descending gindex order) proving all `gindices` of
+    `obj` at once — the witness `verify_merkle_multiproof` consumes. One
+    memoized tree walk serves every helper index."""
+    memo: Dict = {}
+    return [hash_at_gindex(obj, gi, memo) for gi in get_helper_indices(gindices)]

@@ -23,6 +23,17 @@ def prepare_signed_exits(spec, state, indices):
     return [create_signed_exit(index) for index in indices]
 
 
+def get_unslashed_exited_validators(spec, state):
+    """Indices exited (at or before the current epoch) but not slashed
+    (ref: test/helpers/voluntary_exits.py)."""
+    epoch = spec.get_current_epoch(state)
+    return [
+        i
+        for i, v in enumerate(state.validators)
+        if not v.slashed and v.exit_epoch <= epoch
+    ]
+
+
 def run_voluntary_exit_processing(spec, state, signed_voluntary_exit, valid=True):
     """Yield pre/operation/post around process_voluntary_exit."""
     validator_index = signed_voluntary_exit.message.validator_index

@@ -1,0 +1,86 @@
+"""Shared machinery for per-sub-transition epoch-processing tests
+(ref: test/phase0/epoch_processing/test_process_justification_and_finalization.py:14-87).
+
+`mock_epoch_attestations` records target-vote participation for one epoch
+directly into the state — PendingAttestations with right-aligned
+aggregation bits pre-Altair, participation flags after — covering just
+over (or deliberately under) 2/3 of total active balance.
+"""
+from consensus_specs_tpu.test_framework.constants import is_post_altair
+
+
+def mock_epoch_attestations(
+    spec, state, epoch, source, target, sufficient_support=True, messed_up_target=False
+):
+    """Record ~2/3-of-balance participation voting (source → target) for
+    `epoch`; `sufficient_support=False` drops ~1/5 of each committee so the
+    justification threshold is missed."""
+    assert (state.slot + 1) % spec.SLOTS_PER_EPOCH == 0
+    if epoch == spec.get_current_epoch(state):
+        pending = None if is_post_altair(spec) else state.current_epoch_attestations
+        flags = state.current_epoch_participation if is_post_altair(spec) else None
+    elif epoch == spec.get_previous_epoch(state):
+        pending = None if is_post_altair(spec) else state.previous_epoch_attestations
+        flags = state.previous_epoch_participation if is_post_altair(spec) else None
+    else:
+        raise ValueError(f"epoch {epoch} is neither current nor previous")
+
+    remaining = int(spec.get_total_active_balance(state)) * 2 // 3
+    start_slot = spec.compute_start_slot_at_epoch(epoch)
+    committees_per_slot = spec.get_committee_count_per_slot(state, epoch)
+    for slot in range(start_slot, start_slot + spec.SLOTS_PER_EPOCH):
+        for index in range(committees_per_slot):
+            if remaining < 0:
+                return
+            committee = spec.get_beacon_committee(state, slot, index)
+            bits = [0] * len(committee)
+            for v in range(len(committee) * 2 // 3 + 1):
+                if remaining <= 0:
+                    break
+                remaining -= int(state.validators[committee[v]].effective_balance)
+                bits[v] = 1
+            if not sufficient_support:
+                for i in range(max(len(committee) // 5, 1)):
+                    bits[i] = 0
+            if pending is not None:
+                att_target = spec.Checkpoint(epoch=target.epoch, root=target.root)
+                if messed_up_target:
+                    att_target.root = b"\x99" * 32
+                pending.append(
+                    spec.PendingAttestation(
+                        aggregation_bits=bits,
+                        data=spec.AttestationData(
+                            slot=slot,
+                            index=index,
+                            beacon_block_root=b"\xff" * 32,
+                            source=source,
+                            target=att_target,
+                        ),
+                        inclusion_delay=1,
+                    )
+                )
+            else:
+                for i, vidx in enumerate(committee):
+                    if bits[i]:
+                        flag = (
+                            (1 << spec.TIMELY_HEAD_FLAG_INDEX)
+                            | (1 << spec.TIMELY_SOURCE_FLAG_INDEX)
+                            | (0 if messed_up_target else 1 << spec.TIMELY_TARGET_FLAG_INDEX)
+                        )
+                        flags[vidx] = flags[vidx] | flag
+
+
+def checkpoints_back(spec, epoch, count=5):
+    """Distinct mock checkpoints for `epoch - 1 .. epoch - count`."""
+    fills = [b"\xaa", b"\xbb", b"\xcc", b"\xdd", b"\xee"]
+    return [
+        spec.Checkpoint(epoch=epoch - k, root=fills[k - 1] * 32) if epoch >= k else None
+        for k in range(1, count + 1)
+    ]
+
+
+def install_checkpoint_block_roots(spec, state, checkpoints):
+    for c in checkpoints:
+        if c is not None:
+            slot = spec.compute_start_slot_at_epoch(c.epoch)
+            state.block_roots[slot % spec.SLOTS_PER_HISTORICAL_ROOT] = c.root

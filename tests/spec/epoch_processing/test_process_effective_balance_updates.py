@@ -1,0 +1,45 @@
+"""Effective-balance hysteresis (ref:
+test/phase0/epoch_processing/test_process_effective_balance_updates.py)."""
+from consensus_specs_tpu.test_framework.context import spec_state_test, with_all_phases
+from consensus_specs_tpu.test_framework.epoch_processing import run_epoch_processing_to
+
+
+@with_all_phases
+@spec_state_test
+def test_effective_balance_hysteresis(spec, state):
+    # Prepare epoch boundary-1 staging
+    run_epoch_processing_to(spec, state, "process_effective_balance_updates")
+
+    max_bal = spec.MAX_EFFECTIVE_BALANCE
+    min_bal = spec.config.EJECTION_BALANCE
+    inc = spec.EFFECTIVE_BALANCE_INCREMENT
+    div = spec.HYSTERESIS_QUOTIENT
+    hys_inc = inc // div
+    down = spec.HYSTERESIS_DOWNWARD_MULTIPLIER * hys_inc
+    up = spec.HYSTERESIS_UPWARD_MULTIPLIER * hys_inc
+
+    # (pre_eff, bal, post_eff, name)
+    cases = [
+        (max_bal, max_bal, max_bal, "as-is"),
+        (max_bal, max_bal - 1, max_bal, "round up"),
+        (max_bal, max_bal + 1, max_bal, "round down"),
+        (max_bal, max_bal - down, max_bal, "lower balance, but not low enough"),
+        (max_bal, max_bal - down - 1, max_bal - inc, "lower balance, step down"),
+        (max_bal, max_bal + (up * 3) // 2, max_bal, "already at max, as is"),
+        (max_bal - inc, max_bal - inc + up, max_bal - inc, "higher balance, but not high enough"),
+        (max_bal - inc, max_bal - inc + up + 1, max_bal, "higher balance, strong enough, step up"),
+        (min_bal, min_bal - down - 1, min_bal - inc, "ejection balance, step down"),
+    ]
+    current_epoch = spec.get_current_epoch(state)
+    for i, (pre_eff, bal, _, _) in enumerate(cases):
+        state.validators[i].effective_balance = pre_eff
+        state.balances[i] = bal
+        # Keep the validator active
+        assert spec.is_active_validator(state.validators[i], current_epoch)
+
+    yield "pre", state
+    spec.process_effective_balance_updates(state)
+    yield "post", state
+
+    for i, (_, _, post_eff, name) in enumerate(cases):
+        assert state.validators[i].effective_balance == post_eff, name

@@ -1,0 +1,79 @@
+"""Full-withdrawal sweep at the epoch boundary, Capella+ (ref:
+test/capella/epoch_processing/test_process_full_withdrawals.py)."""
+from consensus_specs_tpu.test_framework.context import (
+    spec_state_test,
+    with_capella_and_later,
+)
+from consensus_specs_tpu.test_framework.epoch_processing import run_epoch_processing_with
+
+
+def set_validator_withdrawable(spec, state, index, withdrawable_epoch=None):
+    if withdrawable_epoch is None:
+        withdrawable_epoch = spec.get_current_epoch(state)
+    validator = state.validators[index]
+    validator.withdrawable_epoch = withdrawable_epoch
+    validator.withdrawal_credentials = bytes(spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX) + bytes(
+        validator.withdrawal_credentials
+    )[1:]
+    assert spec.is_fully_withdrawable_validator(validator, withdrawable_epoch)
+
+
+def run_process_full_withdrawals(spec, state, num_expected_withdrawals):
+    pre_withdrawal_index = int(state.withdrawal_index)
+    pre_queue_len = len(state.withdrawals_queue)
+    to_be_withdrawn = [
+        index
+        for index, validator in enumerate(state.validators)
+        if spec.is_fully_withdrawable_validator(validator, spec.get_current_epoch(state))
+    ]
+    assert len(to_be_withdrawn) == num_expected_withdrawals
+
+    yield from run_epoch_processing_with(spec, state, "process_full_withdrawals")
+
+    for index in to_be_withdrawn:
+        assert state.validators[index].fully_withdrawn_epoch == spec.get_current_epoch(state)
+        assert state.balances[index] == 0
+    assert len(state.withdrawals_queue) == pre_queue_len + num_expected_withdrawals
+    assert state.withdrawal_index == pre_withdrawal_index + num_expected_withdrawals
+
+
+@with_capella_and_later
+@spec_state_test
+def test_no_withdrawals(spec, state):
+    pre_validators = state.validators.copy()
+    yield from run_process_full_withdrawals(spec, state, 0)
+    assert pre_validators == state.validators
+
+
+@with_capella_and_later
+@spec_state_test
+def test_no_withdrawals_but_some_next_epoch(spec, state):
+    current_epoch = spec.get_current_epoch(state)
+    for index in range(3):
+        set_validator_withdrawable(spec, state, index, current_epoch + 1)
+    yield from run_process_full_withdrawals(spec, state, 0)
+
+
+@with_capella_and_later
+@spec_state_test
+def test_single_withdrawal(spec, state):
+    set_validator_withdrawable(spec, state, 0)
+    assert state.withdrawal_index == 0
+    yield from run_process_full_withdrawals(spec, state, 1)
+    assert state.withdrawal_index == 1
+
+
+@with_capella_and_later
+@spec_state_test
+def test_multi_withdrawal(spec, state):
+    for index in range(3):
+        set_validator_withdrawable(spec, state, index)
+    yield from run_process_full_withdrawals(spec, state, 3)
+
+
+@with_capella_and_later
+@spec_state_test
+def test_all_withdrawal(spec, state):
+    for index in range(len(state.validators)):
+        set_validator_withdrawable(spec, state, index)
+    yield from run_process_full_withdrawals(spec, state, len(state.validators))

@@ -1,0 +1,16 @@
+"""Historical-roots accumulator (ref:
+test/phase0/epoch_processing/test_process_historical_roots_update.py)."""
+from consensus_specs_tpu.test_framework.context import spec_state_test, with_all_phases
+from consensus_specs_tpu.test_framework.epoch_processing import run_epoch_processing_with
+
+
+@with_all_phases
+@spec_state_test
+def test_historical_root_accumulator(spec, state):
+    # skip ahead to near the end of the historical roots period (excl block before epoch processing)
+    state.slot = spec.SLOTS_PER_HISTORICAL_ROOT - 1
+    history_len = len(state.historical_roots)
+
+    yield from run_epoch_processing_with(spec, state, "process_historical_roots_update")
+
+    assert len(state.historical_roots) == history_len + 1

@@ -1,0 +1,219 @@
+"""Inactivity-score updates, Altair+ (ref:
+test/altair/epoch_processing/test_process_inactivity_updates.py)."""
+from random import Random
+
+from consensus_specs_tpu.test_framework.attestations import prepare_state_with_attestations
+from consensus_specs_tpu.test_framework.context import (
+    spec_state_test,
+    with_altair_and_later,
+)
+from consensus_specs_tpu.test_framework.epoch_processing import run_epoch_processing_with
+from consensus_specs_tpu.test_framework.rewards import transition_to_leaking
+from consensus_specs_tpu.test_framework.state import next_epoch
+
+
+def run_inactivity_updates(spec, state):
+    yield from run_epoch_processing_with(spec, state, "process_inactivity_updates")
+
+
+def randomize_scores(spec, state, rng):
+    for i in range(len(state.validators)):
+        state.inactivity_scores[i] = rng.randint(0, 100)
+
+
+def set_full_participation(spec, state):
+    full = (
+        (1 << spec.TIMELY_HEAD_FLAG_INDEX)
+        | (1 << spec.TIMELY_SOURCE_FLAG_INDEX)
+        | (1 << spec.TIMELY_TARGET_FLAG_INDEX)
+    )
+    for i in range(len(state.validators)):
+        state.previous_epoch_participation[i] = full
+        state.current_epoch_participation[i] = full
+
+
+def clear_participation(spec, state):
+    for i in range(len(state.validators)):
+        state.previous_epoch_participation[i] = 0
+        state.current_epoch_participation[i] = 0
+
+
+@with_altair_and_later
+@spec_state_test
+def test_genesis(spec, state):
+    # no score movement in the genesis epoch
+    pre_scores = [int(s) for s in state.inactivity_scores]
+    yield from run_inactivity_updates(spec, state)
+    assert [int(s) for s in state.inactivity_scores] == pre_scores
+
+
+@with_altair_and_later
+@spec_state_test
+def test_all_zero_inactivity_scores_empty_participation(spec, state):
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    clear_participation(spec, state)
+
+    yield from run_inactivity_updates(spec, state)
+
+    # not leaking: scores bumped then decayed back — never negative
+    assert all(int(s) == 0 for s in state.inactivity_scores)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_all_zero_inactivity_scores_empty_participation_leaking(spec, state):
+    transition_to_leaking(spec, state)
+    clear_participation(spec, state)
+    assert spec.is_in_inactivity_leak(state)
+
+    yield from run_inactivity_updates(spec, state)
+
+    # leaking + not participating: every active validator's score grows
+    for i in spec.get_eligible_validator_indices(state):
+        assert int(state.inactivity_scores[i]) > 0
+
+
+@with_altair_and_later
+@spec_state_test
+def test_all_zero_inactivity_scores_full_participation(spec, state):
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    set_full_participation(spec, state)
+
+    yield from run_inactivity_updates(spec, state)
+
+    assert all(int(s) == 0 for s in state.inactivity_scores)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_all_zero_inactivity_scores_full_participation_leaking(spec, state):
+    transition_to_leaking(spec, state)
+    set_full_participation(spec, state)
+    # the leak staging itself bumped scores; zero them to isolate this run
+    for i in range(len(state.validators)):
+        state.inactivity_scores[i] = 0
+    assert spec.is_in_inactivity_leak(state)
+
+    yield from run_inactivity_updates(spec, state)
+
+    # participating target-timely: decrement floors at 0, no bump
+    assert all(int(s) == 0 for s in state.inactivity_scores)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_random_inactivity_scores_empty_participation(spec, state):
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    rng = Random(9999)
+    randomize_scores(spec, state, rng)
+    clear_participation(spec, state)
+    pre_scores = [int(s) for s in state.inactivity_scores]
+
+    yield from run_inactivity_updates(spec, state)
+
+    # not leaking: misses bump by bias then decay by recovery rate
+    bias = int(spec.config.INACTIVITY_SCORE_BIAS)
+    rec = int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE)
+    for i in spec.get_eligible_validator_indices(state):
+        expected = max(0, pre_scores[i] + bias - rec)
+        assert int(state.inactivity_scores[i]) == expected
+
+
+@with_altair_and_later
+@spec_state_test
+def test_random_inactivity_scores_full_participation(spec, state):
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    rng = Random(10101)
+    randomize_scores(spec, state, rng)
+    set_full_participation(spec, state)
+    pre_scores = [int(s) for s in state.inactivity_scores]
+
+    yield from run_inactivity_updates(spec, state)
+
+    # participating: -1 decrement, then recovery decay (not leaking)
+    rec = int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE)
+    for i in spec.get_eligible_validator_indices(state):
+        assert int(state.inactivity_scores[i]) == max(0, max(0, pre_scores[i] - 1) - rec)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_random_inactivity_scores_random_participation_leaking(spec, state):
+    transition_to_leaking(spec, state)
+    rng = Random(22222)
+    randomize_scores(spec, state, rng)
+    for i in range(len(state.validators)):
+        state.previous_epoch_participation[i] = rng.choice(
+            [0, 1 << spec.TIMELY_TARGET_FLAG_INDEX]
+        )
+    assert spec.is_in_inactivity_leak(state)
+    pre_scores = [int(s) for s in state.inactivity_scores]
+    target_flagged = {
+        int(i)
+        for i in spec.get_unslashed_participating_indices(
+            state, spec.TIMELY_TARGET_FLAG_INDEX, spec.get_previous_epoch(state)
+        )
+    }
+
+    yield from run_inactivity_updates(spec, state)
+
+    bias = int(spec.config.INACTIVITY_SCORE_BIAS)
+    for i in spec.get_eligible_validator_indices(state):
+        if i in target_flagged:
+            # participating in a leak: -1 decrement, no recovery decay
+            assert int(state.inactivity_scores[i]) == max(0, pre_scores[i] - 1)
+        else:
+            assert int(state.inactivity_scores[i]) == pre_scores[i] + bias
+
+
+@with_altair_and_later
+@spec_state_test
+def test_some_slashed_zero_scores_full_participation_leaking(spec, state):
+    transition_to_leaking(spec, state)
+    set_full_participation(spec, state)
+    for i in range(len(state.validators)):
+        state.inactivity_scores[i] = 0
+    slashed_count = len(state.validators) // 4
+    for i in range(slashed_count):
+        state.validators[i].slashed = True
+    assert spec.is_in_inactivity_leak(state)
+
+    yield from run_inactivity_updates(spec, state)
+
+    # slashed validators don't count as participating: their scores grow
+    for i in range(slashed_count):
+        assert int(state.inactivity_scores[i]) > 0
+    for i in spec.get_eligible_validator_indices(state):
+        if i >= slashed_count:
+            assert int(state.inactivity_scores[i]) == 0
+
+
+@with_altair_and_later
+@spec_state_test
+def test_full_participation_after_leak_recovers(spec, state):
+    """Scores seeded high decay by the recovery rate once participation is
+    full and the leak has ended."""
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    prepare_state_with_attestations(spec, state)
+    for i in range(len(state.validators)):
+        state.inactivity_scores[i] = 100
+    assert not spec.is_in_inactivity_leak(state)
+
+    yield from run_inactivity_updates(spec, state)
+
+    rec = int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE)
+    participating = {
+        int(i)
+        for i in spec.get_unslashed_participating_indices(
+            state, spec.TIMELY_TARGET_FLAG_INDEX, spec.get_previous_epoch(state)
+        )
+    }
+    for i in spec.get_eligible_validator_indices(state):
+        if i in participating:
+            # -1 decrement for participating, then recovery decay
+            assert int(state.inactivity_scores[i]) == 100 - 1 - rec

@@ -1,0 +1,90 @@
+"""Participation-flag rotation, Altair+ (ref:
+test/altair/epoch_processing/test_process_participation_flag_updates.py)."""
+from random import Random
+
+from consensus_specs_tpu.test_framework.context import (
+    spec_state_test,
+    with_altair_and_later,
+)
+from consensus_specs_tpu.test_framework.epoch_processing import run_epoch_processing_with
+from consensus_specs_tpu.test_framework.state import next_epoch
+
+
+FULL_FLAGS = 0b111
+
+
+def run_flag_updates(spec, state):
+    old_current = list(state.current_epoch_participation)
+    yield from run_epoch_processing_with(spec, state, "process_participation_flag_updates")
+    # rotation contract: current -> previous, current zeroed
+    assert list(state.previous_epoch_participation) == old_current
+    assert all(int(f) == 0 for f in state.current_epoch_participation)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_all_zeroed(spec, state):
+    next_epoch(spec, state)
+    for i in range(len(state.validators)):
+        state.previous_epoch_participation[i] = 0
+        state.current_epoch_participation[i] = 0
+    yield from run_flag_updates(spec, state)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_filled(spec, state):
+    next_epoch(spec, state)
+    for i in range(len(state.validators)):
+        state.previous_epoch_participation[i] = FULL_FLAGS
+        state.current_epoch_participation[i] = FULL_FLAGS
+    yield from run_flag_updates(spec, state)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_previous_filled(spec, state):
+    next_epoch(spec, state)
+    for i in range(len(state.validators)):
+        state.previous_epoch_participation[i] = FULL_FLAGS
+        state.current_epoch_participation[i] = 0
+    yield from run_flag_updates(spec, state)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_current_filled(spec, state):
+    next_epoch(spec, state)
+    for i in range(len(state.validators)):
+        state.previous_epoch_participation[i] = 0
+        state.current_epoch_participation[i] = FULL_FLAGS
+    yield from run_flag_updates(spec, state)
+
+
+def _random_flags(spec, state, rng):
+    for i in range(len(state.validators)):
+        state.previous_epoch_participation[i] = rng.randint(0, FULL_FLAGS)
+        state.current_epoch_participation[i] = rng.randint(0, FULL_FLAGS)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_random_0(spec, state):
+    next_epoch(spec, state)
+    _random_flags(spec, state, Random(100))
+    yield from run_flag_updates(spec, state)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_random_1(spec, state):
+    next_epoch(spec, state)
+    _random_flags(spec, state, Random(101))
+    yield from run_flag_updates(spec, state)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_random_genesis(spec, state):
+    _random_flags(spec, state, Random(102))
+    yield from run_flag_updates(spec, state)

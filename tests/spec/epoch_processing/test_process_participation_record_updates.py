@@ -1,0 +1,17 @@
+"""Pending-attestation rotation, phase0 only (ref:
+test/phase0/epoch_processing/test_process_participation_record_updates.py)."""
+from consensus_specs_tpu.test_framework.attestations import prepare_state_with_attestations
+from consensus_specs_tpu.test_framework.context import PHASE0, spec_state_test, with_phases
+from consensus_specs_tpu.test_framework.epoch_processing import run_epoch_processing_with
+
+
+@with_phases([PHASE0])
+@spec_state_test
+def test_updated_participation_record(spec, state):
+    prepare_state_with_attestations(spec, state)
+    current_atts = list(state.current_epoch_attestations)
+
+    yield from run_epoch_processing_with(spec, state, "process_participation_record_updates")
+
+    assert list(state.previous_epoch_attestations) == current_atts
+    assert len(state.current_epoch_attestations) == 0

@@ -1,0 +1,215 @@
+"""Rewards/penalties applied at the epoch boundary (ref:
+test/phase0/epoch_processing/test_process_rewards_and_penalties.py).
+Per-component delta validation lives in the rewards suites
+(tests/spec/test_rewards_*.py); these cases check the applied balance
+movements end-to-end through the sub-transition."""
+from random import Random
+
+from consensus_specs_tpu.test_framework.attestations import (
+    next_epoch_with_attestations,
+    prepare_state_with_attestations,
+)
+from consensus_specs_tpu.test_framework.context import (
+    PHASE0,
+    spec_state_test,
+    with_all_phases,
+    with_phases,
+)
+from consensus_specs_tpu.test_framework.epoch_processing import (
+    run_epoch_processing_to,
+    run_epoch_processing_with,
+)
+from consensus_specs_tpu.test_framework.rewards import transition_to_leaking
+from consensus_specs_tpu.test_framework.state import next_epoch
+from consensus_specs_tpu.test_framework.constants import is_post_altair
+
+
+def run_process_rewards_and_penalties(spec, state):
+    yield from run_epoch_processing_with(spec, state, "process_rewards_and_penalties")
+
+
+@with_all_phases
+@spec_state_test
+def test_genesis_epoch_no_attestations_no_penalties(spec, state):
+    pre_state = state.copy()
+    assert spec.compute_epoch_at_slot(state.slot) == spec.GENESIS_EPOCH
+
+    yield from run_process_rewards_and_penalties(spec, state)
+
+    # no penalties in the genesis epoch, even with zero participation
+    for index in range(len(pre_state.validators)):
+        assert state.balances[index] == pre_state.balances[index]
+
+
+@with_all_phases
+@spec_state_test
+def test_genesis_epoch_full_attestations_no_rewards(spec, state):
+    from consensus_specs_tpu.test_framework.attestations import get_valid_attestation
+    from consensus_specs_tpu.test_framework.state import next_slot
+
+    # fill attestations WITHOUT crossing the genesis epoch boundary
+    attestations = []
+    for slot in range(spec.SLOTS_PER_EPOCH - 1):
+        attestation = get_valid_attestation(spec, state, signed=True)
+        attestations.append(attestation)
+        if slot >= spec.MIN_ATTESTATION_INCLUSION_DELAY:
+            spec.process_attestation(state, attestations[slot - spec.MIN_ATTESTATION_INCLUSION_DELAY])
+        next_slot(spec, state)
+    assert spec.compute_epoch_at_slot(state.slot) == spec.GENESIS_EPOCH
+    pre_state = state.copy()
+
+    yield from run_process_rewards_and_penalties(spec, state)
+
+    # rewards never apply to the genesis epoch itself
+    for index in range(len(pre_state.validators)):
+        assert state.balances[index] == pre_state.balances[index]
+
+
+@with_all_phases
+@spec_state_test
+def test_full_attestation_participation(spec, state):
+    next_epoch(spec, state)
+    prepare_state_with_attestations(spec, state)
+    participating = spec.get_active_validator_indices(state, spec.get_previous_epoch(state))
+
+    run_epoch_processing_to(spec, state, "process_rewards_and_penalties")
+    pre_balances = [int(b) for b in state.balances]
+
+    yield "pre", state
+    spec.process_rewards_and_penalties(state)
+    yield "post", state
+
+    # every active validator attested perfectly: balances strictly increase
+    for index in participating:
+        assert int(state.balances[index]) > pre_balances[index]
+
+
+@with_all_phases
+@spec_state_test
+def test_full_attestation_participation_with_leak(spec, state):
+    transition_to_leaking(spec, state)
+    prepare_state_with_attestations(spec, state)
+
+    run_epoch_processing_to(spec, state, "process_rewards_and_penalties")
+    pre_balances = [int(b) for b in state.balances]
+
+    yield "pre", state
+    spec.process_rewards_and_penalties(state)
+    yield "post", state
+
+    # in a leak, perfect participation still forfeits some rewards
+    # (attesters lose at most nothing but gain no head/target rewards
+    # pre-altair; post-altair they keep flag rewards but no leak penalty)
+    assert any(int(b) != pb for b, pb in zip(state.balances, pre_balances)) or is_post_altair(spec)
+
+
+@with_phases([PHASE0])
+@spec_state_test
+def test_no_attestations_all_penalties(spec, state):
+    # move out of the genesis epoch so penalties apply
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    pre_state = state.copy()
+
+    yield from run_process_rewards_and_penalties(spec, state)
+
+    for index in range(len(pre_state.validators)):
+        assert state.balances[index] < pre_state.balances[index]
+
+
+@with_phases([PHASE0])
+@spec_state_test
+def test_duplicate_attestation(spec, state):
+    """The same participation recorded twice pays exactly once (ref
+    test_process_rewards_and_penalties.py:277)."""
+    next_epoch(spec, state)
+    prepare_state_with_attestations(spec, state)
+
+    # duplicate every previous-epoch pending attestation
+    for att in list(state.previous_epoch_attestations):
+        state.previous_epoch_attestations.append(att.copy())
+
+    single = state.copy()
+    # rebuild the single-counted twin by dropping the duplicates
+    n = len(single.previous_epoch_attestations) // 2
+    while len(single.previous_epoch_attestations) > n:
+        single.previous_epoch_attestations.pop()
+
+    run_epoch_processing_to(spec, state, "process_rewards_and_penalties")
+    run_epoch_processing_to(spec, single, "process_rewards_and_penalties")
+    yield "pre", state
+    spec.process_rewards_and_penalties(state)
+    spec.process_rewards_and_penalties(single)
+    yield "post", state
+
+    assert list(state.balances) == list(single.balances)
+
+
+@with_all_phases
+@spec_state_test
+def test_attestations_some_slashed(spec, state):
+    """Slashed validators earn nothing even when their participation was
+    recorded before the slashing."""
+    next_epoch(spec, state)
+    prepare_state_with_attestations(spec, state)
+    slashed_count = min(4, len(state.validators) // 4)
+    for i in range(slashed_count):
+        state.validators[i].slashed = True
+
+    run_epoch_processing_to(spec, state, "process_rewards_and_penalties")
+    pre_balances = [int(b) for b in state.balances]
+
+    yield "pre", state
+    spec.process_rewards_and_penalties(state)
+    yield "post", state
+
+    for i in range(slashed_count):
+        # a slashed validator can only be penalized, never rewarded
+        assert int(state.balances[i]) <= pre_balances[i]
+
+
+@with_all_phases
+@spec_state_test
+def test_almost_empty_attestations(spec, state):
+    """Only one attester per committee: most validators take penalties."""
+    rng = Random(1234)
+
+    def participation_fn(epoch, slot, index, comm):
+        return rng.sample(sorted(comm), 1)
+
+    next_epoch(spec, state)
+    prepare_state_with_attestations(spec, state, participation_fn=participation_fn)
+
+    run_epoch_processing_to(spec, state, "process_rewards_and_penalties")
+    pre_balances = [int(b) for b in state.balances]
+
+    yield "pre", state
+    spec.process_rewards_and_penalties(state)
+    yield "post", state
+
+    losers = sum(1 for b, pb in zip(state.balances, pre_balances) if int(b) < pb)
+    assert losers > len(state.validators) // 2
+
+
+@with_all_phases
+@spec_state_test
+def test_random_fill_attestations(spec, state):
+    """~1/3 participation: rewards and penalties both occur."""
+    rng = Random(4567)
+
+    def participation_fn(epoch, slot, index, comm):
+        return rng.sample(sorted(comm), len(comm) // 3)
+
+    next_epoch(spec, state)
+    prepare_state_with_attestations(spec, state, participation_fn=participation_fn)
+
+    run_epoch_processing_to(spec, state, "process_rewards_and_penalties")
+    pre_balances = [int(b) for b in state.balances]
+
+    yield "pre", state
+    spec.process_rewards_and_penalties(state)
+    yield "post", state
+
+    gained = sum(1 for b, pb in zip(state.balances, pre_balances) if int(b) > pb)
+    lost = sum(1 for b, pb in zip(state.balances, pre_balances) if int(b) < pb)
+    assert gained > 0 and lost > 0

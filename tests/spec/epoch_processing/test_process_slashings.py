@@ -1,0 +1,162 @@
+"""Correlated slashing penalties (ref:
+test/phase0/epoch_processing/test_process_slashings.py)."""
+from consensus_specs_tpu.test_framework.context import spec_state_test, with_all_phases
+from consensus_specs_tpu.test_framework.epoch_processing import (
+    run_epoch_processing_to,
+    run_epoch_processing_with,
+)
+from consensus_specs_tpu.test_framework.state import next_epoch
+
+
+def _slashing_multiplier(spec):
+    if spec.fork in ("bellatrix", "capella"):
+        return spec.PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX
+    if spec.fork == "altair":
+        return spec.PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR
+    return spec.PROPORTIONAL_SLASHING_MULTIPLIER
+
+
+def slash_validators(spec, state, indices, out_epochs):
+    total_slashed_balance = 0
+    for index, out_epoch in zip(indices, out_epochs):
+        v = state.validators[index]
+        v.slashed = True
+        v.withdrawable_epoch = out_epoch
+        total_slashed_balance += int(v.effective_balance)
+    state.slashings[spec.get_current_epoch(state) % spec.EPOCHS_PER_SLASHINGS_VECTOR] = (
+        total_slashed_balance
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_max_penalties(spec, state):
+    # Slash enough validators that the adjusted slashing balance caps at total
+    slashed_count = len(state.validators) // _slashing_multiplier(spec) + 1
+    out_epoch = spec.get_current_epoch(state) + (spec.EPOCHS_PER_SLASHINGS_VECTOR // 2)
+
+    slashed_indices = list(range(slashed_count))
+    slash_validators(spec, state, slashed_indices, [out_epoch] * slashed_count)
+
+    total_balance = spec.get_total_active_balance(state)
+    total_penalties = sum(int(s) for s in state.slashings)
+
+    assert total_balance <= total_penalties * _slashing_multiplier(spec)
+
+    yield from run_epoch_processing_with(spec, state, "process_slashings")
+
+    for i in slashed_indices:
+        assert state.balances[i] == 0
+
+
+@with_all_phases
+@spec_state_test
+def test_low_penalty(spec, state):
+    # Slash one validator: the penalty is proportional and small, not zero
+    # unless it rounds down to below one increment
+    next_epoch(spec, state)
+    out_epoch = spec.get_current_epoch(state) + (spec.EPOCHS_PER_SLASHINGS_VECTOR // 2)
+    slash_validators(spec, state, [0], [out_epoch])
+
+    run_epoch_processing_to(spec, state, "process_slashings")
+    pre_balance = int(state.balances[0])
+
+    yield "pre", state
+    spec.process_slashings(state)
+    yield "post", state
+
+    total_balance = int(spec.get_total_active_balance(state))
+    total_penalties = sum(int(s) for s in state.slashings)
+    v = state.validators[0]
+    expected_penalty = (
+        int(v.effective_balance) // int(spec.EFFECTIVE_BALANCE_INCREMENT)
+        * min(total_penalties * _slashing_multiplier(spec), total_balance)
+        // total_balance
+        * int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    )
+    assert state.balances[0] == pre_balance - expected_penalty
+
+
+@with_all_phases
+@spec_state_test
+def test_minimal_penalty(spec, state):
+    """A single slashed validator against a large total balance rounds the
+    proportional penalty down to zero increments."""
+    next_epoch(spec, state)
+    out_epoch = spec.get_current_epoch(state) + (spec.EPOCHS_PER_SLASHINGS_VECTOR // 2)
+    # tiny slashed balance relative to the total
+    state.validators[0].effective_balance = spec.EFFECTIVE_BALANCE_INCREMENT
+    slash_validators(spec, state, [0], [out_epoch])
+    state.slashings[spec.get_current_epoch(state) % spec.EPOCHS_PER_SLASHINGS_VECTOR] = 1
+
+    run_epoch_processing_to(spec, state, "process_slashings")
+    pre_balance = int(state.balances[0])
+
+    yield "pre", state
+    spec.process_slashings(state)
+    yield "post", state
+
+    # penalty floors at a whole-increment multiple: with slashings sum = 1
+    # gwei the increment-scaled product rounds to zero
+    assert state.balances[0] == pre_balance
+
+
+@with_all_phases
+@spec_state_test
+def test_scaled_penalties(spec, state):
+    # skip to next epoch
+    next_epoch(spec, state)
+
+    # Slash ~1/6 of validators
+    state.slashings[0] = spec.Gwei(0)
+    slashed_count = len(state.validators) // 6 + 1
+    out_epoch = spec.get_current_epoch(state) + (spec.EPOCHS_PER_SLASHINGS_VECTOR // 2)
+
+    slashed_indices = list(range(slashed_count))
+    for i in slashed_indices:
+        v = state.validators[i]
+        v.slashed = True
+        v.withdrawable_epoch = out_epoch
+        state.slashings[5 % spec.EPOCHS_PER_SLASHINGS_VECTOR] += v.effective_balance
+
+    # Stage everything before process_slashings, then capture balances:
+    # earlier sub-transitions (rewards) have already moved them.
+    run_epoch_processing_to(spec, state, "process_slashings")
+    total_balance = spec.get_total_active_balance(state)
+    total_penalties = sum(int(s) for s in state.slashings)
+    pre_slash_balances = [int(state.balances[i]) for i in slashed_indices]
+
+    yield "pre", state
+    spec.process_slashings(state)
+    yield "post", state
+
+    multiplier = _slashing_multiplier(spec)
+    for i in slashed_indices:
+        v = state.validators[i]
+        expected_penalty = (
+            int(v.effective_balance) // int(spec.EFFECTIVE_BALANCE_INCREMENT)
+            * (min(total_penalties * multiplier, total_balance))
+            // total_balance
+            * int(spec.EFFECTIVE_BALANCE_INCREMENT)
+        )
+        assert state.balances[i] == pre_slash_balances[slashed_indices.index(i)] - expected_penalty
+
+
+@with_all_phases
+@spec_state_test
+def test_no_slashings_out_of_window(spec, state):
+    """Validators whose withdrawable epoch is NOT at the slashing-window
+    midpoint take no penalty from this sub-transition."""
+    next_epoch(spec, state)
+    # withdrawable far from the halfway point
+    wrong_out_epoch = spec.get_current_epoch(state) + 1
+    slash_validators(spec, state, [0], [wrong_out_epoch])
+
+    run_epoch_processing_to(spec, state, "process_slashings")
+    pre_balance = int(state.balances[0])
+
+    yield "pre", state
+    spec.process_slashings(state)
+    yield "post", state
+
+    assert state.balances[0] == pre_balance

@@ -1,0 +1,15 @@
+"""Slashings-vector rotation (ref:
+test/phase0/epoch_processing/test_process_slashings_reset.py)."""
+from consensus_specs_tpu.test_framework.context import spec_state_test, with_all_phases
+from consensus_specs_tpu.test_framework.epoch_processing import run_epoch_processing_with
+
+
+@with_all_phases
+@spec_state_test
+def test_flush_slashings(spec, state):
+    next_epoch_index = (spec.get_current_epoch(state) + 1) % spec.EPOCHS_PER_SLASHINGS_VECTOR
+    state.slashings[next_epoch_index] = spec.Gwei(100)
+
+    yield from run_epoch_processing_with(spec, state, "process_slashings_reset")
+
+    assert state.slashings[next_epoch_index] == 0

@@ -1,0 +1,72 @@
+"""Sync-committee rotation at period boundaries, Altair+ (ref:
+test/altair/epoch_processing/test_process_sync_committee_updates.py)."""
+from consensus_specs_tpu.test_framework.context import (
+    misc_balances,
+    spec_state_test,
+    spec_test,
+    single_phase,
+    with_altair_and_later,
+    with_custom_state,
+    zero_activation_threshold,
+)
+from consensus_specs_tpu.test_framework.epoch_processing import run_epoch_processing_with
+from consensus_specs_tpu.test_framework.state import transition_to
+
+
+def run_sync_committees_progress_test(spec, state):
+    first_sync_committee = state.current_sync_committee.copy()
+    second_sync_committee = state.next_sync_committee.copy()
+
+    current_period = spec.get_current_epoch(state) // spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+    next_period_start_epoch = (current_period + 1) * spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+    # advance to the last slot before the period boundary epoch transition
+    transition_to(spec, state, next_period_start_epoch * spec.SLOTS_PER_EPOCH - 1)
+
+    yield from run_epoch_processing_with(spec, state, "process_sync_committee_updates")
+
+    # rotation: next becomes current, a fresh committee is sampled as next
+    # (at genesis both committees start equal, so only the rotation and the
+    # resample are asserted — not inequality with the first committee)
+    assert state.current_sync_committee == second_sync_committee
+    assert state.next_sync_committee == spec.get_next_sync_committee(state)
+    return first_sync_committee
+
+
+@with_altair_and_later
+@spec_state_test
+def test_sync_committees_progress_genesis(spec, state):
+    # genesis-period boundary
+    assert spec.get_current_epoch(state) == 0
+    yield from run_sync_committees_progress_test(spec, state)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_sync_committees_progress_not_genesis(spec, state):
+    # start one period in
+    transition_to(spec, state, spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH)
+    yield from run_sync_committees_progress_test(spec, state)
+
+
+@with_altair_and_later
+@spec_test
+@with_custom_state(balances_fn=misc_balances, threshold_fn=zero_activation_threshold)
+@single_phase
+def test_sync_committees_progress_misc_balances(spec, state):
+    yield from run_sync_committees_progress_test(spec, state)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_sync_committees_no_progress_not_boundary(spec, state):
+    # a non-boundary epoch transition must NOT rotate committees
+    assert spec.get_current_epoch(state) % spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD == 0
+    first_sync_committee = state.current_sync_committee.copy()
+    second_sync_committee = state.next_sync_committee.copy()
+    # stay strictly inside the period
+    transition_to(spec, state, spec.SLOTS_PER_EPOCH - 1)
+
+    yield from run_epoch_processing_with(spec, state, "process_sync_committee_updates")
+
+    assert state.current_sync_committee == first_sync_committee
+    assert state.next_sync_committee == second_sync_committee

@@ -1,0 +1,70 @@
+"""Sanity `slots` suite: pure process_slots advancement with no blocks
+(ref: test/phase0/sanity/test_slots.py). Vector format: pre-state,
+`slots` count (meta), post-state."""
+from consensus_specs_tpu.test_framework.context import spec_state_test, with_all_phases
+from consensus_specs_tpu.test_framework.state import get_state_root
+
+
+def run_slots(spec, state, slots):
+    yield "pre", state
+    yield "slots", int(slots)
+    spec.process_slots(state, state.slot + slots)
+    yield "post", state
+
+
+@with_all_phases
+@spec_state_test
+def test_slots_1(spec, state):
+    pre_slot = state.slot
+    pre_root = state.hash_tree_root()
+
+    yield "pre", state
+    slots = 1
+    yield "slots", int(slots)
+    spec.process_slots(state, state.slot + slots)
+    yield "post", state
+
+    assert state.slot == pre_slot + 1
+    # the skipped slot's state root is recorded
+    assert get_state_root(spec, state, pre_slot) == pre_root
+
+
+@with_all_phases
+@spec_state_test
+def test_slots_2(spec, state):
+    yield from run_slots(spec, state, 2)
+
+
+@with_all_phases
+@spec_state_test
+def test_empty_epoch(spec, state):
+    pre_slot = state.slot
+    yield from run_slots(spec, state, spec.SLOTS_PER_EPOCH)
+    assert state.slot == pre_slot + spec.SLOTS_PER_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_double_empty_epoch(spec, state):
+    pre_slot = state.slot
+    yield from run_slots(spec, state, spec.SLOTS_PER_EPOCH * 2)
+    assert state.slot == pre_slot + spec.SLOTS_PER_EPOCH * 2
+
+
+@with_all_phases
+@spec_state_test
+def test_over_epoch_boundary(spec, state):
+    spec.process_slots(state, state.slot + spec.SLOTS_PER_EPOCH // 2)
+    pre_slot = state.slot
+    yield from run_slots(spec, state, spec.SLOTS_PER_EPOCH)
+    assert state.slot == pre_slot + spec.SLOTS_PER_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_historical_accumulator(spec, state):
+    """Crossing a SLOTS_PER_HISTORICAL_ROOT boundary appends to
+    historical_roots."""
+    pre_len = len(state.historical_roots)
+    yield from run_slots(spec, state, spec.SLOTS_PER_HISTORICAL_ROOT)
+    assert len(state.historical_roots) == pre_len + 1

@@ -1,0 +1,201 @@
+"""Generalized-index proofs: single branches through every composite
+kind, length mix-ins, and multiproofs (ref: ssz/merkle-proofs.md:58-357).
+"""
+import pytest
+
+from consensus_specs_tpu.ssz.proof import (
+    calculate_merkle_root,
+    calculate_multi_merkle_root,
+    compute_merkle_multiproof,
+    compute_merkle_proof,
+    concat_generalized_indices,
+    get_branch_indices,
+    get_helper_indices,
+    get_path_indices,
+    hash_at_gindex,
+    verify_merkle_multiproof,
+    verify_merkle_proof,
+)
+from consensus_specs_tpu.ssz.types import (
+    Bitlist,
+    Bitvector,
+    ByteList,
+    Bytes32,
+    Container,
+    List,
+    Vector,
+    get_generalized_index,
+    uint64,
+)
+
+
+class Inner(Container):
+    a: uint64
+    b: Bytes32
+
+
+class Holder(Container):
+    slot: uint64
+    inner: Inner
+    nums: List[uint64, 1024]
+    items: List[Inner, 64]
+    vec: Vector[uint64, 8]
+    cvec: Vector[Inner, 4]
+    bits: Bitlist[100]
+    bv: Bitvector[12]
+    blob: ByteList[96]
+
+
+def make_holder() -> Holder:
+    return Holder(
+        slot=11,
+        inner=Inner(a=1, b=Bytes32(b"\x22" * 32)),
+        nums=list(range(40)),
+        items=[Inner(a=i) for i in range(5)],
+        vec=list(range(8)),
+        cvec=[Inner(a=9), Inner(a=8), Inner(a=7), Inner(a=6)],
+        bits=[True] * 20,
+        blob=b"\x33" * 50,
+    )
+
+
+def prove_and_verify(obj, path, leaf_obj=None):
+    gi = get_generalized_index(type(obj), *path)
+    proof = compute_merkle_proof(obj, gi)
+    leaf = hash_at_gindex(obj, gi)
+    root = bytes(obj.hash_tree_root())
+    assert verify_merkle_proof(leaf, proof, gi, root), (path, gi)
+    if leaf_obj is not None:
+        assert leaf == bytes(leaf_obj.hash_tree_root())
+    return gi, leaf, proof
+
+
+class TestSingleProofs:
+    def test_container_field(self):
+        h = make_holder()
+        prove_and_verify(h, ["slot"])
+        prove_and_verify(h, ["inner"], h.inner)
+
+    def test_nested_container_path(self):
+        h = make_holder()
+        prove_and_verify(h, ["inner", "b"], h.inner.b)
+
+    def test_composite_list_element(self):
+        h = make_holder()
+        prove_and_verify(h, ["items", 3], h.items[3])
+        prove_and_verify(h, ["items", 3, "a"])
+
+    def test_basic_list_chunk(self):
+        h = make_holder()
+        # element 9 lives in chunk 2 (4 uint64 per chunk)
+        gi = get_generalized_index(type(h), "nums", 9)
+        proof = compute_merkle_proof(h, gi)
+        leaf = hash_at_gindex(h, gi)
+        assert verify_merkle_proof(leaf, proof, gi, bytes(h.hash_tree_root()))
+        # the chunk leaf holds the packed elements 8..11
+        import struct
+
+        assert leaf == struct.pack("<4Q", 8, 9, 10, 11)
+
+    def test_list_length_mixin(self):
+        h = make_holder()
+        gi = get_generalized_index(type(h), "nums", "__len__")
+        proof = compute_merkle_proof(h, gi)
+        leaf = hash_at_gindex(h, gi)
+        assert leaf == (40).to_bytes(32, "little")
+        assert verify_merkle_proof(leaf, proof, gi, bytes(h.hash_tree_root()))
+
+    def test_vector_elements(self):
+        h = make_holder()
+        prove_and_verify(h, ["vec", 3])
+        prove_and_verify(h, ["cvec", 2], h.cvec[2])
+        prove_and_verify(h, ["cvec", 2, "a"])
+
+    def test_bits_and_bytes(self):
+        h = make_holder()
+        prove_and_verify(h, ["bits", 5])
+        prove_and_verify(h, ["bv", 3])
+        prove_and_verify(h, ["blob", 40])
+
+    def test_into_zero_padding_raises(self):
+        h = make_holder()
+        gi = get_generalized_index(type(h), "items", 9, "a")  # only 5 items
+        with pytest.raises(AssertionError):
+            compute_merkle_proof(h, gi)
+
+    def test_standalone_list_data_root(self):
+        nums = List[uint64, 16](1, 2, 3)
+        proof = compute_merkle_proof(nums, 2)
+        assert proof == [(3).to_bytes(32, "little")]
+        leaf = hash_at_gindex(nums, 2)
+        assert verify_merkle_proof(leaf, proof, 2, bytes(nums.hash_tree_root()))
+
+
+class TestIndexSets:
+    def test_branch_and_path(self):
+        assert get_branch_indices(9) == [8, 5, 3]
+        assert get_path_indices(9) == [9, 4, 2]
+
+    def test_helper_indices_excludes_paths(self):
+        helpers = get_helper_indices([9, 8])
+        assert 8 not in helpers and 9 not in helpers
+        assert helpers == sorted(helpers, reverse=True)
+
+    def test_concat(self):
+        # field 2 of a 4-leaf tree (gi 6), then child 1 of a 2-leaf tree
+        assert concat_generalized_indices(6, 3) == 13
+
+
+class TestMultiproofs:
+    def test_two_fields(self):
+        h = make_holder()
+        gis = [
+            get_generalized_index(type(h), "slot"),
+            get_generalized_index(type(h), "inner", "a"),
+        ]
+        leaves = [hash_at_gindex(h, gi) for gi in gis]
+        witness = compute_merkle_multiproof(h, gis)
+        assert verify_merkle_multiproof(leaves, witness, gis, bytes(h.hash_tree_root()))
+
+    def test_siblings_share_witness(self):
+        h = make_holder()
+        gis = [
+            get_generalized_index(type(h), "inner", "a"),
+            get_generalized_index(type(h), "inner", "b"),
+        ]
+        leaves = [hash_at_gindex(h, gi) for gi in gis]
+        witness = compute_merkle_multiproof(h, gis)
+        # sibling leaves need strictly fewer helpers than two separate proofs
+        assert len(witness) < len(compute_merkle_proof(h, gis[0])) + len(
+            compute_merkle_proof(h, gis[1])
+        )
+        assert verify_merkle_multiproof(leaves, witness, gis, bytes(h.hash_tree_root()))
+
+    def test_across_subtrees(self):
+        h = make_holder()
+        gis = [
+            get_generalized_index(type(h), "items", 2, "a"),
+            get_generalized_index(type(h), "nums", "__len__"),
+            get_generalized_index(type(h), "vec", 7),
+        ]
+        leaves = [hash_at_gindex(h, gi) for gi in gis]
+        witness = compute_merkle_multiproof(h, gis)
+        assert verify_merkle_multiproof(leaves, witness, gis, bytes(h.hash_tree_root()))
+
+    def test_bad_leaf_rejected(self):
+        h = make_holder()
+        gis = [get_generalized_index(type(h), "slot")]
+        witness = compute_merkle_multiproof(h, gis)
+        assert not verify_merkle_multiproof(
+            [b"\xff" * 32], witness, gis, bytes(h.hash_tree_root())
+        )
+
+
+class TestFoldEquivalence:
+    def test_calculate_matches_single(self):
+        h = make_holder()
+        gi = get_generalized_index(type(h), "inner", "b")
+        proof = compute_merkle_proof(h, gi)
+        leaf = hash_at_gindex(h, gi)
+        assert calculate_merkle_root(leaf, proof, gi) == bytes(h.hash_tree_root())
+        assert calculate_multi_merkle_root([leaf], proof, [gi]) == bytes(h.hash_tree_root())

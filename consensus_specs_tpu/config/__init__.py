@@ -9,6 +9,13 @@ setup.py:218-247,782-806 and eth2spec/config/config_util.py).
 """
 from .presets import PRESETS, preset_for
 from .runtime import CONFIGS, Config, config_for, load_config_file, parse_config_var
+from .yaml_io import (
+    load_network,
+    load_preset_dir,
+    load_yaml_vars,
+    register_config,
+    register_preset,
+)
 
 __all__ = [
     "PRESETS",
@@ -18,4 +25,9 @@ __all__ = [
     "config_for",
     "load_config_file",
     "parse_config_var",
+    "load_yaml_vars",
+    "load_preset_dir",
+    "register_preset",
+    "register_config",
+    "load_network",
 ]

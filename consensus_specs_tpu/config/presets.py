@@ -117,6 +117,16 @@ _MAINNET_SHARDING = dict(
     TARGET_SAMPLES_PER_BLOCK=2**10,
     MAX_SAMPLE_PRICE=2**33,
     MIN_SAMPLE_PRICE=2**3,
+    # development KZG setup size = MAX_DEGREE+1 (the reference leaves the
+    # setup undefined, sharding/beacon-chain.md:170-173); mainnet covers
+    # the full MAX_SAMPLES_PER_BLOCK * POINTS_PER_SAMPLE degree bound
+    KZG_SETUP_SIZE=2**14,
+)
+
+_MAINNET_EIP4844 = dict(
+    # eip4844/beacon-chain.md:54 + p2p-interface.md:40
+    FIELD_ELEMENTS_PER_BLOB=4096,
+    MAX_BLOBS_PER_BLOCK=2**4,
 )
 
 # -- minimal (only keys that differ from mainnet) ----------------------------
@@ -163,6 +173,12 @@ _MINIMAL_SHARDING = dict(
     MAX_SHARDS=8,
     INITIAL_ACTIVE_SHARDS=2,
     MAX_SHARD_PROPOSER_SLASHINGS=4,
+    KZG_SETUP_SIZE=64,  # fast dev setup; degree bound 64 points
+)
+
+_MINIMAL_EIP4844 = dict(
+    _MAINNET_EIP4844,
+    FIELD_ELEMENTS_PER_BLOB=4,  # tiny blobs for fast minimal-preset tests
 )
 
 PRESETS: Dict[str, Dict[str, Dict[str, int]]] = {
@@ -173,6 +189,7 @@ PRESETS: Dict[str, Dict[str, Dict[str, int]]] = {
         "capella": _MAINNET_CAPELLA,
         "custody_game": _MAINNET_CUSTODY,
         "sharding": _MAINNET_SHARDING,
+        "eip4844": _MAINNET_EIP4844,
     },
     "minimal": {
         "phase0": _MINIMAL_PHASE0,
@@ -181,6 +198,7 @@ PRESETS: Dict[str, Dict[str, Dict[str, int]]] = {
         "capella": _MINIMAL_CAPELLA,
         "custody_game": _MINIMAL_CUSTODY,
         "sharding": _MINIMAL_SHARDING,
+        "eip4844": _MINIMAL_EIP4844,
     },
 }
 

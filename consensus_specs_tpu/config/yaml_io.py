@@ -1,0 +1,80 @@
+"""YAML preset/config loading — the reference's file-driven configuration
+tier (ref: presets/{mainnet,minimal}/*.yaml, configs/*.yaml,
+setup.py:782-806, eth2spec/config/config_util.py:25-63).
+
+Clients re-point the framework at custom networks by loading their YAML
+files and registering them under a name; `build_spec(fork, name)` then
+builds against them like any built-in bundle. The reference's own preset
+and config files load verbatim (see tests/test_config_yaml.py, which
+checks them against the hardcoded bundles key by key).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+from .presets import PRESETS
+from .runtime import CONFIGS
+
+def load_yaml_vars(path: str) -> Dict[str, Any]:
+    """Flat `KEY: value` YAML file → parsed dict.
+
+    Deliberately NOT yaml.safe_load: YAML 1.1 reads `0x...` as an integer,
+    destroying the hex-bytes-vs-number distinction these files rely on
+    (the reference keeps values as strings via ruamel round-trip mode,
+    config_util.py:25-35). The flat line parser preserves it."""
+    from .runtime import load_config_file
+
+    return load_config_file(path)
+
+
+def load_preset_dir(path: str) -> Dict[str, Dict[str, Any]]:
+    """A reference-layout preset directory (one YAML per fork) → per-fork
+    variable dicts (ref setup.py:782-792). Every ``*.yaml`` file loads
+    (stem = fork name), so fork files beyond the built-in set are kept,
+    not silently dropped; missing fork files simply have an empty delta."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for fn in sorted(os.listdir(path)):
+        if fn.endswith(".yaml"):
+            out[fn[: -len(".yaml")]] = load_yaml_vars(os.path.join(path, fn))
+    return out
+
+
+def register_preset(name: str, per_fork: Dict[str, Dict[str, Any]], base: Optional[str] = None) -> None:
+    """Make a preset bundle available to `build_spec` under `name`.
+
+    With `base`, the new bundle starts from a copy of an existing preset
+    and the given per-fork vars override it (a customized-minimal network
+    only states its deltas)."""
+    if base is not None:
+        bundle = {f: dict(v) for f, v in PRESETS[base].items()}
+    else:
+        bundle = {}
+    for fork, vars_ in per_fork.items():
+        bundle.setdefault(fork, {}).update(vars_)
+    PRESETS[name] = bundle
+
+
+def register_config(name: str, values: Dict[str, Any], base: Optional[str] = None) -> None:
+    """Make a runtime config available to `build_spec` under `name`
+    (ref config_util.py:25-63's load-into-globals, done by registration
+    instead of module mutation). CONFIG_NAME becomes `name` unless the
+    values themselves set one — a base's name never leaks through."""
+    merged = dict(CONFIGS[base]) if base is not None else {}
+    merged.update(values)
+    if "CONFIG_NAME" not in values:
+        merged["CONFIG_NAME"] = name
+    CONFIGS[name] = merged
+
+
+def load_network(name: str, preset_dir: str, config_file: str, base_preset: Optional[str] = None) -> str:
+    """One-call client entry: load a network's preset directory + config
+    file and register both under `name`. Returns the name (use it as the
+    `preset_name` for `build_spec`; the config registers under the same
+    key). The config's PRESET_BASE is the default base for BOTH tiers;
+    `base_preset` overrides it for both."""
+    cfg = load_yaml_vars(config_file)
+    base = base_preset or cfg.get("PRESET_BASE")
+    register_preset(name, load_preset_dir(preset_dir), base=base if base in PRESETS else None)
+    register_config(name, cfg, base=base if base in CONFIGS else None)
+    return name

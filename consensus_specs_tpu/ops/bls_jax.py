@@ -135,13 +135,18 @@ def clear_caches() -> None:
 _Pair = Tuple[Optional[Tuple[np.ndarray, np.ndarray]], object]
 
 
-def _run_checks(checks: Sequence[Optional[List[_Pair]]]) -> np.ndarray:
-    n = len(checks)
-    out = np.zeros(n, dtype=bool)
+def _pack_checks(checks: Sequence[Optional[List[_Pair]]], min_rows: int = _MIN_BUCKET,
+                 row_multiple: int = 1):
+    """Pack live checks into (B, K)-bucketed limb arrays for the pairing
+    kernel. Returns (arrays, live-index list); None when nothing is live.
+    ``row_multiple`` rounds the row count up so a mesh axis of any size
+    divides it (sharded callers)."""
     live = [i for i, c in enumerate(checks) if c is not None and len(c) > 0]
     if not live:
-        return out
-    b = _bucket(len(live))
+        return None, live
+    b = _bucket(len(live), minimum=min_rows)
+    if b % row_multiple:
+        b += row_multiple - b % row_multiple
     k = _bucket(max(len(checks[i]) for i in live), minimum=2)
     gx, gy = _neg_g1_limbs()
     px = np.tile(gx, (b, k, 1))
@@ -159,10 +164,59 @@ def _run_checks(checks: Sequence[Optional[List[_Pair]]]) -> np.ndarray:
             qx[row, col] = q[0]
             qy[row, col] = q[1]
             active[row, col] = True
-    ok = np.asarray(pairing_jax.pairing_check_jit(px, py, qx, qy, active))
+    return (px, py, qx, qy, active), live
+
+
+def _run_checks(checks: Sequence[Optional[List[_Pair]]]) -> np.ndarray:
+    out = np.zeros(len(checks), dtype=bool)
+    packed, live = _pack_checks(checks)
+    if packed is None:
+        return out
+    ok = np.asarray(pairing_jax.pairing_check_jit(*packed))
     for row, i in enumerate(live):
         out[i] = bool(ok[row])
     return out
+
+
+def run_checks_sharded(checks: Sequence[Optional[List[_Pair]]], mesh, axis_name: str = "dp"):
+    """Pairing checks sharded over a device mesh's batch axis
+    (SURVEY §2.6 collectives row: the cross-chip verify shape).
+
+    Rows are placed `PartitionSpec(axis_name)` so each device runs the
+    Miller loops of its shard; the accept mask comes back per-row, and the
+    accepted-count is reduced with an explicit `psum` over the mesh axis
+    (ICI collective on real hardware). Returns (mask, accepted_count)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    out = np.zeros(len(checks), dtype=bool)
+    n_axis = mesh.shape[axis_name]
+    packed, live = _pack_checks(
+        checks, min_rows=max(_MIN_BUCKET, n_axis), row_multiple=n_axis
+    )
+    if packed is None:
+        return out, 0
+    row_sharding = NamedSharding(mesh, P(axis_name))
+    px, py, qx, qy, active = (jax.device_put(a, row_sharding) for a in packed)
+    ok = pairing_jax.pairing_check_jit(px, py, qx, qy, active)
+
+    # bucket-padding rows are all-inactive and the empty pairing product
+    # == 1, so the kernel reports them True; mask them device-side before
+    # the cross-shard reduction
+    real = np.zeros(len(ok), dtype=bool)
+    real[: len(live)] = True
+    real = jax.device_put(real, row_sharding)
+
+    def local_count(mask, is_real):
+        return jax.lax.psum((mask & is_real).sum(dtype=np.int32), axis_name)
+
+    count = jax.shard_map(
+        local_count, mesh=mesh, in_specs=(P(axis_name), P(axis_name)), out_specs=P()
+    )(ok, real)
+    ok = np.asarray(ok)
+    for row, i in enumerate(live):
+        out[i] = bool(ok[row])
+    return out, int(np.asarray(count))
 
 
 # -- check builders (exact ciphersuite semantics) ----------------------------
@@ -259,6 +313,29 @@ def fast_aggregate_verify_batch(pubkey_lists, messages, signatures) -> np.ndarra
             _fast_aggregate_verify_check(pks, m, s)
             for pks, m, s in zip(pubkey_lists, messages, signatures)
         ]
+    )
+
+
+def verify_batch_sharded(pubkeys, messages, signatures, mesh, axis_name: str = "dp"):
+    """`verify_batch` with the pairing batch sharded over a mesh axis and
+    the accept count psum-reduced across shards. Returns (mask, count)."""
+    return run_checks_sharded(
+        [_verify_check(p, m, s) for p, m, s in zip(pubkeys, messages, signatures)],
+        mesh,
+        axis_name,
+    )
+
+
+def fast_aggregate_verify_batch_sharded(pubkey_lists, messages, signatures, mesh, axis_name: str = "dp"):
+    """`fast_aggregate_verify_batch` sharded over a mesh axis (the
+    128-attestation block shape distributed across chips)."""
+    return run_checks_sharded(
+        [
+            _fast_aggregate_verify_check(pks, m, s)
+            for pks, m, s in zip(pubkey_lists, messages, signatures)
+        ],
+        mesh,
+        axis_name,
     )
 
 

@@ -18,12 +18,26 @@ from ..config import config_for, preset_for
 
 FORK_ORDER = ["phase0", "altair", "bellatrix", "capella"]
 
+# R&D forks branch off the production chain rather than extending its tip
+# (ref: setup.py's builder hierarchy — sharding extends bellatrix,
+# custody_game and das extend sharding, eip4844 extends bellatrix)
+RND_FORK_CHAINS = {
+    "sharding": ["phase0", "altair", "bellatrix", "sharding"],
+    "custody_game": ["phase0", "altair", "bellatrix", "sharding", "custody_game"],
+    "das": ["phase0", "altair", "bellatrix", "sharding", "das"],
+    "eip4844": ["phase0", "altair", "bellatrix", "eip4844"],
+}
+
 # Previous fork mapping (linear chain for the production forks)
 PREVIOUS_FORK = {
     "phase0": None,
     "altair": "phase0",
     "bellatrix": "altair",
     "capella": "bellatrix",
+    "sharding": "bellatrix",
+    "custody_game": "sharding",
+    "das": "sharding",
+    "eip4844": "bellatrix",
 }
 
 _SOURCE_DIR = Path(__file__).resolve().parent
@@ -32,13 +46,26 @@ _code_cache: Dict[str, Any] = {}
 
 
 def available_forks():
-    """Forks whose spec source exists on disk, in dependency order."""
+    """Production forks whose spec source exists on disk, in dependency
+    order. R&D branches are deliberately NOT included: generators iterate
+    this list and the reference keeps R&D testgen disabled
+    (tests/generators/operations/main.py:26-34)."""
     return [f for f in FORK_ORDER if (_SOURCE_DIR / f"{f}.py").exists()]
 
 
+def available_rnd_forks():
+    """R&D branch forks with spec sources — selectable only by explicit
+    `with_phases([...])` in tests, never by generators."""
+    return [f for f in RND_FORK_CHAINS if (_SOURCE_DIR / f"{f}.py").exists()]
+
+
 def _fork_chain(fork: str):
+    if fork in RND_FORK_CHAINS:
+        return RND_FORK_CHAINS[fork]
     if fork not in FORK_ORDER:
-        raise ValueError(f"unknown fork {fork!r} (have {FORK_ORDER})")
+        raise ValueError(
+            f"unknown fork {fork!r} (have {FORK_ORDER + sorted(RND_FORK_CHAINS)})"
+        )
     return FORK_ORDER[: FORK_ORDER.index(fork) + 1]
 
 

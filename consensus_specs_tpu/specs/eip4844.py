@@ -1,0 +1,162 @@
+"""EIP-4844 (proto-danksharding) spec source — delta over bellatrix
+(ref: specs/eip4844/{beacon-chain,fork,validator,p2p-interface}.md at
+v1.1.10).
+
+Blob transactions carry KZG-committed data; the consensus layer checks
+the block's `blob_kzgs` list against the versioned hashes peeked from
+execution-payload transactions. The reference leaves the trusted setup
+"contents TBD" (eip4844/beacon-chain.md:70-73); here KZG commitments are
+fully functional against the deterministic development setup
+(crypto/kzg.py — INSECURE, test/dev only), with the batched device FFT
+path in ops/fft_jax.py behind the same host-oracle semantics.
+"""
+
+# ---------------------------------------------------------------------------
+# Custom types (eip4844/beacon-chain.md:40-48)
+# ---------------------------------------------------------------------------
+
+class BLSFieldElement(uint256):  # noqa: F821
+    pass
+
+
+Blob = Vector[BLSFieldElement, FIELD_ELEMENTS_PER_BLOB]  # noqa: F821
+
+
+class VersionedHash(Bytes32):  # noqa: F821
+    pass
+
+
+class KZGCommitment(Bytes48):  # noqa: F821
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Constants (eip4844/beacon-chain.md:50-61, fork.md:10-14)
+# ---------------------------------------------------------------------------
+
+BLOB_TX_TYPE = 0x05
+BLS_MODULUS = 52435875175126190479447740508185965837690552500527637822603658699938581184513
+DOMAIN_BLOBS_SIDECAR = Bytes4(bytes.fromhex("0a000000"))  # noqa: F821
+# versioned-hash prefix byte for KZG commitments
+BLOB_COMMITMENT_VERSION_KZG = b"\x01"
+
+
+# ---------------------------------------------------------------------------
+# Trusted setup (eip4844/beacon-chain.md:65-73 — "TBD" upstream; the
+# in-tree development setup stands in; see crypto/kzg.insecure_setup)
+# ---------------------------------------------------------------------------
+
+class _LazySetup:
+    """Defers the (expensive) setup construction until KZG is first used,
+    so spec builds stay fast for the (majority of) tests that never touch
+    blobs."""
+
+    def __init__(self, size):
+        self._size = int(size)
+        self._setup = None
+
+    def get(self):
+        if self._setup is None:
+            from consensus_specs_tpu.crypto.kzg import insecure_setup
+
+            self._setup = insecure_setup(self._size)
+        return self._setup
+
+
+_KZG = _LazySetup(FIELD_ELEMENTS_PER_BLOB)  # noqa: F821
+
+
+# ---------------------------------------------------------------------------
+# Containers (eip4844/beacon-chain.md:84-101)
+# ---------------------------------------------------------------------------
+
+class BeaconBlockBody(Container):  # noqa: F821
+    randao_reveal: BLSSignature  # noqa: F821
+    eth1_data: Eth1Data  # noqa: F821
+    graffiti: Bytes32  # noqa: F821
+    proposer_slashings: List[ProposerSlashing, MAX_PROPOSER_SLASHINGS]  # noqa: F821
+    attester_slashings: List[AttesterSlashing, MAX_ATTESTER_SLASHINGS]  # noqa: F821
+    attestations: List[Attestation, MAX_ATTESTATIONS]  # noqa: F821
+    deposits: List[Deposit, MAX_DEPOSITS]  # noqa: F821
+    voluntary_exits: List[SignedVoluntaryExit, MAX_VOLUNTARY_EXITS]  # noqa: F821
+    sync_aggregate: SyncAggregate  # noqa: F821
+    execution_payload: ExecutionPayload  # noqa: F821
+    blob_kzgs: List[KZGCommitment, MAX_BLOBS_PER_BLOCK]  # [New in EIP-4844]  # noqa: F821
+
+
+class BeaconBlock(Container):  # noqa: F821
+    slot: Slot  # noqa: F821
+    proposer_index: ValidatorIndex  # noqa: F821
+    parent_root: Root  # noqa: F821
+    state_root: Root  # noqa: F821
+    body: BeaconBlockBody
+
+
+class SignedBeaconBlock(Container):  # noqa: F821
+    message: BeaconBlock
+    signature: BLSSignature  # noqa: F821
+
+
+# ---------------------------------------------------------------------------
+# KZG core (eip4844/beacon-chain.md:105-133)
+# ---------------------------------------------------------------------------
+
+def blob_to_kzg(blob: "Blob") -> "KZGCommitment":
+    """Commit to the blob's field elements in the Lagrange basis
+    (eip4844/beacon-chain.md:111-123). The MSM runs against the
+    Lagrange-form setup (KZG_SETUP_LAGRANGE analog)."""
+    from consensus_specs_tpu.crypto import kzg as _kzg
+
+    for value in blob:
+        assert value < BLS_MODULUS
+    return KZGCommitment(_kzg.commit_to_evaluations([int(v) for v in blob], _KZG.get()))
+
+
+def kzg_to_versioned_hash(kzg: "KZGCommitment") -> "VersionedHash":
+    return VersionedHash(BLOB_COMMITMENT_VERSION_KZG + hash(kzg)[1:])
+
+
+def tx_peek_blob_versioned_hashes(opaque_tx: "Transaction"):  # noqa: F821
+    """SSZ-offset peek into a blob transaction's versioned hashes
+    (eip4844/beacon-chain.md:138-145)."""
+    assert opaque_tx[0] == BLOB_TX_TYPE
+    message_offset = 1 + int.from_bytes(opaque_tx[1:5], "little")
+    # field offset within SignedBlobTransaction.message: 32+8+32+32+8+4+32+4+4
+    # (SSZ offsets are relative to the message start; the reference's draft
+    # reads the raw value as absolute — the relative interpretation here is
+    # the normative SSZ behavior, simple-serialize.md:105-187)
+    blob_versioned_hashes_offset = int.from_bytes(
+        opaque_tx[message_offset + 156 : message_offset + 160], "little"
+    )
+    return [
+        VersionedHash(opaque_tx[x : x + 32])
+        for x in range(message_offset + blob_versioned_hashes_offset, len(opaque_tx), 32)
+    ]
+
+
+def verify_kzgs_against_transactions(transactions, blob_kzgs) -> bool:
+    """(eip4844/beacon-chain.md:149-155)"""
+    all_versioned_hashes = []
+    for tx in transactions:
+        if len(tx) > 0 and tx[0] == BLOB_TX_TYPE:
+            all_versioned_hashes.extend(tx_peek_blob_versioned_hashes(tx))
+    return all_versioned_hashes == [kzg_to_versioned_hash(kzg) for kzg in blob_kzgs]
+
+
+# ---------------------------------------------------------------------------
+# Block processing (eip4844/beacon-chain.md:160-178)
+# ---------------------------------------------------------------------------
+
+def process_blob_kzgs(state: "BeaconState", body: "BeaconBlockBody") -> None:  # noqa: F821
+    assert verify_kzgs_against_transactions(body.execution_payload.transactions, body.blob_kzgs)
+
+
+def process_block(state: "BeaconState", block: "BeaconBlock") -> None:  # noqa: F821
+    process_block_header(state, block)  # noqa: F821
+    if is_execution_enabled(state, block.body):  # noqa: F821
+        process_execution_payload(state, block.body.execution_payload, EXECUTION_ENGINE)  # noqa: F821
+    process_randao(state, block.body)  # noqa: F821
+    process_eth1_data(state, block.body)  # noqa: F821
+    process_operations(state, block.body)  # noqa: F821
+    process_sync_aggregate(state, block.body.sync_aggregate)  # noqa: F821
+    process_blob_kzgs(state, block.body)  # [New in EIP-4844]

@@ -241,9 +241,22 @@ def _proof(obj, bits: str) -> PyList[bytes]:
 
 
 def _subtree_proof(chunks, depth, children, bits: str) -> PyList[bytes]:
+    if len(bits) <= depth:
+        # the proven node lives in THIS tree — possibly an interior node
+        # (e.g. a custody-chunk subtree root inside a ByteList's data tree)
+        idx = int(bits, 2) if bits else 0
+        base = depth - len(bits)  # height of the proven node
+        levels = _levels(chunks, depth)
+        siblings = []
+        pos = idx
+        for level in range(base, depth):  # proven-node-level sibling first
+            row = levels[level]
+            sib = pos ^ 1
+            siblings.append(row[sib] if sib < len(row) else ZERO_HASHES[level])
+            pos //= 2
+        return siblings
     tree_bits, rest = bits[:depth], bits[depth:]
-    assert len(tree_bits) == depth, "generalized index path ends at an interior node"
-    idx = int(tree_bits, 2) if tree_bits else 0
+    idx = int(tree_bits, 2) if tree_bits else 0  # depth-0 subtree: one child
     levels = _levels(chunks, depth)
     siblings = []
     pos = idx
@@ -252,8 +265,6 @@ def _subtree_proof(chunks, depth, children, bits: str) -> PyList[bytes]:
         sib = pos ^ 1
         siblings.append(row[sib] if sib < len(row) else ZERO_HASHES[level])
         pos //= 2
-    if not rest:
-        return siblings
     assert children is not None, "cannot descend into packed basic chunks"
     assert idx < len(children), "path descends into zero padding"
     return _proof(children[idx], rest) + siblings

@@ -1161,6 +1161,19 @@ class Union(_Cached, SSZType):
         return _parameterize(Union, params, f"Union[{names}]", {"options": params})
 
     def __init__(self, selector: int, value: Any = None):
+        self.change(selector, value)
+
+    def __setattr__(self, name, value):
+        object.__setattr__(self, name, value)
+        if name in ("value", "selector"):
+            if name == "value":
+                self._link_child(value, 0)
+            self._mark_self_dirty()
+
+    def change(self, selector: int, value: Any = None) -> None:
+        """Re-point the union in place (remerkleable's `.change` API the
+        sharding spec mutates ShardWork.status with,
+        specs/sharding/beacon-chain.md:659-671)."""
         if not (0 <= selector < len(self.options)):
             raise ValueError(f"{type(self).__name__}: bad selector {selector}")
         opt = self.options[selector]
@@ -1171,13 +1184,6 @@ class Union(_Cached, SSZType):
         else:
             self.value = opt.coerce(value)
         self.selector = selector
-
-    def __setattr__(self, name, value):
-        object.__setattr__(self, name, value)
-        if name in ("value", "selector"):
-            if name == "value":
-                self._link_child(value, 0)
-            self._mark_self_dirty()
 
     @classmethod
     def is_fixed_byte_length(cls) -> bool:

@@ -75,10 +75,14 @@ def build_empty_block(spec, state, slot=None):
 
     if is_post_altair(spec):
         empty_block.body.sync_aggregate.sync_committee_signature = spec.G2_POINT_AT_INFINITY
-    if is_post_bellatrix(spec) and spec.is_execution_enabled(state, empty_block.body):
-        from .execution_payload import build_empty_execution_payload
+    if is_post_bellatrix(spec):
+        # sharding+ drop is_execution_enabled: execution is always on
+        # (sharding/beacon-chain.md:551-553)
+        always_on = spec.fork in ("sharding", "custody_game", "das")
+        if always_on or spec.is_execution_enabled(state, empty_block.body):
+            from .execution_payload import build_empty_execution_payload
 
-        empty_block.body.execution_payload = build_empty_execution_payload(spec, state)
+            empty_block.body.execution_payload = build_empty_execution_payload(spec, state)
 
     apply_randao_reveal(spec, state, empty_block)
     return empty_block

@@ -5,8 +5,16 @@ ALTAIR = "altair"
 BELLATRIX = "bellatrix"
 CAPELLA = "capella"
 
+# R&D branches (ref constants.py SHARDING/CUSTODY_GAME/DAS — unstable,
+# excluded from the production fork matrix and vector generation)
+SHARDING = "sharding"
+CUSTODY_GAME = "custody_game"
+DAS = "das"
+EIP4844 = "eip4844"
+
 # In dependency order
 ALL_PHASES = (PHASE0, ALTAIR, BELLATRIX, CAPELLA)
+RND_PHASES = (SHARDING, CUSTODY_GAME, DAS, EIP4844)
 # Forks with enabled vector generation (ref constants.py:19-22)
 TESTGEN_FORKS = (PHASE0, ALTAIR, BELLATRIX)
 

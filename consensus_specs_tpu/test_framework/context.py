@@ -21,6 +21,7 @@ from .utils import vector_test, with_meta_tags
 # Set by tests/conftest.py from CLI flags (ref conftest.py:30-93)
 DEFAULT_PRESET = MINIMAL
 DEFAULT_BLS_ACTIVE = False
+ALLOWED_FORKS = None  # --fork filter: None = all implemented forks
 
 
 def get_spec(fork: str, preset: str, config_overrides: Optional[Dict[str, Any]] = None):
@@ -215,9 +216,11 @@ def with_phases(phases: Sequence[str], other_phases: Optional[Sequence[str]] = N
 
     def deco(fn):
         def entry(*args, **kw):
-            from consensus_specs_tpu.specs.build import available_forks
+            from consensus_specs_tpu.specs.build import available_forks, available_rnd_forks
 
-            have = set(available_forks())
+            have = set(available_forks()) | set(available_rnd_forks())
+            if ALLOWED_FORKS is not None:
+                have &= set(ALLOWED_FORKS)
             run_phases = [p for p in phases if p in have]
             phase = kw.pop("phase", None)
             if phase is not None:

@@ -36,6 +36,13 @@ def _fork_versions(spec):
         "altair": (cfg.GENESIS_FORK_VERSION, cfg.ALTAIR_FORK_VERSION),
         "bellatrix": (cfg.ALTAIR_FORK_VERSION, cfg.BELLATRIX_FORK_VERSION),
         "capella": (cfg.BELLATRIX_FORK_VERSION, cfg.CAPELLA_FORK_VERSION),
+        # R&D branches run off bellatrix versioning (their fork configs
+        # are TBD upstream; SHARDING_FORK_VERSION stands in for sharding's
+        # family, bellatrix's own for eip4844)
+        "sharding": (cfg.BELLATRIX_FORK_VERSION, cfg.SHARDING_FORK_VERSION),
+        "custody_game": (cfg.BELLATRIX_FORK_VERSION, cfg.SHARDING_FORK_VERSION),
+        "das": (cfg.BELLATRIX_FORK_VERSION, cfg.SHARDING_FORK_VERSION),
+        "eip4844": (cfg.BELLATRIX_FORK_VERSION, cfg.BELLATRIX_FORK_VERSION),
     }
     return by_fork[spec.fork]
 

@@ -52,8 +52,12 @@ def next_epoch_via_block(spec, state):
     return state_transition_and_sign_block(spec, state, block)
 
 
-def transition_to_valid_shard_slot(spec, state):  # sharding R&D placeholder
-    raise NotImplementedError
+def transition_to_valid_shard_slot(spec, state):
+    """Advance into the first slot of epoch 1: the epoch transition's
+    reset_pending_shard_work has then seeded SHARD_WORK_PENDING lists for
+    the current epoch's (slot, shard) pairs, so process_shard_header
+    accepts headers for slot SLOTS_PER_EPOCH (0 < header.slot <= state.slot)."""
+    transition_to(spec, state, spec.SLOTS_PER_EPOCH + 1)
 
 
 def state_transition_and_sign_block(spec, state, block, expect_fail=False):

@@ -1,4 +1,13 @@
-"""Test session config.
+"""Test session config + CLI flags (ref: test/conftest.py:30-93).
+
+Flags:
+  --preset=minimal|mainnet|<registered>  preset every spec test builds against
+  --fork=<name> (repeatable)             restrict the fork matrix
+  --disable-bls / --enable-bls           BLS tri-state default for bls-switch
+                                         tests (default: disabled — the
+                                         reference's `make test` posture)
+  --bls-type=reference|jax               BLS backend (default reference;
+                                         jax = the batched device backend)
 
 Tests run on a virtual 8-device CPU mesh so multi-chip sharding is
 exercised without TPU hardware (task spec: xla_force_host_platform_device_count).
@@ -21,3 +30,45 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--preset", action="store", default="minimal",
+        help="preset name the spec tests build against (ref conftest.py:31-37)",
+    )
+    parser.addoption(
+        "--fork", action="append", default=None,
+        help="restrict the fork matrix; repeatable (ref conftest.py:39-45)",
+    )
+    parser.addoption(
+        "--disable-bls", action="store_true", default=False,
+        help="force BLS off for bls-switch tests (ref conftest.py:47-52)",
+    )
+    parser.addoption(
+        "--enable-bls", action="store_true", default=False,
+        help="force real BLS on for bls-switch tests",
+    )
+    parser.addoption(
+        "--bls-type", action="store", default=None,
+        choices=("reference", "jax"),
+        help="BLS backend: 'reference' host oracle or 'jax' device batch "
+             "(ref conftest.py:54-60, py_ecc/milagro analog)",
+    )
+
+
+def pytest_configure(config):
+    from consensus_specs_tpu.crypto import bls
+    from consensus_specs_tpu.test_framework import context
+
+    context.DEFAULT_PRESET = config.getoption("--preset")
+    forks = config.getoption("--fork")
+    if forks:
+        context.ALLOWED_FORKS = list(forks)
+    if config.getoption("--enable-bls"):
+        context.DEFAULT_BLS_ACTIVE = True
+    elif config.getoption("--disable-bls"):
+        context.DEFAULT_BLS_ACTIVE = False
+    bls_type = config.getoption("--bls-type")
+    if bls_type:
+        bls.use_backend(bls_type)

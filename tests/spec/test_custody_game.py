@@ -1,0 +1,237 @@
+"""Custody game (R&D) fork tests: Legendre custody bits, key reveals,
+chunk challenges/responses, and the custody epoch steps (ref:
+specs/custody_game/beacon-chain.md — upstream custody testgen is
+disabled, tests/generators/operations/main.py:26-34)."""
+import pytest
+
+from consensus_specs_tpu.specs import build_spec
+from consensus_specs_tpu.test_framework.constants import CUSTODY_GAME
+from consensus_specs_tpu.test_framework.context import always_bls, spec_state_test, with_phases
+from consensus_specs_tpu.test_framework.keys import privkeys
+from consensus_specs_tpu.test_framework.state import next_epoch, transition_to
+
+
+@pytest.fixture(scope="module")
+def uspec():
+    return build_spec(CUSTODY_GAME, "minimal")
+
+
+class TestHelpers:
+    def test_legendre_bit_matches_euler(self, uspec):
+        q = 1000003  # prime, q % 2 == 1
+        for a in range(1, 40):
+            euler = pow(a, (q - 1) // 2, q)
+            want = 1 if euler == 1 else 0
+            assert uspec.legendre_bit(a, q) == want, a
+        assert uspec.legendre_bit(0, q) == 0
+
+    def test_custody_atoms_padding(self, uspec):
+        atoms = uspec.get_custody_atoms(b"\x05" * 33)
+        assert len(atoms) == 2
+        assert atoms[1][1:] == b"\x00" * 31
+        assert uspec.get_custody_atoms(b"") == []
+
+    def test_custody_period_and_randao_epoch(self, uspec):
+        period = uspec.get_custody_period_for_validator(3, 100)
+        epoch = uspec.get_randao_epoch_for_custody_period(period, 3)
+        assert epoch > 100  # reveal epoch is padded into the future
+
+    def test_custody_bit_deterministic(self, uspec):
+        from consensus_specs_tpu.crypto.bls import ciphersuite as host
+
+        key = host.Sign(7, b"\x01" * 32)
+        data = b"custody data" * 100
+        assert uspec.compute_custody_bit(key, data) == uspec.compute_custody_bit(key, data)
+
+    def test_universal_hash_sensitivity(self, uspec):
+        secrets = [3, 5, 7]
+        atoms_a = uspec.get_custody_atoms(b"\x01" * 64)
+        atoms_b = uspec.get_custody_atoms(b"\x01" * 63 + b"\x02")
+        assert uspec.universal_hash_function(atoms_a, secrets) != uspec.universal_hash_function(atoms_b, secrets)
+
+    def test_replace_empty_or_append(self, uspec):
+        records = uspec.List[uspec.CustodyChunkChallengeRecord, 8]()
+        r1 = uspec.CustodyChunkChallengeRecord(challenge_index=1)
+        assert uspec.replace_empty_or_append(records, r1) == 0
+        r2 = uspec.CustodyChunkChallengeRecord(challenge_index=2)
+        assert uspec.replace_empty_or_append(records, r2) == 1
+        # clearing slot 0 lets the next record reuse it
+        records[0] = uspec.CustodyChunkChallengeRecord()
+        r3 = uspec.CustodyChunkChallengeRecord(challenge_index=3)
+        assert uspec.replace_empty_or_append(records, r3) == 0
+
+
+def mark_custody_active(spec, state):
+    """Give validators custody-game-consistent reveal state."""
+    epoch = spec.get_current_epoch(state)
+    for i in range(len(state.validators)):
+        state.validators[i].next_custody_secret_to_reveal = spec.get_custody_period_for_validator(i, epoch)
+
+
+class TestKeyReveal:
+    @with_phases([CUSTODY_GAME])
+    @spec_state_test
+    def test_custody_key_reveal_success(self, spec, state):
+        mark_custody_active(spec, state)
+        # advance so the current period is past the first reveal period
+        transition_to(spec, state, spec.EPOCHS_PER_CUSTODY_PERIOD * spec.SLOTS_PER_EPOCH * 2)
+        index = 0
+        revealer = state.validators[index]
+        epoch_to_sign = spec.get_randao_epoch_for_custody_period(
+            revealer.next_custody_secret_to_reveal, index
+        )
+        domain = spec.get_domain(state, spec.DOMAIN_RANDAO, epoch_to_sign)
+        signing_root = spec.compute_signing_root(spec.Epoch(epoch_to_sign), domain)
+        reveal = spec.CustodyKeyReveal(
+            revealer_index=index, reveal=spec.bls.Sign(privkeys[index], signing_root)
+        )
+        pre_next = int(revealer.next_custody_secret_to_reveal)
+
+        yield "pre", state
+        yield "custody_key_reveal", reveal
+        spec.process_custody_key_reveal(state, reveal)
+        yield "post", state
+
+        assert state.validators[index].next_custody_secret_to_reveal == pre_next + 1
+
+    @with_phases([CUSTODY_GAME])
+    @spec_state_test
+    def test_custody_key_reveal_too_early_rejected(self, spec, state):
+        mark_custody_active(spec, state)
+        index = 0
+        reveal = spec.CustodyKeyReveal(revealer_index=index, reveal=b"\x00" * 96)
+        yield "pre", state
+        with pytest.raises(AssertionError):
+            spec.process_custody_key_reveal(state, reveal)
+        yield "post", None
+
+    @with_phases([CUSTODY_GAME])
+    @spec_state_test
+    @always_bls
+    def test_custody_key_reveal_wrong_signature_rejected(self, spec, state):
+        mark_custody_active(spec, state)
+        transition_to(spec, state, spec.EPOCHS_PER_CUSTODY_PERIOD * spec.SLOTS_PER_EPOCH * 2)
+        reveal = spec.CustodyKeyReveal(revealer_index=0, reveal=b"\x11" * 96)
+        yield "pre", state
+        with pytest.raises(AssertionError):
+            spec.process_custody_key_reveal(state, reveal)
+        yield "post", None
+
+
+class TestChunkChallengeResponse:
+    def _chunked_data_root(self, spec, data: bytes):
+        """hash_tree_root of the data as ByteList[MAX_SHARD_BLOCK_SIZE] and
+        the per-chunk Merkle branches the response format proves against."""
+        chunks = [
+            data[i : i + int(spec.BYTES_PER_CUSTODY_CHUNK)]
+            for i in range(0, len(data), int(spec.BYTES_PER_CUSTODY_CHUNK))
+        ]
+        padded = [
+            c + b"\x00" * (int(spec.BYTES_PER_CUSTODY_CHUNK) - len(c)) for c in chunks
+        ]
+        return padded
+
+    @with_phases([CUSTODY_GAME])
+    @spec_state_test
+    def test_chunk_response_clears_record(self, spec, state):
+        """A synthetic challenge record + a chunk whose branch proves into
+        the recorded data root clears the record and pays the proposer."""
+        from consensus_specs_tpu.ssz import get_generalized_index, hash_tree_root
+        from consensus_specs_tpu.ssz.proof import compute_merkle_proof
+
+        next_epoch(spec, state)
+        data = b"\xab" * (int(spec.BYTES_PER_CUSTODY_CHUNK) * 2)  # 2 chunks
+        data_list = spec.ByteList[spec.MAX_SHARD_BLOCK_SIZE](data)
+        chunks = self._chunked_data_root(spec, data)
+
+        # the response proves chunk i against the ByteList tree: gindex of
+        # the chunk run within the data subtree at CUSTODY_RESPONSE_DEPTH+1
+        chunk_index = 1
+        chunk = spec.ByteVector[spec.BYTES_PER_CUSTODY_CHUNK](chunks[chunk_index])
+        chunks_per_custody_chunk = int(spec.BYTES_PER_CUSTODY_CHUNK) // 32
+        # custody chunks are contiguous runs of SSZ chunks: the subtree
+        # covering run i sits at depth CUSTODY_RESPONSE_DEPTH+1 (incl. the
+        # list length mix-in level at the top)
+        depth = int(spec.CUSTODY_RESPONSE_DEPTH) + 1
+        gindex = (1 << depth) + chunk_index  # within the ByteList tree
+        branch = compute_merkle_proof(data_list, gindex)
+
+        record = spec.CustodyChunkChallengeRecord(
+            challenge_index=7,
+            challenger_index=1,
+            responder_index=2,
+            inclusion_epoch=spec.get_current_epoch(state),
+            data_root=hash_tree_root(data_list),
+            chunk_index=chunk_index,
+        )
+        state.custody_chunk_challenge_records.append(record)
+
+        response = spec.CustodyChunkResponse(
+            challenge_index=7, chunk_index=chunk_index, chunk=chunk, branch=branch
+        )
+
+        pre_proposer_balance = int(state.balances[spec.get_beacon_proposer_index(state)])
+        yield "pre", state
+        yield "custody_response", response
+        spec.process_chunk_challenge_response(state, response)
+        yield "post", state
+
+        assert state.custody_chunk_challenge_records[0] == spec.CustodyChunkChallengeRecord()
+        assert int(state.balances[spec.get_beacon_proposer_index(state)]) > pre_proposer_balance
+
+    @with_phases([CUSTODY_GAME])
+    @spec_state_test
+    def test_chunk_response_wrong_chunk_rejected(self, spec, state):
+        from consensus_specs_tpu.ssz import hash_tree_root
+        from consensus_specs_tpu.ssz.proof import compute_merkle_proof
+
+        next_epoch(spec, state)
+        data = b"\xcd" * (int(spec.BYTES_PER_CUSTODY_CHUNK) * 2)
+        data_list = spec.ByteList[spec.MAX_SHARD_BLOCK_SIZE](data)
+        depth = int(spec.CUSTODY_RESPONSE_DEPTH) + 1
+        branch = compute_merkle_proof(data_list, (1 << depth) + 0)
+        record = spec.CustodyChunkChallengeRecord(
+            challenge_index=7, responder_index=2,
+            inclusion_epoch=spec.get_current_epoch(state),
+            data_root=hash_tree_root(data_list), chunk_index=0,
+        )
+        state.custody_chunk_challenge_records.append(record)
+        wrong = spec.ByteVector[spec.BYTES_PER_CUSTODY_CHUNK](b"\xff" * int(spec.BYTES_PER_CUSTODY_CHUNK))
+        response = spec.CustodyChunkResponse(challenge_index=7, chunk_index=0, chunk=wrong, branch=branch)
+        yield "pre", state
+        with pytest.raises(AssertionError):
+            spec.process_chunk_challenge_response(state, response)
+        yield "post", None
+
+
+class TestCustodyEpochSteps:
+    @with_phases([CUSTODY_GAME])
+    @spec_state_test
+    def test_challenge_deadline_slashes_responder(self, spec, state):
+        mark_custody_active(spec, state)
+        record = spec.CustodyChunkChallengeRecord(
+            challenge_index=1, challenger_index=1, responder_index=2,
+            inclusion_epoch=0, data_root=b"\x11" * 32, chunk_index=0,
+        )
+        state.custody_chunk_challenge_records.append(record)
+        # jump far past the challenge deadline
+        state.slot = (spec.EPOCHS_PER_CUSTODY_PERIOD + 2) * spec.SLOTS_PER_EPOCH
+        mark_custody_active(spec, state)  # keep reveal deadlines satisfied
+
+        yield "pre", state
+        spec.process_challenge_deadlines(state)
+        yield "post", state
+
+        assert state.validators[2].slashed
+        assert state.custody_chunk_challenge_records[0] == spec.CustodyChunkChallengeRecord()
+
+    @with_phases([CUSTODY_GAME])
+    @spec_state_test
+    def test_custody_final_updates_clears_exposed_secrets(self, spec, state):
+        epoch = spec.get_current_epoch(state)
+        loc = epoch % spec.EARLY_DERIVED_SECRET_PENALTY_MAX_FUTURE_EPOCHS
+        state.exposed_derived_secrets[loc].append(3)
+        yield "pre", state
+        spec.process_custody_final_updates(state)
+        yield "post", state
+        assert len(state.exposed_derived_secrets[loc]) == 0

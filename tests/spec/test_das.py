@@ -1,0 +1,110 @@
+"""DAS (R&D) fork tests: data extension/recovery, KZG sample proofs, and
+device-FFT parity (ref: specs/das/das-core.md — the reference ships no
+DAS tests; recover_data/check_multi_kzg_proof are `...` upstream)."""
+import random
+
+import pytest
+
+from consensus_specs_tpu.specs import build_spec
+from consensus_specs_tpu.test_framework.constants import DAS
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return build_spec(DAS, "minimal")
+
+
+@pytest.fixture(scope="module")
+def extended(spec):
+    rng = random.Random(11)
+    pps = int(spec.POINTS_PER_SAMPLE)
+    data = [rng.randrange(spec.MODULUS) for _ in range(2 * pps)]
+    return data, spec.extend_data(data)
+
+
+class TestExtension:
+    def test_extend_preserves_prefix(self, spec, extended):
+        data, ext = extended
+        assert ext[: len(data)] == data
+        assert len(ext) == 2 * len(data)
+
+    def test_unextend_roundtrip(self, spec, extended):
+        data, ext = extended
+        assert spec.unextend_data(ext) == data
+
+    def test_extension_is_low_degree(self, spec, extended):
+        _, ext = extended
+        poly = spec.ifft(spec.reverse_bit_order_list(ext))
+        assert all(v == 0 for v in poly[len(poly) // 2 :])
+
+    def test_reverse_bit_order_involution(self, spec):
+        xs = list(range(16))
+        assert spec.reverse_bit_order_list(spec.reverse_bit_order_list(xs)) == xs
+
+
+class TestSamples:
+    def test_sample_verify_all(self, spec, extended):
+        _, ext = extended
+        samples = spec.sample_data(3, 1, ext)
+        poly = spec.ifft(spec.reverse_bit_order_list(ext))
+        comm = spec.DataCommitment(point=spec.commit_to_data(poly), samples_count=len(samples))
+        for s in samples:
+            spec.verify_sample(s, len(samples), comm)
+
+    def test_tampered_sample_rejected(self, spec, extended):
+        _, ext = extended
+        samples = spec.sample_data(3, 1, ext)
+        poly = spec.ifft(spec.reverse_bit_order_list(ext))
+        comm = spec.DataCommitment(point=spec.commit_to_data(poly), samples_count=len(samples))
+        bad = samples[0].copy()
+        bad.data[0] = (int(bad.data[0]) + 1) % spec.MODULUS
+        with pytest.raises(AssertionError):
+            spec.verify_sample(bad, len(samples), comm)
+
+    def test_wrong_proof_rejected(self, spec, extended):
+        # NOTE: swapping two samples' proofs is NOT a negative test here —
+        # for extended data of degree < 2*POINTS_PER_SAMPLE every coset
+        # shares one quotient polynomial, so all proofs coincide. Use a
+        # genuinely wrong group element (the commitment itself) instead.
+        _, ext = extended
+        samples = spec.sample_data(3, 1, ext)
+        poly = spec.ifft(spec.reverse_bit_order_list(ext))
+        comm = spec.DataCommitment(point=spec.commit_to_data(poly), samples_count=len(samples))
+        bad = samples[0].copy()
+        bad.proof = comm.point
+        with pytest.raises(AssertionError):
+            spec.verify_sample(bad, len(samples), comm)
+
+
+class TestRecovery:
+    @pytest.mark.parametrize("drop", [(1,), (0, 3), (2, 3)])
+    def test_reconstruct_with_missing(self, spec, extended, drop):
+        _, ext = extended
+        samples = spec.sample_data(3, 1, ext)
+        damaged = [None if i in drop else s for i, s in enumerate(samples)]
+        rec = spec.reconstruct_extended_data(damaged)
+        assert [int(v) for v in rec] == [int(v) for v in ext]
+
+    def test_too_many_missing_rejected(self, spec, extended):
+        _, ext = extended
+        samples = spec.sample_data(3, 1, ext)
+        damaged = [None, None, None, samples[3]]
+        with pytest.raises(AssertionError):
+            spec.reconstruct_extended_data(damaged)
+
+
+class TestDeviceParity:
+    def test_device_fft_matches_spec(self, spec):
+        from consensus_specs_tpu.ops import fft_jax
+
+        rng = random.Random(23)
+        vals = [rng.randrange(spec.MODULUS) for _ in range(64)]
+        assert fft_jax.fft_device(vals) == spec.fft(vals)
+        assert fft_jax.fft_device(vals, inverse=True) == spec.ifft(vals)
+
+    def test_device_das_extension_matches_spec(self, spec):
+        from consensus_specs_tpu.ops import fft_jax
+
+        rng = random.Random(29)
+        data = [rng.randrange(spec.MODULUS) for _ in range(32)]
+        assert fft_jax.das_fft_extension_device(data) == spec.das_fft_extension(data)

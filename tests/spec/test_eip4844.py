@@ -1,0 +1,103 @@
+"""EIP-4844 (R&D) fork tests: blob commitments, versioned hashes, and the
+kzg-vs-transactions block check (ref: specs/eip4844/beacon-chain.md — no
+tests exist upstream; the trusted setup is TBD there)."""
+import struct
+
+import pytest
+
+from consensus_specs_tpu.crypto import fr, kzg
+from consensus_specs_tpu.specs import build_spec
+from consensus_specs_tpu.test_framework.constants import EIP4844
+from consensus_specs_tpu.test_framework.context import spec_state_test, with_phases
+from consensus_specs_tpu.test_framework.block import build_empty_block_for_next_slot
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return build_spec(EIP4844, "minimal")
+
+
+def make_blob_tx(spec, versioned_hashes):
+    """A minimal SignedBlobTransaction encoding that satisfies
+    tx_peek_blob_versioned_hashes' offset walk."""
+    body_fixed = b"\x00" * 156
+    hashes_offset = 156 + 4
+    message = body_fixed + struct.pack("<I", hashes_offset) + b"".join(
+        bytes(h) for h in versioned_hashes
+    )
+    tx_body = struct.pack("<I", 4) + message
+    return bytes([spec.BLOB_TX_TYPE]) + tx_body
+
+
+class TestKZGCore:
+    def test_blob_to_kzg_matches_coefficient_commit(self, spec):
+        blob = spec.Blob([3, 5, 7, 11])
+        c = spec.blob_to_kzg(blob)
+        # oracle: interpolate the evaluations and commit in coefficient form
+        coeffs = fr.ifft([3, 5, 7, 11])
+        setup = kzg.insecure_setup(int(spec.FIELD_ELEMENTS_PER_BLOB))
+        assert bytes(c) == kzg.commit(coeffs, setup)
+
+    def test_blob_value_out_of_field_rejected(self, spec):
+        blob = spec.Blob([spec.BLS_MODULUS, 0, 0, 0])
+        with pytest.raises(AssertionError):
+            spec.blob_to_kzg(blob)
+
+    def test_versioned_hash_prefix(self, spec):
+        blob = spec.Blob([1, 2, 3, 4])
+        vh = spec.kzg_to_versioned_hash(spec.blob_to_kzg(blob))
+        assert bytes(vh)[:1] == spec.BLOB_COMMITMENT_VERSION_KZG
+        assert len(bytes(vh)) == 32
+
+
+class TestTransactionPeek:
+    def test_peek_roundtrip(self, spec):
+        vhs = [b"\x01" + bytes(31), b"\x01" + b"\x22" * 31]
+        tx = make_blob_tx(spec, vhs)
+        assert [bytes(h) for h in spec.tx_peek_blob_versioned_hashes(tx)] == vhs
+
+    def test_non_blob_tx_rejected(self, spec):
+        with pytest.raises(AssertionError):
+            spec.tx_peek_blob_versioned_hashes(b"\x02" + b"\x00" * 40)
+
+    def test_verify_kzgs_against_transactions(self, spec):
+        blob = spec.Blob([9, 8, 7, 6])
+        c = spec.blob_to_kzg(blob)
+        tx = make_blob_tx(spec, [spec.kzg_to_versioned_hash(c)])
+        assert spec.verify_kzgs_against_transactions([tx], [c])
+        # wrong commitment
+        c2 = spec.blob_to_kzg(spec.Blob([1, 1, 1, 1]))
+        assert not spec.verify_kzgs_against_transactions([tx], [c2])
+        # missing commitment
+        assert not spec.verify_kzgs_against_transactions([tx], [])
+        # non-blob transactions are ignored
+        assert spec.verify_kzgs_against_transactions([b"\x02abc"], [])
+
+
+class TestBlockProcessing:
+    @with_phases([EIP4844])
+    @spec_state_test
+    def test_process_blob_kzgs_in_block(self, spec, state):
+        blob = spec.Blob([4, 3, 2, 1])
+        commitment = spec.blob_to_kzg(blob)
+        tx = make_blob_tx(spec, [spec.kzg_to_versioned_hash(commitment)])
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.execution_payload.transactions.append(tx)
+        block.body.blob_kzgs.append(commitment)
+        yield "pre", state
+        spec.process_blob_kzgs(state, block.body)  # must not raise
+        yield "post", state
+
+    @with_phases([EIP4844])
+    @spec_state_test
+    def test_process_blob_kzgs_mismatch_rejected(self, spec, state):
+        blob = spec.Blob([4, 3, 2, 1])
+        commitment = spec.blob_to_kzg(blob)
+        tx = make_blob_tx(spec, [spec.kzg_to_versioned_hash(commitment)])
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.execution_payload.transactions.append(tx)
+        # commitment list doesn't match the transaction's versioned hash
+        yield "pre", state
+        with pytest.raises(AssertionError):
+            spec.process_blob_kzgs(state, block.body)
+        yield "post", None

@@ -1,0 +1,135 @@
+"""YAML configuration tier: file loading, registration, parity with the
+hardcoded bundles, and custom-network spec builds
+(ref: eth2spec/config/config_util.py:25-63, setup.py:782-806)."""
+import os
+
+import pytest
+
+from consensus_specs_tpu.config import (
+    CONFIGS,
+    PRESETS,
+    load_network,
+    load_preset_dir,
+    load_yaml_vars,
+    register_config,
+    register_preset,
+)
+from consensus_specs_tpu.specs import build_spec
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REFERENCE = "/root/reference"
+
+
+class TestRepoYamlFiles:
+    """The shipped presets/ + configs/ YAML files are the file-tier truth
+    and must match the in-code bundles exactly."""
+
+    @pytest.mark.parametrize("preset", ["mainnet", "minimal"])
+    def test_preset_dir_matches_bundles(self, preset):
+        per_fork = load_preset_dir(os.path.join(REPO, "presets", preset))
+        assert set(per_fork) == set(PRESETS[preset])
+        for fork, vars_ in per_fork.items():
+            assert vars_ == dict(PRESETS[preset][fork]), fork
+
+    @pytest.mark.parametrize("name", ["mainnet", "minimal"])
+    def test_config_matches_bundle(self, name):
+        vals = load_yaml_vars(os.path.join(REPO, "configs", f"{name}.yaml"))
+        assert vals == dict(CONFIGS[name])
+
+
+@pytest.mark.skipif(not os.path.isdir(REFERENCE), reason="reference tree not mounted")
+class TestReferenceYamlFiles:
+    """The reference's own YAML files load verbatim, and every key they
+    define agrees with our bundles (reference capella.yaml is empty at
+    v1.1.10, and our capella sizes come from the spec draft — so the check
+    is per-key over the reference's keys)."""
+
+    @pytest.mark.parametrize("preset", ["mainnet", "minimal"])
+    def test_reference_presets_agree(self, preset):
+        per_fork = load_preset_dir(os.path.join(REFERENCE, "presets", preset))
+        assert per_fork, "reference preset dir loaded empty"
+        for fork, vars_ in per_fork.items():
+            ours = PRESETS[preset][fork]
+            for k, v in vars_.items():
+                assert k in ours, f"{fork}.{k} missing from bundles"
+                assert ours[k] == v, (fork, k, ours[k], v)
+
+    @pytest.mark.parametrize("name", ["mainnet", "minimal"])
+    def test_reference_configs_agree(self, name):
+        vals = load_yaml_vars(os.path.join(REFERENCE, "configs", f"{name}.yaml"))
+        for k, v in vals.items():
+            if k in ("PRESET_BASE", "CONFIG_NAME"):
+                continue
+            assert k in CONFIGS[name], k
+            assert CONFIGS[name][k] == v, (k, CONFIGS[name][k], v)
+
+
+class TestCustomNetwork:
+    def test_register_and_build(self, tmp_path):
+        # a custom network: minimal preset with a doubled epoch length
+        pdir = tmp_path / "presets" / "testnet"
+        pdir.mkdir(parents=True)
+        (pdir / "phase0.yaml").write_text("SLOTS_PER_EPOCH: 16\n")
+        cfg = tmp_path / "testnet.yaml"
+        cfg.write_text(
+            "PRESET_BASE: 'minimal'\n"
+            "CONFIG_NAME: 'testnet'\n"
+            "MIN_GENESIS_ACTIVE_VALIDATOR_COUNT: 16\n"
+            "GENESIS_FORK_VERSION: 0x00000099\n"
+        )
+
+        name = load_network("testnet", str(pdir), str(cfg))
+        spec = build_spec("phase0", name)
+        assert spec.SLOTS_PER_EPOCH == 16  # overridden
+        assert spec.MAX_COMMITTEES_PER_SLOT == 4  # inherited from minimal
+        assert spec.config.CONFIG_NAME == "testnet"
+        assert spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT == 16
+        assert spec.config.GENESIS_FORK_VERSION == bytes.fromhex("00000099")
+        # inherited runtime var
+        assert spec.config.SECONDS_PER_SLOT == 6
+
+    def test_registered_preset_isolated(self):
+        register_preset("iso_test", {"phase0": {"SLOTS_PER_EPOCH": 4}}, base="minimal")
+        register_config("iso_test", {}, base="minimal")
+        spec = build_spec("phase0", "iso_test")
+        assert spec.SLOTS_PER_EPOCH == 4
+        # the base bundle is untouched
+        assert PRESETS["minimal"]["phase0"]["SLOTS_PER_EPOCH"] == 8
+        base_spec = build_spec("phase0", "minimal")
+        assert base_spec.SLOTS_PER_EPOCH == 8
+
+    def test_config_name_never_leaks_from_base(self):
+        register_config("leakcheck", {"MIN_GENESIS_TIME": 1}, base="minimal")
+        assert CONFIGS["leakcheck"]["CONFIG_NAME"] == "leakcheck"
+        assert CONFIGS["leakcheck"]["MIN_GENESIS_TIME"] == 1
+
+    def test_load_network_base_preset_param_covers_config(self, tmp_path):
+        # config file with NO PRESET_BASE: the base_preset argument must
+        # base both tiers, so inherited runtime vars are present
+        pdir = tmp_path / "p"
+        pdir.mkdir()
+        (pdir / "phase0.yaml").write_text("SLOTS_PER_EPOCH: 4\n")
+        cfg = tmp_path / "c.yaml"
+        cfg.write_text("MIN_GENESIS_TIME: 7\n")
+        name = load_network("baseparam", str(pdir), str(cfg), base_preset="minimal")
+        spec = build_spec("phase0", name)
+        assert spec.SLOTS_PER_EPOCH == 4
+        assert spec.config.MIN_GENESIS_TIME == 7
+        assert spec.config.SECONDS_PER_SLOT == 6  # inherited via base_preset
+
+    def test_preset_dir_extra_fork_files_load(self, tmp_path):
+        pdir = tmp_path / "p"
+        pdir.mkdir()
+        (pdir / "phase0.yaml").write_text("SLOTS_PER_EPOCH: 4\n")
+        (pdir / "deneb.yaml").write_text("FIELD_ELEMENTS_PER_BLOB: 4096\n")
+        per_fork = load_preset_dir(str(pdir))
+        assert per_fork["deneb"] == {"FIELD_ELEMENTS_PER_BLOB": 4096}
+
+    def test_hex_and_int_parsing(self, tmp_path):
+        p = tmp_path / "v.yaml"
+        p.write_text("A: 0x0a0b\nB: 12\nC: 'text'\nD: 115792089237316195423570985008687907853269984665640564039457584007913129638912\n")
+        vals = load_yaml_vars(str(p))
+        assert vals["A"] == bytes.fromhex("0a0b")
+        assert vals["B"] == 12
+        assert vals["C"] == "text"
+        assert vals["D"] == 2**256 - 2**10

@@ -71,3 +71,45 @@ def test_2d_mesh_merkle_reduce_cross_shard_levels():
     sharded = jax.device_put(words, NamedSharding(mesh, P("dp", "mp")))
     got = np.asarray(merkle_reduce_jit(sharded, 8))
     assert np.array_equal(got, want)
+
+
+def test_registry_scale_sharded_merkle_root():
+    """2^20 chunks (mainnet-registry scale, 32 MiB) sharded over dp; the
+    top 3 reduce levels cross shards. Oracle: the host-native merkleize
+    (SHA-NI C path) — bit-identical required (VERDICT r2 item 7a)."""
+    from consensus_specs_tpu.ops.sha256 import _words_to_bytes
+    from consensus_specs_tpu.ssz.merkle import merkleize_chunks
+
+    levels = 20
+    n = 1 << levels
+    rng = np.random.default_rng(21)
+    words_np = rng.integers(0, 2**32, size=(n, 8), dtype=np.uint32)
+
+    mesh = _mesh_1d()
+    sharded = jax.device_put(jnp.asarray(words_np), NamedSharding(mesh, P("dp", None)))
+    got = _words_to_bytes(np.asarray(merkle_reduce_jit(sharded, levels)))
+
+    want = merkleize_chunks(words_np.astype(">u4").tobytes(), limit=n)
+    assert got == want
+
+
+def test_sharded_pairing_batch_psum_mask():
+    """Batched signature verification sharded over the batch axis with a
+    psum'd accept mask — bit-identical to the single-device mask
+    (VERDICT r2 item 7b)."""
+    from consensus_specs_tpu.crypto.bls import ciphersuite as host
+    from consensus_specs_tpu.ops import bls_jax
+
+    n = 8
+    sks = [i + 1 for i in range(n)]
+    pks = [host.SkToPk(sk) for sk in sks]
+    msgs = [bytes([i]) * 32 for i in range(n)]
+    sigs = [host.Sign(sk, m) for sk, m in zip(sks, msgs)]
+    sigs[3] = sigs[4]  # one corrupted: wrong message's signature
+
+    want = bls_jax.verify_batch(pks, msgs, sigs)
+    mesh = _mesh_1d()
+    got, count = bls_jax.verify_batch_sharded(pks, msgs, sigs, mesh, "dp")
+
+    assert np.array_equal(got, want)
+    assert count == int(want.sum()) == n - 1

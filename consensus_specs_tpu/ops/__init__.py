@@ -1,4 +1,26 @@
 """Device kernels and batched primitives: SHA-256 compression + fused
 Merkle reduce (sha256.py), BLS12-381 limb arithmetic (fq.py), extension
-tower (tower.py), batched ate pairing (pairing_jax.py), and the device
-BLS signature backend (bls_jax.py)."""
+tower (tower.py), batched ate pairing (pairing_jax.py), curve group ops
++ subgroup checks (curve_jax.py), hash-to-G2 (h2c_jax.py), and the
+device BLS signature backend (bls_jax.py).
+
+The persistent XLA compile cache is configured here, before any sibling
+module jits anything: the pairing/ladder/h2c graphs are expensive to
+build (minutes on a small host core) and identical across processes, so
+caching them is the difference between a usable and an unusable test
+suite on CPU — and between cold and warm bench start-up on TPU.
+"""
+import os
+
+try:
+    import jax
+
+    if jax.config.jax_compilation_cache_dir is None:  # respect host app config
+        _cache_dir = os.environ.get(
+            "CONSENSUS_SPECS_TPU_JAX_CACHE",
+            os.path.expanduser("~/.cache/jax_consensus"),
+        )
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+except Exception:  # pragma: no cover - cache is best-effort
+    pass

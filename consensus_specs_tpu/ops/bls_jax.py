@@ -36,20 +36,11 @@ from ..crypto.bls.curve import (
 from ..crypto.bls.hash_to_curve import hash_to_g2
 from . import fq, tower
 
-try:  # persistent compile cache: the pairing graphs are expensive to build
-    import jax
+# NOTE: the persistent compile cache is configured by ops/__init__.py
+# (import of this package) before any jit below is built.
+import jax.numpy as jnp  # noqa: E402
 
-    if jax.config.jax_compilation_cache_dir is None:  # respect host app config
-        _cache_dir = os.environ.get(
-            "CONSENSUS_SPECS_TPU_JAX_CACHE",
-            os.path.expanduser("~/.cache/jax_consensus"),
-        )
-        jax.config.update("jax_compilation_cache_dir", _cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
-except Exception:  # pragma: no cover - cache is best-effort
-    pass
-
-from . import pairing_jax  # noqa: E402  (after cache config)
+from . import pairing_jax  # noqa: E402
 
 G2_POINT_AT_INFINITY = _host.G2_POINT_AT_INFINITY
 
@@ -172,7 +163,7 @@ def _run_checks(checks: Sequence[Optional[List[_Pair]]]) -> np.ndarray:
     packed, live = _pack_checks(checks)
     if packed is None:
         return out
-    ok = np.asarray(pairing_jax.pairing_check_jit(*packed))
+    ok = np.asarray(pairing_jax.pairing_check_fast_jit(*packed))
     for row, i in enumerate(live):
         out[i] = bool(ok[row])
     return out
@@ -198,7 +189,7 @@ def run_checks_sharded(checks: Sequence[Optional[List[_Pair]]], mesh, axis_name:
         return out, 0
     row_sharding = NamedSharding(mesh, P(axis_name))
     px, py, qx, qy, active = (jax.device_put(a, row_sharding) for a in packed)
-    ok = pairing_jax.pairing_check_jit(px, py, qx, qy, active)
+    ok = pairing_jax.pairing_check_fast_jit(px, py, qx, qy, active)
 
     # bucket-padding rows are all-inactive and the empty pairing product
     # == 1, so the kernel reports them True; mask them device-side before
@@ -345,4 +336,165 @@ def aggregate_verify_batch(pubkey_lists, message_lists, signatures) -> np.ndarra
             _aggregate_verify_check(pks, ms, s)
             for pks, ms, s in zip(pubkey_lists, message_lists, signatures)
         ]
+    )
+
+
+# -- cold-path device pipeline ------------------------------------------------
+#
+# The cached scalar path above is ideal when messages/signatures repeat
+# (pytest mode). Vector *generation* sees fresh messages and fresh
+# signatures every case; with host-side hash-to-curve + subgroup checks
+# those dominate (the round-2 weakness: warm-cache 115 v/s was really
+# a few v/s cold). This pipeline keeps only byte parsing and the cached
+# pubkey table on host and runs everything else as batched device jits:
+#   signatures: sqrt-decompress + psi subgroup check   (ops/curve_jax)
+#   messages:   SSWU hash-to-curve                      (ops/h2c_jax)
+#   pubkeys:    per-row Jacobian tree aggregation       (ops/curve_jax)
+#   decision:   multi-pairing + fast final exponent     (ops/pairing_jax)
+
+_G2_GEN_COMPRESSED = None  # lazy: valid pad signature for bucket slots
+
+
+def _sig_pad_bytes() -> bytes:
+    global _G2_GEN_COMPRESSED
+    if _G2_GEN_COMPRESSED is None:
+        from ..crypto.bls.curve import g2_generator, g2_to_bytes
+
+        _G2_GEN_COMPRESSED = g2_to_bytes(g2_generator())
+    return _G2_GEN_COMPRESSED
+
+
+def _parse_g2_x(sig: bytes):
+    """Compressed-G2 wire checks that stay on host (pure byte logic,
+    curve.py:221-243): returns (x_mont_limbs, s_flag) | "inf" | None."""
+    sig = bytes(sig)
+    if len(sig) != 96:
+        return None
+    flags = sig[0]
+    if not flags & 0x80:
+        return None
+    if flags & 0x40:
+        if any(sig[1:]) or (flags & ~0xC0):
+            return None
+        return "inf"
+    x1 = int.from_bytes(bytes([flags & 0x1F]) + sig[1:48], "big")
+    x0 = int.from_bytes(sig[48:], "big")
+    if x0 >= fq.P_INT or x1 >= fq.P_INT:
+        return None
+    from ..crypto.bls import fields as hf
+
+    return tower.fq2_to_limbs_mont(hf.Fq2(x0, x1)), bool(flags & 0x20)
+
+
+@functools.lru_cache(maxsize=8)
+def _cold_jits(_key=None):
+    """Jitted stages, shared process-wide (curve_jax.jitted registry +
+    the single h2c graph); batch shapes are bucketed by the callers so
+    each graph compiles exactly once."""
+    import jax
+
+    from . import curve_jax as cj, h2c_jax as h2
+
+    decompress = cj.jitted("g2_decompress")
+    h2c = h2.hash_to_g2_jit()
+
+    def _aggregate(px, py, active):
+        one = cj.FQ.one(px.shape[:-1])
+        zero = cj.FQ.zero(px.shape[:-1])
+        z = jnp.where(active[..., None], one, zero)
+        sx, sy, sz = cj.jac_tree_sum(cj.FQ, (px, py, z), active)
+        ax, ay, inf = cj.jac_to_affine(cj.FQ, (sx, sy, sz))
+        return ax, ay, inf
+
+    aggregate = jax.jit(_aggregate)
+    return decompress, h2c, aggregate
+
+
+def fast_aggregate_verify_batch_cold(pubkey_lists, messages, signatures) -> np.ndarray:
+    """FastAggregateVerify over a batch with NO message/signature caching
+    assumptions: fresh inputs run as four device dispatches + the fused
+    pairing check. Pubkey decode/subgroup stays behind the LRU (validator
+    sets repeat across a workload; the registry is warm in practice).
+    Semantics identical to the scalar host path (crypto/bls/ciphersuite.py)."""
+    n = len(messages)
+    out = np.zeros(n, dtype=bool)
+    if n == 0:
+        return out
+    decompress, h2c, aggregate = _cold_jits()
+
+    # -- host: wire checks + cached pubkey lookup --
+    sig_parsed = [_parse_g2_x(s) for s in signatures]
+    rows = []  # (idx, pk_pts, sig_kind)
+    kmax = 1
+    for i in range(n):
+        if sig_parsed[i] is None or len(pubkey_lists[i]) == 0:
+            continue
+        pks = [_pk_affine(bytes(pk)) for pk in pubkey_lists[i]]
+        if any(p is None for p in pks):
+            continue
+        rows.append((i, pks, sig_parsed[i]))
+        kmax = max(kmax, len(pks))
+    if not rows:
+        return out
+
+    b = _bucket(len(rows))
+    k = _bucket(kmax, minimum=2)
+
+    # -- signatures: batched decompress + subgroup --
+    pad_x, pad_flag = _parse_g2_x(_sig_pad_bytes())
+    sig_x = np.tile(pad_x, (b, 1, 1))
+    sig_flag = np.full(b, pad_flag, dtype=bool)
+    sig_inf = np.zeros(b, dtype=bool)
+    for r, (_, _, sp) in enumerate(rows):
+        if sp == "inf":
+            sig_inf[r] = True
+        else:
+            sig_x[r], sig_flag[r] = sp
+    qx_sig, qy_sig, on_curve, in_subgroup = decompress(jnp.asarray(sig_x), jnp.asarray(sig_flag))
+    sig_ok = (np.asarray(on_curve) & np.asarray(in_subgroup)) | sig_inf
+
+    # -- messages: batched hash-to-curve --
+    from . import h2c_jax as h2
+
+    msg_bytes = [bytes(messages[i]) for i, _, _ in rows]
+    msg_bytes += [b""] * (b - len(rows))
+    u = jnp.asarray(h2.messages_to_field_limbs(msg_bytes))
+    qx_msg, qy_msg = h2c(u)
+
+    # -- pubkeys: batched aggregation --
+    px = np.zeros((b, k, fq.N_LIMBS), dtype=np.int32)
+    py = np.zeros((b, k, fq.N_LIMBS), dtype=np.int32)
+    active = np.zeros((b, k), dtype=bool)
+    for r, (_, pks, _) in enumerate(rows):
+        for c, (x, y) in enumerate(pks):
+            px[r, c] = x
+            py[r, c] = y
+            active[r, c] = True
+    agg_x, agg_y, agg_inf = aggregate(jnp.asarray(px), jnp.asarray(py), jnp.asarray(active))
+
+    # -- pairing rows: [(-g1, sig), (agg, H(m))] --
+    gx, gy = _neg_g1_limbs()
+    row_px = jnp.stack(
+        [jnp.broadcast_to(jnp.asarray(gx), (b, fq.N_LIMBS)), agg_x], axis=1
+    )
+    row_py = jnp.stack(
+        [jnp.broadcast_to(jnp.asarray(gy), (b, fq.N_LIMBS)), agg_y], axis=1
+    )
+    row_qx = jnp.stack([qx_sig, qx_msg], axis=1)
+    row_qy = jnp.stack([qy_sig, qy_msg], axis=1)
+    lane0_active = jnp.asarray(~sig_inf)
+    lane1_active = ~agg_inf
+    row_active = jnp.stack([lane0_active, lane1_active], axis=1)
+    ok = np.asarray(
+        pairing_jax.pairing_check_fast_jit(row_px, row_py, row_qx, row_qy, row_active)
+    )
+    for r, (i, _, _) in enumerate(rows):
+        out[i] = bool(ok[r]) and bool(sig_ok[r])
+    return out
+
+
+def verify_batch_cold(pubkeys, messages, signatures) -> np.ndarray:
+    """Element-wise Verify with the cold-path pipeline (K=1 rows)."""
+    return fast_aggregate_verify_batch_cold(
+        [[pk] for pk in pubkeys], messages, signatures
     )

@@ -1,0 +1,76 @@
+"""Cold-path device BLS pipeline (ops/bls_jax.fast_aggregate_verify_
+batch_cold): fresh messages + fresh signatures run through device
+hash-to-curve, device signature decompression/subgroup checks, device
+pubkey aggregation, and the staged fast pairing check — vs the host
+oracle, including malformed-input modes."""
+from __future__ import annotations
+
+import random
+
+import numpy as np
+
+from consensus_specs_tpu.crypto.bls import ciphersuite as host
+from consensus_specs_tpu.ops import bls_jax
+
+rng = random.Random(0xC01D)
+
+N_KEYS = 12
+SKS = [i + 1 for i in range(N_KEYS)]
+PKS = [host.SkToPk(sk) for sk in SKS]
+
+
+def _workload(n_checks, keys_per, tag=0):
+    msgs, pklists, sigs = [], [], []
+    for i in range(n_checks):
+        m = bytes([tag, i]) * 16
+        idx = rng.sample(range(N_KEYS), keys_per)
+        sigs.append(host.Aggregate([host.Sign(SKS[j], m) for j in idx]))
+        msgs.append(m)
+        pklists.append([PKS[j] for j in idx])
+    return pklists, msgs, sigs
+
+
+def test_cold_fav_valid_and_corrupted():
+    pklists, msgs, sigs = _workload(6, 4)
+    # corruption modes: wrong message, malformed sig, empty pubkey list,
+    # infinity-point signature
+    msgs[1] = b"\x99" * 32
+    sigs[2] = b"\x00" * 96
+    pklists[3] = []
+    sigs[4] = bytes(host.G2_POINT_AT_INFINITY)
+
+    got = bls_jax.fast_aggregate_verify_batch_cold(pklists, msgs, sigs)
+    want = np.array(
+        [
+            host.FastAggregateVerify(pk, m, s) if pk else False
+            for pk, m, s in zip(pklists, msgs, sigs)
+        ]
+    )
+    assert (got == want).all(), (got.tolist(), want.tolist())
+    assert got[0] and got[5]  # the untouched rows verify
+    assert not got[1] and not got[2] and not got[3] and not got[4]
+
+
+def test_cold_fav_fresh_batches_stay_correct():
+    """Two batches of entirely fresh inputs — nothing may leak between
+    dispatches via caches (the cold path must not depend on them)."""
+    for tag in (7, 8):
+        pklists, msgs, sigs = _workload(5, 3, tag=tag)
+        assert bls_jax.fast_aggregate_verify_batch_cold(pklists, msgs, sigs).all()
+
+
+def test_cold_verify_batch_single_keys():
+    pks = PKS[:5]
+    msgs = [bytes([50 + i]) * 32 for i in range(5)]
+    sigs = [host.Sign(SKS[i], msgs[i]) for i in range(5)]
+    sigs[2] = sigs[3]  # row 2 carries row 3's signature: invalid there only
+    got = bls_jax.verify_batch_cold(pks, msgs, sigs)
+    assert got.tolist() == [True, True, False, True, True]
+
+
+def test_cold_matches_warm_path():
+    pklists, msgs, sigs = _workload(4, 4, tag=9)
+    cold = bls_jax.fast_aggregate_verify_batch_cold(pklists, msgs, sigs)
+    warm = bls_jax.fast_aggregate_verify_batch(pklists, msgs, sigs)
+    assert (cold == warm).all()
+    assert cold.all()

@@ -1,0 +1,214 @@
+"""Device curve-arithmetic layer (ops/curve_jax.py) vs the host oracle:
+Jacobian add/double/ladder, endomorphism subgroup checks, batched
+square roots and decompression. These are the cold-path primitives the
+round-2 design kept on host (per-element Python, LRU-hidden).
+
+Kept intentionally small-batch: every jit here compiles scans whose
+cost is per-process; shapes are shared across tests via module fixtures.
+"""
+from __future__ import annotations
+
+import random
+
+import jax
+import numpy as np
+import pytest
+
+from consensus_specs_tpu.crypto.bls import ciphersuite as cs, curve as hc, fields as hf
+from consensus_specs_tpu.ops import curve_jax as cj, tower
+
+rng = random.Random(0xC0FFEE)
+
+# Module-level jits: one compile per graph per process, shared across
+# tests (XLA compiles are minutes-scale on small host cores; see
+# curve_jax.jitted docstring).
+_dbl_g1 = jax.jit(lambda p: cj.jac_double(cj.FQ, p))
+_dbl_g2 = jax.jit(lambda p: cj.jac_double(cj.FQ2, p))
+_add_g1 = jax.jit(lambda a, b: cj.jac_add(cj.FQ, a, b))
+_selfadd_g1 = jax.jit(lambda a: cj.jac_add(cj.FQ, a, a))
+_addneg_g1 = jax.jit(lambda a: cj.jac_add(cj.FQ, a, cj.jac_neg(cj.FQ, a)))
+_smul_g1 = jax.jit(lambda p: cj.scalar_mul_static(cj.FQ, p, cj.X_PARAM))
+_tree_sum_g1 = jax.jit(lambda p, a: cj.jac_tree_sum(cj.FQ, p, a))
+
+
+def stack_points(pts):
+    trips = [cj.host_point_to_jac_limbs(p) for p in pts]
+    return tuple(np.stack([t[i] for t in trips]) for i in range(3))
+
+
+@pytest.fixture(scope="module")
+def g1_points():
+    g = hc.g1_generator()
+    return [g.mul(rng.randrange(1, hf.R)) for _ in range(4)] + [hc.g1_infinity()]
+
+
+@pytest.fixture(scope="module")
+def g2_points():
+    g = hc.g2_generator()
+    return [g.mul(rng.randrange(1, hf.R)) for _ in range(3)] + [hc.g2_infinity()]
+
+
+def unpack(arrs, i, g2):
+    return cj.jac_limbs_to_host_point(
+        np.asarray(arrs[0])[i], np.asarray(arrs[1])[i], np.asarray(arrs[2])[i], g2=g2
+    )
+
+
+def test_jac_double_matches_host(g1_points, g2_points):
+    for fn, pts, g2 in ((_dbl_g1, g1_points, False), (_dbl_g2, g2_points, True)):
+        P = stack_points(pts)
+        D = fn(P)
+        for i, p in enumerate(pts):
+            assert unpack(D, i, g2) == p.double()
+
+
+def test_jac_add_general_and_specials(g1_points):
+    pts = g1_points
+    P = stack_points(pts)
+    Q = tuple(np.roll(np.asarray(c), 1, axis=0) for c in P)
+    A = _add_g1(P, Q)
+    for i, p in enumerate(pts):
+        q = pts[(i - 1) % len(pts)]
+        assert unpack(A, i, False) == p.add(q)
+    # self-add == double; P + (-P) == infinity
+    S = _selfadd_g1(P)
+    N = _addneg_g1(P)
+    for i, p in enumerate(pts):
+        assert unpack(S, i, False) == p.double()
+        assert unpack(N, i, False).is_infinity
+
+
+def test_scalar_mul_static(g1_points):
+    k = cj.X_PARAM
+    P = stack_points(g1_points)
+    S = _smul_g1(P)
+    for i, p in enumerate(g1_points):
+        assert unpack(S, i, False) == p.mul(k)
+
+
+def _non_subgroup_g2():
+    x = hf.Fq2(5, 1)
+    while True:
+        y = (x * x.square() + hc.B2).sqrt()
+        if y is not None:
+            pt = hc.g2_point(x, y)
+            if not pt.in_subgroup():
+                return pt
+        x = hf.Fq2(int(x.c0) + 1, 1)
+
+
+def _non_subgroup_g1():
+    x = hf.Fq(3)
+    while True:
+        y = (x * x.square() + hc.B1).sqrt()
+        if y is not None:
+            pt = hc.g1_point(x, y)
+            if not pt.in_subgroup():
+                return pt
+        x = hf.Fq(int(x) + 1)
+
+
+def test_subgroup_masks(g1_points, g2_points):
+    """Scott endomorphism tests agree with the [r]P oracle definition
+    (curve.py:134-135) on subgroup members, infinity, and cofactor
+    remnants."""
+    g1_mask = cj.jitted("g1_subgroup_mask")
+    g2_mask = cj.jitted("g2_subgroup_mask")
+    m1 = np.asarray(g1_mask(stack_points(g1_points)))
+    assert m1.all()
+    m2 = np.asarray(g2_mask(stack_points(g2_points)))
+    assert m2.all()
+    # negatives padded to the SAME batch shapes to reuse the compiled graphs
+    bad1 = stack_points([_non_subgroup_g1()] * len(g1_points))
+    bad2 = stack_points([_non_subgroup_g2()] * len(g2_points))
+    assert not np.asarray(g1_mask(bad1)).any()
+    assert not np.asarray(g2_mask(bad2)).any()
+
+
+def test_fq2_sqrt_roundtrip():
+    vals = [hf.Fq2(rng.randrange(hf.P), rng.randrange(hf.P)) for _ in range(5)]
+    squares = [v.square() for v in vals] + [hf.Fq2(0, 0)]
+    arr = np.stack([tower.fq2_to_limbs_mont(v) for v in squares])
+    sqrt_jit = cj.jitted("fq2_sqrt")
+    root, ok = sqrt_jit(arr)
+    assert np.asarray(ok).all()
+    root = np.asarray(root)
+    for i, v in enumerate(squares):
+        got = hf.Fq2(tower.limbs_to_int(root[i, 0]), tower.limbs_to_int(root[i, 1]))
+        assert got.square() == v
+    # non-squares flagged
+    bads = []
+    x = hf.Fq2(7, 3)
+    while len(bads) < 2:
+        if x.sqrt() is None:
+            bads.append(x)
+        x = hf.Fq2(int(x.c0) + 1, 3)
+    bads = (bads * 3)[: len(squares)]  # same shape -> same compiled graph
+    _, ok2 = sqrt_jit(np.stack([tower.fq2_to_limbs_mont(v) for v in bads]))
+    assert not np.asarray(ok2).any()
+
+
+def test_g2_decompress_matches_host():
+    sigs = [cs.Sign(i + 1, bytes([i]) * 32) for i in range(4)]
+    xs, flags = [], []
+    for s in sigs:
+        x1 = int.from_bytes(bytes([s[0] & 0x1F]) + s[1:48], "big")
+        x0 = int.from_bytes(s[48:], "big")
+        xs.append(tower.fq2_to_limbs_mont(hf.Fq2(x0, x1)))
+        flags.append(bool(s[0] & 0x20))
+    qx, qy, on_curve, in_sub = cj.jitted("g2_decompress")(np.stack(xs), np.array(flags))
+    assert np.asarray(on_curve).all() and np.asarray(in_sub).all()
+    for i, s in enumerate(sigs):
+        want = hc.g2_from_bytes(s).affine()
+        got_x = hf.Fq2(
+            tower.limbs_to_int(np.asarray(qx)[i, 0]), tower.limbs_to_int(np.asarray(qx)[i, 1])
+        )
+        got_y = hf.Fq2(
+            tower.limbs_to_int(np.asarray(qy)[i, 0]), tower.limbs_to_int(np.asarray(qy)[i, 1])
+        )
+        assert (got_x, got_y) == want
+
+
+def test_g1_decompress_matches_host():
+    pks = [cs.SkToPk(i + 1) for i in range(4)]
+    xs = [
+        tower.fq_to_limbs_mont(int.from_bytes(bytes([p[0] & 0x1F]) + p[1:], "big"))
+        for p in pks
+    ]
+    flags = np.array([bool(p[0] & 0x20) for p in pks])
+    px, py, on_curve, in_sub = cj.jitted("g1_decompress")(np.stack(xs), flags)
+    assert np.asarray(on_curve).all() and np.asarray(in_sub).all()
+    for i, p in enumerate(pks):
+        want = hc.g1_from_bytes(p).affine()
+        got = (
+            tower.limbs_to_int(np.asarray(px)[i]),
+            tower.limbs_to_int(np.asarray(py)[i]),
+        )
+        assert got == (int(want[0]), int(want[1]))
+
+
+def test_jac_tree_sum_matches_host_aggregate():
+    pks = [cs.SkToPk(i + 1) for i in range(4)]
+    pts = [hc.g1_from_bytes(pks[i % len(pks)]) for i in range(7)]
+    want = pts[0]
+    for p in pts[1:]:
+        want = want.add(p)
+    trips = [cj.host_point_to_jac_limbs(p) for p in pts]
+    stacked = tuple(np.stack([t[i] for t in trips])[None] for i in range(3))
+    active = np.ones((1, 7), dtype=bool)
+    sx, sy, sz = _tree_sum_g1(stacked, active)
+    got = cj.jac_limbs_to_host_point(
+        np.asarray(sx)[0], np.asarray(sy)[0], np.asarray(sz)[0], g2=False
+    )
+    assert got == want
+    # inactive lanes are identity: zero out half and compare
+    active2 = active.copy()
+    active2[0, 4:] = False
+    want2 = pts[0]
+    for p in pts[1:4]:
+        want2 = want2.add(p)
+    sx, sy, sz = _tree_sum_g1(stacked, active2)
+    got2 = cj.jac_limbs_to_host_point(
+        np.asarray(sx)[0], np.asarray(sy)[0], np.asarray(sz)[0], g2=False
+    )
+    assert got2 == want2

@@ -1,0 +1,129 @@
+"""Device hash-to-G2 (ops/h2c_jax.py) and the fast final-exponentiation
+check path (ops/pairing_jax.py) vs host oracles.
+
+The h2c pipeline (SSWU + isogeny + Budroni-Pintore cofactor) must be
+bit-identical to the host RFC 9380 implementation — interoperability
+depends on exact equality, not just subgroup membership.
+"""
+from __future__ import annotations
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consensus_specs_tpu.crypto.bls import fields as hf
+from consensus_specs_tpu.crypto.bls.hash_to_curve import (
+    clear_cofactor as host_clear_cofactor,
+    hash_to_field_fq2,
+    hash_to_g2 as host_hash_to_g2,
+    map_to_curve_g2,
+    map_to_curve_simple_swu,
+)
+from consensus_specs_tpu.ops import curve_jax as cj, h2c_jax as h2, pairing_jax as pj, tower
+
+rng = random.Random(0x42C)
+
+# module-level jits: one compile per graph per process
+_sswu_jit = jax.jit(h2.map_to_curve_sswu)
+_cyc_sq_jit = jax.jit(pj.cyclotomic_square)
+_frob1_jit = jax.jit(pj.fq12_frobenius_p1)
+_exp_x_jit = jax.jit(pj.cyclotomic_exp_x_abs)
+_fe_fast_jit = jax.jit(pj.final_exponentiation_fast)
+
+
+def _fq2_of(qx, i):
+    a = np.asarray(qx)
+    return hf.Fq2(tower.limbs_to_int(a[i, 0]), tower.limbs_to_int(a[i, 1]))
+
+
+def test_sswu_matches_host():
+    msgs = [bytes([i]) * 8 for i in range(3)]
+    us = []
+    for m in msgs:
+        us.extend(hash_to_field_fq2(m, 2))
+    arr = np.stack([tower.fq2_to_limbs_mont(u) for u in us])
+    x, y, ok = _sswu_jit(jnp.asarray(arr))
+    assert np.asarray(ok).all()
+    for i, u in enumerate(us):
+        wx, wy = map_to_curve_simple_swu(u)
+        assert _fq2_of(x, i) == wx and _fq2_of(y, i) == wy
+
+
+def test_cofactor_clearing_equals_h_eff_ladder():
+    """The psi-decomposition must equal the RFC 9380 [h_eff]Q ladder
+    exactly (hash_to_curve.py:160-164). Runs through the production
+    staged jits at the production bucket shape (8,) so no extra graphs
+    compile."""
+    pts = [map_to_curve_g2(hash_to_field_fq2(bytes([i]) * 4, 2)[0]) for i in range(3)]
+    padded = (pts * 3)[:8]
+    trips = [cj.host_point_to_jac_limbs(p) for p in padded]
+    q = tuple(np.stack([t[i] for t in trips]) for i in range(3))
+    _, cof_a, cof_b, cof_c = h2._jits()
+    t1, t2, sshift = cof_a(*q)
+    m = cof_b(t1, t2)
+    ax, ay = cof_c(q, t1, t2, sshift, m)
+    for i, p in enumerate(pts):
+        want = host_clear_cofactor(p).affine()
+        got = (_fq2_of(ax, i), _fq2_of(ay, i))
+        assert got == want
+
+
+def test_hash_to_g2_batch_matches_host():
+    msgs = [bytes([i]) * 32 for i in range(4)] + [b"", b"x"]
+    qx, qy = h2.hash_to_g2_batch(msgs)
+    for i, m in enumerate(msgs):
+        want = host_hash_to_g2(m).affine()
+        assert (_fq2_of(qx, i), _fq2_of(qy, i)) == want
+
+
+# -- fast final exponentiation ------------------------------------------------
+
+def _rand_fq12():
+    def rf2():
+        return hf.Fq2(rng.randrange(hf.P), rng.randrange(hf.P))
+
+    return hf.Fq12(hf.Fq6(rf2(), rf2(), rf2()), hf.Fq6(rf2(), rf2(), rf2()))
+
+
+@pytest.fixture(scope="module")
+def cyclotomic_element():
+    f = _rand_fq12()
+    return f.pow(hf.P**6 - 1).pow(hf.P * hf.P + 1)
+
+
+def test_cyclotomic_square_matches_full_square(cyclotomic_element):
+    cyc = cyclotomic_element
+    limbs = jnp.asarray(tower.fq12_to_limbs_mont(cyc)[None])
+    got = _cyc_sq_jit(limbs)
+    assert tower.limbs_to_fq12(np.asarray(got)[0]) == cyc * cyc
+
+
+def test_frobenius_p1_matches_host():
+    f = _rand_fq12()
+    got = _frob1_jit(jnp.asarray(tower.fq12_to_limbs_mont(f)[None]))
+    assert tower.limbs_to_fq12(np.asarray(got)[0]) == f.frobenius(1)
+
+
+def test_cyclotomic_exp_x(cyclotomic_element):
+    cyc = cyclotomic_element
+    limbs = jnp.asarray(tower.fq12_to_limbs_mont(cyc)[None])
+    got = _exp_x_jit(limbs)
+    assert tower.limbs_to_fq12(np.asarray(got)[0]) == cyc.pow(pj.X_PARAM)
+
+
+def test_fast_final_exponentiation_is_3d_exponent():
+    """final_exponentiation_fast == f^(3*(p^12-1)/r) — the integer
+    identity 3*(p^4-p^2+1)/r == (x-1)^2(x+p)(x^2+p^2-1)+3 realized by
+    the x-chain; equivalent to the exact exponent for the ==1 decision
+    since gcd(3, r) == 1."""
+    P, R = hf.P, hf.R
+    x = -pj.X_PARAM
+    d = (P**4 - P**2 + 1) // R
+    assert 3 * d == (x - 1) ** 2 * (x + P) * (x * x + P * P - 1) + 3
+    f = _rand_fq12()
+    want = f.pow(3 * ((P**12 - 1) // R))
+    got = _fe_fast_jit(jnp.asarray(tower.fq12_to_limbs_mont(f)[None]))
+    assert tower.limbs_to_fq12(np.asarray(got)[0]) == want

@@ -75,6 +75,12 @@ def load_network(name: str, preset_dir: str, config_file: str, base_preset: Opti
     `base_preset` overrides it for both."""
     cfg = load_yaml_vars(config_file)
     base = base_preset or cfg.get("PRESET_BASE")
-    register_preset(name, load_preset_dir(preset_dir), base=base if base in PRESETS else None)
+    if base is not None and base not in PRESETS:
+        # fail at the root cause: a silent None base would surface much
+        # later as a missing-variable NameError inside build_spec
+        raise KeyError(
+            f"unknown base preset {base!r} (registered: {sorted(PRESETS)})"
+        )
+    register_preset(name, load_preset_dir(preset_dir), base=base)
     register_config(name, cfg, base=base if base in CONFIGS else None)
     return name

@@ -1,0 +1,244 @@
+"""Deposit-contract reference model: the eth1 on-chain contract the
+beacon chain bootstraps from, re-implemented as an executable Python
+model with the exact on-chain semantics (incremental 32-depth SHA-256
+Merkle tree, little-endian count mix-in, gwei validation rules).
+
+Reference surface being modeled (NOT transcribed — this is an
+independent implementation of the documented interface):
+  solidity_deposit_contract/deposit_contract.sol (178 LoC Solidity):
+    get_deposit_root() -> bytes32
+    get_deposit_count() -> bytes (8, little-endian)
+    deposit(pubkey[48], withdrawal_credentials[32], signature[96],
+            deposit_data_root) payable
+  specs/phase0/deposit-contract.md (semantics: incremental Merkle
+  accumulator over DepositData hash_tree_roots, depth 32).
+
+Design notes:
+- The contract's root is definitionally equal to the SSZ
+  hash_tree_root of List[DepositData, 2**32]: Merkle depth 32 over
+  per-deposit container roots, then sha256(root || count_le64 ||
+  bytes24(0)) — exactly SSZ's mix_in_length with the length in the
+  first 8 bytes of the length chunk. Tests pin this equality against
+  the SSZ library.
+- Unlike the chain contract, the model can also *emit Merkle proofs*
+  (the full tree is retained), so test harnesses can drive the spec's
+  process_deposit / is_valid_merkle_branch (beacon-chain.md:742,1854)
+  with real branches instead of hand-built ones.
+- ABI surface: abi() returns the canonical JSON fragment a web3-style
+  harness would bind against.
+"""
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+TREE_DEPTH = 32
+MAX_DEPOSITS = 2**TREE_DEPTH
+GWEI = 10**9
+MIN_DEPOSIT_WEI = GWEI * 10**9  # 1 ETH in wei
+
+
+def _sha256(x: bytes) -> bytes:
+    return hashlib.sha256(x).digest()
+
+
+def _zerohashes() -> List[bytes]:
+    zh = [b"\x00" * 32]
+    for _ in range(TREE_DEPTH):
+        zh.append(_sha256(zh[-1] + zh[-1]))
+    return zh
+
+
+ZERO_HASHES = _zerohashes()
+
+
+def compute_deposit_data_root(
+    pubkey: bytes, withdrawal_credentials: bytes, amount_gwei: int, signature: bytes
+) -> bytes:
+    """SSZ hash_tree_root of DepositData computed with raw chunk hashing
+    (the same fixed-shape reduction the on-chain code performs):
+      pubkey_root  = H(pubkey || 0^16)
+      sig_root     = H(H(sig[0:64]) || H(sig[64:96] || 0^32))
+      node         = H(H(pubkey_root || wc) || H(amount_le8 || 0^24 || sig_root))
+    """
+    pubkey_root = _sha256(pubkey + b"\x00" * 16)
+    sig_root = _sha256(
+        _sha256(signature[:64]) + _sha256(signature[64:] + b"\x00" * 32)
+    )
+    amount_chunk = amount_gwei.to_bytes(8, "little") + b"\x00" * 24
+    return _sha256(
+        _sha256(pubkey_root + withdrawal_credentials)
+        + _sha256(amount_chunk + sig_root)
+    )
+
+
+class DepositContractError(ValueError):
+    """Model analog of a contract revert."""
+
+
+@dataclass
+class DepositEvent:
+    pubkey: bytes
+    withdrawal_credentials: bytes
+    amount: bytes  # 8-byte little-endian gwei (as emitted on-chain)
+    signature: bytes
+    index: bytes  # 8-byte little-endian deposit index
+
+
+@dataclass
+class DepositContract:
+    """Stateful model. `deposit` mirrors the payable entrypoint
+    (value in wei); the incremental-tree `branch` is the O(log n)
+    on-chain accumulator, while `leaves` additionally retains history
+    for proof generation (test-harness affordance)."""
+
+    branch: List[bytes] = field(default_factory=lambda: [b"\x00" * 32] * TREE_DEPTH)
+    deposit_count: int = 0
+    leaves: List[bytes] = field(default_factory=list)
+    events: List[DepositEvent] = field(default_factory=list)
+
+    # -- views ---------------------------------------------------------------
+
+    def get_deposit_count(self) -> bytes:
+        return self.deposit_count.to_bytes(8, "little")
+
+    def get_deposit_root(self) -> bytes:
+        node = b"\x00" * 32
+        size = self.deposit_count
+        for height in range(TREE_DEPTH):
+            if size & 1:
+                node = _sha256(self.branch[height] + node)
+            else:
+                node = _sha256(node + ZERO_HASHES[height])
+            size >>= 1
+        return _sha256(node + self.get_deposit_count() + b"\x00" * 24)
+
+    # -- entrypoint ----------------------------------------------------------
+
+    def deposit(
+        self,
+        pubkey: bytes,
+        withdrawal_credentials: bytes,
+        signature: bytes,
+        deposit_data_root: bytes,
+        value_wei: int,
+    ) -> DepositEvent:
+        if len(pubkey) != 48:
+            raise DepositContractError("DepositContract: invalid pubkey length")
+        if len(withdrawal_credentials) != 32:
+            raise DepositContractError(
+                "DepositContract: invalid withdrawal_credentials length"
+            )
+        if len(signature) != 96:
+            raise DepositContractError("DepositContract: invalid signature length")
+        if value_wei < MIN_DEPOSIT_WEI:
+            raise DepositContractError("DepositContract: deposit value too low")
+        if value_wei % GWEI != 0:
+            raise DepositContractError(
+                "DepositContract: deposit value not multiple of gwei"
+            )
+        amount_gwei = value_wei // GWEI
+        node = compute_deposit_data_root(
+            pubkey, withdrawal_credentials, amount_gwei, signature
+        )
+        if node != bytes(deposit_data_root):
+            raise DepositContractError(
+                "DepositContract: reconstructed DepositData does not match supplied deposit_data_root"
+            )
+        if self.deposit_count >= MAX_DEPOSITS - 1:
+            raise DepositContractError("DepositContract: merkle tree full")
+
+        event = DepositEvent(
+            pubkey=bytes(pubkey),
+            withdrawal_credentials=bytes(withdrawal_credentials),
+            amount=amount_gwei.to_bytes(8, "little"),
+            signature=bytes(signature),
+            index=self.deposit_count.to_bytes(8, "little"),
+        )
+        self.events.append(event)
+        self.leaves.append(node)
+
+        # incremental insert: ripple the new leaf up to the first
+        # even-sized level and park it there
+        self.deposit_count += 1
+        size = self.deposit_count
+        for height in range(TREE_DEPTH):
+            if size & 1:
+                self.branch[height] = node
+                break
+            node = _sha256(self.branch[height] + node)
+            size >>= 1
+        return event
+
+    # -- proof generation (model extra; the chain contract has no view
+    #    for this — clients reconstruct from event logs the same way) ---------
+
+    def get_merkle_proof(self, index: int) -> List[bytes]:
+        """Branch for leaf `index` against the CURRENT root, 33 elements:
+        32 tree siblings + the length mix-in chunk — exactly the shape
+        process_deposit validates with is_valid_merkle_branch(depth =
+        DEPOSIT_CONTRACT_TREE_DEPTH + 1) (beacon-chain.md:1854)."""
+        if not 0 <= index < self.deposit_count:
+            raise DepositContractError("proof index out of range")
+        layer = list(self.leaves)
+        proof: List[bytes] = []
+        idx = index
+        for height in range(TREE_DEPTH):
+            sibling = idx ^ 1
+            if sibling < len(layer):
+                proof.append(layer[sibling])
+            else:
+                proof.append(ZERO_HASHES[height])
+            nxt = []
+            for i in range(0, len(layer), 2):
+                left = layer[i]
+                right = layer[i + 1] if i + 1 < len(layer) else ZERO_HASHES[height]
+                nxt.append(_sha256(left + right))
+            layer = nxt
+            idx >>= 1
+        proof.append(self.get_deposit_count() + b"\x00" * 24)
+        return proof
+
+
+def abi() -> list:
+    """Canonical ABI fragment (the shape a web3 binding consumes)."""
+    return [
+        {
+            "name": "get_deposit_root",
+            "type": "function",
+            "stateMutability": "view",
+            "inputs": [],
+            "outputs": [{"name": "", "type": "bytes32"}],
+        },
+        {
+            "name": "get_deposit_count",
+            "type": "function",
+            "stateMutability": "view",
+            "inputs": [],
+            "outputs": [{"name": "", "type": "bytes"}],
+        },
+        {
+            "name": "deposit",
+            "type": "function",
+            "stateMutability": "payable",
+            "inputs": [
+                {"name": "pubkey", "type": "bytes"},
+                {"name": "withdrawal_credentials", "type": "bytes"},
+                {"name": "signature", "type": "bytes"},
+                {"name": "deposit_data_root", "type": "bytes32"},
+            ],
+            "outputs": [],
+        },
+        {
+            "name": "DepositEvent",
+            "type": "event",
+            "inputs": [
+                {"name": "pubkey", "type": "bytes", "indexed": False},
+                {"name": "withdrawal_credentials", "type": "bytes", "indexed": False},
+                {"name": "amount", "type": "bytes", "indexed": False},
+                {"name": "signature", "type": "bytes", "indexed": False},
+                {"name": "index", "type": "bytes", "indexed": False},
+            ],
+        },
+    ]

@@ -59,7 +59,13 @@ def get_provider(create_provider_fn, fork_name: str, preset_name: str, all_mods)
 
 def get_create_provider_fn(runner_name: str):
     def prepare_fn() -> None:
-        bls.use_backend("reference")
+        # generator mode runs real BLS; the backend is selectable the way
+        # the reference's generators select milagro (gen.py:75-77) — here
+        # the fast analog is the batched device backend ("jax"),
+        # opted into via env so CPU-only hosts keep the pure-host path.
+        import os
+
+        bls.use_backend(os.environ.get("CONSENSUS_SPECS_TPU_BLS_BACKEND", "reference"))
         return
 
     def create_provider(fork_name: str, preset_name: str, handler_name: str,

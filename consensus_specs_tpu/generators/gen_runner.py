@@ -4,6 +4,7 @@ lifecycle, resume, error log (ref: gen_helpers/gen_base/gen_runner.py).
 from __future__ import annotations
 
 import argparse
+import contextlib
 import shutil
 import time
 import traceback
@@ -13,6 +14,7 @@ from typing import Iterable
 import yaml
 
 from consensus_specs_tpu.exceptions import SkippedTest
+from consensus_specs_tpu.utils import profiling
 from consensus_specs_tpu.ssz.types import SSZType
 from consensus_specs_tpu.utils import snappy
 
@@ -44,6 +46,9 @@ def run_generator(generator_name: str, test_providers: Iterable[TestProvider], a
                         help="only generate the given presets")
     parser.add_argument("-c", "--collect-only", action="store_true", default=False,
                         help="list the test cases without generating")
+    parser.add_argument("--profile", action="store_true", default=False,
+                        help="per-handler wall-clock accounting + JAX device trace "
+                             "(trace emitted when CONSENSUS_SPECS_TPU_TRACE_DIR is set)")
 
     ns = parser.parse_args(args=args)
 
@@ -53,7 +58,8 @@ def run_generator(generator_name: str, test_providers: Iterable[TestProvider], a
     generated = skipped = failed = 0
     collected = 0
 
-    for provider in test_providers:
+    with (profiling.trace(generator_name) if ns.profile else contextlib.nullcontext()):
+      for provider in test_providers:
         provider.prepare()
 
         for test_case in provider.make_cases():
@@ -75,6 +81,11 @@ def run_generator(generator_name: str, test_providers: Iterable[TestProvider], a
 
             print(f"generating: {case_dir}")
             written_parts = 0
+            profile_ctx = (
+                profiling.section(f"{test_case.runner_name}/{test_case.handler_name}")
+                if ns.profile
+                else contextlib.nullcontext()
+            )
             try:
                 case_dir.mkdir(parents=True, exist_ok=True)
                 start = time.time()
@@ -82,7 +93,12 @@ def run_generator(generator_name: str, test_providers: Iterable[TestProvider], a
                 incomplete_tag_file.touch()
 
                 meta = {}
-                for (name, kind, data) in test_case.case_fn():
+                if ns.profile:
+                    with profile_ctx:
+                        parts = list(test_case.case_fn())
+                else:
+                    parts = test_case.case_fn()
+                for (name, kind, data) in parts:
                     if kind == "meta":
                         meta[name] = data
                         continue
@@ -132,5 +148,7 @@ def run_generator(generator_name: str, test_providers: Iterable[TestProvider], a
     else:
         summary = f"completed generation of {generator_name}: {generated} generated, {skipped} skipped, {failed} failed"
         print(summary)
+        if ns.profile:
+            profiling.print_report(header="per-handler wall clock:")
         if failed:
             raise SystemExit(1)

@@ -340,3 +340,33 @@ def use_host_hasher() -> None:
     hashing.set_fused_root_backend(None)
     hashing.set_tree_backend(None)
     hashing.set_item_roots_backend(None)
+
+
+def hash_many_pipelined(batches) -> list:
+    """Pipeline-parallel variant of hash_many_device over an iterable of
+    byte batches: host packing of batch i+1 overlaps the device
+    compression of batch i (SURVEY §2.6 pipeline row — 'overlap host SSZ
+    packing <-> device hashing via async dispatch').
+
+    JAX dispatch is asynchronous: `sha256_blocks_jit` returns a future-
+    backed array immediately, so by submitting batch i before packing
+    batch i+1 and only materializing (np.asarray) a result AFTER the
+    next batch is in flight, host prep and device compute run
+    concurrently with no extra machinery. Returns the per-batch digest
+    byte strings in order."""
+    in_flight = None  # (device_array, n_blocks)
+    results = []
+    for data in batches:
+        n = len(data) // 64
+        size = 1 << (n - 1).bit_length() if n > 1 else 1
+        blocks = np.zeros((size, 16), dtype=np.uint32)
+        blocks[:n] = _bytes_to_words(data, 16)
+        submitted = (sha256_blocks_jit(jnp.asarray(blocks)), n)
+        if in_flight is not None:
+            out, prev_n = in_flight
+            results.append(_words_to_bytes(np.asarray(out)[:prev_n]))
+        in_flight = submitted
+    if in_flight is not None:
+        out, prev_n = in_flight
+        results.append(_words_to_bytes(np.asarray(out)[:prev_n]))
+    return results

@@ -1,0 +1,146 @@
+"""Deposit-contract model harness — the L7 artifact surface
+(solidity_deposit_contract/web3_tester/tests/test_deposit.py analog):
+input validation reverts, root/count evolution, event logs, and the
+contract-root == SSZ List[DepositData] hash_tree_root identity that
+the beacon chain's process_deposit relies on (beacon-chain.md:1854).
+"""
+from __future__ import annotations
+
+import pytest
+
+from consensus_specs_tpu.deposit_contract import (
+    GWEI,
+    DepositContract,
+    DepositContractError,
+    MIN_DEPOSIT_WEI,
+    abi,
+    compute_deposit_data_root,
+)
+from consensus_specs_tpu.test_framework import context
+from consensus_specs_tpu.test_framework.deposits import build_deposit_data
+from consensus_specs_tpu.test_framework.keys import privkeys, pubkeys
+
+
+def _spec():
+    return context.get_spec("phase0", context.DEFAULT_PRESET)
+
+
+def _deposit_args(spec, i, amount_gwei):
+    wc = spec.BLS_WITHDRAWAL_PREFIX + spec.hash(pubkeys[i])[1:]
+    data = build_deposit_data(
+        spec, pubkeys[i], privkeys[i], amount_gwei, wc, signed=True
+    )
+    return (
+        bytes(data.pubkey),
+        bytes(data.withdrawal_credentials),
+        bytes(data.signature),
+        bytes(spec.hash_tree_root(data)),
+        data,
+    )
+
+
+def test_initial_state():
+    c = DepositContract()
+    assert c.get_deposit_count() == (0).to_bytes(8, "little")
+    # empty root == SSZ root of an empty List[DepositData, 2**32]
+    spec = _spec()
+    empty = spec.List[spec.DepositData, 2**spec.DEPOSIT_CONTRACT_TREE_DEPTH]()
+    assert c.get_deposit_root() == bytes(spec.hash_tree_root(empty))
+
+
+def test_deposit_data_root_matches_ssz():
+    spec = _spec()
+    pk, wc, sig, root, data = _deposit_args(spec, 0, spec.MAX_EFFECTIVE_BALANCE)
+    assert compute_deposit_data_root(pk, wc, int(data.amount), sig) == root
+
+
+@pytest.mark.parametrize(
+    "mutate,err",
+    [
+        (lambda a: {**a, "pubkey": a["pubkey"][:-1]}, "pubkey"),
+        (lambda a: {**a, "withdrawal_credentials": a["withdrawal_credentials"] + b"\x00"}, "withdrawal_credentials"),
+        (lambda a: {**a, "signature": a["signature"][:-2]}, "signature"),
+        (lambda a: {**a, "value_wei": MIN_DEPOSIT_WEI - GWEI}, "too low"),
+        (lambda a: {**a, "value_wei": a["value_wei"] + 1}, "gwei"),
+        (lambda a: {**a, "deposit_data_root": b"\x00" * 32}, "deposit_data_root"),
+    ],
+)
+def test_deposit_reverts(mutate, err):
+    spec = _spec()
+    pk, wc, sig, root, data = _deposit_args(spec, 0, spec.MAX_EFFECTIVE_BALANCE)
+    args = dict(
+        pubkey=pk,
+        withdrawal_credentials=wc,
+        signature=sig,
+        deposit_data_root=root,
+        value_wei=int(data.amount) * GWEI,
+    )
+    c = DepositContract()
+    with pytest.raises(DepositContractError, match=err):
+        c.deposit(**mutate(args))
+    assert c.deposit_count == 0
+
+
+def test_deposit_root_tracks_ssz_list_root():
+    """After every deposit the contract root equals the SSZ
+    hash_tree_root of the accumulated List[DepositData, 2**32] — the
+    identity that makes eth1 deposit roots consumable as SSZ roots."""
+    spec = _spec()
+    c = DepositContract()
+    data_list = []
+    for i in range(4):
+        amount = spec.MAX_EFFECTIVE_BALANCE if i % 2 == 0 else spec.MIN_DEPOSIT_AMOUNT
+        pk, wc, sig, root, data = _deposit_args(spec, i, amount)
+        ev = c.deposit(pk, wc, sig, root, value_wei=int(data.amount) * GWEI)
+        data_list.append(data)
+        lst = spec.List[spec.DepositData, 2**spec.DEPOSIT_CONTRACT_TREE_DEPTH](data_list)
+        assert c.get_deposit_root() == bytes(spec.hash_tree_root(lst)), i
+        assert c.get_deposit_count() == len(data_list).to_bytes(8, "little")
+        assert ev.index == i.to_bytes(8, "little")
+        assert ev.amount == int(data.amount).to_bytes(8, "little")
+
+
+def test_merkle_proofs_feed_process_deposit():
+    """Model-emitted branches satisfy is_valid_merkle_branch at depth
+    DEPOSIT_CONTRACT_TREE_DEPTH + 1 against the live contract root —
+    the exact check process_deposit performs (beacon-chain.md:742,1854)."""
+    spec = _spec()
+    c = DepositContract()
+    datas = []
+    for i in range(3):
+        pk, wc, sig, root, data = _deposit_args(spec, i, spec.MAX_EFFECTIVE_BALANCE)
+        c.deposit(pk, wc, sig, root, value_wei=int(data.amount) * GWEI)
+        datas.append(data)
+    live_root = c.get_deposit_root()
+    for i, data in enumerate(datas):
+        proof = c.get_merkle_proof(i)
+        assert len(proof) == spec.DEPOSIT_CONTRACT_TREE_DEPTH + 1
+        assert spec.is_valid_merkle_branch(
+            leaf=spec.hash_tree_root(data),
+            branch=proof,
+            depth=spec.DEPOSIT_CONTRACT_TREE_DEPTH + 1,
+            index=i,
+            root=live_root,
+        )
+    # wrong index fails
+    assert not spec.is_valid_merkle_branch(
+        leaf=spec.hash_tree_root(datas[0]),
+        branch=c.get_merkle_proof(0),
+        depth=spec.DEPOSIT_CONTRACT_TREE_DEPTH + 1,
+        index=1,
+        root=live_root,
+    )
+
+
+def test_abi_shape():
+    fragment = abi()
+    names = {f["name"] for f in fragment}
+    assert {"get_deposit_root", "get_deposit_count", "deposit", "DepositEvent"} <= names
+    dep = next(f for f in fragment if f["name"] == "deposit")
+    assert dep["stateMutability"] == "payable"
+    assert [inp["name"] for inp in dep["inputs"]] == [
+        "pubkey",
+        "withdrawal_credentials",
+        "signature",
+        "deposit_data_root",
+    ]

@@ -1,0 +1,143 @@
+"""Generate the human-readable normative spec documents from the
+executable fork deltas — the reverse of the reference's build direction.
+
+The reference keeps markdown as the root of truth and compiles Python
+out of it (ref setup.py:168-264). This framework keeps the *executable
+delta modules* as the root of truth (consensus_specs_tpu/specs/<fork>.py)
+and emits the markdown layer from them, so the documents' code blocks
+are the shipped code by construction — they can never drift.
+
+Usage:  python tools/gen_spec_docs.py     (writes docs/specs/<fork>/*.md)
+
+Structure mirrors the reference's document set (specs/<fork>/*.md): one
+`beacon-chain.md`-style document per fork built from the delta module's
+banner sections, plus a constants appendix from the preset/config
+tables. The p2p-interface and deposit-contract documents are prose
+(maintained by hand in docs/specs/, not generated).
+"""
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+SPEC_DIR = REPO / "consensus_specs_tpu" / "specs"
+OUT_DIR = REPO / "docs" / "specs"
+
+FORKS = [
+    ("phase0", "Phase 0 — The Beacon Chain"),
+    ("altair", "Altair — Sync Committees & Participation Flags"),
+    ("bellatrix", "Bellatrix — The Merge"),
+    ("capella", "Capella — Withdrawals"),
+    ("sharding", "Sharding (R&D) — Shard Blob Commitments"),
+    ("custody_game", "Custody Game (R&D) — Proof of Custody"),
+    ("das", "DAS (R&D) — Data Availability Sampling"),
+    ("eip4844", "EIP-4844 — Proto-Danksharding"),
+]
+
+_BANNER = re.compile(
+    r"^# -{20,}\n# (?P<title>[^\n]+)\n# -{20,}\n", re.M
+)
+
+
+def _slug(title: str) -> str:
+    return re.sub(r"[^a-z0-9]+", "-", title.lower()).strip("-")
+
+
+def split_sections(source: str):
+    """(title, code) pairs from the module's banner sections; the
+    preamble before the first banner is dropped (imports/builder glue)."""
+    matches = list(_BANNER.finditer(source))
+    out = []
+    for i, m in enumerate(matches):
+        start = m.end()
+        end = matches[i + 1].start() if i + 1 < len(matches) else len(source)
+        out.append((m.group("title").strip(), source[start:end].strip("\n")))
+    return out
+
+
+def render_fork(fork: str, heading: str) -> str:
+    src_path = SPEC_DIR / f"{fork}.py"
+    source = src_path.read_text()
+    sections = split_sections(source)
+    lines = [
+        f"# {heading}",
+        "",
+        "**Notice**: this document is generated from the executable fork delta",
+        f"`consensus_specs_tpu/specs/{fork}.py` by `tools/gen_spec_docs.py`;",
+        "the code blocks below ARE the shipped implementation (they cannot",
+        "drift). Preset/config values referenced by the code live in",
+        "`presets/` and `configs/` (see `constants.md`).",
+        "",
+        "## Table of contents",
+        "",
+    ]
+    for title, _ in sections:
+        lines.append(f"- [{title}](#{_slug(title)})")
+    lines.append("")
+    for title, code in sections:
+        lines.append(f"## {title}")
+        lines.append("")
+        lines.append("```python")
+        lines.append(code)
+        lines.append("```")
+        lines.append("")
+    return "\n".join(lines)
+
+
+def render_constants() -> str:
+    from consensus_specs_tpu.config.presets import PRESETS
+    from consensus_specs_tpu.config.runtime import config_for
+
+    lines = [
+        "# Constants, presets, and configuration",
+        "",
+        "Three-tier model (matching the reference's constants/presets/configs",
+        "split, ref setup.py:218-247):",
+        "",
+        "- **constants** — protocol invariants, baked into the fork deltas;",
+        "- **presets** — compile-time bundles (`mainnet`, `minimal`) below;",
+        "- **configs** — runtime-swappable values (fork epochs, time, churn),",
+        "  loadable from YAML (`configs/*.yaml`).",
+        "",
+    ]
+    for preset_name in ("mainnet", "minimal"):
+        lines.append(f"## `{preset_name}` preset")
+        lines.append("")
+        for fork, table in PRESETS[preset_name].items():
+            if not table:
+                continue
+            lines.append(f"### {fork}")
+            lines.append("")
+            lines.append("| name | value |")
+            lines.append("|---|---|")
+            for key in sorted(table):
+                lines.append(f"| `{key}` | `{table[key]!r}` |")
+            lines.append("")
+    for config_name in ("mainnet", "minimal"):
+        config = config_for(config_name)
+        lines.append(f"## `{config_name}` config")
+        lines.append("")
+        lines.append("| name | value |")
+        lines.append("|---|---|")
+        for key in sorted(vars(config)):
+            lines.append(f"| `{key}` | `{getattr(config, key)!r}` |")
+        lines.append("")
+    return "\n".join(lines)
+
+
+def main() -> None:
+    for fork, heading in FORKS:
+        out = OUT_DIR / fork
+        out.mkdir(parents=True, exist_ok=True)
+        (out / "spec.md").write_text(render_fork(fork, heading))
+        print(f"wrote docs/specs/{fork}/spec.md")
+    OUT_DIR.mkdir(parents=True, exist_ok=True)
+    (OUT_DIR / "constants.md").write_text(render_constants())
+    print("wrote docs/specs/constants.md")
+
+
+if __name__ == "__main__":
+    main()

@@ -508,11 +508,21 @@ def _shuffle_permutation(index_count: int, seed: bytes) -> np.ndarray:
 
 
 def compute_committee(indices: Sequence[ValidatorIndex], seed: Bytes32, index: uint64, count: uint64) -> Sequence[ValidatorIndex]:
-    """Slice of the shuffled active set (beacon-chain.md:807)."""
+    """Slice of the shuffled active set (beacon-chain.md:807).
+
+    The per-element bound assert mirrors the reference's
+    compute_shuffled_index(i, index_count) precondition (beacon-chain.md
+    :760 `assert index < index_count`) — an out-of-range committee index
+    must surface as the spec's AssertionError control flow, not an
+    implementation IndexError from the batched permutation."""
     start = (len(indices) * int(index)) // int(count)
     end = (len(indices) * (int(index) + 1)) // int(count)
     perm = _shuffle_permutation(len(indices), seed)
-    return [indices[perm[i]] for i in range(start, end)]
+    out = []
+    for i in range(start, end):
+        assert i < len(indices)
+        out.append(indices[perm[i]])
+    return out
 
 
 def compute_proposer_index(state: "BeaconState", indices: Sequence[ValidatorIndex], seed: Bytes32) -> ValidatorIndex:

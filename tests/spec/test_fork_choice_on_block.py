@@ -9,15 +9,20 @@ from consensus_specs_tpu.test_framework.context import (
     spec_state_test,
     with_all_phases,
 )
+from consensus_specs_tpu.test_framework.attestations import (
+    next_epoch_with_attestations,
+)
 from consensus_specs_tpu.test_framework.fork_choice import (
     add_block,
     apply_next_epoch_with_attestations,
+    apply_next_slots_with_attestations,
     get_genesis_forkchoice_store_and_block,
     on_tick_and_append_step,
     tick_and_add_block,
 )
 from consensus_specs_tpu.test_framework.state import (
     next_epoch,
+    next_slots,
     state_transition_and_sign_block,
 )
 
@@ -142,4 +147,238 @@ def test_proposer_boost_untimely_block(spec, state):
     assert store.proposer_boost_root == spec.Root()
     assert spec.get_head(store) == spec.hash_tree_root(block)
 
+    yield "steps", test_steps
+
+
+# -- store-level chain scenarios (ref test_on_block.py) ----------------------
+
+@with_all_phases
+@spec_state_test
+def test_basic(spec, state):
+    """Head follows blocks across a slot and an epoch boundary."""
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+    current_time = state.slot * spec.config.SECONDS_PER_SLOT + store.genesis_time
+    on_tick_and_append_step(spec, store, current_time, test_steps)
+    assert store.time == current_time
+
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield from tick_and_add_block(spec, store, signed_block, test_steps)
+    assert spec.get_head(store) == signed_block.message.hash_tree_root()
+
+    store.time = current_time + spec.config.SECONDS_PER_SLOT * spec.SLOTS_PER_EPOCH
+    block = build_empty_block(spec, state, state.slot + spec.SLOTS_PER_EPOCH)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield from tick_and_add_block(spec, store, signed_block, test_steps)
+    assert spec.get_head(store) == signed_block.message.hash_tree_root()
+
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_on_block_checkpoints(spec, state):
+    """A proposal on top of a mocked later finalized checkpoint is
+    accepted and becomes head."""
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+    current_time = state.slot * spec.config.SECONDS_PER_SLOT + store.genesis_time
+    on_tick_and_append_step(spec, store, current_time, test_steps)
+
+    next_epoch(spec, state)
+    on_tick_and_append_step(
+        spec, store, store.genesis_time + state.slot * spec.config.SECONDS_PER_SLOT, test_steps
+    )
+    state, store, last_signed_block = yield from apply_next_epoch_with_attestations(
+        spec, state, store, True, False, test_steps=test_steps
+    )
+    last_block_root = spec.hash_tree_root(last_signed_block.message)
+    assert spec.get_head(store) == last_block_root
+
+    next_epoch(spec, state)
+    on_tick_and_append_step(
+        spec, store, store.genesis_time + state.slot * spec.config.SECONDS_PER_SLOT, test_steps
+    )
+
+    fin_state = store.block_states[last_block_root].copy()
+    fin_state.finalized_checkpoint = store.block_states[
+        last_block_root
+    ].current_justified_checkpoint.copy()
+    block = build_empty_block_for_next_slot(spec, fin_state)
+    signed_block = state_transition_and_sign_block(spec, fin_state.copy(), block)
+    yield from tick_and_add_block(spec, store, signed_block, test_steps)
+    assert spec.get_head(store) == signed_block.message.hash_tree_root()
+    yield "steps", test_steps
+
+
+def _finalize_epoch_2_with_skips(spec, state, store, test_steps):
+    """Shared scaffold: finalize epoch 2 whose start slot was skipped.
+    Returns the state snapshot taken after the skipped slots."""
+    state, store, _ = yield from apply_next_slots_with_attestations(
+        spec, state, store, spec.SLOTS_PER_EPOCH, True, False, test_steps
+    )
+    next_slots(spec, state, spec.SLOTS_PER_EPOCH)
+    target_state = state.copy()
+    for _ in range(2):
+        state, store, _ = yield from apply_next_epoch_with_attestations(
+            spec, state, store, True, True, test_steps=test_steps
+        )
+    assert state.finalized_checkpoint.epoch == store.finalized_checkpoint.epoch == 2
+    assert store.finalized_checkpoint.root == spec.get_block_root(state, 1) == spec.get_block_root(state, 2)
+    assert state.current_justified_checkpoint.epoch == store.justified_checkpoint.epoch == 3
+    return target_state
+
+
+@with_all_phases
+@spec_state_test
+def test_on_block_finalized_skip_slots(spec, state):
+    """Finalized epoch's start slot was skipped; a proposal built on the
+    chain that INCLUDES the finalized block is accepted."""
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+    current_time = state.slot * spec.config.SECONDS_PER_SLOT + store.genesis_time
+    on_tick_and_append_step(spec, store, current_time, test_steps)
+
+    target_state = yield from _finalize_epoch_2_with_skips(spec, state, store, test_steps)
+
+    block = build_empty_block_for_next_slot(spec, target_state)
+    signed_block = state_transition_and_sign_block(spec, target_state, block)
+    yield from tick_and_add_block(spec, store, signed_block, test_steps)
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_on_block_finalized_skip_slots_not_in_skip_chain(spec, state):
+    """A proposal on the finalized ROOT's state (pre-skip chain) does
+    not descend from the finalized checkpoint: rejected."""
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+    current_time = state.slot * spec.config.SECONDS_PER_SLOT + store.genesis_time
+    on_tick_and_append_step(spec, store, current_time, test_steps)
+
+    yield from _finalize_epoch_2_with_skips(spec, state, store, test_steps)
+
+    another_state = store.block_states[store.finalized_checkpoint.root].copy()
+    assert another_state.slot == spec.compute_start_slot_at_epoch(store.finalized_checkpoint.epoch - 1)
+    block = build_empty_block_for_next_slot(spec, another_state)
+    signed_block = state_transition_and_sign_block(spec, another_state, block)
+    yield from tick_and_add_block(spec, store, signed_block, test_steps, valid=False)
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_new_finalized_slot_is_justified_checkpoint_ancestor(spec, state):
+    """A fork advancing finality where the store's justified checkpoint
+    remains a descendant of the new finalized root: the store adopts the
+    fork's checkpoints."""
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+    current_time = state.slot * spec.config.SECONDS_PER_SLOT + store.genesis_time
+    on_tick_and_append_step(spec, store, current_time, test_steps)
+
+    next_epoch(spec, state)
+    state, store, _ = yield from apply_next_epoch_with_attestations(
+        spec, state, store, False, True, test_steps=test_steps
+    )
+    state, store, _ = yield from apply_next_epoch_with_attestations(
+        spec, state, store, True, False, test_steps=test_steps
+    )
+    next_epoch(spec, state)
+    for _ in range(2):
+        state, store, _ = yield from apply_next_epoch_with_attestations(
+            spec, state, store, False, True, test_steps=test_steps
+        )
+    assert state.finalized_checkpoint.epoch == store.finalized_checkpoint.epoch == 2
+    assert state.current_justified_checkpoint.epoch == store.justified_checkpoint.epoch == 4
+
+    # fork from epoch 3 and finalize epoch 3 on the fork
+    all_blocks = []
+    slot = spec.compute_start_slot_at_epoch(3)
+    block_root = spec.get_block_root_at_slot(state, slot)
+    another_state = store.block_states[block_root].copy()
+    for _ in range(2):
+        _, signed_blocks, another_state = next_epoch_with_attestations(
+            spec, another_state, True, True
+        )
+        all_blocks += signed_blocks
+    assert another_state.finalized_checkpoint.epoch == 3
+    assert another_state.current_justified_checkpoint.epoch == 4
+
+    pre_store_justified_checkpoint_root = store.justified_checkpoint.root
+    for block in all_blocks:
+        yield from tick_and_add_block(spec, store, block, test_steps)
+
+    finalized_slot = spec.compute_start_slot_at_epoch(store.finalized_checkpoint.epoch)
+    ancestor_at_finalized_slot = spec.get_ancestor(
+        store, pre_store_justified_checkpoint_root, finalized_slot
+    )
+    assert ancestor_at_finalized_slot == store.finalized_checkpoint.root
+    assert store.finalized_checkpoint == another_state.finalized_checkpoint
+    assert store.justified_checkpoint == another_state.current_justified_checkpoint
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_new_finalized_slot_is_not_justified_checkpoint_ancestor(spec, state):
+    """A fork whose finality conflicts with the store's justified
+    checkpoint lineage: the store switches finalized+justified to the
+    fork's checkpoints (on_block unconditional update path)."""
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+    current_time = state.slot * spec.config.SECONDS_PER_SLOT + store.genesis_time
+    on_tick_and_append_step(spec, store, current_time, test_steps)
+
+    # main chain: finalized 0, justified 3 (previous-epoch attestations only)
+    next_epoch(spec, state)
+    another_state = state.copy()
+    state, store, _ = yield from apply_next_epoch_with_attestations(
+        spec, state, store, False, True, test_steps=test_steps
+    )
+    next_epoch(spec, state)
+    for _ in range(2):
+        state, store, _ = yield from apply_next_epoch_with_attestations(
+            spec, state, store, False, True, test_steps=test_steps
+        )
+    assert state.finalized_checkpoint.epoch == store.finalized_checkpoint.epoch == 0
+    assert state.current_justified_checkpoint.epoch == store.justified_checkpoint.epoch == 3
+
+    # fork chain from epoch-1 start: finalized 2, justified 3
+    all_blocks = []
+    for _ in range(3):
+        _, signed_blocks, another_state = next_epoch_with_attestations(
+            spec, another_state, True, True
+        )
+        all_blocks += signed_blocks
+    assert another_state.finalized_checkpoint.epoch == 2
+    assert another_state.current_justified_checkpoint.epoch == 3
+    assert state.finalized_checkpoint != another_state.finalized_checkpoint
+    assert state.current_justified_checkpoint != another_state.current_justified_checkpoint
+
+    pre_store_justified_checkpoint_root = store.justified_checkpoint.root
+    for block in all_blocks:
+        yield from tick_and_add_block(spec, store, block, test_steps)
+
+    finalized_slot = spec.compute_start_slot_at_epoch(store.finalized_checkpoint.epoch)
+    ancestor_at_finalized_slot = spec.get_ancestor(
+        store, pre_store_justified_checkpoint_root, finalized_slot
+    )
+    assert ancestor_at_finalized_slot != store.finalized_checkpoint.root
+    assert store.finalized_checkpoint == another_state.finalized_checkpoint
+    assert store.justified_checkpoint == another_state.current_justified_checkpoint
     yield "steps", test_steps

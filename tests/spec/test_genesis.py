@@ -147,3 +147,143 @@ def test_is_valid_genesis_state_false_not_enough_validator(spec, phases=None):
     state.validators[0].activation_epoch = spec.FAR_FUTURE_EPOCH
     yield "genesis", state
     assert not spec.is_valid_genesis_state(state)
+
+
+def prepare_random_genesis_deposits(spec, rng, deposit_count, min_pubkey_index=0,
+                                    max_pubkey_index=None, deposit_data_list=None):
+    """Random (pubkey, amount, validity) deposits — some signed, some
+    with garbage signatures (ref genesis helpers: random deposit mix)."""
+    if max_pubkey_index is None:
+        max_pubkey_index = min_pubkey_index + deposit_count
+    if deposit_data_list is None:
+        deposit_data_list = []
+    deposits = []
+    root = None
+    for _ in range(deposit_count):
+        pubkey_index = rng.randrange(min_pubkey_index, max_pubkey_index)
+        amount = rng.randrange(spec.MIN_DEPOSIT_AMOUNT, spec.MAX_EFFECTIVE_BALANCE + 1)
+        deposit, root, deposit_data_list = build_deposit(
+            spec,
+            deposit_data_list=deposit_data_list,
+            pubkey=pubkeys[pubkey_index],
+            privkey=privkeys[pubkey_index],
+            amount=amount,
+            withdrawal_credentials=bytes(spec.BLS_WITHDRAWAL_PREFIX) + spec.hash(pubkeys[pubkey_index])[1:],
+            signed=rng.choice([True, False]),
+        )
+        deposits.append(deposit)
+    return deposits, root, deposit_data_list
+
+
+@with_phases([PHASE0])
+@spec_test
+@single_phase
+@with_presets([MINIMAL], reason="too slow")
+def test_initialize_beacon_state_one_topup_activation(spec, phases=None):
+    """A partial deposit completed by a top-up still activates at genesis."""
+    main_deposit_count = spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT - 1
+    main_deposits, _, deposit_data_list = prepare_full_genesis_deposits(
+        spec, spec.MAX_EFFECTIVE_BALANCE, deposit_count=main_deposit_count, signed=True
+    )
+    partial_deposits, _, deposit_data_list = prepare_full_genesis_deposits(
+        spec, spec.MAX_EFFECTIVE_BALANCE - spec.MIN_DEPOSIT_AMOUNT,
+        deposit_count=1,
+        min_pubkey_index=main_deposit_count,
+        signed=True,
+        deposit_data_list=deposit_data_list,
+    )
+    top_up_deposits, _, _ = prepare_full_genesis_deposits(
+        spec, spec.MIN_DEPOSIT_AMOUNT,
+        deposit_count=1,
+        min_pubkey_index=main_deposit_count,
+        signed=True,
+        deposit_data_list=deposit_data_list,
+    )
+    deposits = main_deposits + partial_deposits + top_up_deposits
+
+    eth1_block_hash = b"\x13" * 32
+    eth1_timestamp = spec.config.MIN_GENESIS_TIME
+    yield "eth1_block_hash", eth1_block_hash
+
+    state = spec.initialize_beacon_state_from_eth1(eth1_block_hash, eth1_timestamp, deposits)
+    assert spec.is_valid_genesis_state(state)
+    yield "state", state
+
+
+@with_phases([PHASE0])
+@spec_test
+@single_phase
+@with_presets([MINIMAL], reason="too slow")
+def test_initialize_beacon_state_random_invalid_genesis(spec, phases=None):
+    """Too few distinct full deposits: genesis state must be invalid."""
+    from random import Random
+
+    rng = Random(2019)
+    deposits, _, _ = prepare_random_genesis_deposits(
+        spec, rng, deposit_count=20, max_pubkey_index=10
+    )
+    eth1_block_hash = b"\x14" * 32
+    eth1_timestamp = spec.config.MIN_GENESIS_TIME + 1
+    yield "eth1_block_hash", eth1_block_hash
+
+    state = spec.initialize_beacon_state_from_eth1(eth1_block_hash, eth1_timestamp, deposits)
+    assert not spec.is_valid_genesis_state(state)
+    yield "state", state
+
+
+@with_phases([PHASE0])
+@spec_test
+@single_phase
+@with_presets([MINIMAL], reason="too slow")
+def test_initialize_beacon_state_random_valid_genesis(spec, phases=None):
+    """Random deposit noise on top of a full validator set stays valid."""
+    from random import Random
+
+    rng = Random(2020)
+    random_deposits, _, deposit_data_list = prepare_random_genesis_deposits(
+        spec, rng,
+        deposit_count=20,
+        min_pubkey_index=spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT - 5,
+        max_pubkey_index=spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT + 5,
+    )
+    full_deposits, _, _ = prepare_full_genesis_deposits(
+        spec, spec.MAX_EFFECTIVE_BALANCE,
+        deposit_count=spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT,
+        signed=True,
+        deposit_data_list=deposit_data_list,
+    )
+    deposits = random_deposits + full_deposits
+    eth1_block_hash = b"\x15" * 32
+    eth1_timestamp = spec.config.MIN_GENESIS_TIME + 2
+    yield "eth1_block_hash", eth1_block_hash
+
+    state = spec.initialize_beacon_state_from_eth1(eth1_block_hash, eth1_timestamp, deposits)
+    assert spec.is_valid_genesis_state(state)
+    yield "state", state
+
+
+@with_phases([PHASE0])
+@spec_test
+@single_phase
+@with_presets([MINIMAL], reason="too slow")
+def test_is_valid_genesis_state_true_more_balance(spec, phases=None):
+    state = create_valid_beacon_state(spec)
+    state.validators[0].effective_balance = spec.MAX_EFFECTIVE_BALANCE + 1
+    assert spec.is_valid_genesis_state(state)
+    yield "state", state
+
+
+@with_phases([PHASE0])
+@spec_test
+@single_phase
+@with_presets([MINIMAL], reason="too slow")
+def test_is_valid_genesis_state_true_one_more_validator(spec, phases=None):
+    deposit_count = spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT + 1
+    deposits, _, _ = prepare_full_genesis_deposits(
+        spec, spec.MAX_EFFECTIVE_BALANCE, deposit_count=deposit_count, signed=True
+    )
+    eth1_block_hash = b"\x12" * 32
+    eth1_timestamp = spec.config.MIN_GENESIS_TIME
+    state = spec.initialize_beacon_state_from_eth1(eth1_block_hash, eth1_timestamp, deposits)
+    assert spec.is_valid_genesis_state(state)
+    yield "state", state

@@ -1,9 +1,15 @@
 """Multi-block sanity tests (ref: test/phase0/sanity/test_blocks.py)."""
+from random import Random
+
 from consensus_specs_tpu.test_framework.attestations import (
     get_valid_attestation,
     next_epoch_with_attestations,
 )
-from consensus_specs_tpu.test_framework.attester_slashings import get_valid_attester_slashing
+from consensus_specs_tpu.exceptions import SkippedTest
+from consensus_specs_tpu.test_framework.attester_slashings import (
+    get_valid_attester_slashing,
+    get_valid_attester_slashing_by_indices,
+)
 from consensus_specs_tpu.test_framework.block import (
     build_empty_block,
     build_empty_block_for_next_slot,
@@ -25,9 +31,14 @@ from consensus_specs_tpu.test_framework.proposer_slashings import (
     check_proposer_slashing_effect,
     get_valid_proposer_slashing,
 )
+from consensus_specs_tpu.test_framework.random_block_tests import (
+    build_random_block,
+    randomize_state,
+)
 from consensus_specs_tpu.test_framework.state import (
     get_balance,
     next_epoch,
+    next_epoch_via_block,
     next_slot,
     transition_to,
 )
@@ -456,3 +467,451 @@ def test_eth1_data_votes_consensus(spec, state):
     assert state.slot % voting_period_slots == 0
     assert len(state.eth1_data_votes) == 1
     assert state.eth1_data_votes[0].block_hash == c
+
+
+# -- signature / header validity edges (ref sanity/test_blocks.py) -----------
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_block_sig(spec, state):
+    """Block body valid, outer signature produced by the wrong key."""
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    wrong_proposer = (block.proposer_index + 3) % len(state.validators)
+    domain = spec.get_domain(state, spec.DOMAIN_BEACON_PROPOSER, spec.compute_epoch_at_slot(block.slot))
+    signing_root = spec.compute_signing_root(block, domain)
+    signed_block = spec.SignedBeaconBlock(
+        message=block, signature=spec.bls.Sign(privkeys[wrong_proposer], signing_root)
+    )
+    expect_assertion_error(lambda: spec.state_transition(state, signed_block, True))
+    yield "blocks", [signed_block]
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_zero_block_sig(spec, state):
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = spec.SignedBeaconBlock(message=block)  # default (zero) signature
+    expect_assertion_error(lambda: spec.state_transition(state, signed_block, True))
+    yield "blocks", [signed_block]
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_state_root(spec, state):
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.state_root = b"\xaa" * 32
+    signed_block = sign_block(spec, state, block)
+    expect_assertion_error(lambda: spec.state_transition(state, signed_block, True))
+    yield "blocks", [signed_block]
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_proposer_index_sig_from_expected_proposer(spec, state):
+    """Wrong proposer_index in the header, signed by the EXPECTED
+    proposer: process_block_header's index check must reject it."""
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    expected_proposer = block.proposer_index
+    block.proposer_index = (expected_proposer + 1) % len(state.validators)
+    # sign over the mutated block with the expected proposer's key
+    domain = spec.get_domain(state, spec.DOMAIN_BEACON_PROPOSER, spec.compute_epoch_at_slot(block.slot))
+    signed_block = spec.SignedBeaconBlock(
+        message=block,
+        signature=spec.bls.Sign(privkeys[expected_proposer], spec.compute_signing_root(block, domain)),
+    )
+    expect_assertion_error(lambda: spec.state_transition(state, signed_block, True))
+    yield "blocks", [signed_block]
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_proposer_index_sig_from_proposer_index(spec, state):
+    """Wrong proposer_index, signed by the STATED index's key: the
+    signature itself verifies under the wrong pubkey, the header check
+    still rejects."""
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    stated = (block.proposer_index + 1) % len(state.validators)
+    block.proposer_index = stated
+    domain = spec.get_domain(state, spec.DOMAIN_BEACON_PROPOSER, spec.compute_epoch_at_slot(block.slot))
+    signed_block = spec.SignedBeaconBlock(
+        message=block,
+        signature=spec.bls.Sign(privkeys[stated], spec.compute_signing_root(block, domain)),
+    )
+    expect_assertion_error(lambda: spec.state_transition(state, signed_block, True))
+    yield "blocks", [signed_block]
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_parent_from_same_slot(spec, state):
+    """A proposal whose parent occupies the same slot as itself."""
+    parent = build_empty_block_for_next_slot(spec, state)
+    signed_parent = state_transition_and_sign_block(spec, state, parent)
+
+    yield "pre", state
+    child = build_empty_block(spec, state, slot=state.slot)
+    child.parent_root = signed_parent.message.hash_tree_root()
+    expect_assertion_error(lambda: transition_unsigned_block(spec, state, child))
+    yield "blocks", [spec.SignedBeaconBlock(message=child)]
+    yield "post", None
+
+
+# -- proposer-index edges -----------------------------------------------------
+
+@with_all_phases
+@spec_state_test
+def test_high_proposer_index(spec, state):
+    """A proposer whose registry index exceeds the ACTIVE validator
+    count must still be recognized (shuffled index space is over active
+    validators, registry index space is not)."""
+    current_epoch = spec.get_current_epoch(state)
+    for i in range(len(state.validators) // 3):
+        state.validators[i].exit_epoch = current_epoch
+
+    state.slot = spec.SLOTS_PER_EPOCH * 2
+    state_transition_and_sign_block(spec, state, build_empty_block_for_next_slot(spec, state))
+
+    active_count = len(spec.get_active_validator_indices(state, current_epoch))
+    while True:
+        if spec.get_beacon_proposer_index(state) >= active_count:
+            yield "pre", state
+            signed_block = state_transition_and_sign_block(
+                spec, state, build_empty_block_for_next_slot(spec, state)
+            )
+            yield "blocks", [signed_block]
+            yield "post", state
+            break
+        next_slot(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_after_inactive_index(spec, state):
+    """Proposals keep working for indices above an exited validator."""
+    inactive_index = 10
+    state.validators[inactive_index].exit_epoch = spec.get_current_epoch(state)
+
+    next_epoch_via_block(spec, state)
+    next_epoch_via_block(spec, state)
+    while True:
+        if spec.get_beacon_proposer_index(state) > inactive_index:
+            yield "pre", state
+            signed_block = state_transition_and_sign_block(
+                spec, state, build_empty_block_for_next_slot(spec, state)
+            )
+            yield "blocks", [signed_block]
+            yield "post", state
+            break
+        next_slot(spec, state)
+
+
+# -- multi-operation blocks ---------------------------------------------------
+
+def _check_attester_slashing_effect(spec, pre_state, state, slashed_indices):
+    for index in slashed_indices:
+        assert state.validators[index].slashed
+        assert get_balance(state, index) < get_balance(pre_state, index)
+
+
+@with_all_phases
+@spec_state_test
+def test_multiple_attester_slashings_no_overlap(spec, state):
+    if spec.MAX_ATTESTER_SLASHINGS < 2:
+        raise SkippedTest("config cannot hold multiple AttesterSlashings per block")
+    pre_state = state.copy()
+    full_indices = spec.get_active_validator_indices(state, spec.get_current_epoch(state))[:8]
+    half = len(full_indices) // 2
+    slashing_1 = get_valid_attester_slashing_by_indices(
+        spec, state, full_indices[:half], signed_1=True, signed_2=True
+    )
+    slashing_2 = get_valid_attester_slashing_by_indices(
+        spec, state, full_indices[half:], signed_1=True, signed_2=True
+    )
+    assert not any(state.validators[i].slashed for i in full_indices)
+
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.attester_slashings = [slashing_1, slashing_2]
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    _check_attester_slashing_effect(spec, pre_state, state, full_indices)
+
+
+@with_all_phases
+@spec_state_test
+def test_multiple_attester_slashings_partial_overlap(spec, state):
+    if spec.MAX_ATTESTER_SLASHINGS < 2:
+        raise SkippedTest("config cannot hold multiple AttesterSlashings per block")
+    pre_state = state.copy()
+    full_indices = spec.get_active_validator_indices(state, spec.get_current_epoch(state))[:8]
+    third = len(full_indices) // 3
+    slashing_1 = get_valid_attester_slashing_by_indices(
+        spec, state, full_indices[: third * 2], signed_1=True, signed_2=True
+    )
+    slashing_2 = get_valid_attester_slashing_by_indices(
+        spec, state, full_indices[third:], signed_1=True, signed_2=True
+    )
+    assert not any(state.validators[i].slashed for i in full_indices)
+
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.attester_slashings = [slashing_1, slashing_2]
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    _check_attester_slashing_effect(spec, pre_state, state, full_indices)
+
+
+@with_all_phases
+@spec_state_test
+def test_double_same_proposer_slashings_same_block(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=True)
+    slashed_index = proposer_slashing.signed_header_1.message.proposer_index
+    assert not state.validators[slashed_index].slashed
+
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.proposer_slashings = [proposer_slashing, proposer_slashing]
+    signed_block = state_transition_and_sign_block(spec, state, block, expect_fail=True)
+    yield "blocks", [signed_block]
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+def test_double_similar_proposer_slashings_same_block(spec, state):
+    slashed_index = spec.get_active_validator_indices(state, spec.get_current_epoch(state))[-1]
+    slashing_1 = get_valid_proposer_slashing(
+        spec, state, random_root=b"\xaa" * 32, slashed_index=slashed_index,
+        signed_1=True, signed_2=True,
+    )
+    slashing_2 = get_valid_proposer_slashing(
+        spec, state, random_root=b"\xbb" * 32, slashed_index=slashed_index,
+        signed_1=True, signed_2=True,
+    )
+    assert not state.validators[slashed_index].slashed
+
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.proposer_slashings = [slashing_1, slashing_2]
+    signed_block = state_transition_and_sign_block(spec, state, block, expect_fail=True)
+    yield "blocks", [signed_block]
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+def test_multiple_different_proposer_slashings_same_block(spec, state):
+    pre_state = state.copy()
+    active = spec.get_active_validator_indices(state, spec.get_current_epoch(state))
+    proposer_slashings = [
+        get_valid_proposer_slashing(
+            spec, state, slashed_index=active[i], signed_1=True, signed_2=True
+        )
+        for i in range(3)
+    ]
+
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.proposer_slashings = proposer_slashings
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    for proposer_slashing in proposer_slashings:
+        check_proposer_slashing_effect(
+            spec, pre_state, state, proposer_slashing.signed_header_1.message.proposer_index, block
+        )
+
+
+@with_all_phases
+@spec_state_test
+def test_multiple_different_validator_exits_same_block(spec, state):
+    validator_indices = [
+        spec.get_active_validator_indices(state, spec.get_current_epoch(state))[i]
+        for i in range(3)
+    ]
+    state.slot += spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH
+    signed_exits = prepare_signed_exits(spec, state, validator_indices)
+
+    yield "pre", state
+    initiate_block = build_empty_block_for_next_slot(spec, state)
+    initiate_block.body.voluntary_exits = signed_exits
+    signed_initiate = state_transition_and_sign_block(spec, state, initiate_block)
+
+    for index in validator_indices:
+        assert state.validators[index].exit_epoch < spec.FAR_FUTURE_EPOCH
+
+    exit_block = build_empty_block(spec, state, state.slot + spec.SLOTS_PER_EPOCH)
+    signed_exit_block = state_transition_and_sign_block(spec, state, exit_block)
+
+    yield "blocks", [signed_initiate, signed_exit_block]
+    yield "post", state
+    for index in validator_indices:
+        assert state.validators[index].exit_epoch < spec.FAR_FUTURE_EPOCH
+
+
+def _run_slash_and_exit(spec, state, slash_index, exit_index, valid):
+    """One block carrying both an attester slashing of slash_index and a
+    voluntary exit of exit_index; invalid when they collide (a slashed
+    validator's exit was already initiated, beacon-chain.md:1894)."""
+    state.slot += spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH
+
+    slashing = get_valid_attester_slashing_by_indices(
+        spec, state, [slash_index], signed_1=True, signed_2=True
+    )
+    signed_exit = prepare_signed_exits(spec, state, [exit_index])[0]
+
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.attester_slashings = [slashing]
+    block.body.voluntary_exits = [signed_exit]
+    signed_block = state_transition_and_sign_block(spec, state, block, expect_fail=not valid)
+    yield "blocks", [signed_block]
+    yield "post", state if valid else None
+
+
+@with_all_phases
+@spec_state_test
+def test_slash_and_exit_same_index(spec, state):
+    index = spec.get_active_validator_indices(state, spec.get_current_epoch(state))[-1]
+    yield from _run_slash_and_exit(spec, state, index, index, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_slash_and_exit_diff_index(spec, state):
+    active = spec.get_active_validator_indices(state, spec.get_current_epoch(state))
+    yield from _run_slash_and_exit(spec, state, active[-1], active[-2], valid=True)
+
+
+# -- deposits / eth1 / epoch edges -------------------------------------------
+
+@with_all_phases
+@spec_state_test
+def test_expected_deposit_in_block(spec, state):
+    """State expects a deposit (eth1 count ahead of index); an empty
+    block must fail process_operations' deposit-count assert."""
+    state.eth1_data.deposit_count += 1
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block, expect_fail=True)
+    yield "blocks", [signed_block]
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+def test_eth1_data_votes_no_consensus(spec, state):
+    voting_period_slots = spec.EPOCHS_PER_ETH1_VOTING_PERIOD * spec.SLOTS_PER_EPOCH
+    pre_eth1_hash = state.eth1_data.block_hash
+
+    offset_block = build_empty_block(spec, state, slot=voting_period_slots - 1)
+    state_transition_and_sign_block(spec, state, offset_block)
+    yield "pre", state
+
+    a = b"\xaa" * 32
+    b = b"\xbb" * 32
+    blocks = []
+    for i in range(voting_period_slots):
+        block = build_empty_block_for_next_slot(spec, state)
+        # precisely 50% for A, then B for the other 50%: no winner
+        block.body.eth1_data.block_hash = b if i * 2 >= voting_period_slots else a
+        blocks.append(state_transition_and_sign_block(spec, state, block))
+
+    assert len(state.eth1_data_votes) == voting_period_slots
+    assert state.eth1_data.block_hash == pre_eth1_hash
+    yield "blocks", blocks
+    yield "post", state
+
+
+@with_all_phases
+@spec_state_test
+def test_empty_epoch_transition_not_finalizing(spec, state):
+    if spec.SLOTS_PER_EPOCH > 8:
+        raise SkippedTest("minimal config suffices; mainnet run too slow")
+    pre_balances = list(state.balances)
+    yield "pre", state
+
+    spec.process_slots(state, state.slot + spec.SLOTS_PER_EPOCH * 5)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+
+    assert state.slot == block.slot
+    assert state.finalized_checkpoint.epoch < spec.get_current_epoch(state) - 4
+    for index in range(len(state.validators)):
+        assert state.balances[index] < pre_balances[index]
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_self_slashing(spec, state):
+    """A proposer may include a slashing of itself; the block is valid
+    (validity of the proposal is judged at proposal time)."""
+    block = build_empty_block_for_next_slot(spec, state)
+    assert not state.validators[block.proposer_index].slashed
+
+    proposer_slashing = get_valid_proposer_slashing(
+        spec, state, slashed_index=block.proposer_index, signed_1=True, signed_2=True
+    )
+    block.body.proposer_slashings = [proposer_slashing]
+
+    yield "pre", state
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    assert state.validators[block.proposer_index].slashed
+
+
+# -- randomized multi-operation blocks ---------------------------------------
+
+def _run_full_random_operations(spec, state, rng):
+    # move out of the genesis slot and bury the randomization in history
+    next_slot(spec, state)
+    randomize_state(spec, state, rng)
+    yield "pre", state
+    slashed = {i for i, v in enumerate(state.validators) if v.slashed}
+    block = build_random_block(spec, state, rng, slashed)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+
+
+@with_all_phases
+@spec_state_test
+def test_full_random_operations_0(spec, state):
+    yield from _run_full_random_operations(spec, state, Random(2020))
+
+
+@with_all_phases
+@spec_state_test
+def test_full_random_operations_1(spec, state):
+    yield from _run_full_random_operations(spec, state, Random(2021))
+
+
+@with_all_phases
+@spec_state_test
+def test_full_random_operations_2(spec, state):
+    yield from _run_full_random_operations(spec, state, Random(2022))
+
+
+@with_all_phases
+@spec_state_test
+def test_full_random_operations_3(spec, state):
+    yield from _run_full_random_operations(spec, state, Random(2023))

@@ -1,0 +1,248 @@
+"""on_attestation unit tests: validation windows, target/head topology,
+LMD vote recording (ref: test/phase0/unittests/fork_choice/
+test_on_attestation.py)."""
+from consensus_specs_tpu.test_framework.attestations import (
+    get_valid_attestation,
+    sign_attestation,
+)
+from consensus_specs_tpu.test_framework.block import build_empty_block_for_next_slot
+from consensus_specs_tpu.test_framework.context import spec_state_test, with_all_phases
+from consensus_specs_tpu.test_framework.fork_choice import get_genesis_forkchoice_store
+from consensus_specs_tpu.test_framework.state import (
+    next_epoch,
+    next_slot,
+    state_transition_and_sign_block,
+    transition_to,
+)
+
+
+def run_on_attestation(spec, state, store, attestation, valid=True):
+    if not valid:
+        try:
+            spec.on_attestation(store, attestation)
+        except AssertionError:
+            return
+        raise AssertionError("on_attestation unexpectedly accepted")
+
+    indexed_attestation = spec.get_indexed_attestation(state, attestation)
+    spec.on_attestation(store, attestation)
+    sample_index = indexed_attestation.attesting_indices[0]
+    assert store.latest_messages[sample_index] == spec.LatestMessage(
+        epoch=attestation.data.target.epoch,
+        root=attestation.data.beacon_block_root,
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_current_epoch(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    spec.on_tick(store, store.time + spec.config.SECONDS_PER_SLOT * 2)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    spec.on_block(store, signed_block)
+
+    attestation = get_valid_attestation(spec, state, slot=block.slot, signed=True)
+    assert attestation.data.target.epoch == spec.GENESIS_EPOCH
+    assert spec.compute_epoch_at_slot(spec.get_current_slot(store)) == spec.GENESIS_EPOCH
+    run_on_attestation(spec, state, store, attestation)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_previous_epoch(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    spec.on_tick(store, store.time + spec.config.SECONDS_PER_SLOT * spec.SLOTS_PER_EPOCH)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    spec.on_block(store, signed_block)
+
+    attestation = get_valid_attestation(spec, state, slot=block.slot, signed=True)
+    assert attestation.data.target.epoch == spec.GENESIS_EPOCH
+    assert spec.compute_epoch_at_slot(spec.get_current_slot(store)) == spec.GENESIS_EPOCH + 1
+    run_on_attestation(spec, state, store, attestation)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_past_epoch(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    spec.on_tick(store, store.time + 2 * spec.config.SECONDS_PER_SLOT * spec.SLOTS_PER_EPOCH)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    spec.on_block(store, signed_block)
+
+    attestation = get_valid_attestation(spec, state, slot=state.slot, signed=True)
+    assert attestation.data.target.epoch == spec.GENESIS_EPOCH
+    assert spec.compute_epoch_at_slot(spec.get_current_slot(store)) == spec.GENESIS_EPOCH + 2
+    run_on_attestation(spec, state, store, attestation, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_mismatched_target_and_slot(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    spec.on_tick(store, store.time + spec.config.SECONDS_PER_SLOT * spec.SLOTS_PER_EPOCH)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    spec.on_block(store, signed_block)
+
+    attestation = get_valid_attestation(spec, state, slot=block.slot)
+    attestation.data.target.epoch += 1
+    sign_attestation(spec, state, attestation)
+    assert attestation.data.target.epoch == spec.GENESIS_EPOCH + 1
+    assert spec.compute_epoch_at_slot(attestation.data.slot) == spec.GENESIS_EPOCH
+    run_on_attestation(spec, state, store, attestation, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_inconsistent_target_and_head(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    spec.on_tick(store, store.time + 2 * spec.config.SECONDS_PER_SLOT * spec.SLOTS_PER_EPOCH)
+
+    # chain 1: empty through epoch 1
+    target_state_1 = state.copy()
+    next_epoch(spec, target_state_1)
+
+    # chain 2: one different block, then to epoch 1
+    target_state_2 = state.copy()
+    diff_block = build_empty_block_for_next_slot(spec, target_state_2)
+    signed_diff_block = state_transition_and_sign_block(spec, target_state_2, diff_block)
+    spec.on_block(store, signed_diff_block)
+    next_epoch(spec, target_state_2)
+    next_slot(spec, target_state_2)
+
+    head_block = build_empty_block_for_next_slot(spec, target_state_1)
+    signed_head_block = state_transition_and_sign_block(spec, target_state_1, head_block)
+    spec.on_block(store, signed_head_block)
+
+    attestation = get_valid_attestation(spec, target_state_1, slot=head_block.slot, signed=False)
+    epoch = spec.compute_epoch_at_slot(attestation.data.slot)
+    attestation.data.target = spec.Checkpoint(
+        epoch=epoch, root=spec.get_block_root(target_state_2, epoch)
+    )
+    sign_attestation(spec, state, attestation)
+    assert spec.get_block_root(target_state_1, epoch) != attestation.data.target.root
+    run_on_attestation(spec, state, store, attestation, False)
+
+
+def _to_next_epoch_boundary_block(spec, state, store, offset=1):
+    """Tick one epoch + 1 slot, transition to just before the next epoch,
+    and build the would-be target block."""
+    spec.on_tick(store, store.time + spec.config.SECONDS_PER_SLOT * (spec.SLOTS_PER_EPOCH + 1))
+    next_epoch_num = spec.get_current_epoch(state) + 1
+    transition_to(spec, state, spec.compute_start_slot_at_epoch(next_epoch_num) - offset)
+    target_block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, target_block)
+    return target_block, signed
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_target_block_not_in_store(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    target_block, _ = _to_next_epoch_boundary_block(spec, state, store)
+    # target block never added to store
+    attestation = get_valid_attestation(spec, state, slot=target_block.slot, signed=True)
+    assert attestation.data.target.root == target_block.hash_tree_root()
+    run_on_attestation(spec, state, store, attestation, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_target_checkpoint_not_in_store(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    target_block, signed_target_block = _to_next_epoch_boundary_block(spec, state, store)
+    spec.on_block(store, signed_target_block)
+    # checkpoint state derived on demand
+    attestation = get_valid_attestation(spec, state, slot=target_block.slot, signed=True)
+    assert attestation.data.target.root == target_block.hash_tree_root()
+    run_on_attestation(spec, state, store, attestation)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_target_checkpoint_not_in_store_diff_slot(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    target_block, signed_target_block = _to_next_epoch_boundary_block(spec, state, store, offset=2)
+    spec.on_block(store, signed_target_block)
+
+    attestation_slot = target_block.slot + 1
+    transition_to(spec, state, attestation_slot)
+    attestation = get_valid_attestation(spec, state, slot=attestation_slot, signed=True)
+    assert attestation.data.target.root == target_block.hash_tree_root()
+    run_on_attestation(spec, state, store, attestation)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_beacon_block_not_in_store(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    target_block, signed_target_block = _to_next_epoch_boundary_block(spec, state, store)
+    spec.on_block(store, signed_target_block)
+
+    head_block = build_empty_block_for_next_slot(spec, state)
+    state_transition_and_sign_block(spec, state, head_block)
+    # head block NOT added to store
+    attestation = get_valid_attestation(spec, state, slot=head_block.slot, signed=True)
+    assert attestation.data.target.root == target_block.hash_tree_root()
+    assert attestation.data.beacon_block_root == head_block.hash_tree_root()
+    run_on_attestation(spec, state, store, attestation, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_future_epoch(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    spec.on_tick(store, store.time + 3 * spec.config.SECONDS_PER_SLOT)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    spec.on_block(store, signed_block)
+
+    next_epoch(spec, state)  # state ahead of store clock
+    attestation = get_valid_attestation(spec, state, slot=state.slot, signed=True)
+    run_on_attestation(spec, state, store, attestation, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_future_block(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    spec.on_tick(store, store.time + spec.config.SECONDS_PER_SLOT * 5)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    spec.on_block(store, signed_block)
+
+    # attestation points at a block newer than its own slot
+    attestation = get_valid_attestation(spec, state, slot=block.slot - 1, signed=False)
+    attestation.data.beacon_block_root = block.hash_tree_root()
+    sign_attestation(spec, state, attestation)
+    run_on_attestation(spec, state, store, attestation, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_same_slot(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    spec.on_tick(store, store.time + spec.config.SECONDS_PER_SLOT)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    spec.on_block(store, signed_block)
+
+    attestation = get_valid_attestation(spec, state, slot=block.slot, signed=True)
+    run_on_attestation(spec, state, store, attestation, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_invalid_attestation(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    spec.on_tick(store, store.time + 3 * spec.config.SECONDS_PER_SLOT)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    spec.on_block(store, signed_block)
+
+    attestation = get_valid_attestation(spec, state, slot=block.slot, signed=True)
+    attestation.data.index = spec.MAX_COMMITTEES_PER_SLOT * spec.SLOTS_PER_EPOCH
+    run_on_attestation(spec, state, store, attestation, False)

@@ -1,0 +1,86 @@
+"""on_block unit tests: should_update_justified_checkpoint mechanics
+(ref: test/phase0/unittests/fork_choice/test_on_block.py)."""
+from consensus_specs_tpu.test_framework.block import build_empty_block_for_next_slot
+from consensus_specs_tpu.test_framework.context import spec_state_test, with_all_phases
+from consensus_specs_tpu.test_framework.fork_choice import get_genesis_forkchoice_store
+from consensus_specs_tpu.test_framework.state import (
+    next_epoch,
+    state_transition_and_sign_block,
+    transition_to,
+)
+
+
+def _store_with_block_at_epoch(spec, state, store, epoch):
+    """Append a real block at the given epoch to the store; returns its
+    checkpoint (epoch, root)."""
+    transition_to(spec, state, spec.compute_start_slot_at_epoch(epoch))
+    block = build_empty_block_for_next_slot(spec, state)
+    state_transition_and_sign_block(spec, state, block)
+    root = block.hash_tree_root()
+    store.blocks[root] = block.copy()
+    store.block_states[root] = state.copy()
+    return spec.Checkpoint(epoch=spec.compute_epoch_at_slot(block.slot), root=root)
+
+
+@with_all_phases
+@spec_state_test
+def test_should_update_justified_within_safe_slots(spec, state):
+    """Early in the epoch (inside SAFE_SLOTS_TO_UPDATE_JUSTIFIED) any
+    later justified checkpoint is adopted."""
+    store = get_genesis_forkchoice_store(spec, state)
+    new_justified = _store_with_block_at_epoch(spec, state, store, 2)
+    # store time at an epoch boundary: slots_since_epoch_start == 0
+    store.time = store.genesis_time + (
+        spec.compute_start_slot_at_epoch(3) * spec.config.SECONDS_PER_SLOT
+    )
+    assert (
+        spec.compute_slots_since_epoch_start(spec.get_current_slot(store))
+        < spec.SAFE_SLOTS_TO_UPDATE_JUSTIFIED
+    )
+    assert spec.should_update_justified_checkpoint(store, new_justified)
+
+
+@with_all_phases
+@spec_state_test
+def test_should_not_update_outside_safe_slots_conflicting(spec, state):
+    """Late in the epoch a conflicting (non-descendant) justified
+    checkpoint is refused."""
+    store = get_genesis_forkchoice_store(spec, state)
+    fork_state = state.copy()
+
+    # store's justified checkpoint: a block on chain A at epoch 1
+    chain_a = _store_with_block_at_epoch(spec, state, store, 1)
+    store.justified_checkpoint = chain_a
+
+    # conflicting chain B block at epoch 2 (different lineage: different
+    # first block), not a descendant of chain A's justified root
+    block_b = build_empty_block_for_next_slot(spec, fork_state)
+    block_b.body.graffiti = b"\x42" * 32
+    state_transition_and_sign_block(spec, fork_state, block_b)
+    store.blocks[block_b.hash_tree_root()] = block_b.copy()
+    store.block_states[block_b.hash_tree_root()] = fork_state.copy()
+    next_epoch(spec, fork_state)
+    new_justified = _store_with_block_at_epoch(spec, fork_state, store, 2)
+
+    # put the store clock late in an epoch
+    late_slot = spec.compute_start_slot_at_epoch(3) + spec.SAFE_SLOTS_TO_UPDATE_JUSTIFIED
+    store.time = store.genesis_time + late_slot * spec.config.SECONDS_PER_SLOT
+    assert (
+        spec.compute_slots_since_epoch_start(spec.get_current_slot(store))
+        >= spec.SAFE_SLOTS_TO_UPDATE_JUSTIFIED
+    )
+    assert not spec.should_update_justified_checkpoint(store, new_justified)
+
+
+@with_all_phases
+@spec_state_test
+def test_should_update_outside_safe_slots_descendant(spec, state):
+    """Late in the epoch a DESCENDANT justified checkpoint is accepted
+    (no conflict with the current justified lineage)."""
+    store = get_genesis_forkchoice_store(spec, state)
+    # store justified stays at genesis; a later checkpoint on the same
+    # chain descends from it
+    new_justified = _store_with_block_at_epoch(spec, state, store, 2)
+    late_slot = spec.compute_start_slot_at_epoch(3) + spec.SAFE_SLOTS_TO_UPDATE_JUSTIFIED
+    store.time = store.genesis_time + late_slot * spec.config.SECONDS_PER_SLOT
+    assert spec.should_update_justified_checkpoint(store, new_justified)

@@ -1,0 +1,385 @@
+"""Honest-validator guide unit tests: duty discovery, signature
+production, eth1 voting, aggregation (ref: test/phase0/unittests/
+validator/test_validator_unittest.py, 478 LoC)."""
+from consensus_specs_tpu.test_framework.attestations import (
+    build_attestation_data,
+    get_valid_attestation,
+)
+from consensus_specs_tpu.test_framework.block import build_empty_block
+from consensus_specs_tpu.test_framework.context import (
+    always_bls,
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.test_framework.keys import privkeys, pubkeys
+from consensus_specs_tpu.test_framework.state import next_epoch, transition_to
+
+
+def run_get_committee_assignment(spec, state, epoch, validator_index, valid=True):
+    try:
+        assignment = spec.get_committee_assignment(state, epoch, validator_index)
+        committee, committee_index, slot = assignment
+        assert spec.compute_epoch_at_slot(slot) == epoch
+        assert committee == spec.get_beacon_committee(state, slot, committee_index)
+        assert committee_index < spec.get_committee_count_per_slot(state, epoch)
+        assert validator_index in committee
+        assert valid
+    except AssertionError:
+        assert not valid
+    else:
+        assert valid
+
+
+@with_all_phases
+@spec_state_test
+def test_check_if_validator_active(spec, state):
+    active_index = 0
+    assert spec.check_if_validator_active(state, active_index)
+
+    new_validator_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE
+    validator = spec.Validator(
+        pubkey=pubkeys[new_validator_index],
+        withdrawal_credentials=spec.BLS_WITHDRAWAL_PREFIX + spec.hash(pubkeys[new_validator_index])[1:],
+        activation_eligibility_epoch=spec.FAR_FUTURE_EPOCH,
+        activation_epoch=spec.FAR_FUTURE_EPOCH,
+        exit_epoch=spec.FAR_FUTURE_EPOCH,
+        withdrawable_epoch=spec.FAR_FUTURE_EPOCH,
+        effective_balance=amount,
+    )
+    state.validators.append(validator)
+    state.balances.append(amount)
+    assert not spec.check_if_validator_active(state, new_validator_index)
+
+
+@with_all_phases
+@spec_state_test
+def test_get_committee_assignment_current_epoch(spec, state):
+    epoch = spec.get_current_epoch(state)
+    run_get_committee_assignment(spec, state, epoch, validator_index=1)
+
+
+@with_all_phases
+@spec_state_test
+def test_get_committee_assignment_next_epoch(spec, state):
+    epoch = spec.get_current_epoch(state) + 1
+    run_get_committee_assignment(spec, state, epoch, validator_index=1)
+
+
+@with_all_phases
+@spec_state_test
+def test_get_committee_assignment_out_bound_epoch(spec, state):
+    epoch = spec.get_current_epoch(state) + 2
+    run_get_committee_assignment(spec, state, epoch, validator_index=1, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_is_proposer(spec, state):
+    proposer_index = spec.get_beacon_proposer_index(state)
+    assert spec.is_proposer(state, proposer_index)
+    for index in range(len(state.validators)):
+        if index != proposer_index:
+            assert not spec.is_proposer(state, index)
+            break
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_get_epoch_signature(spec, state):
+    block = spec.BeaconBlock()
+    privkey = privkeys[0]
+    pubkey = pubkeys[0]
+    signature = spec.get_epoch_signature(state, block, privkey)
+    domain = spec.get_domain(state, spec.DOMAIN_RANDAO, spec.compute_epoch_at_slot(block.slot))
+    signing_root = spec.compute_signing_root(spec.compute_epoch_at_slot(block.slot), domain)
+    assert spec.bls.Verify(pubkey, signing_root, signature)
+
+
+def run_is_candidate_block(spec, eth1_block, period_start, success=True):
+    assert success == spec.is_candidate_block(eth1_block, period_start)
+
+
+@with_all_phases
+@spec_state_test
+def test_is_candidate_block(spec, state):
+    distance_duration = spec.config.SECONDS_PER_ETH1_BLOCK * spec.config.ETH1_FOLLOW_DISTANCE
+    period_start = distance_duration * 2 + 1000
+    run_is_candidate_block(spec, spec.Eth1Block(timestamp=period_start - distance_duration), period_start, True)
+    run_is_candidate_block(spec, spec.Eth1Block(timestamp=period_start - distance_duration + 1), period_start, False)
+    run_is_candidate_block(spec, spec.Eth1Block(timestamp=period_start - distance_duration * 2), period_start, True)
+    run_is_candidate_block(spec, spec.Eth1Block(timestamp=period_start - distance_duration * 2 - 1), period_start, False)
+
+
+def _eth1_chain_for_vote(spec, state, vote_hashes):
+    """An eth1 chain whose in-range blocks carry the given vote hashes."""
+    distance_duration = spec.config.SECONDS_PER_ETH1_BLOCK * spec.config.ETH1_FOLLOW_DISTANCE
+    period_start = spec.voting_period_start_time(state)
+    eth1_chain = []
+    for i, h in enumerate(vote_hashes):
+        eth1_chain.append(
+            spec.Eth1Block(
+                timestamp=period_start - distance_duration - i,
+                deposit_count=state.eth1_data.deposit_count,
+                deposit_root=h,
+            )
+        )
+    return eth1_chain
+
+
+@with_all_phases
+@spec_state_test
+def test_get_eth1_vote_default_vote(spec, state):
+    state.genesis_time = 1_600_000_000
+    min_new_period_epochs = spec.EPOCHS_PER_ETH1_VOTING_PERIOD
+    for _ in range(min_new_period_epochs + 2):
+        next_epoch(spec, state)
+    state.eth1_data_votes = ()
+    eth1_chain = []
+    eth1_data = spec.get_eth1_vote(state, eth1_chain)
+    assert eth1_data == state.eth1_data
+
+
+@with_all_phases
+@spec_state_test
+def test_get_eth1_vote_consensus_vote(spec, state):
+    state.genesis_time = 1_600_000_000
+    min_new_period_epochs = spec.EPOCHS_PER_ETH1_VOTING_PERIOD
+    for _ in range(min_new_period_epochs + 2):
+        next_epoch(spec, state)
+
+    period_start = spec.voting_period_start_time(state)
+    votes_length = spec.get_current_epoch(state) % spec.EPOCHS_PER_ETH1_VOTING_PERIOD
+    assert votes_length >= 0
+
+    block_1 = spec.Eth1Block(
+        timestamp=period_start - spec.config.SECONDS_PER_ETH1_BLOCK * spec.config.ETH1_FOLLOW_DISTANCE - 1,
+        deposit_count=state.eth1_data.deposit_count,
+        deposit_root=b"\x04" * 32,
+    )
+    block_2 = spec.Eth1Block(
+        timestamp=period_start - spec.config.SECONDS_PER_ETH1_BLOCK * spec.config.ETH1_FOLLOW_DISTANCE,
+        deposit_count=state.eth1_data.deposit_count + 1,
+        deposit_root=b"\x05" * 32,
+    )
+    eth1_chain = [block_1, block_2]
+    eth1_data_votes = []
+    # all votes for block_2
+    for _ in range(votes_length):
+        eth1_data_votes.append(spec.get_eth1_data(block_2))
+    state.eth1_data_votes = tuple(eth1_data_votes)
+    eth1_data = spec.get_eth1_vote(state, eth1_chain)
+    assert eth1_data.block_hash == spec.get_eth1_data(block_2).block_hash
+
+
+@with_all_phases
+@spec_state_test
+def test_get_eth1_vote_tie(spec, state):
+    state.genesis_time = 1_600_000_000
+    min_new_period_epochs = spec.EPOCHS_PER_ETH1_VOTING_PERIOD
+    for _ in range(min_new_period_epochs + 2):
+        next_epoch(spec, state)
+
+    period_start = spec.voting_period_start_time(state)
+    votes_length = spec.get_current_epoch(state) % spec.EPOCHS_PER_ETH1_VOTING_PERIOD
+    assert votes_length > 0 and votes_length % 2 == 0
+
+    block_1 = spec.Eth1Block(
+        timestamp=period_start - spec.config.SECONDS_PER_ETH1_BLOCK * spec.config.ETH1_FOLLOW_DISTANCE - 1,
+        deposit_count=state.eth1_data.deposit_count,
+        deposit_root=b"\x04" * 32,
+    )
+    block_2 = spec.Eth1Block(
+        timestamp=period_start - spec.config.SECONDS_PER_ETH1_BLOCK * spec.config.ETH1_FOLLOW_DISTANCE,
+        deposit_count=state.eth1_data.deposit_count,
+        deposit_root=b"\x05" * 32,
+    )
+    eth1_chain = [block_1, block_2]
+    eth1_data_votes = []
+    # half votes for each block
+    for i in range(votes_length):
+        block = block_1 if i % 2 == 0 else block_2
+        eth1_data_votes.append(spec.get_eth1_data(block))
+    state.eth1_data_votes = tuple(eth1_data_votes)
+    eth1_data = spec.get_eth1_vote(state, eth1_chain)
+    # tie-break: the earlier block in the candidate order wins
+    assert eth1_data.block_hash == spec.get_eth1_data(block_1).block_hash
+
+
+@with_all_phases
+@spec_state_test
+def test_get_eth1_vote_chain_in_past(spec, state):
+    state.genesis_time = 1_600_000_000
+    min_new_period_epochs = spec.EPOCHS_PER_ETH1_VOTING_PERIOD
+    for _ in range(min_new_period_epochs + 2):
+        next_epoch(spec, state)
+
+    period_start = spec.voting_period_start_time(state)
+    votes_length = spec.get_current_epoch(state) % spec.EPOCHS_PER_ETH1_VOTING_PERIOD
+    assert votes_length > 0
+
+    block_1 = spec.Eth1Block(
+        timestamp=period_start - spec.config.SECONDS_PER_ETH1_BLOCK * spec.config.ETH1_FOLLOW_DISTANCE,
+        deposit_count=state.eth1_data.deposit_count - 1,  # chain deposit count BEHIND state
+        deposit_root=b"\x42" * 32,
+    )
+    eth1_chain = [block_1]
+    state.eth1_data_votes = ()
+    eth1_data = spec.get_eth1_vote(state, eth1_chain)
+    # no valid candidate (would decrease deposit count): default vote
+    assert eth1_data == state.eth1_data
+
+
+@with_all_phases
+@spec_state_test
+def test_compute_new_state_root(spec, state):
+    pre = state.copy()
+    post = state.copy()
+    block = build_empty_block(spec, state, state.slot + 1)
+    state_root = spec.compute_new_state_root(state, block)
+    assert state == pre  # input state must be unmodified
+    spec.process_slots(post, block.slot)
+    spec.process_block(post, block)
+    assert state_root == post.hash_tree_root()
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_get_block_signature(spec, state):
+    privkey = privkeys[0]
+    pubkey = pubkeys[0]
+    block = build_empty_block(spec, state, state.slot + 1)
+    signature = spec.get_block_signature(state, block, privkey)
+    domain = spec.get_domain(state, spec.DOMAIN_BEACON_PROPOSER, spec.compute_epoch_at_slot(block.slot))
+    signing_root = spec.compute_signing_root(block, domain)
+    assert spec.bls.Verify(pubkey, signing_root, signature)
+
+
+@with_all_phases
+@spec_state_test
+def test_compute_fork_digest(spec, state):
+    digest = spec.compute_fork_digest(state.fork.current_version, state.genesis_validators_root)
+    fork_data_root = spec.hash_tree_root(
+        spec.ForkData(
+            current_version=state.fork.current_version,
+            genesis_validators_root=state.genesis_validators_root,
+        )
+    )
+    assert digest == fork_data_root[:4]
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_get_attestation_signature_phase0(spec, state):
+    privkey = privkeys[0]
+    pubkey = pubkeys[0]
+    transition_to(spec, state, 10)
+    attestation_data = build_attestation_data(spec, state, slot=10, index=0)
+    signature = spec.get_attestation_signature(state, attestation_data, privkey)
+    domain = spec.get_domain(state, spec.DOMAIN_BEACON_ATTESTER, attestation_data.target.epoch)
+    signing_root = spec.compute_signing_root(attestation_data, domain)
+    assert spec.bls.Verify(pubkey, signing_root, signature)
+
+
+@with_all_phases
+@spec_state_test
+def test_compute_subnet_for_attestation(spec, state):
+    for committee_idx in range(spec.MAX_COMMITTEES_PER_SLOT):
+        for slot in range(state.slot, state.slot + spec.SLOTS_PER_EPOCH):
+            committees_per_slot = spec.get_committee_count_per_slot(state, spec.compute_epoch_at_slot(slot))
+            subnet = spec.compute_subnet_for_attestation(committees_per_slot, slot, committee_idx)
+            slots_since_epoch_start = slot % spec.SLOTS_PER_EPOCH
+            committees_since_epoch_start = committees_per_slot * slots_since_epoch_start
+            expected = (committees_since_epoch_start + committee_idx) % spec.ATTESTATION_SUBNET_COUNT
+            assert subnet == expected
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_get_slot_signature(spec, state):
+    privkey = privkeys[0]
+    pubkey = pubkeys[0]
+    slot = spec.Slot(10)
+    signature = spec.get_slot_signature(state, slot, privkey)
+    domain = spec.get_domain(state, spec.DOMAIN_SELECTION_PROOF, spec.compute_epoch_at_slot(slot))
+    signing_root = spec.compute_signing_root(slot, domain)
+    assert spec.bls.Verify(pubkey, signing_root, signature)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_is_aggregator(spec, state):
+    # at least one committee member must be selected as aggregator
+    slot = state.slot
+    committee_index = 0
+    committee = spec.get_beacon_committee(state, slot, committee_index)
+    found = False
+    for validator_index in committee:
+        sig = spec.get_slot_signature(state, slot, privkeys[validator_index])
+        if spec.is_aggregator(state, slot, committee_index, sig):
+            found = True
+            break
+    assert found
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_get_aggregate_signature(spec, state):
+    attestations = []
+    attesting_pubkeys = []
+    slot = state.slot
+    committee_index = 0
+    attestation_data = build_attestation_data(spec, state, slot=slot, index=committee_index)
+    beacon_committee = spec.get_beacon_committee(state, slot, committee_index)
+    committee_size = len(beacon_committee)
+    empty_bits = spec.Bitlist[spec.MAX_VALIDATORS_PER_COMMITTEE](*([0] * committee_size))
+    for i, validator_index in enumerate(beacon_committee):
+        bits = empty_bits.copy()
+        bits[i] = True
+        attestations.append(
+            spec.Attestation(
+                data=attestation_data,
+                aggregation_bits=bits,
+                signature=spec.get_attestation_signature(state, attestation_data, privkeys[validator_index]),
+            )
+        )
+        attesting_pubkeys.append(state.validators[validator_index].pubkey)
+    assert len(attestations) > 0
+
+    signature = spec.get_aggregate_signature(attestations)
+    domain = spec.get_domain(state, spec.DOMAIN_BEACON_ATTESTER, attestation_data.target.epoch)
+    signing_root = spec.compute_signing_root(attestation_data, domain)
+    assert spec.bls.FastAggregateVerify(attesting_pubkeys, signing_root, signature)
+
+
+@with_all_phases
+@spec_state_test
+def test_get_aggregate_and_proof(spec, state):
+    privkey = privkeys[0]
+    aggregate = get_valid_attestation(spec, state, signed=True)
+    aggregate_and_proof = spec.get_aggregate_and_proof(state, spec.ValidatorIndex(1), aggregate, privkey)
+    assert aggregate_and_proof.aggregator_index == 1
+    assert aggregate_and_proof.aggregate == aggregate
+    assert aggregate_and_proof.selection_proof == spec.get_slot_signature(state, aggregate.data.slot, privkey)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_get_aggregate_and_proof_signature(spec, state):
+    privkey = privkeys[0]
+    pubkey = pubkeys[0]
+    aggregate = get_valid_attestation(spec, state, signed=True)
+    aggregate_and_proof = spec.get_aggregate_and_proof(state, spec.ValidatorIndex(1), aggregate, privkey)
+    signature = spec.get_aggregate_and_proof_signature(state, aggregate_and_proof, privkey)
+    domain = spec.get_domain(
+        state, spec.DOMAIN_AGGREGATE_AND_PROOF, spec.compute_epoch_at_slot(aggregate.data.slot)
+    )
+    signing_root = spec.compute_signing_root(aggregate_and_proof, domain)
+    assert spec.bls.Verify(pubkey, signing_root, signature)

@@ -1,26 +1,51 @@
-"""Benchmark: the two north-star metrics (BASELINE.md / BASELINE.json).
+"""Benchmark: the north-star metrics (BASELINE.md / BASELINE.json).
 
-1. BLS verifies/sec — batched device FastAggregateVerify over a
-   128-attestation block shape (BASELINE configs #1/#3/#4): 128 checks,
-   each an aggregate of 64 pubkeys over a distinct 32-byte message,
-   dispatched to the TPU pairing backend (ops/bls_jax.py) in one call.
-   Baseline = the host pure-Python oracle (the reference's py_ecc
-   analog, crypto/bls/ciphersuite.py) timed on a sample and extrapolated.
-2. hash_tree_root MiB/s — fused device Merkleization of a 32 MiB chunk
-   tree (BASELINE configs #2/#5) vs host hashlib merkleize.
+Primary metric — COLD-cache batched device FastAggregateVerify over the
+128-attestation block shape (BASELINE configs #1/#3/#4): every timed
+iteration uses FRESH messages and FRESH signatures, so hash-to-curve,
+signature decompression and subgroup checks are paid inside the loop
+(on device: ops/h2c_jax + ops/curve_jax). Only the pubkey table is warm,
+matching reality (the validator registry repeats across a workload).
+Baseline = the host pure-Python oracle (the reference's py_ecc analog)
+timed cold on a sample.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
-with the BLS number as the primary metric and the hash numbers as extra
-keys (the driver records the line; the judge reads both).
+Extra keys:
+- bls_warm_verifies_per_sec — the round-2 metric (cached messages),
+  for continuity.
+- hash_tree_root MiB/s — fused device Merkleization of a 32 MiB chunk
+  tree (config #2) vs host hashlib, plus the spec-path rate.
+- incremental_reroot_ms — 1M-leaf list root after a single mutation
+  (the remerkleable-analog capability, dirty-tracked backing).
+- e2e generation (config #5): wall-clock of regenerating the phase0
+  minimal `operations/attestation` suite with device backends on
+  (BLS=jax + device hasher) vs the pure-host path, as a speedup.
+
+Prints ONE JSON line.
 """
 from __future__ import annotations
 
 import json
+import os
+import shutil
 import sys
+import tempfile
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fresh_workload(host, sks, pks, rng, n_checks, keys_per_agg, tag):
+    messages, pubkey_lists, signatures = [], [], []
+    for i in range(n_checks):
+        msg = bytes([tag, i % 256, (i >> 8) % 256]) * 10 + bytes([tag, i % 256])
+        idx = rng.choice(len(sks), size=keys_per_agg, replace=False)
+        sigs = [host.Sign(sks[j], msg) for j in idx]
+        messages.append(msg)
+        pubkey_lists.append([pks[j] for j in idx])
+        signatures.append(host.Aggregate(sigs))
+    return pubkey_lists, messages, signatures
 
 
 def bench_bls():
@@ -30,39 +55,48 @@ def bench_bls():
     n_checks = 128
     keys_per_agg = 64
     n_keys = 256
+    iterations = 3
 
     sks = [i + 1 for i in range(n_keys)]
     pks = [host.SkToPk(sk) for sk in sks]
-
     rng = np.random.default_rng(1)
-    messages, pubkey_lists, signatures = [], [], []
-    for i in range(n_checks):
-        msg = bytes([i]) * 32
-        idx = rng.choice(n_keys, size=keys_per_agg, replace=False)
-        sigs = [host.Sign(sks[j], msg) for j in idx]
-        messages.append(msg)
-        pubkey_lists.append([pks[j] for j in idx])
-        signatures.append(host.Aggregate(sigs))
 
-    # Warm-up: compile + fill host-side caches (pubkey/subgroup/h2c)
-    ok = bls_jax.fast_aggregate_verify_batch(pubkey_lists, messages, signatures)
-    assert bool(np.all(ok)), "device batch verify failed on valid inputs"
+    # pre-generate fresh workloads (signing is the signer's cost, not the
+    # verifier's — excluded from timing) + one warm-up set for compiles
+    workloads = [
+        _fresh_workload(host, sks, pks, rng, n_checks, keys_per_agg, tag)
+        for tag in range(iterations + 1)
+    ]
 
+    # warm-up: compiles all cold-path graphs; warm pubkey cache
+    ok = bls_jax.fast_aggregate_verify_batch_cold(*workloads[0])
+    assert bool(np.all(ok)), "device cold batch verify failed on valid inputs"
+
+    t0 = time.perf_counter()
+    for w in workloads[1:]:
+        ok = bls_jax.fast_aggregate_verify_batch_cold(*w)
+        assert bool(np.all(ok))
+    cold_rate = iterations * n_checks / (time.perf_counter() - t0)
+
+    # warm path (round-2 metric): same messages repeatedly, cached prep
+    warm = workloads[0]
+    ok = bls_jax.fast_aggregate_verify_batch(*warm)
+    assert bool(np.all(ok))
     times = []
     for _ in range(3):
         t0 = time.perf_counter()
-        ok = bls_jax.fast_aggregate_verify_batch(pubkey_lists, messages, signatures)
+        ok = bls_jax.fast_aggregate_verify_batch(*warm)
         times.append(time.perf_counter() - t0)
-    assert bool(np.all(ok))
-    device_rate = n_checks / min(times)
+    warm_rate = n_checks / min(times)
 
-    # Host-oracle baseline on a sample (full verify incl. hash-to-curve)
+    # host-oracle baseline, cold (fresh message + full verify)
+    pubkey_lists, messages, signatures = workloads[1]
     sample = 3
     t0 = time.perf_counter()
     for i in range(sample):
         assert host.FastAggregateVerify(pubkey_lists[i], messages[i], signatures[i])
     host_rate = sample / (time.perf_counter() - t0)
-    return device_rate, host_rate
+    return cold_rate, warm_rate, host_rate
 
 
 def bench_hash():
@@ -89,21 +123,19 @@ def bench_hash():
     root_dev = _words_to_bytes(root_dev_words)
 
     chunk_bytes = words_np.astype(">u4").tobytes()
-    chunk_list = [chunk_bytes[i : i + 32] for i in range(0, len(chunk_bytes), 32)]
     t0 = time.perf_counter()
-    root_host = merkle.merkleize_chunks(chunk_list, limit=n_chunks)
+    root_host = merkle.merkleize_chunks(chunk_bytes, limit=n_chunks)
     host_mbs = mib / (time.perf_counter() - t0)
     if root_dev != root_host:
         raise AssertionError("device root mismatch")
 
-    # Spec-path: the same data through ssz merkleize with the fused
-    # device backend on (host packs bytes once; one dispatch)
+    # Spec-path: same data through ssz merkleize with the device backend on
     from consensus_specs_tpu.ops import sha256 as dev
 
     dev.use_device_hasher()
     try:
         t0 = time.perf_counter()
-        root_spec = merkle.merkleize_chunks(chunk_list, limit=n_chunks)
+        root_spec = merkle.merkleize_chunks(chunk_bytes, limit=n_chunks)
         spec_mbs = mib / (time.perf_counter() - t0)
     finally:
         dev.use_host_hasher()
@@ -112,20 +144,76 @@ def bench_hash():
     return dev_mbs, host_mbs, spec_mbs
 
 
+def bench_incremental_reroot():
+    """1M-leaf List root after a single mutation — the structural-sharing
+    capability the reference gets from remerkleable (ssz_impl.py:11-13)."""
+    from consensus_specs_tpu.ssz import hash_tree_root
+    from consensus_specs_tpu.ssz.types import List, uint64
+
+    n = 1 << 20
+    big = List[uint64, 1 << 40](range(n))
+    hash_tree_root(big)  # first (full) root — populates the backing
+    t0 = time.perf_counter()
+    big[12345] = uint64(999)
+    root2 = hash_tree_root(big)
+    ms = (time.perf_counter() - t0) * 1e3
+    assert bytes(root2) != b"\x00" * 32
+    return ms
+
+
+def bench_generation():
+    """BASELINE config #5 (sliced): regenerate phase0-minimal
+    operations/attestation vectors, device backends on vs off."""
+    from consensus_specs_tpu.generators.gen_from_tests import run_state_test_generators
+    from consensus_specs_tpu.ops import sha256 as dev_hash
+
+    mods = {"phase0": {"attestation": "tests.spec.test_operations_attestation"}}
+
+    def run_once(backend: str, device_hasher: bool) -> float:
+        out = tempfile.mkdtemp(prefix=f"bench_gen_{backend}_")
+        os.environ["CONSENSUS_SPECS_TPU_BLS_BACKEND"] = backend
+        if device_hasher:
+            dev_hash.use_device_hasher()
+        try:
+            t0 = time.perf_counter()
+            run_state_test_generators(
+                "operations", mods, presets=("minimal",), args=["-o", out]
+            )
+            return time.perf_counter() - t0
+        finally:
+            if device_hasher:
+                dev_hash.use_host_hasher()
+            os.environ.pop("CONSENSUS_SPECS_TPU_BLS_BACKEND", None)
+            shutil.rmtree(out, ignore_errors=True)
+
+    # warm-up pass compiles the device graphs (untimed), then timed passes
+    run_once("jax", True)
+    t_dev = run_once("jax", True)
+    t_host = run_once("reference", False)
+    return t_dev, t_host
+
+
 def main() -> None:
-    dev_rate, host_rate = bench_bls()
+    cold_rate, warm_rate, host_rate = bench_bls()
     dev_mbs, host_mbs, spec_mbs = bench_hash()
+    reroot_ms = bench_incremental_reroot()
+    t_dev, t_host = bench_generation()
     print(
         json.dumps(
             {
-                "metric": "bls_fast_aggregate_verifies_per_sec",
-                "value": round(dev_rate, 2),
+                "metric": "bls_cold_fast_aggregate_verifies_per_sec",
+                "value": round(cold_rate, 2),
                 "unit": "verifies/s",
-                "vs_baseline": round(dev_rate / host_rate, 2),
-                "bls_host_oracle_rate": round(host_rate, 3),
+                "vs_baseline": round(cold_rate / host_rate, 2),
+                "bls_warm_verifies_per_sec": round(warm_rate, 2),
+                "bls_host_oracle_cold_rate": round(host_rate, 3),
                 "hash_tree_root_mibs": round(dev_mbs, 2),
                 "hash_vs_baseline": round(dev_mbs / host_mbs, 2),
                 "hash_spec_path_mibs": round(spec_mbs, 2),
+                "incremental_reroot_ms": round(reroot_ms, 3),
+                "gen_attestation_suite_device_s": round(t_dev, 2),
+                "gen_attestation_suite_host_s": round(t_host, 2),
+                "gen_suite_speedup": round(t_host / t_dev, 2) if t_dev else None,
             }
         )
     )

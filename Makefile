@@ -1,0 +1,62 @@
+# Developer/CI entry points (the reference's Makefile surface,
+# ref Makefile:89-197, re-shaped for this framework: no markdown
+# compile step — spec deltas are executable; docs are generated the
+# other way around).
+
+PYTHON ?= python
+TEST_VECTOR_DIR ?= ./test-vectors
+GENERATORS = bls epoch_processing finality fork_choice forks genesis merkle \
+             operations random rewards sanity shuffling ssz_generic ssz_static transition
+
+.PHONY: test citest test-fast lint docs generate_tests gen_% bench dryrun \
+        detect_generator_incomplete clean-vectors help
+
+help:
+	@echo "test                  full pytest suite (CPU, virtual 8-device mesh)"
+	@echo "citest fork=<fork>    per-fork suite slice (CI shape, ref Makefile:109-117)"
+	@echo "test-fast             suite minus device-kernel tests (no XLA compiles)"
+	@echo "lint                  byte-compile every source file"
+	@echo "docs                  regenerate docs/specs/ from the executable deltas"
+	@echo "generate_tests        run every vector generator into $(TEST_VECTOR_DIR)"
+	@echo "gen_<name>            run one generator (e.g. make gen_operations)"
+	@echo "bench                 run bench.py (one JSON line)"
+	@echo "dryrun                multi-chip dry-run on a virtual 8-device mesh"
+
+test:
+	$(PYTHON) -m pytest tests/ -q
+
+# per-fork CI slice: run the spec suites restricted to one fork
+citest:
+	$(if $(fork),,$(error citest requires fork=<name>, e.g. make citest fork=phase0))
+	$(PYTHON) -m pytest tests/spec -q --fork $(fork)
+
+test-fast:
+	$(PYTHON) -m pytest tests/ -q --ignore=tests/test_bls_device.py \
+	  --ignore=tests/test_curve_device.py --ignore=tests/test_h2c_device.py \
+	  --ignore=tests/test_bls_cold.py --ignore=tests/test_fq_device.py \
+	  --ignore=tests/test_sha256_device.py --ignore=tests/test_multichip.py
+
+lint:
+	$(PYTHON) -m compileall -q consensus_specs_tpu tests tools bench.py __graft_entry__.py
+
+docs:
+	$(PYTHON) tools/gen_spec_docs.py
+
+generate_tests: $(addprefix gen_,$(GENERATORS))
+
+gen_%:
+	$(PYTHON) -m consensus_specs_tpu.generators.main --runners $* -o $(TEST_VECTOR_DIR)
+
+bench:
+	$(PYTHON) bench.py
+
+dryrun:
+	$(PYTHON) -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
+
+# list test-vector cases whose INCOMPLETE sentinel survived a crash
+# (ref Makefile:199-203)
+detect_generator_incomplete:
+	@find $(TEST_VECTOR_DIR) -name INCOMPLETE 2>/dev/null || true
+
+clean-vectors:
+	rm -rf $(TEST_VECTOR_DIR)

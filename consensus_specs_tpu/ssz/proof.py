@@ -240,31 +240,30 @@ def _proof(obj, bits: str) -> PyList[bytes]:
     return _subtree_proof(chunks, depth, children, bits)
 
 
-def _subtree_proof(chunks, depth, children, bits: str) -> PyList[bytes]:
-    if len(bits) <= depth:
-        # the proven node lives in THIS tree — possibly an interior node
-        # (e.g. a custody-chunk subtree root inside a ByteList's data tree)
-        idx = int(bits, 2) if bits else 0
-        base = depth - len(bits)  # height of the proven node
-        levels = _levels(chunks, depth)
-        siblings = []
-        pos = idx
-        for level in range(base, depth):  # proven-node-level sibling first
-            row = levels[level]
-            sib = pos ^ 1
-            siblings.append(row[sib] if sib < len(row) else ZERO_HASHES[level])
-            pos //= 2
-        return siblings
-    tree_bits, rest = bits[:depth], bits[depth:]
-    idx = int(tree_bits, 2) if tree_bits else 0  # depth-0 subtree: one child
+def _sibling_walk(chunks, depth: int, idx: int, base: int) -> PyList[bytes]:
+    """Siblings of node `idx` (at height `base`) up to this tree's root,
+    proven-node-level sibling first."""
     levels = _levels(chunks, depth)
     siblings = []
     pos = idx
-    for level in range(depth):  # leaf-level sibling first
+    for level in range(base, depth):
         row = levels[level]
         sib = pos ^ 1
         siblings.append(row[sib] if sib < len(row) else ZERO_HASHES[level])
         pos //= 2
+    return siblings
+
+
+def _subtree_proof(chunks, depth, children, bits: str) -> PyList[bytes]:
+    if len(bits) <= depth:
+        # the proven node lives in THIS tree — possibly an interior node
+        # (e.g. a custody-chunk subtree root inside a ByteList's data
+        # tree); base = its height, with base = 0 the plain leaf case
+        idx = int(bits, 2) if bits else 0
+        return _sibling_walk(chunks, depth, idx, depth - len(bits))
+    tree_bits, rest = bits[:depth], bits[depth:]
+    idx = int(tree_bits, 2) if tree_bits else 0  # depth-0 subtree: one child
+    siblings = _sibling_walk(chunks, depth, idx, 0)
     assert children is not None, "cannot descend into packed basic chunks"
     assert idx < len(children), "path descends into zero padding"
     return _proof(children[idx], rest) + siblings

@@ -124,6 +124,29 @@ SCENARIOS = {
 }
 
 
+def _expand_matrix() -> None:
+    """The reference's scenario product (tests/generators/random/
+    generate.py: {leak, no-leak} x epochs-to-skip x slot-offset, each
+    with BLOCK_TRANSITIONS_COUNT=2 block transitions) — expanded into
+    the data-driven table instead of generated source files."""
+    setups = {"nl": [], "lk": ["leak"]}
+    skips = {"e0": [], "e1": ["next_epoch"]}
+    offsets = {
+        "s0": [],
+        "last": ["to_last_slot"],
+        "rand": ["to_random_slot"],
+        "penult": ["to_penultimate_slot"],
+    }
+    for sname, setup in setups.items():
+        for kname, skip in skips.items():
+            for oname, offset in offsets.items():
+                name = f"matrix_{sname}_{kname}_{oname}"
+                SCENARIOS[name] = setup + skip + offset + ["block", "next_epoch", "block"]
+
+
+_expand_matrix()
+
+
 def run_random_scenario(spec, state, scenario_name, seed):
     rng = Random(seed)
     randomize_state(spec, state, rng)
@@ -139,6 +162,17 @@ def run_random_scenario(spec, state, scenario_name, seed):
             next_epoch(spec, state)
         elif step == "random_slots":
             next_slots(spec, state, rng.randrange(1, int(spec.SLOTS_PER_EPOCH)))
+        elif step == "to_last_slot":
+            slots_per_epoch = int(spec.SLOTS_PER_EPOCH)
+            next_slots(spec, state, slots_per_epoch - 1 - int(state.slot) % slots_per_epoch)
+        elif step == "to_penultimate_slot":
+            slots_per_epoch = int(spec.SLOTS_PER_EPOCH)
+            next_slots(spec, state, (slots_per_epoch - 2 - int(state.slot) % slots_per_epoch) % slots_per_epoch)
+        elif step == "to_random_slot":
+            slots_per_epoch = int(spec.SLOTS_PER_EPOCH)
+            target = rng.randrange(0, slots_per_epoch)
+            delta = (target - int(state.slot)) % slots_per_epoch
+            next_slots(spec, state, delta)
         elif step == "leak":
             # no attestations for > MIN_EPOCHS_TO_INACTIVITY_PENALTY epochs
             for _ in range(int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 3):

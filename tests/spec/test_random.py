@@ -42,3 +42,27 @@ def test_randomized_leak_0(spec, state):
 @spec_state_test
 def test_randomized_leak_1(spec, state):
     yield from run_random_scenario(spec, state, "leak_1", seed=445)
+
+
+# -- scenario-matrix tests: generated from the same table that defines
+# the scenarios (random_block_tests._expand_matrix) so the two can
+# never drift; seeds are positional (500 + index)
+
+def _install_matrix_tests():
+    from consensus_specs_tpu.test_framework.random_block_tests import SCENARIOS
+
+    matrix_names = sorted(n for n in SCENARIOS if n.startswith("matrix_"))
+    for i, scenario_name in enumerate(matrix_names):
+        def make(scenario_name=scenario_name, seed=500 + i):
+            @with_all_phases
+            @spec_state_test
+            def test_fn(spec, state):
+                yield from run_random_scenario(spec, state, scenario_name, seed=seed)
+            return test_fn
+
+        fn = make()
+        fn.__name__ = f"test_{scenario_name}"
+        globals()[fn.__name__] = fn
+
+
+_install_matrix_tests()

@@ -8,6 +8,13 @@ TEST_VECTOR_DIR ?= ./test-vectors
 GENERATORS = bls epoch_processing finality fork_choice forks genesis merkle \
              operations random rewards sanity shuffling ssz_generic ssz_static transition
 
+# the XLA-compile-heavy suites (single source of truth for test-fast /
+# test-device / CI partitioning)
+DEVICE_TESTS = tests/test_bls_device.py tests/test_curve_device.py \
+               tests/test_h2c_device.py tests/test_bls_cold.py \
+               tests/test_fq_device.py tests/test_sha256_device.py \
+               tests/test_multichip.py
+
 .PHONY: test citest test-fast lint docs generate_tests gen_% bench dryrun \
         detect_generator_incomplete clean-vectors help
 
@@ -31,10 +38,10 @@ citest:
 	$(PYTHON) -m pytest tests/spec -q --fork $(fork)
 
 test-fast:
-	$(PYTHON) -m pytest tests/ -q --ignore=tests/test_bls_device.py \
-	  --ignore=tests/test_curve_device.py --ignore=tests/test_h2c_device.py \
-	  --ignore=tests/test_bls_cold.py --ignore=tests/test_fq_device.py \
-	  --ignore=tests/test_sha256_device.py --ignore=tests/test_multichip.py
+	$(PYTHON) -m pytest tests/ -q $(addprefix --ignore=,$(DEVICE_TESTS))
+
+test-device:
+	$(PYTHON) -m pytest $(DEVICE_TESTS) -q
 
 lint:
 	$(PYTHON) -m compileall -q consensus_specs_tpu tests tools bench.py __graft_entry__.py

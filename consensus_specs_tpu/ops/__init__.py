@@ -4,23 +4,24 @@ tower (tower.py), batched ate pairing (pairing_jax.py), curve group ops
 + subgroup checks (curve_jax.py), hash-to-G2 (h2c_jax.py), and the
 device BLS signature backend (bls_jax.py).
 
-The persistent XLA compile cache is configured here, before any sibling
-module jits anything: the pairing/ladder/h2c graphs are expensive to
-build (minutes on a small host core) and identical across processes, so
-caching them is the difference between a usable and an unusable test
-suite on CPU — and between cold and warm bench start-up on TPU.
+The persistent XLA compile cache is OPT-IN via the
+CONSENSUS_SPECS_TPU_JAX_CACHE env var (path to a cache dir). It is NOT
+enabled by default: on the CPU backend of this jaxlib, serializing the
+large pairing executable into the cache was observed to segfault
+(compilation_cache.put_executable_and_time), and cached CPU AOT entries
+fail to load across machines with differing feature sets anyway
+(cpu_aot_loader machine-feature mismatch). On TPU runners that want
+warm restarts, set the env var explicitly.
 """
 import os
 
 try:
-    import jax
+    _cache_dir = os.environ.get("CONSENSUS_SPECS_TPU_JAX_CACHE")
+    if _cache_dir:
+        import jax
 
-    if jax.config.jax_compilation_cache_dir is None:  # respect host app config
-        _cache_dir = os.environ.get(
-            "CONSENSUS_SPECS_TPU_JAX_CACHE",
-            os.path.expanduser("~/.cache/jax_consensus"),
-        )
-        jax.config.update("jax_compilation_cache_dir", _cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+        if jax.config.jax_compilation_cache_dir is None:  # respect host app config
+            jax.config.update("jax_compilation_cache_dir", _cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
 except Exception:  # pragma: no cover - cache is best-effort
     pass

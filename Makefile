@@ -15,7 +15,7 @@ DEVICE_TESTS = tests/test_bls_device.py tests/test_curve_device.py \
                tests/test_fq_device.py tests/test_sha256_device.py \
                tests/test_multichip.py
 
-.PHONY: test citest test-fast lint docs generate_tests gen_% bench dryrun \
+.PHONY: test citest test-fast test-device lint docs generate_tests gen_% bench dryrun \
         detect_generator_incomplete clean-vectors help
 
 help:
@@ -38,7 +38,7 @@ citest:
 	$(PYTHON) -m pytest tests/spec -q --fork $(fork)
 
 test-fast:
-	$(PYTHON) -m pytest tests/ -q $(addprefix --ignore=,$(DEVICE_TESTS))
+	$(PYTHON) -m pytest tests/ -q $(addprefix --ignore=,$(DEVICE_TESTS)) $(PYTEST_EXTRA)
 
 test-device:
 	$(PYTHON) -m pytest $(DEVICE_TESTS) -q

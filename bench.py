@@ -141,7 +141,30 @@ def bench_hash():
         dev.use_host_hasher()
     if root_spec != root_host:
         raise AssertionError("spec-path device root mismatch")
-    return dev_mbs, host_mbs, spec_mbs
+
+    # pallas kernel (opt-in fast path): report when it verifies here;
+    # unavailable backends leave the metric null, but a WRONG root from
+    # an available kernel is a correctness regression and must raise
+    pallas_mbs = None
+    try:
+        from consensus_specs_tpu.ops import sha256_pallas
+
+        pallas_status = sha256_pallas.self_check_status()
+    except Exception:
+        pallas_status = "unavailable"
+    if pallas_status == "mismatch":
+        raise AssertionError("pallas sha256 kernel digest mismatch")
+    if pallas_status == "ok":
+        root_p = np.asarray(sha256_pallas.merkle_reduce_pallas(words, levels))
+        if _words_to_bytes(root_p) != root_host:
+            raise AssertionError("pallas merkle root mismatch")
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(sha256_pallas.merkle_reduce_pallas(words, levels))
+            times.append(time.perf_counter() - t0)
+        pallas_mbs = mib / min(times)
+    return dev_mbs, host_mbs, spec_mbs, pallas_mbs
 
 
 def bench_incremental_reroot():
@@ -195,7 +218,7 @@ def bench_generation():
 
 def main() -> None:
     cold_rate, warm_rate, host_rate = bench_bls()
-    dev_mbs, host_mbs, spec_mbs = bench_hash()
+    dev_mbs, host_mbs, spec_mbs, pallas_mbs = bench_hash()
     reroot_ms = bench_incremental_reroot()
     t_dev, t_host = bench_generation()
     print(
@@ -210,6 +233,7 @@ def main() -> None:
                 "hash_tree_root_mibs": round(dev_mbs, 2),
                 "hash_vs_baseline": round(dev_mbs / host_mbs, 2),
                 "hash_spec_path_mibs": round(spec_mbs, 2),
+                "hash_pallas_mibs": round(pallas_mbs, 2) if pallas_mbs else None,
                 "incremental_reroot_ms": round(reroot_ms, 3),
                 "gen_attestation_suite_device_s": round(t_dev, 2),
                 "gen_attestation_suite_host_s": round(t_host, 2),

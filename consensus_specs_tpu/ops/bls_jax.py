@@ -158,14 +158,36 @@ def _pack_checks(checks: Sequence[Optional[List[_Pair]]], min_rows: int = _MIN_B
     return (px, py, qx, qy, active), live
 
 
+def _max_rows() -> int:
+    """Row cap per pairing dispatch. Chunking bounds the set of compiled
+    batch shapes to ONE per K bucket: long CPU test sessions were
+    observed to segfault inside the XLA CPU compiler when a fresh
+    (bigger) batch shape forced a recompile late in the process, and on
+    CPU the chunking costs nothing. On TPU larger dispatches utilize the
+    chip better, so the cap is the full block shape."""
+    env = os.environ.get("CONSENSUS_SPECS_TPU_MAX_ROWS")
+    if env:
+        return max(1, int(env))
+    import jax
+
+    return _MIN_BUCKET if jax.default_backend() == "cpu" else 128
+
+
 def _run_checks(checks: Sequence[Optional[List[_Pair]]]) -> np.ndarray:
     out = np.zeros(len(checks), dtype=bool)
-    packed, live = _pack_checks(checks)
-    if packed is None:
+    # pre-filter only sizes the chunks; _pack_checks re-applies the
+    # authoritative liveness predicate, so a predicate change cannot
+    # desync indices (its `live` is relative to the sub-list)
+    live_idx = [i for i, c in enumerate(checks) if c is not None and len(c) > 0]
+    if not live_idx:
         return out
-    ok = np.asarray(pairing_jax.pairing_check_fast_jit(*packed))
-    for row, i in enumerate(live):
-        out[i] = bool(ok[row])
+    cap = _max_rows()
+    for start in range(0, len(live_idx), cap):
+        sub = live_idx[start : start + cap]
+        packed, live = _pack_checks([checks[i] for i in sub])
+        ok = np.asarray(pairing_jax.pairing_check_fast_jit(*packed))
+        for row, j in enumerate(live):
+            out[sub[j]] = bool(ok[row])
     return out
 
 
@@ -419,6 +441,13 @@ def fast_aggregate_verify_batch_cold(pubkey_lists, messages, signatures) -> np.n
     n = len(messages)
     out = np.zeros(n, dtype=bool)
     if n == 0:
+        return out
+    cap = _max_rows()
+    if n > cap:  # bound the compiled batch shapes (see _max_rows)
+        for s in range(0, n, cap):
+            out[s : s + cap] = fast_aggregate_verify_batch_cold(
+                pubkey_lists[s : s + cap], messages[s : s + cap], signatures[s : s + cap]
+            )
         return out
     decompress, h2c, aggregate = _cold_jits()
 

@@ -78,3 +78,24 @@ def pytest_configure(config):
     bls_type = config.getoption("--bls-type")
     if bls_type:
         bls.use_backend(bls_type)
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _release_compiled_executables(request):
+    """Free XLA executables between test modules.
+
+    Long single-process runs were observed to SEGFAULT inside
+    backend_compile_and_load once enough compiled executables had
+    accumulated (the crash point moved with the compile count, not with
+    any particular graph — three runs died on three different,
+    individually-compilable graphs). Dropping all jit caches when a
+    module finishes keeps the resident-executable count bounded by one
+    module's worth; modules already share their graphs internally, so
+    the re-compile cost across modules is unchanged."""
+    yield
+    import jax
+
+    jax.clear_caches()

@@ -24,6 +24,7 @@ Prints ONE JSON line.
 """
 from __future__ import annotations
 
+import faulthandler
 import json
 import os
 import shutil
@@ -33,18 +34,26 @@ import time
 
 import numpy as np
 
+faulthandler.enable()
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
 def _fresh_workload(host, sks, pks, rng, n_checks, keys_per_agg, tag):
+    """Fresh (pubkeys, message, aggregate signature) rows. Signing uses
+    the aggregate secret key (sum of the participants' keys mod r) —
+    bit-identical to aggregating per-key signatures on one message, and
+    ~keys_per_agg x cheaper to PREPARE; the measured verifier work is
+    unchanged (it still aggregates the 64 individual pubkeys)."""
+    from consensus_specs_tpu.crypto.bls.fields import R as _R
+
     messages, pubkey_lists, signatures = [], [], []
     for i in range(n_checks):
         msg = bytes([tag, i % 256, (i >> 8) % 256]) * 10 + bytes([tag, i % 256])
         idx = rng.choice(len(sks), size=keys_per_agg, replace=False)
-        sigs = [host.Sign(sks[j], msg) for j in idx]
+        agg_sk = sum(sks[j] for j in idx) % _R
         messages.append(msg)
         pubkey_lists.append([pks[j] for j in idx])
-        signatures.append(host.Aggregate(sigs))
+        signatures.append(host.Sign(agg_sk, msg))
     return pubkey_lists, messages, signatures
 
 
@@ -99,17 +108,87 @@ def bench_bls():
     return cold_rate, warm_rate, host_rate
 
 
-def bench_hash():
+_HASH_LEVELS = 20  # 1M chunks = 32 MiB — mainnet-registry scale
+_HASH_SEED = 42  # probe child + bench_hash must hash the SAME tree
+
+
+def bench_pallas_probe(timeout_s: int = 300):
+    """Pallas section, in a DISPOSABLE CHILD with a hard timeout.
+
+    Mosaic compilation can hang indefinitely on tunneled backends (the
+    axon TPU tunnel blocks in backend_compile rather than erroring), so
+    the probe must not share a process with the rest of the bench. Runs
+    before the parent opens the device; returns
+    {"status": ok|mismatch|unavailable|timeout, "mibs", "root_hex"}.
+    The child re-derives the same rng(42) chunk tree as bench_hash so
+    the parent can cross-check root_hex against the host root.
+    """
+    import subprocess
+
+    child = (
+        "import json, sys, time\n"
+        "import numpy as np, jax, jax.numpy as jnp\n"
+        f"sys.path.insert(0, {os.path.dirname(os.path.abspath(__file__))!r})\n"
+        "from consensus_specs_tpu.ops.sha256_pallas import self_check_status, merkle_reduce_pallas\n"
+        "from consensus_specs_tpu.ops.sha256 import _words_to_bytes\n"
+        "out = {'status': self_check_status(), 'mibs': None, 'root_hex': None}\n"
+        "if out['status'] == 'ok':\n"
+        f"    levels = {_HASH_LEVELS}\n"
+        "    n = 1 << levels; mib = n * 32 / (1 << 20)\n"
+        f"    rng = np.random.default_rng({_HASH_SEED})\n"
+        "    words = jax.device_put(jnp.asarray(rng.integers(0, 2**32, size=(n, 8), dtype=np.uint32)))\n"
+        "    root = np.asarray(merkle_reduce_pallas(words, levels))\n"
+        "    out['root_hex'] = _words_to_bytes(root).hex()\n"
+        "    times = []\n"
+        "    for _ in range(3):\n"
+        "        t0 = time.perf_counter()\n"
+        "        np.asarray(merkle_reduce_pallas(words, levels))\n"
+        "        times.append(time.perf_counter() - t0)\n"
+        "    out['mibs'] = mib / min(times)\n"
+        "print(json.dumps(out))\n"
+    )
+    import signal
+
+    # own session so the WHOLE process group can be killed — subprocess.run's
+    # timeout only kills the direct child and then blocks on pipe EOF, which
+    # a forked compile helper holding the pipe would defeat
+    proc = subprocess.Popen(
+        [sys.executable, "-c", child],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+        start_new_session=True,
+    )
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        proc.wait()
+        return {"status": "timeout", "mibs": None, "root_hex": None}
+    if proc.returncode != 0:
+        # child died AFTER import (e.g. kernel aborted mid-timing): not a
+        # clean "unavailable" — surface as an error status in the output
+        return {"status": "error", "mibs": None, "root_hex": None}
+    try:
+        return json.loads(out.strip().splitlines()[-1])
+    except Exception:
+        return {"status": "error", "mibs": None, "root_hex": None}
+
+
+def bench_hash(pallas_root_hex):
     import jax
     import jax.numpy as jnp
 
     from consensus_specs_tpu.ops.sha256 import _words_to_bytes, merkle_reduce_jit
     from consensus_specs_tpu.ssz import merkle
 
-    levels = 20
-    n_chunks = 1 << levels  # 32 MiB of chunk data — mainnet-registry scale
+    levels = _HASH_LEVELS
+    n_chunks = 1 << levels
     mib = n_chunks * 32 / (1 << 20)
-    rng = np.random.default_rng(42)
+    rng = np.random.default_rng(_HASH_SEED)
     words_np = rng.integers(0, 2**32, size=(n_chunks, 8), dtype=np.uint32)
     words = jax.device_put(jnp.asarray(words_np))
 
@@ -128,6 +207,10 @@ def bench_hash():
     host_mbs = mib / (time.perf_counter() - t0)
     if root_dev != root_host:
         raise AssertionError("device root mismatch")
+    # a pallas kernel that RAN but produced a wrong root is a correctness
+    # regression, not an unavailability — fail loudly
+    if pallas_root_hex is not None and pallas_root_hex != root_host.hex():
+        raise AssertionError("pallas merkle root mismatch")
 
     # Spec-path: same data through ssz merkleize with the device backend on
     from consensus_specs_tpu.ops import sha256 as dev
@@ -141,30 +224,7 @@ def bench_hash():
         dev.use_host_hasher()
     if root_spec != root_host:
         raise AssertionError("spec-path device root mismatch")
-
-    # pallas kernel (opt-in fast path): report when it verifies here;
-    # unavailable backends leave the metric null, but a WRONG root from
-    # an available kernel is a correctness regression and must raise
-    pallas_mbs = None
-    try:
-        from consensus_specs_tpu.ops import sha256_pallas
-
-        pallas_status = sha256_pallas.self_check_status()
-    except Exception:
-        pallas_status = "unavailable"
-    if pallas_status == "mismatch":
-        raise AssertionError("pallas sha256 kernel digest mismatch")
-    if pallas_status == "ok":
-        root_p = np.asarray(sha256_pallas.merkle_reduce_pallas(words, levels))
-        if _words_to_bytes(root_p) != root_host:
-            raise AssertionError("pallas merkle root mismatch")
-        times = []
-        for _ in range(3):
-            t0 = time.perf_counter()
-            np.asarray(sha256_pallas.merkle_reduce_pallas(words, levels))
-            times.append(time.perf_counter() - t0)
-        pallas_mbs = mib / min(times)
-    return dev_mbs, host_mbs, spec_mbs, pallas_mbs
+    return dev_mbs, host_mbs, spec_mbs
 
 
 def bench_incremental_reroot():
@@ -174,7 +234,7 @@ def bench_incremental_reroot():
     from consensus_specs_tpu.ssz.types import List, uint64
 
     n = 1 << 20
-    big = List[uint64, 1 << 40](range(n))
+    big = List[uint64, 1 << 40](list(range(n)))
     hash_tree_root(big)  # first (full) root — populates the backing
     t0 = time.perf_counter()
     big[12345] = uint64(999)
@@ -216,10 +276,26 @@ def bench_generation():
     return t_dev, t_host
 
 
+def _note(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
 def main() -> None:
-    cold_rate, warm_rate, host_rate = bench_bls()
-    dev_mbs, host_mbs, spec_mbs, pallas_mbs = bench_hash()
+    _note("bench: pallas probe (subprocess) ...")
+    pallas = bench_pallas_probe()
+    _note(f"bench: pallas probe done status={pallas['status']} mibs={pallas['mibs']}")
+    if pallas["status"] == "mismatch":
+        raise AssertionError("pallas sha256 kernel digest mismatch")
+    pallas_mbs = pallas["mibs"]
+    _note("bench: hashing ...")
+    dev_mbs, host_mbs, spec_mbs = bench_hash(pallas.get("root_hex"))
+    _note(f"bench: hashing done dev={dev_mbs:.1f} host={host_mbs:.1f} spec={spec_mbs:.1f} pallas={pallas_mbs}")
+    _note("bench: incremental re-root ...")
     reroot_ms = bench_incremental_reroot()
+    _note("bench: bls (cold + warm) ...")
+    cold_rate, warm_rate, host_rate = bench_bls()
+    _note(f"bench: bls done cold={cold_rate:.2f}/s warm={warm_rate:.2f}/s host={host_rate:.3f}/s")
+    _note("bench: e2e generation ...")
     t_dev, t_host = bench_generation()
     print(
         json.dumps(
@@ -234,6 +310,7 @@ def main() -> None:
                 "hash_vs_baseline": round(dev_mbs / host_mbs, 2),
                 "hash_spec_path_mibs": round(spec_mbs, 2),
                 "hash_pallas_mibs": round(pallas_mbs, 2) if pallas_mbs else None,
+                "hash_pallas_status": pallas["status"],
                 "incremental_reroot_ms": round(reroot_ms, 3),
                 "gen_attestation_suite_device_s": round(t_dev, 2),
                 "gen_attestation_suite_host_s": round(t_host, 2),

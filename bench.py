@@ -13,7 +13,11 @@ Extra keys:
 - bls_warm_verifies_per_sec — the round-2 metric (cached messages),
   for continuity.
 - hash_tree_root MiB/s — fused device Merkleization of a 32 MiB chunk
-  tree (config #2) vs host hashlib, plus the spec-path rate.
+  tree (config #2). hash_vs_baseline compares against this repo's OWN
+  host backend (the SHA-NI C extension); hash_hashlib_ref_mibs /
+  hash_vs_hashlib_ref compare against plain hashlib — the reference
+  stack's rate (pycryptodome, utils/hash_function.py:8). The spec-path
+  rate is also reported.
 - incremental_reroot_ms — 1M-leaf list root after a single mutation
   (the remerkleable-analog capability, dirty-tracked backing).
 - e2e generation (config #5): wall-clock of regenerating the phase0
@@ -207,6 +211,23 @@ def bench_hash(pallas_root_hex):
     host_mbs = mib / (time.perf_counter() - t0)
     if root_dev != root_host:
         raise AssertionError("device root mismatch")
+
+    # reference-stack baseline: plain hashlib pairwise loop (the analog of
+    # the reference's pycryptodome-backed hash(), utils/hash_function.py:8)
+    # — "host" above is this repo's own SHA-NI C extension, so hash_vs_
+    # baseline understates the win over the reference without this line
+    import hashlib
+
+    nodes = chunk_bytes
+    t0 = time.perf_counter()
+    for _ in range(levels):
+        nodes = b"".join(
+            hashlib.sha256(nodes[i : i + 64]).digest()
+            for i in range(0, len(nodes), 64)
+        )
+    hashlib_mbs = mib / (time.perf_counter() - t0)
+    if nodes != root_host:
+        raise AssertionError("hashlib reference root mismatch")
     # a pallas kernel that RAN but produced a wrong root is a correctness
     # regression, not an unavailability — fail loudly
     if pallas_root_hex is not None and pallas_root_hex != root_host.hex():
@@ -224,7 +245,7 @@ def bench_hash(pallas_root_hex):
         dev.use_host_hasher()
     if root_spec != root_host:
         raise AssertionError("spec-path device root mismatch")
-    return dev_mbs, host_mbs, spec_mbs
+    return dev_mbs, host_mbs, spec_mbs, hashlib_mbs
 
 
 def bench_incremental_reroot():
@@ -292,8 +313,11 @@ def main() -> None:
         raise AssertionError("pallas sha256 kernel digest mismatch")
     pallas_mbs = pallas["mibs"]
     _note("bench: hashing ...")
-    dev_mbs, host_mbs, spec_mbs = bench_hash(pallas.get("root_hex"))
-    _note(f"bench: hashing done dev={dev_mbs:.1f} host={host_mbs:.1f} spec={spec_mbs:.1f} pallas={pallas_mbs}")
+    dev_mbs, host_mbs, spec_mbs, hashlib_mbs = bench_hash(pallas.get("root_hex"))
+    _note(
+        f"bench: hashing done dev={dev_mbs:.1f} host={host_mbs:.1f} "
+        f"spec={spec_mbs:.1f} hashlib={hashlib_mbs:.1f} pallas={pallas_mbs}"
+    )
     _note("bench: incremental re-root ...")
     reroot_ms = bench_incremental_reroot()
     _note("bench: bls (cold + warm) ...")
@@ -312,6 +336,8 @@ def main() -> None:
                 "bls_host_oracle_cold_rate": round(host_rate, 3),
                 "hash_tree_root_mibs": round(dev_mbs, 2),
                 "hash_vs_baseline": round(dev_mbs / host_mbs, 2),
+                "hash_hashlib_ref_mibs": round(hashlib_mbs, 2),
+                "hash_vs_hashlib_ref": round(dev_mbs / hashlib_mbs, 2),
                 "hash_spec_path_mibs": round(spec_mbs, 2),
                 "hash_pallas_mibs": round(pallas_mbs, 2) if pallas_mbs else None,
                 "hash_pallas_status": pallas["status"],

@@ -16,6 +16,21 @@ def vector_test():
 
     def runner(fn):
         def entry(*args, **kw):
+            # Parts must be captured AT YIELD TIME: helpers yield the live
+            # state ("pre") and then mutate it in place, so a raw reference
+            # written after the case finishes would reflect the post state.
+            # SSZ values are frozen by serializing on yield and None-valued
+            # parts are dropped, both exactly as the reference adapter does
+            # (utils.py:29-55).
+            def snapshot(kind, value):
+                if kind == "ssz" and isinstance(value, SSZType):
+                    return value.encode_bytes()
+                if kind == "data" and isinstance(value, SSZType):
+                    return value.copy()
+                if isinstance(value, bytearray):
+                    return bytes(value)
+                return value
+
             def generator_mode():
                 out = fn(*args, **kw)
                 if out is None:
@@ -23,12 +38,17 @@ def vector_test():
                 for part in out:
                     if len(part) == 2:
                         (key, value) = part
+                        if value is None:
+                            continue
                         if isinstance(value, (SSZType, bytes, bytearray)):
-                            yield key, "ssz", value
+                            yield key, "ssz", snapshot("ssz", value)
                         else:
-                            yield key, "data", value
+                            yield key, "data", snapshot("data", value)
                     else:
-                        yield part
+                        (key, kind, value) = part
+                        if value is None and kind != "meta":
+                            continue
+                        yield key, kind, snapshot(kind, value)
 
             if kw.pop("generator_mode", False):
                 return list(generator_mode())

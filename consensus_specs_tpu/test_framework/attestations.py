@@ -6,7 +6,7 @@ from .block import build_empty_block_for_next_slot
 from .block_processing import state_transition_and_sign_block
 from .constants import is_post_altair
 from .context import expect_assertion_error
-from .keys import privkeys
+from .keys import aggregate_sign, privkeys
 from .state import next_slot, next_slots, transition_to
 
 
@@ -70,18 +70,20 @@ def build_attestation_data(spec, state, slot, index, beacon_block_root=None):
     )
 
 
-def get_attestation_signature(spec, state, attestation_data, privkey):
+def get_attestation_signing_root(spec, state, attestation_data):
     domain = spec.get_domain(state, spec.DOMAIN_BEACON_ATTESTER, attestation_data.target.epoch)
-    signing_root = spec.compute_signing_root(attestation_data, domain)
-    return spec.bls.Sign(privkey, signing_root)
+    return spec.compute_signing_root(attestation_data, domain)
+
+
+def get_attestation_signature(spec, state, attestation_data, privkey):
+    return spec.bls.Sign(privkey, get_attestation_signing_root(spec, state, attestation_data))
 
 
 def sign_aggregate_attestation(spec, state, attestation_data, participants):
-    signatures = [
-        get_attestation_signature(spec, state, attestation_data, privkeys[i])
-        for i in participants
-    ]
-    return spec.bls.Aggregate(signatures)
+    # one Sign under the summed key — bit-identical to aggregating
+    # per-participant signatures (see keys.aggregate_sign)
+    signing_root = get_attestation_signing_root(spec, state, attestation_data)
+    return aggregate_sign([privkeys[i] for i in participants], signing_root)
 
 
 def sign_indexed_attestation(spec, state, indexed_attestation):

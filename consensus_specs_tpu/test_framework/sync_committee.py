@@ -4,7 +4,7 @@ from __future__ import annotations
 
 from .block_processing import run_block_processing_to
 from .context import expect_assertion_error
-from .keys import privkeys, pubkey_to_privkey, pubkeys
+from .keys import aggregate_sign, privkeys, pubkey_to_privkey, pubkeys
 
 
 def compute_committee_indices(spec, state, committee=None):
@@ -15,7 +15,7 @@ def compute_committee_indices(spec, state, committee=None):
     return [all_pubkeys.index(pubkey) for pubkey in committee.pubkeys]
 
 
-def compute_sync_committee_signature(spec, state, slot, privkey, block_root=None, domain_type=None):
+def compute_sync_committee_signing_root(spec, state, slot, block_root=None, domain_type=None):
     domain = spec.get_domain(
         state, domain_type or spec.DOMAIN_SYNC_COMMITTEE, spec.compute_epoch_at_slot(slot)
     )
@@ -24,8 +24,13 @@ def compute_sync_committee_signature(spec, state, slot, privkey, block_root=None
             block_root = build_empty_block_root(spec, state)
         else:
             block_root = spec.get_block_root_at_slot(state, slot)
-    signing_root = spec.compute_signing_root(spec.Root(block_root), domain)
-    return spec.bls.Sign(privkey, signing_root)
+    return spec.compute_signing_root(spec.Root(block_root), domain)
+
+
+def compute_sync_committee_signature(spec, state, slot, privkey, block_root=None, domain_type=None):
+    return spec.bls.Sign(
+        privkey, compute_sync_committee_signing_root(spec, state, slot, block_root, domain_type)
+    )
 
 
 def build_empty_block_root(spec, state):
@@ -39,13 +44,13 @@ def compute_aggregate_sync_committee_signature(spec, state, slot, participants, 
     if len(participants) == 0:
         return spec.G2_POINT_AT_INFINITY
 
-    signatures = [
-        compute_sync_committee_signature(
-            spec, state, slot, privkeys[validator_index], block_root=block_root, domain_type=domain_type
-        )
-        for validator_index in participants
-    ]
-    return spec.bls.Aggregate(signatures)
+    # all participants sign the same (block_root, domain) message: one
+    # Sign under the summed key is bit-identical to the per-key loop
+    # (duplicated committee members contribute their key once per seat)
+    signing_root = compute_sync_committee_signing_root(spec, state, slot, block_root, domain_type)
+    return aggregate_sign(
+        [privkeys[validator_index] for validator_index in participants], signing_root
+    )
 
 
 def compute_sync_committee_inclusion_reward(spec, state):

@@ -83,3 +83,22 @@ def test_invalid_case_has_no_post(attestation_suite):
     # no post part in ANY form — a post.yaml containing `null` would read
     # as "expect success" to a reference-format client runner
     assert not any(d.glob("post.*"))
+
+
+def test_aggregate_sign_matches_per_key_path():
+    """keys.aggregate_sign must be bit-identical to the reference-shaped
+    per-key Sign + Aggregate loop (BLS linearity), including duplicates."""
+    from consensus_specs_tpu.crypto import bls
+    from consensus_specs_tpu.test_framework.keys import aggregate_sign
+
+    root = b"\x5a" * 32
+    for sks in ([7], [1, 2, 3], [5, 5, 9]):  # incl. a duplicated key
+        per_key = bls.Aggregate([bls.Sign(sk, root) for sk in sks])
+        assert aggregate_sign(sks, root) == per_key
+
+    prev = bls.bls_active
+    bls.bls_active = False
+    try:
+        assert aggregate_sign([1, 2], root) == bls.G2_POINT_AT_INFINITY
+    finally:
+        bls.bls_active = prev

@@ -15,7 +15,7 @@ DEVICE_TESTS = tests/test_bls_device.py tests/test_curve_device.py \
                tests/test_fq_device.py tests/test_sha256_device.py \
                tests/test_multichip.py
 
-.PHONY: test citest test-fast test-device lint docs generate_tests gen_% bench dryrun \
+.PHONY: test citest test-fast test-device test-mainnet lint docs generate_tests gen_% bench dryrun \
         detect_generator_incomplete clean-vectors help
 
 help:
@@ -43,8 +43,13 @@ test-fast:
 test-device:
 	$(PYTHON) -m pytest $(DEVICE_TESTS) -q
 
+test-mainnet:
+	$(PYTHON) -m pytest -q --preset=mainnet tests/spec/test_sanity_slots.py \
+		tests/spec/test_operations_attestation.py tests/spec/test_altair_sync_aggregate.py
+
 lint:
 	$(PYTHON) -m compileall -q consensus_specs_tpu tests tools bench.py __graft_entry__.py
+	$(PYTHON) tools/lint.py
 
 docs:
 	$(PYTHON) tools/gen_spec_docs.py

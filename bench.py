@@ -271,33 +271,208 @@ def bench_incremental_reroot():
 
 def bench_generation():
     """BASELINE config #5 (sliced): regenerate phase0-minimal
-    operations/attestation vectors, device backends on vs off."""
+    operations/attestation vectors, device path (batched-deferred BLS +
+    device hasher) vs the pure-host path."""
     from consensus_specs_tpu.generators.gen_from_tests import run_state_test_generators
     from consensus_specs_tpu.ops import sha256 as dev_hash
 
     mods = {"phase0": {"attestation": "tests.spec.test_operations_attestation"}}
 
-    def run_once(backend: str, device_hasher: bool) -> float:
+    # the widened config-#5 slice: five handlers' worth of real-BLS cases
+    # flushing through the same deferred batches (the scaling story —
+    # the per-flush dispatch amortizes across every case in a provider)
+    ops_mods = {
+        "phase0": {
+            "attestation": "tests.spec.test_operations_attestation",
+            "attester_slashing": "tests.spec.test_operations_attester_slashing",
+            "proposer_slashing": "tests.spec.test_operations_proposer_slashing",
+            "voluntary_exit": "tests.spec.test_operations_voluntary_exit",
+            "deposit": "tests.spec.test_operations_deposit",
+        }
+    }
+
+    def run_once(backend: str, device_hasher: bool, defer: bool, which=None) -> float:
         out = tempfile.mkdtemp(prefix=f"bench_gen_{backend}_")
+        saved = os.environ.get("CONSENSUS_SPECS_TPU_BLS_BACKEND")
         os.environ["CONSENSUS_SPECS_TPU_BLS_BACKEND"] = backend
         if device_hasher:
             dev_hash.use_device_hasher()
         try:
             t0 = time.perf_counter()
             run_state_test_generators(
-                "operations", mods, presets=("minimal",), args=["-o", out]
+                "operations", which if which is not None else mods, presets=("minimal",),
+                args=["-o", out] + (["--bls-defer"] if defer else []),
             )
             return time.perf_counter() - t0
         finally:
             if device_hasher:
                 dev_hash.use_host_hasher()
-            os.environ.pop("CONSENSUS_SPECS_TPU_BLS_BACKEND", None)
+            if saved is None:
+                os.environ.pop("CONSENSUS_SPECS_TPU_BLS_BACKEND", None)
+            else:
+                os.environ["CONSENSUS_SPECS_TPU_BLS_BACKEND"] = saved
             shutil.rmtree(out, ignore_errors=True)
 
     # warm-up pass compiles the device graphs (untimed), then timed passes
-    run_once("jax", True)
-    t_dev = run_once("jax", True)
-    t_host = run_once("reference", False)
+    run_once("jax", True, True)
+    t_dev = run_once("jax", True, True)
+    t_host = run_once("reference", False, False)
+    # widened slice: one timed run per path (graphs already warm)
+    t_dev_ops = run_once("jax", True, True, which=ops_mods)
+    t_host_ops = run_once("reference", False, False, which=ops_mods)
+    return t_dev, t_host, t_dev_ops, t_host_ops
+
+
+def _deferred_transition(spec, state, signed_block):
+    """Device-style block validation: run the transition with signature
+    checks deferred, flush ONCE as a batched device dispatch, and require
+    every optimistic answer to have been True (valid-block fast path; an
+    invalid block would re-run strictly — not the benchmarked case)."""
+    from consensus_specs_tpu.crypto import bls
+
+    v = bls.DeferredVerifier()
+    with bls.deferring(v):
+        spec.state_transition(state, signed_block)
+    v.flush()
+    assert all(v.results), "deferred transition: a signature check failed"
+
+
+def _block_with_attestations(spec, state):
+    """A signed mainnet block carrying MAX_ATTESTATIONS distinct
+    attestations (BASELINE config #3): previous-epoch slots, committee
+    index 0, varying participant subsets so every signature check is a
+    distinct (pubkeys, msg, sig) row."""
+    from consensus_specs_tpu.test_framework.attestations import (
+        build_attestation_data,
+        sign_aggregate_attestation,
+    )
+    from consensus_specs_tpu.test_framework.block import build_empty_block_for_next_slot
+    from consensus_specs_tpu.test_framework.block_processing import (
+        state_transition_and_sign_block,
+    )
+
+    rng = np.random.default_rng(7)
+    block = build_empty_block_for_next_slot(spec, state)
+    n_slots = int(spec.SLOTS_PER_EPOCH)
+    added = 0
+    while added < int(spec.MAX_ATTESTATIONS):
+        slot = state.slot - 1 - (added % (n_slots // 2))
+        data = build_attestation_data(spec, state, slot=slot, index=0)
+        committee = spec.get_beacon_committee(state, data.slot, data.index)
+        bits = spec.Bitlist[spec.MAX_VALIDATORS_PER_COMMITTEE]([0] * len(committee))
+        # distinct non-empty participant subset per attestation
+        participants = [
+            i for i in range(len(committee)) if rng.integers(0, 2) or i == added % len(committee)
+        ]
+        for i in participants:
+            bits[i] = True
+        att = spec.Attestation(aggregation_bits=bits, data=data)
+        att.signature = sign_aggregate_attestation(
+            spec, state, data, [committee[i] for i in participants]
+        )
+        block.body.attestations.append(att)
+        added += 1
+    # the construction-time transition (state-root computation) would pay
+    # all 128 checks synchronously; defer them — every signature here is
+    # valid by construction, so the optimistic answers are the truth
+    from consensus_specs_tpu.crypto import bls
+
+    with bls.deferring(bls.DeferredVerifier()):
+        return state_transition_and_sign_block(spec, state.copy(), block)
+
+
+def bench_block_mainnet():
+    """BASELINE config #3: full mainnet-preset state_transition of a block
+    carrying 128 attestation aggregate checks — synchronous host BLS vs
+    the deferred single-flush device path. One warmup (compiles) + one
+    timed run per path (cold inputs both times)."""
+    from consensus_specs_tpu.crypto import bls
+    from consensus_specs_tpu.specs.build import build_spec
+    from consensus_specs_tpu.test_framework.context import (
+        _prepare_state,
+        default_activation_threshold,
+        default_balances,
+    )
+    from consensus_specs_tpu.test_framework.state import next_epoch
+
+    spec = build_spec("phase0", "mainnet")
+    bls.bls_active = False
+    base = _prepare_state(default_balances, default_activation_threshold, spec).copy()
+    next_epoch(spec, base)
+    next_epoch(spec, base)
+    bls.bls_active = True
+
+    signed_block = _block_with_attestations(spec, base)
+
+    bls.use_jax()
+    try:
+        _deferred_transition(spec, base.copy(), signed_block)  # warmup/compiles
+        t0 = time.perf_counter()
+        _deferred_transition(spec, base.copy(), signed_block)
+        t_dev = time.perf_counter() - t0
+    finally:
+        bls.use_reference()
+
+    t0 = time.perf_counter()
+    spec.state_transition(base.copy(), signed_block)
+    t_host = time.perf_counter() - t0
+    return t_dev, t_host
+
+
+def bench_sync_aggregate_mainnet():
+    """BASELINE config #4: altair-mainnet process_sync_aggregate with the
+    512-key sync committee — host vs deferred-flush device."""
+    from consensus_specs_tpu.crypto import bls
+    from consensus_specs_tpu.specs.build import build_spec
+    from consensus_specs_tpu.test_framework.block import build_empty_block_for_next_slot
+    from consensus_specs_tpu.test_framework.context import (
+        _prepare_state,
+        default_activation_threshold,
+        default_balances,
+    )
+    from consensus_specs_tpu.test_framework.sync_committee import (
+        compute_aggregate_sync_committee_signature,
+        compute_committee_indices,
+    )
+    from consensus_specs_tpu.test_framework.state import next_slot, transition_to
+
+    spec = build_spec("altair", "mainnet")
+    bls.bls_active = False
+    state = _prepare_state(default_balances, default_activation_threshold, spec).copy()
+    next_slot(spec, state)
+    bls.bls_active = True
+
+    committee_indices = compute_committee_indices(spec, state)
+    assert len(committee_indices) == int(spec.SYNC_COMMITTEE_SIZE)
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=[True] * len(committee_indices),
+        sync_committee_signature=compute_aggregate_sync_committee_signature(
+            spec, state, block.slot - 1, committee_indices
+        ),
+    )
+    transition_to(spec, state, block.slot)
+
+    def run_sync(deferred: bool) -> float:
+        work = state.copy()
+        t0 = time.perf_counter()
+        if deferred:
+            v = bls.DeferredVerifier()
+            with bls.deferring(v):
+                spec.process_sync_aggregate(work, block.body.sync_aggregate)
+            v.flush()
+            assert all(v.results)
+        else:
+            spec.process_sync_aggregate(work, block.body.sync_aggregate)
+        return time.perf_counter() - t0
+
+    bls.use_jax()
+    try:
+        run_sync(True)  # warmup/compiles (k=512 bucket)
+        t_dev = run_sync(True)
+    finally:
+        bls.use_reference()
+    t_host = run_sync(False)
     return t_dev, t_host
 
 
@@ -323,8 +498,14 @@ def main() -> None:
     _note("bench: bls (cold + warm) ...")
     cold_rate, warm_rate, host_rate = bench_bls()
     _note(f"bench: bls done cold={cold_rate:.2f}/s warm={warm_rate:.2f}/s host={host_rate:.3f}/s")
+    _note("bench: config #3 (mainnet block, 128 atts) ...")
+    blk_dev, blk_host = bench_block_mainnet()
+    _note(f"bench: config #3 done dev={blk_dev:.2f}s host={blk_host:.2f}s")
+    _note("bench: config #4 (512-key sync aggregate) ...")
+    sa_dev, sa_host = bench_sync_aggregate_mainnet()
+    _note(f"bench: config #4 done dev={sa_dev:.2f}s host={sa_host:.2f}s")
     _note("bench: e2e generation ...")
-    t_dev, t_host = bench_generation()
+    t_dev, t_host, t_dev_ops, t_host_ops = bench_generation()
     print(
         json.dumps(
             {
@@ -342,9 +523,18 @@ def main() -> None:
                 "hash_pallas_mibs": round(pallas_mbs, 2) if pallas_mbs else None,
                 "hash_pallas_status": pallas["status"],
                 "incremental_reroot_ms": round(reroot_ms, 3),
+                "block_128atts_mainnet_device_s": round(blk_dev, 2),
+                "block_128atts_mainnet_host_s": round(blk_host, 2),
+                "block_128atts_speedup": round(blk_host / blk_dev, 2) if blk_dev else None,
+                "sync_aggregate_512_device_s": round(sa_dev, 3),
+                "sync_aggregate_512_host_s": round(sa_host, 3),
+                "sync_aggregate_512_speedup": round(sa_host / sa_dev, 2) if sa_dev else None,
                 "gen_attestation_suite_device_s": round(t_dev, 2),
                 "gen_attestation_suite_host_s": round(t_host, 2),
                 "gen_suite_speedup": round(t_host / t_dev, 2) if t_dev else None,
+                "gen_operations_suite_device_s": round(t_dev_ops, 2),
+                "gen_operations_suite_host_s": round(t_host_ops, 2),
+                "gen_operations_speedup": round(t_host_ops / t_dev_ops, 2) if t_dev_ops else None,
             }
         )
     )

@@ -9,10 +9,24 @@ Backends:
 `bls_active` kill-switch + `only_with_bls` decorator mirror the
 reference's test-speed escape hatch (utils/bls.py:33-44): signature
 checks are skipped wholesale when off.
+
+Deferred verification (TPU-first addition, no reference analog): the
+boolean Verify family can run in three modes —
+  - normal: synchronous backend call;
+  - deferring: the check is RECORDED and answered optimistically (True),
+    so a whole workload's checks accumulate and later flush as ONE
+    batched device dispatch (DeferredVerifier.flush) instead of paying
+    the fixed per-dispatch latency per call;
+  - replaying: checks are answered from a flushed truth table, so a
+    consumer that must re-run a workload item whose optimistic answer
+    was wrong (the signature was actually invalid) replays it with the
+    true answers at zero crypto cost.
+The vector generator drives this (generators/gen_runner.py --bls-defer).
 """
 from __future__ import annotations
 
-from typing import Optional, Sequence
+import contextlib
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from . import ciphersuite as _reference
 
@@ -48,6 +62,111 @@ def backend_name() -> str:
     return _backend_name
 
 
+_defer: Optional["DeferredVerifier"] = None
+_replay: Optional[Dict[tuple, bool]] = None
+
+
+class DeferredVerifier:
+    """Records Verify-family checks while installed (see `deferring`),
+    then resolves them all in `flush()` — batched through the active
+    backend's cold batch pipeline when it has one (ops/bls_jax), scalar
+    otherwise. After flush, `table()` maps each recorded check key to
+    its true result for use with `replaying`."""
+
+    def __init__(self) -> None:
+        self.entries: List[tuple] = []
+        self.results: List[bool] = []  # grows at flush; aligned with entries
+
+    def record(self, key: tuple) -> bool:
+        self.entries.append(key)
+        return True
+
+    def mark(self) -> int:
+        """Current queue position — bracket a workload item with two
+        marks to later ask `all_true(m0, m1)`."""
+        return len(self.entries)
+
+    def all_true(self, start: int, end: int) -> bool:
+        assert end <= len(self.results), "flush() the queue first"
+        return all(self.results[start:end])
+
+    def table(self) -> Dict[tuple, bool]:
+        return dict(zip(self.entries, self.results))
+
+    def flush(self) -> None:
+        """Resolve every still-pending check. Duplicate keys (the same
+        check recorded by several workload items — pure function of the
+        key) resolve once; the unique Verify/FastAggregateVerify
+        population goes through one batched device dispatch (they share
+        the 2-pairing row shape). AggregateVerify resolves scalar (it
+        never appears in spec-level state-transition code)."""
+        todo = self.entries[len(self.results):]
+        if not todo:
+            return
+        unique: Dict[tuple, Optional[bool]] = dict.fromkeys(todo)
+
+        batch_rows = []  # (key, pubkey_list, message, signature)
+        for key in unique:
+            kind = key[0]
+            if kind == "v":
+                _, pk, msg, sig = key
+                batch_rows.append((key, [pk], msg, sig))
+            elif kind == "fav":
+                _, pks, msg, sig = key
+                batch_rows.append((key, list(pks), msg, sig))
+            else:  # "av"
+                _, pks, msgs, sig = key
+                try:
+                    unique[key] = bool(_backend.AggregateVerify(list(pks), list(msgs), sig))
+                except Exception:
+                    unique[key] = False
+
+        if batch_rows:
+            cold = getattr(_backend, "fast_aggregate_verify_batch_cold", None)
+            if cold is not None:
+                ok = cold(
+                    [r[1] for r in batch_rows],
+                    [r[2] for r in batch_rows],
+                    [r[3] for r in batch_rows],
+                )
+                for (key, _, _, _), o in zip(batch_rows, ok):
+                    unique[key] = bool(o)
+            else:
+                for key, pks, msg, sig in batch_rows:
+                    try:
+                        unique[key] = bool(_backend.FastAggregateVerify(pks, msg, sig))
+                    except Exception:
+                        unique[key] = False
+
+        out = [unique[key] for key in todo]
+        assert all(o is not None for o in out)
+        self.results.extend(out)  # type: ignore[arg-type]
+
+
+@contextlib.contextmanager
+def deferring(verifier: DeferredVerifier):
+    """Install `verifier`: Verify-family calls record + return True."""
+    global _defer
+    prev, _defer = _defer, verifier
+    try:
+        yield verifier
+    finally:
+        _defer = prev
+
+
+@contextlib.contextmanager
+def replaying(table: Dict[tuple, bool]):
+    """Answer Verify-family calls from a flushed truth table; checks not
+    in the table (control flow diverged from the deferred run) fall
+    through to the synchronous backend."""
+    global _replay
+    prev, _replay = _replay, table
+    try:
+        yield
+    finally:
+        _replay = prev
+
+
 def only_with_bls(alt_return=None):
     """Decorator: skip the wrapped check (returning `alt_return`) when
     bls_active is False (utils/bls.py:37-44)."""
@@ -66,6 +185,11 @@ def only_with_bls(alt_return=None):
 
 @only_with_bls(alt_return=True)
 def Verify(pubkey: bytes, message: bytes, signature: bytes) -> bool:
+    key = ("v", bytes(pubkey), bytes(message), bytes(signature))
+    if _defer is not None:
+        return _defer.record(key)
+    if _replay is not None and key in _replay:
+        return _replay[key]
     try:
         return _backend.Verify(pubkey, message, signature)
     except Exception:
@@ -74,6 +198,16 @@ def Verify(pubkey: bytes, message: bytes, signature: bytes) -> bool:
 
 @only_with_bls(alt_return=True)
 def AggregateVerify(pubkeys: Sequence[bytes], messages: Sequence[bytes], signature: bytes) -> bool:
+    key = (
+        "av",
+        tuple(bytes(p) for p in pubkeys),
+        tuple(bytes(m) for m in messages),
+        bytes(signature),
+    )
+    if _defer is not None:
+        return _defer.record(key)
+    if _replay is not None and key in _replay:
+        return _replay[key]
     try:
         return _backend.AggregateVerify(pubkeys, messages, signature)
     except Exception:
@@ -82,6 +216,11 @@ def AggregateVerify(pubkeys: Sequence[bytes], messages: Sequence[bytes], signatu
 
 @only_with_bls(alt_return=True)
 def FastAggregateVerify(pubkeys: Sequence[bytes], message: bytes, signature: bytes) -> bool:
+    key = ("fav", tuple(bytes(p) for p in pubkeys), bytes(message), bytes(signature))
+    if _defer is not None:
+        return _defer.record(key)
+    if _replay is not None and key in _replay:
+        return _replay[key]
     try:
         return _backend.FastAggregateVerify(pubkeys, message, signature)
     except Exception:

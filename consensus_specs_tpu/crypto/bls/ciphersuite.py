@@ -8,8 +8,7 @@ keys.
 """
 from __future__ import annotations
 
-import hashlib
-from typing import List, Optional, Sequence
+from typing import Sequence
 
 from .curve import (
     DeserializationError,
@@ -23,7 +22,7 @@ from .curve import (
     g2_to_bytes,
 )
 from .fields import R
-from .hash_to_curve import DST_G2_POP, hash_to_g2
+from .hash_to_curve import hash_to_g2
 from .pairing import FQ12_ONE, miller_loop, final_exponentiation
 
 G2_POINT_AT_INFINITY = b"\xc0" + b"\x00" * 95

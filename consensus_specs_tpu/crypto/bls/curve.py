@@ -7,7 +7,7 @@ C/I/S flag bits in the top three bits of the first byte.
 """
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Tuple
 
 from .fields import FQ2_ONE, FQ2_ZERO, FQ_ONE, FQ_ZERO, Fq, Fq2, P, R
 

@@ -11,7 +11,7 @@ from __future__ import annotations
 import hashlib
 from typing import List, Tuple
 
-from .curve import B2, Point, g2_point
+from .curve import Point, g2_point
 from .fields import FQ2_ONE, Fq2, P
 
 # eth2 ciphersuite DST (proof-of-possession scheme)
@@ -159,9 +159,55 @@ def iso_map_g2(x: Fq2, y: Fq2) -> Tuple[Fq2, Fq2]:
 # RFC 9380 §8.8.2 h_eff for G2
 H_EFF = 0xBC69F08F2EE75B3584C6A0EA91B352888E2A8E9145AD7689986FF031508FFE1329C2F178731DB956D82BF015D1212B02EC0EC69D7477C1AE954CBC06689F6A359894C0ADEBBF6B4E8020005AAA95551
 
+# psi-endomorphism decomposition (Budroni–Pintore): on E'(Fq2),
+#   [h_eff]Q == [x^2-x-1]Q + [x-1]psi(Q) + psi2([2]Q)
+# with the (negative) BLS parameter x = -X_ABS. Two 64-bit ladders
+# instead of one 636-bit ladder (~4x fewer point ops); the exact
+# equality with the RFC h_eff ladder is pinned by
+# tests/test_bls.py::test_clear_cofactor_psi_equals_h_eff and by the
+# RFC 9380 G2 suite known-answer vectors. The device kernel implements
+# the identical staging (ops/h2c_jax.py:122-141).
+X_ABS = 0xD201000000010000
+
+# psi(x, y) = (conj(x)*PSI_CX, conj(y)*PSI_CY) with the twist constants
+# (u+1)^-((p-1)/3), (u+1)^-((p-1)/2) (same derivation as
+# ops/curve_jax.py:_compute_endo_constants, pinned there against
+# psi(G2) == [x]G2 at import).
+_PSI_CX = Fq2(1, 1).pow((P - 1) // 3).inv()
+_PSI_CY = Fq2(1, 1).pow((P - 1) // 2).inv()
+_PSI2_CX = _PSI_CX.conjugate() * _PSI_CX
+_PSI2_CY = _PSI_CY.conjugate() * _PSI_CY
+
+
+def psi(p: Point) -> Point:
+    """Twist-Frobenius endomorphism on Jacobian coords: conjugation
+    commutes with the Jacobian scaling, so conjugate all three
+    coordinates and apply the affine constants to X and Y."""
+    return p._make(
+        p.x.conjugate() * _PSI_CX,
+        p.y.conjugate() * _PSI_CY,
+        p.z.conjugate(),
+    )
+
+
+def psi2(p: Point) -> Point:
+    """psi twice: the conjugations cancel, the constants fold."""
+    return p._make(p.x * _PSI2_CX, p.y * _PSI2_CY, p.z)
+
+
+def _mul_by_x(p: Point) -> Point:
+    """[x]P for the negative BLS parameter: -[|x|]P."""
+    return p.mul(X_ABS).neg()
+
 
 def clear_cofactor(p: Point) -> Point:
-    return p.mul(H_EFF)
+    # [x^2-x-1]Q + [x-1]psi(Q) + psi2(2Q)
+    #   = psi2(2Q) + [x](t1 + t2) - t1 - t2 - Q,  t1 = [x]Q, t2 = psi(Q)
+    t1 = _mul_by_x(p)
+    t2 = psi(p)
+    acc = psi2(p.double()).add(_mul_by_x(t1.add(t2)))
+    acc = acc.add(t1.neg()).add(t2.neg())
+    return acc.add(p.neg())
 
 
 # --- top level --------------------------------------------------------------

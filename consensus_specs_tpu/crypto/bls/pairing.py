@@ -11,7 +11,7 @@ all pairs — the shape both `Verify` (2 pairs) and `AggregateVerify`
 """
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple
+from typing import Sequence, Tuple
 
 from .curve import Point
 from .fields import FQ12_ONE, Fq2, Fq6, Fq12, FQ2_ONE, FQ2_ZERO, FQ6_ZERO, P, R, X

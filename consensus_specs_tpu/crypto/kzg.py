@@ -25,10 +25,8 @@ from .bls.curve import (
     g1_generator,
     g1_infinity,
     g1_to_bytes,
-    g2_from_bytes,
     g2_generator,
     g2_infinity,
-    g2_to_bytes,
 )
 from .bls.pairing import pairing_product
 

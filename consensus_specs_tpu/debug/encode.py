@@ -2,14 +2,10 @@
 from __future__ import annotations
 
 from consensus_specs_tpu.ssz.types import (
-    Bitlist,
-    Bitvector,
     ByteList,
     ByteVector,
     Container,
-    List,
     Union,
-    Vector,
     boolean,
     uint,
     _BitsBase,

@@ -1,5 +1,14 @@
 """Vector-writing runner: CLI, case directories, INCOMPLETE sentinel
 lifecycle, resume, error log (ref: gen_helpers/gen_base/gen_runner.py).
+
+Deferred-BLS mode (--bls-defer, TPU-first addition): cases run with the
+facade's DeferredVerifier installed, so every signature check records
+and returns optimistically instead of dispatching; a whole provider's
+checks then flush as ONE batched device call. Cases whose optimistic
+answers were all genuinely True commit their buffered parts untouched;
+the rest replay against the flushed truth table at zero crypto cost.
+Output bytes are identical to the synchronous path by construction —
+pinned by tests/test_gen_defer.py.
 """
 from __future__ import annotations
 
@@ -9,7 +18,7 @@ import shutil
 import time
 import traceback
 from pathlib import Path
-from typing import Iterable
+from typing import Iterable, List, Tuple
 
 import yaml
 
@@ -22,12 +31,81 @@ from .gen_typing import TestCase, TestProvider
 
 TIME_THRESHOLD_TO_PRINT = 1.0  # seconds
 
+# bound deferred-case buffering (parts are already-encoded bytes; this is
+# a memory bound, not a dispatch bound — one flush still covers a batch)
+DEFER_FLUSH_EVERY = 256
+
 
 def validate_output_dir(path_str: str) -> Path:
     path = Path(path_str)
     if path.exists() and not path.is_dir():
         raise argparse.ArgumentTypeError(f"Output path must be a directory: {path}")
     return path
+
+
+def _encode_parts(raw_parts) -> Tuple[List[Tuple[str, str, object]], dict]:
+    """Materialize a case's yielded parts into write-ready form:
+    ssz → snappy-framed bytes, data → jsonable structures, meta → dict.
+    Runs INSIDE the case execution window so buffered commits are
+    byte-stable regardless of later mutation or replay."""
+    from consensus_specs_tpu.debug.encode import encode
+
+    encoded: List[Tuple[str, str, object]] = []
+    meta: dict = {}
+    for (name, kind, data) in raw_parts:
+        if kind == "meta":
+            meta[name] = data
+        elif kind == "ssz":
+            raw = bytes(data.encode_bytes()) if isinstance(data, SSZType) else bytes(data)
+            encoded.append((name, "ssz", snappy.compress(raw)))
+        elif kind == "data":
+            encoded.append((name, "data", encode(data) if isinstance(data, SSZType) else data))
+        else:
+            raise ValueError(f"unknown part kind {kind!r}")
+    return encoded, meta
+
+
+def _write_case(case_dir: Path, encoded: List[Tuple[str, str, object]], meta: dict) -> int:
+    """Write encoded parts under the INCOMPLETE sentinel; returns the
+    number of parts written (0 ⇒ caller removes the empty case dir)."""
+    case_dir.mkdir(parents=True, exist_ok=True)
+    incomplete_tag_file = case_dir / "INCOMPLETE"
+    incomplete_tag_file.touch()
+
+    written_parts = 0
+    for (name, kind, payload) in encoded:
+        written_parts += 1
+        if kind == "ssz":
+            (case_dir / f"{name}.ssz_snappy").write_bytes(payload)
+        else:
+            with open(case_dir / f"{name}.yaml", "w") as f:
+                yaml.safe_dump(payload, f, default_flow_style=None)
+    if len(meta) != 0:
+        written_parts += 1
+        with open(case_dir / "meta.yaml", "w") as f:
+            yaml.safe_dump(meta, f, default_flow_style=None)
+
+    if written_parts == 0:
+        print(f"test case {case_dir} did not produce any parts, removing")
+        shutil.rmtree(case_dir)
+    else:
+        incomplete_tag_file.unlink()
+    return written_parts
+
+
+class _CaseOutcome:
+    """One deferred case awaiting its flush verdict."""
+
+    __slots__ = ("test_case", "case_dir", "encoded", "meta", "error", "marks", "start")
+
+    def __init__(self, test_case, case_dir, encoded, meta, error, marks, start):
+        self.test_case = test_case
+        self.case_dir = case_dir
+        self.encoded = encoded
+        self.meta = meta
+        self.error = error
+        self.marks = marks
+        self.start = start
 
 
 def run_generator(generator_name: str, test_providers: Iterable[TestProvider], args=None) -> None:
@@ -49,18 +127,107 @@ def run_generator(generator_name: str, test_providers: Iterable[TestProvider], a
     parser.add_argument("--profile", action="store_true", default=False,
                         help="per-handler wall-clock accounting + JAX device trace "
                              "(trace emitted when CONSENSUS_SPECS_TPU_TRACE_DIR is set)")
+    parser.add_argument("--bls-defer", action="store_true",
+                        default=_defer_default(),
+                        help="batch signature checks across cases: run each case "
+                             "optimistically, flush all checks as one device "
+                             "dispatch, replay only mispredicted cases "
+                             "(default: CONSENSUS_SPECS_TPU_BLS_DEFER env)")
 
     ns = parser.parse_args(args=args)
 
     output_dir: Path = ns.output_dir
     log_file = output_dir / "testgen_error_log.txt"
 
-    generated = skipped = failed = 0
+    counts = {"generated": 0, "skipped": 0, "failed": 0}
     collected = 0
+
+    def record_failure(case_dir: Path, err: str) -> None:
+        counts["failed"] += 1
+        print(f"ERROR in {case_dir}:\n{err}")
+        # leave an INCOMPLETE-marked dir so detect_generator_incomplete
+        # (and a -f rerun) sees the failed case
+        case_dir.mkdir(parents=True, exist_ok=True)
+        (case_dir / "INCOMPLETE").touch()
+        output_dir.mkdir(parents=True, exist_ok=True)
+        with open(log_file, "a") as f:
+            f.write(f"\n--- {case_dir} ---\n{err}\n")
+
+    def commit(case_dir: Path, encoded, meta, start: float) -> None:
+        if _write_case(case_dir, encoded, meta) == 0:
+            return
+        counts["generated"] += 1
+        elapsed = time.time() - start
+        if elapsed >= TIME_THRESHOLD_TO_PRINT:
+            print(f"  done in {elapsed:.2f}s")
+
+    verifier = None
+    if ns.bls_defer and not ns.collect_only:
+        from consensus_specs_tpu.crypto import bls
+
+        verifier = bls.DeferredVerifier()
+
+    def run_case_deferred(test_case: TestCase, case_dir: Path, start: float):
+        """Execute under deferral, buffering encoded parts. Commits
+        immediately when the case recorded no checks; otherwise returns a
+        _CaseOutcome for the flush to adjudicate."""
+        from consensus_specs_tpu.crypto import bls
+
+        m0 = verifier.mark()
+        encoded, meta, error = None, None, None
+        try:
+            with bls.deferring(verifier):
+                encoded, meta = _encode_parts(test_case.case_fn())
+        except SkippedTest as e:
+            error = e
+        except Exception:
+            error = traceback.format_exc()
+        m1 = verifier.mark()
+
+        if m0 == m1:  # no signature checks: verdict already final
+            finalize_case(case_dir, encoded, meta, error, start)
+            return None
+        return _CaseOutcome(test_case, case_dir, encoded, meta, error, (m0, m1), start)
+
+    def finalize_case(case_dir, encoded, meta, error, start) -> None:
+        if isinstance(error, SkippedTest):
+            print(f"skipped: {error}")
+            counts["skipped"] += 1
+        elif error is not None:
+            record_failure(case_dir, error)
+        else:
+            commit(case_dir, encoded, meta, start)
+
+    def flush_pending(pending: List[_CaseOutcome]) -> None:
+        """One batched dispatch for every recorded check, then commit the
+        correctly-predicted cases and replay the rest."""
+        from consensus_specs_tpu.crypto import bls
+
+        if not pending:
+            return
+        verifier.flush()
+        table = verifier.table()
+        for p in pending:
+            if p.error is None and verifier.all_true(*p.marks):
+                commit(p.case_dir, p.encoded, p.meta, p.start)
+                continue
+            # misprediction (or an error that may stem from one): replay
+            # with true answers — pure-Python re-run, no crypto
+            encoded, meta, error = None, None, None
+            try:
+                with bls.replaying(table):
+                    encoded, meta = _encode_parts(p.test_case.case_fn())
+            except SkippedTest as e:
+                error = e
+            except Exception:
+                error = traceback.format_exc()
+            finalize_case(p.case_dir, encoded, meta, error, p.start)
+        pending.clear()
 
     with (profiling.trace(generator_name) if ns.profile else contextlib.nullcontext()):
       for provider in test_providers:
         provider.prepare()
+        pending: List[_CaseOutcome] = []
 
         for test_case in provider.make_cases():
             if ns.preset_list is not None and test_case.preset_name not in ns.preset_list:
@@ -75,80 +242,53 @@ def run_generator(generator_name: str, test_providers: Iterable[TestProvider], a
 
             if case_dir.exists():
                 if not ns.force and not incomplete_tag_file.exists():
-                    skipped += 1
+                    counts["skipped"] += 1
                     continue
                 shutil.rmtree(case_dir)
 
             print(f"generating: {case_dir}")
-            written_parts = 0
+            start = time.time()
             profile_ctx = (
                 profiling.section(f"{test_case.runner_name}/{test_case.handler_name}")
                 if ns.profile
                 else contextlib.nullcontext()
             )
-            try:
-                case_dir.mkdir(parents=True, exist_ok=True)
-                start = time.time()
-                # sentinel first: a crash leaves the case marked incomplete
-                incomplete_tag_file.touch()
-
-                meta = {}
-                if ns.profile:
-                    with profile_ctx:
-                        parts = list(test_case.case_fn())
+            with profile_ctx:
+                if verifier is not None:
+                    outcome = run_case_deferred(test_case, case_dir, start)
+                    if outcome is not None:
+                        pending.append(outcome)
+                        if len(pending) >= DEFER_FLUSH_EVERY:
+                            flush_pending(pending)
                 else:
-                    parts = test_case.case_fn()
-                for (name, kind, data) in parts:
-                    if kind == "meta":
-                        meta[name] = data
-                        continue
-                    written_parts += 1
-                    if kind == "ssz":
-                        raw = bytes(data.encode_bytes()) if isinstance(data, SSZType) else bytes(data)
-                        (case_dir / f"{name}.ssz_snappy").write_bytes(snappy.compress(raw))
-                    elif kind == "data":
-                        from consensus_specs_tpu.debug.encode import encode
+                    encoded, meta, error = None, None, None
+                    try:
+                        encoded, meta = _encode_parts(test_case.case_fn())
+                    except SkippedTest as e:
+                        error = e
+                    except Exception:
+                        error = traceback.format_exc()
+                    finalize_case(case_dir, encoded, meta, error, start)
 
-                        out_data = encode(data) if isinstance(data, SSZType) else data
-                        with open(case_dir / f"{name}.yaml", "w") as f:
-                            yaml.safe_dump(out_data, f, default_flow_style=None)
-                    else:
-                        raise ValueError(f"unknown part kind {kind!r}")
-
-                if len(meta) != 0:
-                    written_parts += 1
-                    with open(case_dir / "meta.yaml", "w") as f:
-                        yaml.safe_dump(meta, f, default_flow_style=None)
-
-                if written_parts == 0:
-                    print(f"test case {case_dir} did not produce any parts, removing")
-                    shutil.rmtree(case_dir)
-                    continue
-
-                incomplete_tag_file.unlink()
-                generated += 1
-                elapsed = time.time() - start
-                if elapsed >= TIME_THRESHOLD_TO_PRINT:
-                    print(f"  done in {elapsed:.2f}s")
-            except SkippedTest as e:
-                print(f"skipped: {e}")
-                skipped += 1
-                if case_dir.exists():
-                    shutil.rmtree(case_dir)
-            except Exception:
-                failed += 1
-                err = traceback.format_exc()
-                print(f"ERROR in {case_dir}:\n{err}")
-                output_dir.mkdir(parents=True, exist_ok=True)
-                with open(log_file, "a") as f:
-                    f.write(f"\n--- {case_dir} ---\n{err}\n")
+        if verifier is not None:
+            flush_pending(pending)
 
     if ns.collect_only:
         print(f"collected {collected} test cases")
     else:
-        summary = f"completed generation of {generator_name}: {generated} generated, {skipped} skipped, {failed} failed"
+        summary = (
+            f"completed generation of {generator_name}: "
+            f"{counts['generated']} generated, {counts['skipped']} skipped, "
+            f"{counts['failed']} failed"
+        )
         print(summary)
         if ns.profile:
             profiling.print_report(header="per-handler wall clock:")
-        if failed:
+        if counts["failed"]:
             raise SystemExit(1)
+
+
+def _defer_default() -> bool:
+    import os
+
+    return os.environ.get("CONSENSUS_SPECS_TPU_BLS_DEFER", "") not in ("", "0", "false")

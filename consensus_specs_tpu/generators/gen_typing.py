@@ -1,8 +1,8 @@
 """Generator datatypes (ref: gen_helpers/gen_base/gen_typing.py)."""
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import Any, Callable, Iterable, List, Tuple
+from dataclasses import dataclass
+from typing import Any, Callable, Iterable, Tuple
 
 # (name, kind, data) where kind in {"meta", "data", "ssz"}
 TestCasePart = Tuple[str, str, Any]

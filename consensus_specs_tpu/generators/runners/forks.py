@@ -5,7 +5,7 @@ import importlib
 
 from ..gen_from_tests import generate_from_tests
 from ..gen_runner import run_generator
-from ..gen_typing import TestCase, TestProvider
+from ..gen_typing import TestProvider
 
 # post-fork name -> (pre-fork phase, test module)
 FORK_TESTS = {

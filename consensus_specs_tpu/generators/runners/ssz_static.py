@@ -58,19 +58,24 @@ def create_provider(fork_name, preset_name, seed, mode, chaos, count):
 
 
 def run(args=None):
+    # reference-scale matrix (ref ssz_static/main.py:74-84): every
+    # randomization mode on minimal at count 30, a chaos setting at 30,
+    # and a mainnet random slice at 5; non-changing modes (zero/max/
+    # nil/one/lengthy-with-fixed-shapes) collapse to a single case
     settings = []
     seed = 1
-    for mode in (RandomizationMode.mode_random, RandomizationMode.mode_zero, RandomizationMode.mode_max):
-        settings.append((seed, "minimal", mode, False, 3))
+    for mode in RandomizationMode:
+        settings.append((seed, "minimal", mode, False, 30))
         seed += 1
-    settings.append((seed, "minimal", RandomizationMode.mode_random, True, 2))
+    settings.append((seed, "minimal", RandomizationMode.mode_random, True, 30))
     seed += 1
-    settings.append((seed, "mainnet", RandomizationMode.mode_random, False, 1))
+    settings.append((seed, "mainnet", RandomizationMode.mode_random, False, 5))
     seed += 1
 
     providers = []
     for fork in available_forks():
-        for (seed, preset, mode, chaos, count) in settings:
+        for (seed, preset, mode, chaos, cases_if_random) in settings:
+            count = cases_if_random if chaos or mode.is_changing() else 1
             providers.append(create_provider(fork, preset, seed, mode, chaos, count))
     run_generator("ssz_static", providers, args=args)
 

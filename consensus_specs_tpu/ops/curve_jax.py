@@ -339,7 +339,7 @@ def fq2_sqrt(a):
     one2 = FQ2.one(a.shape[:-2])
     minus_one = fq.neg(one2)
     is_m1 = FQ2.is_zero(fq.sub(alpha, minus_one))
-    u_lane = jnp.broadcast_to(jnp.asarray(tower.fq2_to_limbs_mont(hf.Fq2(0, 1))), a.shape)
+    u_lane = jnp.broadcast_to(jnp.asarray(_FQ2_U), a.shape)
     x_m1 = tower.fq2_mul(u_lane, x0)
     b = fq2_pow_bits(fq.add(one2, alpha), _FQ_LEGENDRE_BITS)
     x_gen = tower.fq2_mul(b, x0)

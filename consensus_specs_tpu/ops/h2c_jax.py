@@ -13,7 +13,9 @@ here the whole pipeline after expand_message_xmd runs as one batched jit:
           -> cofactor clearing via the psi-endomorphism decomposition
              [x^2-x-1]Q + [x-1]psi(Q) + psi2(2Q)  (Budroni-Pintore),
              two 64-bit ladders instead of a 636-bit h_eff ladder;
-             asserted equal to the host [h_eff]Q at import time
+             equality with the host oracle (which itself pins the psi
+             path against the RFC [h_eff]Q ladder, tests/test_bls.py)
+             is pinned by tests/test_h2c_device.py
           -> batched affine conversion
 
 Outputs affine Montgomery limb arrays that feed ops/pairing_jax.py
@@ -21,7 +23,7 @@ directly — the hashed points never round-trip through host Python.
 """
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+from typing import Sequence
 
 import jax.numpy as jnp
 import numpy as np
@@ -34,7 +36,6 @@ from ..crypto.bls.hash_to_curve import (
     _YDEN,
     _YNUM,
     expand_message_xmd,
-    hash_to_g2 as host_hash_to_g2,
 )
 from . import curve_jax as cj, fq, tower
 
@@ -117,28 +118,6 @@ def iso_map_g2(x, y):
     X = tower.fq2_mul(xn, tower.fq2_mul(xd, yd2))
     Y = tower.fq2_mul(tower.fq2_mul(y, yn), tower.fq2_mul(tower.fq2_mul(xd2, xd), yd2))
     return (X, Y, z)
-
-
-def clear_cofactor(q):
-    """Psi-endomorphism cofactor clearing (Budroni-Pintore):
-      [x^2-x-1]Q + [x-1]psi(Q) + psi2([2]Q)
-    = psi2(2Q) + [x](t1 + t2) - t1 - t2 - Q,  t1 = [x]Q, t2 = psi(Q)
-    with [x]P = -[|x|]P (the BLS parameter is negative). Exactly equals
-    the RFC 9380 [h_eff]Q ladder — asserted at import."""
-
-    def mul_by_x(p):
-        return cj.jac_neg(cj.FQ2, cj.scalar_mul_static(cj.FQ2, p, cj.X_PARAM))
-
-    t1 = mul_by_x(q)
-    t2 = cj.psi(q)
-    acc = cj.jac_add(
-        cj.FQ2,
-        cj.psi2(cj.jac_double(cj.FQ2, q)),
-        mul_by_x(cj.jac_add(cj.FQ2, t1, t2)),
-    )
-    acc = cj.jac_add(cj.FQ2, acc, cj.jac_neg(cj.FQ2, t1))
-    acc = cj.jac_add(cj.FQ2, acc, cj.jac_neg(cj.FQ2, t2))
-    return cj.jac_add(cj.FQ2, acc, cj.jac_neg(cj.FQ2, q))
 
 
 def _sswu_iso(u_pairs):
@@ -278,18 +257,3 @@ def hash_to_g2_batch(messages: Sequence[bytes], dst: bytes = DST_G2_POP):
     u = messages_to_field_limbs(padded, dst)
     qx, qy = hash_to_g2_jit()(jnp.asarray(u))
     return qx[:n], qy[:n]
-
-
-# -- import-time self-check ---------------------------------------------------
-
-def _self_check():  # pragma: no cover - exercised by tests explicitly too
-    """Pin the cofactor decomposition numerically against the host
-    [h_eff] ladder on one real hashed point (cheap: runs the tiny (1,)
-    batch through the jit once at first use, not at import)."""
-    msg = b"h2c-self-check"
-    qx, qy = hash_to_g2_batch([msg])
-    want = host_hash_to_g2(msg).affine()
-    got_x = hf.Fq2(tower.limbs_to_int(np.asarray(qx)[0, 0]), tower.limbs_to_int(np.asarray(qx)[0, 1]))
-    got_y = hf.Fq2(tower.limbs_to_int(np.asarray(qy)[0, 0]), tower.limbs_to_int(np.asarray(qy)[0, 1]))
-    if (got_x, got_y) != want:
-        raise AssertionError("device hash_to_g2 != host oracle")

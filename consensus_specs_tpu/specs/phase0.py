@@ -21,13 +21,13 @@ TPU-first notes:
 # ---- injected by the builder: preset constants, `config`, fork name ----
 import math as _math
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, Optional, Sequence, Set, Tuple
+from typing import Any, Callable, Dict, Optional, Sequence, Set, Tuple  # noqa: F401 (spec namespace: fork deltas exec here)
 
 import numpy as np
 
-from consensus_specs_tpu import ssz
+from consensus_specs_tpu import ssz  # noqa: F401 (spec namespace)
 from consensus_specs_tpu.crypto import bls
-from consensus_specs_tpu.ssz import (
+from consensus_specs_tpu.ssz import (  # noqa: F401 (spec namespace: later forks use the full type menagerie)
     Bitlist,
     Bitvector,
     ByteList,

@@ -10,24 +10,24 @@ bytes, lists) rather than persistent binary trees. Roots are computed on
 demand by flattening to chunk lists and reducing level-by-level through the
 batched hasher (`hashing.hash_many`) — the shape a TPU kernel wants.
 
-Assignment semantics caveat: composite values (Containers, sequences) are
-coerced BY REFERENCE when the type already matches, so two parents can
-alias one child — unlike remerkleable, whose views share only immutable
-nodes. Spec code is safe (it copies states explicitly, per the spec text);
-test helpers that move containers between a state and a block/payload must
-`.copy()` at the boundary (see execution_payload.build_empty_execution_payload).
+Assignment semantics: mutable composites (Containers, sequences, bit
+types, Unions) pass through an ownership barrier on their way into any
+parent slot (`_adopt`): a fresh value is adopted in place, while a value
+already owned by some parent is snapshotted first. Two parents therefore
+never share one mutable child — remerkleable's assignment-captures-the-
+current-backing semantics, enforced structurally (regression:
+tests/test_ssz_basic.py::test_no_aliasing_between_parents).
 """
 from __future__ import annotations
 
 import sys
 import weakref
 from array import array
-from typing import Any, Dict, Iterable, Optional, Sequence, Tuple, Type
+from typing import Any, Dict, Optional, Sequence, Tuple
 
 from . import hashing
 from .backing import ChunkTree
 from .merkle import (
-    ceil_log2,
     merkleize_chunks,
     mix_in_length,
     mix_in_selector,
@@ -66,6 +66,7 @@ class _Cached:
 
     _ht_cache: Optional[bytes] = None
     _parents: Optional[list] = None
+    _owned: bool = False
 
     def _set_cache(self, v: Optional[bytes]) -> None:
         object.__setattr__(self, "_ht_cache", v)
@@ -116,6 +117,24 @@ class _Cached:
             self._set_cache(None)
             self._bubble()
         # cache already None ⇒ ancestors were notified when it was cleared
+
+
+def _adopt(value):
+    """Ownership barrier for mutable composites entering a parent slot.
+
+    A freshly-built value is adopted in place (no copy); adopting a value
+    that some parent already owns snapshots it first, so two parents can
+    never share one mutable child. This is remerkleable's assignment
+    semantics (a view assignment captures the value's current backing,
+    ssz_impl.py:11-13) enforced on the value-backed model — the
+    one-forgotten-`.copy()` root-corruption footgun cannot occur.
+    Immutable leaves (uints, ByteVector/ByteList bytes) are shared freely.
+    """
+    if isinstance(value, _Cached):
+        if value._owned:
+            value = value.copy()
+        object.__setattr__(value, "_owned", True)
+    return value
 
 
 class SSZType:
@@ -731,7 +750,7 @@ class _SequenceBase(_Cached, SSZType):
             raw = list(args[0])
         else:
             raw = list(args)
-        self._items = [self.element_type.coerce(v) for v in raw]
+        self._items = [_adopt(self.element_type.coerce(v)) for v in raw]
         self._check_len(len(self._items))
         self._tree: Optional[ChunkTree] = None
         self._dirty: set = set()
@@ -756,7 +775,7 @@ class _SequenceBase(_Cached, SSZType):
             i += n
         if not 0 <= i < n:
             raise IndexError(f"{type(self).__name__}: index {i} out of range")
-        val = self.element_type.coerce(v)
+        val = _adopt(self.element_type.coerce(v))
         self._items[i] = val
         self._link_child(val, i)
         self._mark_item_dirty(i)
@@ -849,6 +868,8 @@ class _SequenceBase(_Cached, SSZType):
         new = cls.__new__(cls)
         new._items = [v.copy() for v in self._items]
         for i, v in enumerate(new._items):
+            if isinstance(v, _Cached):
+                object.__setattr__(v, "_owned", True)
             new._link_child(v, i)
         new._tree = self._tree.copy() if self._tree is not None else None
         new._dirty = set(self._dirty)
@@ -967,7 +988,7 @@ class List(_SequenceBase):
     def append(self, v):
         if len(self._items) + 1 > self.limit:
             raise ValueError(f"{type(self).__name__}: append exceeds limit {self.limit}")
-        val = self.element_type.coerce(v)
+        val = _adopt(self.element_type.coerce(v))
         self._items.append(val)
         n = len(self._items) - 1
         self._link_child(val, n)
@@ -1046,7 +1067,7 @@ class Container(_Cached, SSZType):
     def __init__(self, **kwargs):
         for name, typ in self._fields.items():
             if name in kwargs:
-                object.__setattr__(self, name, typ.coerce(kwargs.pop(name)))
+                object.__setattr__(self, name, _adopt(typ.coerce(kwargs.pop(name))))
             else:
                 object.__setattr__(self, name, typ.default())
         if kwargs:
@@ -1063,7 +1084,7 @@ class Container(_Cached, SSZType):
         typ = self._fields.get(name)
         if typ is None:
             raise AttributeError(f"{type(self).__name__} has no SSZ field {name!r}")
-        v = typ.coerce(value)
+        v = _adopt(typ.coerce(value))
         object.__setattr__(self, name, v)
         self._link_child(v, name)
         self._mark_self_dirty()
@@ -1140,6 +1161,8 @@ class Container(_Cached, SSZType):
         new = cls.__new__(cls)
         for n in self._fields:
             cv = getattr(self, n).copy()
+            if isinstance(cv, _Cached):
+                object.__setattr__(cv, "_owned", True)
             object.__setattr__(new, n, cv)
             new._link_child(cv, n)
         new._set_cache(self._ht_cache)
@@ -1182,7 +1205,7 @@ class Union(_Cached, SSZType):
                 raise ValueError("Union: selector 0 (None) takes no value")
             self.value = None
         else:
-            self.value = opt.coerce(value)
+            self.value = _adopt(opt.coerce(value))
         self.selector = selector
 
     @classmethod

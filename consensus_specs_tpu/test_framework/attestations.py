@@ -7,7 +7,7 @@ from .block_processing import state_transition_and_sign_block
 from .constants import is_post_altair
 from .context import expect_assertion_error
 from .keys import aggregate_sign, privkeys
-from .state import next_slot, next_slots, transition_to
+from .state import next_slot
 
 
 def run_attestation_processing(spec, state, attestation, valid=True):

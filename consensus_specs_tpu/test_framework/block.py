@@ -2,7 +2,7 @@
 from __future__ import annotations
 
 from .constants import is_post_altair, is_post_bellatrix
-from .keys import privkeys, pubkeys, pubkey_to_privkey
+from .keys import privkeys
 
 
 def get_proposer_index_maybe(spec, state, slot, proposer_index=None):

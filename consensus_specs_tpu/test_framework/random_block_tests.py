@@ -13,8 +13,6 @@ from __future__ import annotations
 
 from random import Random
 
-from consensus_specs_tpu.crypto import bls
-
 from .attestations import get_valid_attestation
 from .attester_slashings import get_valid_attester_slashing_by_indices
 from .block import build_empty_block_for_next_slot

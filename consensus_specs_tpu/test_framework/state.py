@@ -1,7 +1,6 @@
 """State progression helpers (ref: test/helpers/state.py)."""
 from __future__ import annotations
 
-from .context import expect_assertion_error
 
 
 def get_balance(state, index):

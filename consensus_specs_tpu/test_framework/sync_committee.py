@@ -4,7 +4,7 @@ from __future__ import annotations
 
 from .block_processing import run_block_processing_to
 from .context import expect_assertion_error
-from .keys import aggregate_sign, privkeys, pubkey_to_privkey, pubkeys
+from .keys import aggregate_sign, privkeys
 
 
 def compute_committee_indices(spec, state, committee=None):

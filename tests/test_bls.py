@@ -145,3 +145,17 @@ def test_facade_switch():
         assert bls.Verify(pk, b"anything", b"junk")  # skipped -> True
     finally:
         bls.bls_active = True
+
+
+def test_clear_cofactor_psi_equals_h_eff():
+    """The psi-decomposition fast path must EXACTLY equal the RFC 9380
+    [h_eff]Q ladder — same point, not just same subgroup."""
+    from consensus_specs_tpu.crypto.bls import hash_to_curve as h2c
+    from consensus_specs_tpu.crypto.bls.curve import g2_generator
+
+    for msg in (b"", b"psi-check", b"\xff" * 48):
+        u0, u1 = h2c.hash_to_field_fq2(msg, 2, h2c.DST_G2_POP)
+        q = h2c.map_to_curve_g2(u0).add(h2c.map_to_curve_g2(u1))
+        assert h2c.clear_cofactor(q).affine() == q.mul(h2c.H_EFF).affine()
+    g = g2_generator()
+    assert h2c.clear_cofactor(g).affine() == g.mul(h2c.H_EFF).affine()

@@ -1,0 +1,136 @@
+"""External known-answer vectors for the BLS stack.
+
+Everything else in the BLS test suite is self-referential (device vs
+host oracle, both same-author); these literals come from OUTSIDE the
+repo, so a shared misreading of RFC 9380 or the IETF BLS draft fails
+here even when the two backends agree with each other:
+
+- RFC 9380 appendix J.10.1 — BLS12381G2_XMD:SHA-256_SSWU_RO_ suite
+  (DST "QUUX-V01-CS02-with-...") final output points.
+- RFC 9380 appendix K.1 — expand_message_xmd(SHA-256) uniform bytes.
+- The IETF BLS-signature draft / eth2 bls conformance corpus
+  (the reference generates its cases from the same three secret keys,
+  /root/reference/tests/generators/bls/main.py:23-35) — SkToPk and
+  Sign pinned bytes, and the G2-infinity edge-case truth table the
+  reference generator encodes (main.py:40-60).
+
+Device-backend rows are covered by running the SAME functions through
+ops/bls_jax where a device is available; here the host oracle is the
+subject — the existing device-parity suites (tests/test_h2c_device.py,
+tests/test_bls_device.py) transfer these anchors to the device path.
+"""
+from __future__ import annotations
+
+import pytest
+
+from consensus_specs_tpu.crypto.bls import ciphersuite as cs
+from consensus_specs_tpu.crypto.bls import hash_to_curve as h2c
+from consensus_specs_tpu.crypto.bls.fields import Fq2
+
+# --- RFC 9380 K.1: expand_message_xmd SHA-256, len_in_bytes = 0x20 ---------
+
+XMD_DST = b"QUUX-V01-CS02-with-expander-SHA256-128"
+
+XMD_VECTORS = [
+    (b"", "68a985b87eb6b46952128911f2a4412bbc302a9d759667f87f7a21d803f07235"),
+    (b"abc", "d8ccab23b5985ccea865c6c97b6e5b8350e794e603b4b97902f53a8a0d605615"),
+    (b"abcdef0123456789", "eff31487c770a893cfb36f912fbfcbff40d5661771ca4b2cb4eafe524333f5c1"),
+]
+
+
+@pytest.mark.parametrize("msg,expect", XMD_VECTORS, ids=["empty", "abc", "abcdef"])
+def test_expand_message_xmd_rfc9380(msg, expect):
+    assert h2c.expand_message_xmd(msg, XMD_DST, 0x20).hex() == expect
+
+
+# --- RFC 9380 J.10.1: BLS12381G2_XMD:SHA-256_SSWU_RO_ ----------------------
+
+H2C_DST = b"QUUX-V01-CS02-with-BLS12381G2_XMD:SHA-256_SSWU_RO_"
+
+# (msg, P.x_re, P.x_im, P.y_re, P.y_im)
+H2C_VECTORS = [
+    (
+        b"",
+        "0141ebfbdca40eb85b87142e130ab689c673cf60f1a3e98d69335266f30d9b8d4ac44c1038e9dcdd5393faf5c41fb78a",
+        "05cb8437535e20ecffaef7752baddf98034139c38452458baeefab379ba13dff5bf5dd71b72418717047f5b0f37da03d",
+        "0503921d7f6a12805e72940b963c0cf3471c7b2a524950ca195d11062ee75ec076daf2d4bc358c4b190c0c98064fdd92",
+        "12424ac32561493f3fe3c260708a12b7c620e7be00099a974e259ddc7d1f6395c3c811cdd19f1e8dbf3e9ecfdcbab8d6",
+    ),
+    (
+        b"abc",
+        "02c2d18e033b960562aae3cab37a27ce00d80ccd5ba4b7fe0e7a210245129dbec7780ccc7954725f4168aff2787776e6",
+        "139cddbccdc5e91b9623efd38c49f81a6f83f175e80b06fc374de9eb4b41dfe4ca3a230ed250fbe3a2acf73a41177fd8",
+        "1787327b68159716a37440985269cf584bcb1e621d3a7202be6ea05c4cfe244aeb197642555a0645fb87bf7466b2ba48",
+        "00aa65dae3c8d732d10ecd2c50f8a1baf3001578f71c694e03866e9f3d49ac1e1ce70dd94a733534f106d4cec0eddd16",
+    ),
+]
+
+
+@pytest.mark.parametrize("msg,xr,xi,yr,yi", H2C_VECTORS, ids=["empty", "abc"])
+def test_hash_to_g2_rfc9380(msg, xr, xi, yr, yi):
+    p = h2c.hash_to_g2(msg, dst=H2C_DST)
+    x, y = p.affine()
+    assert x == Fq2(int(xr, 16), int(xi, 16))
+    assert y == Fq2(int(yr, 16), int(yi, 16))
+
+
+# --- IETF BLS draft / eth2 conformance corpus ------------------------------
+
+# the three secret keys every eth2 bls conformance case is built from
+SK1 = 0x263DBD792F5B1BE47ED85F8938C0F29586AF0D3AC7B977F21C278FE1462040E3
+SK2 = 0x47B8192D77BF871B62E87859D653922725724A5C031AFEABC60BCEF5FF665138
+SK3 = 0x328388AFF0D4A5B7DC9205ABD374E7E98F3CD9F3418EDB4EAFDA5FB16473D216
+
+PK1 = "a491d1b0ecd9bb917989f0e74f0dea0422eac4a873e5e2644f368dffb9a6e20fd6e10c1b77654d067c0618f6e5a7f79a"
+PK3 = "b53d21a4cfd562c469cc81514d4ce5a6b577d8403d32a394dc265dd190b47fa9f829fdd7963afdf972e5e77854051f6f"
+
+MSG_AB = bytes([0xAB] * 32)
+
+
+@pytest.mark.parametrize("sk,pk", [(SK1, PK1), (SK3, PK3)], ids=["sk1", "sk3"])
+def test_sk_to_pk_pinned(sk, pk):
+    assert cs.SkToPk(sk).hex() == pk
+
+
+SIGN_VECTORS = [
+    # (sk, msg, pk, signature) — BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_
+    # (the corpus' sign_case for the third secret key over 0xab*32)
+    (
+        SK3,
+        MSG_AB,
+        PK3,
+        "ae82747ddeefe4fd64cf9cedb9b04ae3e8a43420cd255e3c7cd06a8d88b7c7f8"
+        "638543719981c5d16fa3527c468c25f0026704a6951bde891360c7e8d12ddee0"
+        "559004ccdbe6046b55bae1b257ee97f7cdb955773d7cf29adf3ccbb9975e4eb9",
+    ),
+]
+
+
+@pytest.mark.parametrize("sk,msg,pk,sig", SIGN_VECTORS, ids=["sk3_abab"])
+def test_sign_pinned(sk, msg, pk, sig):
+    got = cs.Sign(sk, msg)
+    assert got.hex() == sig
+    assert cs.Verify(bytes.fromhex(pk), msg, got)
+
+
+# --- G2-infinity / degenerate edge truth table -----------------------------
+# mirrors the reference generator's hand-built edge cases (bls/main.py:40-60)
+
+G2_INF = b"\xc0" + b"\x00" * 95
+G1_INF = b"\xc0" + b"\x00" * 47
+
+
+def test_infinity_edge_cases():
+    # aggregate of nothing is an error, not infinity
+    with pytest.raises(Exception):
+        cs.Aggregate([])
+    # verify against the identity pubkey always fails
+    assert not cs.Verify(G1_INF, MSG_AB, cs.Sign(SK1, MSG_AB))
+    # the infinity signature never verifies under a real pubkey
+    assert not cs.Verify(cs.SkToPk(SK1), MSG_AB, G2_INF)
+    # FastAggregateVerify: no pubkeys -> False, even with the infinity sig
+    assert not cs.FastAggregateVerify([], MSG_AB, G2_INF)
+    # AggregateVerify: empty inputs -> False, even with the infinity sig
+    assert not cs.AggregateVerify([], [], G2_INF)
+    # infinity pubkey poisons an otherwise-valid fast aggregate
+    assert not cs.FastAggregateVerify([cs.SkToPk(SK1), G1_INF], MSG_AB, cs.Sign(SK1, MSG_AB))

@@ -127,3 +127,16 @@ def test_fast_final_exponentiation_is_3d_exponent():
     want = f.pow(3 * ((P**12 - 1) // R))
     got = _fe_fast_jit(jnp.asarray(tower.fq12_to_limbs_mont(f)[None]))
     assert tower.limbs_to_fq12(np.asarray(got)[0]) == want
+
+
+def test_hash_to_g2_batch_rfc9380_vectors():
+    """The DEVICE pipeline must reproduce the RFC 9380 J.10.1 appendix
+    literals (BLS12381G2_XMD:SHA-256_SSWU_RO_) — the external anchor,
+    not just host parity (tests/test_bls_kat.py pins the host)."""
+    from tests.test_bls_kat import H2C_DST, H2C_VECTORS
+
+    msgs = [v[0] for v in H2C_VECTORS]
+    qx, qy = h2.hash_to_g2_batch(msgs, dst=H2C_DST)
+    for i, (_, xr, xi, yr, yi) in enumerate(H2C_VECTORS):
+        assert _fq2_of(qx, i) == hf.Fq2(int(xr, 16), int(xi, 16))
+        assert _fq2_of(qy, i) == hf.Fq2(int(yr, 16), int(yi, 16))

@@ -295,3 +295,45 @@ def test_zero_hashes():
     assert ssz.ZERO_HASHES[0] == b"\x00" * 32
     assert ssz.ZERO_HASHES[1] == h(b"\x00" * 64)
     assert ssz.ZERO_HASHES[2] == h(ssz.ZERO_HASHES[1] * 2)
+
+
+def test_no_aliasing_between_parents():
+    """The ownership barrier: assigning an already-owned composite into a
+    second parent snapshots it, so mutating through one parent can never
+    corrupt the other's value or root."""
+    from consensus_specs_tpu.ssz.types import Container, List, uint64
+
+    class Inner(Container):
+        a: uint64
+        b: uint64
+
+    class Outer(Container):
+        x: Inner
+
+    inner = Inner(a=1, b=2)
+    o1 = Outer(x=inner)          # fresh: adopted in place
+    o2 = Outer(x=o1.x)           # owned: snapshotted
+    assert o1.x is not o2.x
+    r1, r2 = o1.hash_tree_root(), o2.hash_tree_root()
+    assert r1 == r2
+    o1.x.a = uint64(99)
+    assert o2.x.a == 1           # o2 unaffected by o1's mutation
+    assert o1.hash_tree_root() != r1
+    assert o2.hash_tree_root() == r2
+
+    # same barrier through list slots
+    lst = List[Inner, 16]([Inner(a=7, b=8)])
+    child = lst[0]
+    lst2 = List[Inner, 16]([child])
+    assert lst2[0] is not child
+    lst.append(child)            # re-adopting into the SAME list copies too
+    child.b = uint64(42)
+    assert lst[1].b == 8 and lst2[0].b == 8
+
+    # copies own their children: a copied state's child entering another
+    # parent must also snapshot
+    o3 = o1.copy()
+    o4 = Outer(x=o3.x)
+    assert o4.x is not o3.x
+    o3.x.b = uint64(1234)
+    assert o4.x.b != 1234

@@ -1,0 +1,152 @@
+#!/usr/bin/env python3
+"""AST lint for this repo (no third-party linters in the image).
+
+Checks, per file:
+  F401  imported name never used (respects ``# noqa`` on the line)
+  F811  import redefined by a later import in the same scope
+  W901  private module-level binding (``_NAME``) never referenced
+        in its module (dead constant/helper)
+
+`__init__.py` files are exempt from F401 (re-export surface), like
+flake8's per-file-ignores convention the reference uses
+(ref Makefile:136-141, setup.cfg). Exit code 1 on any finding.
+"""
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+TARGETS = ["consensus_specs_tpu", "generators", "tools", "bench.py", "__graft_entry__.py"]
+
+
+def _noqa_lines(source: str) -> set:
+    return {
+        i + 1
+        for i, line in enumerate(source.splitlines())
+        if "# noqa" in line or "#noqa" in line
+    }
+
+
+def _used_names(tree: ast.AST) -> set:
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            # record the root of dotted access: `mod.attr` uses `mod`
+            n = node
+            while isinstance(n, ast.Attribute):
+                n = n.value
+            if isinstance(n, ast.Name):
+                used.add(n.id)
+    # string-typed annotations and __all__ entries count as usage
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            used.add(node.value)
+    return used
+
+
+def _import_bindings(tree: ast.Module):
+    """Yield (lineno, bound_name) for every MODULE-LEVEL import.
+    Imports inside functions are deliberate lazy imports — skipped."""
+    for node in tree.body:
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                name = alias.asname or alias.name.split(".")[0]
+                yield node.lineno, name
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                yield node.lineno, alias.asname or alias.name
+
+
+def lint_file(path: Path) -> list:
+    source = path.read_text()
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: E999 syntax error: {e.msg}"]
+
+    findings = []
+    noqa = _noqa_lines(source)
+    used = _used_names(tree)
+
+    # F401 / F811
+    if path.name != "__init__.py":
+        seen = {}
+        for lineno, name in _import_bindings(tree):
+            if lineno in noqa:
+                continue
+            if name in seen and seen[name] not in noqa:
+                findings.append(
+                    f"{path}:{lineno}: F811 redefinition of imported '{name}' "
+                    f"(first at line {seen[name]})"
+                )
+            seen[name] = lineno
+        for name, lineno in seen.items():
+            if name not in used and not name.startswith("_"):
+                findings.append(f"{path}:{lineno}: F401 '{name}' imported but unused")
+
+    # W901: dead private module-level bindings
+    module_private = {}
+    for node in tree.body:
+        targets = []
+        if isinstance(node, (ast.Assign,)):
+            targets = [t for t in node.targets if isinstance(t, ast.Name)]
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+            targets = [node.target]
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            if node.name.startswith("_") and not node.name.startswith("__"):
+                module_private.setdefault(node.name, node.lineno)
+            continue
+        for t in targets:
+            if t.id.startswith("_") and not t.id.startswith("__"):
+                module_private.setdefault(t.id, node.lineno)
+    for name, lineno in module_private.items():
+        if lineno in noqa:
+            continue
+        # "used" must mean referenced anywhere beyond the def site
+        count = sum(
+            1
+            for node in ast.walk(tree)
+            if isinstance(node, ast.Name) and node.id == name
+        )
+        defs = sum(
+            1
+            for node in ast.walk(tree)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef))
+            and node.name == name
+        )
+        if count == 0 and defs == 1:
+            findings.append(f"{path}:{lineno}: W901 private '{name}' defined but never used")
+        elif count == 1 and defs == 0:
+            # a plain assignment's own Name node is the single reference
+            findings.append(f"{path}:{lineno}: W901 private '{name}' assigned but never used")
+    return findings
+
+
+def main(argv) -> int:
+    root = Path(__file__).resolve().parent.parent
+    targets = argv[1:] or TARGETS
+    files = []
+    for t in targets:
+        p = (root / t) if not Path(t).is_absolute() else Path(t)
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py":
+            files.append(p)
+    all_findings = []
+    for f in files:
+        all_findings.extend(lint_file(f))
+    for line in all_findings:
+        print(line)
+    print(f"lint: {len(files)} files, {len(all_findings)} findings")
+    return 1 if all_findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -1,0 +1,144 @@
+#!/usr/bin/env python3
+"""Diagnose the Mosaic/Pallas compile hang over the axon tunnel.
+
+Runs a LADDER of ever-smaller Pallas programs, each in a disposable
+child process with a hard timeout (the hang blocks inside
+backend_compile_and_load and never errors, so in-process timeouts
+cannot fire). The smallest rung is a trivial elementwise add — if even
+that times out, Mosaic compilation is unavailable on this backend
+full stop, and the SHA-256 Pallas kernel's "timeout" status is a
+platform property, not a kernel bug.
+
+Also measures the pure-JAX (XLA) kernel's DEVICE-RESIDENT throughput:
+a lax.fori_loop re-rooting the same tree R times inside ONE dispatch,
+so the per-iteration time excludes the ~0.7 s tunnel dispatch latency
+that dominates every single-shot number on this box.
+
+Usage: python tools/pallas_probe.py [--timeout 180]
+Prints one JSON line:
+  {"tiny_add": "...", "row_sha256": "...", "merkle": "...",
+   "xla_resident_mibs": N, "xla_dispatch_mibs": N}
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD_TMPL = r"""
+import sys
+sys.path.insert(0, {repo!r})
+import jax, jax.numpy as jnp, numpy as np
+
+which = {which!r}
+if which == "tiny_add":
+    import jax.experimental.pallas as pl
+
+    def kern(x_ref, o_ref):
+        o_ref[...] = x_ref[...] + 1
+
+    x = jnp.zeros((8, 128), jnp.int32)
+    out = pl.pallas_call(kern, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)
+    assert int(np.asarray(out)[0, 0]) == 1
+elif which == "row_sha256":
+    from consensus_specs_tpu.ops.sha256_pallas import sha256_pair_rows_pallas
+    rng = np.random.default_rng(0)
+    words = jnp.asarray(rng.integers(0, 2**32, size=(256, 16), dtype=np.uint32))
+    np.asarray(sha256_pair_rows_pallas(words))
+elif which == "merkle":
+    from consensus_specs_tpu.ops.sha256_pallas import merkle_reduce_pallas
+    rng = np.random.default_rng(0)
+    words = jnp.asarray(rng.integers(0, 2**32, size=(1 << 10, 8), dtype=np.uint32))
+    np.asarray(merkle_reduce_pallas(words, 10))
+print("OK")
+"""
+
+
+def probe(which: str, timeout_s: int) -> str:
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _CHILD_TMPL.format(repo=REPO, which=which)],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        start_new_session=True,
+    )
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        proc.wait()
+        return "timeout"
+    if proc.returncode != 0:
+        return "error: " + (err.strip().splitlines() or ["?"])[-1][:200]
+    return "ok" if "OK" in out else "no-output"
+
+
+def xla_resident_throughput(levels: int = 18, reps: int = 8):
+    """Device-resident MiB/s of the pure-JAX merkle kernel: `reps`
+    re-roots inside one dispatch (fori_loop) vs one re-root per
+    dispatch. The difference isolates the tunnel dispatch latency."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from consensus_specs_tpu.ops.sha256 import merkle_reduce_jit, _merkle_reduce
+
+    n = 1 << levels
+    mib = n * 32 / (1 << 20)
+    rng = np.random.default_rng(3)
+    words = jax.device_put(jnp.asarray(rng.integers(0, 2**32, size=(n, 8), dtype=np.uint32)))
+
+    @jax.jit
+    def repeated(w):
+        def body(_, acc):
+            root = _merkle_reduce(w, levels)
+            # fold the root back in so XLA cannot hoist the loop body
+            return acc ^ root[0, 0]
+
+        return jax.lax.fori_loop(0, reps, body, jnp.uint32(0))
+
+    np.asarray(repeated(words))  # compile
+    t0 = time.perf_counter()
+    np.asarray(repeated(words))
+    resident = reps * mib / (time.perf_counter() - t0)
+
+    np.asarray(merkle_reduce_jit(words, levels))  # compile
+    t0 = time.perf_counter()
+    np.asarray(merkle_reduce_jit(words, levels))
+    dispatch = mib / (time.perf_counter() - t0)
+    return resident, dispatch
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--timeout", type=int, default=180)
+    ap.add_argument("--skip-xla", action="store_true")
+    ns = ap.parse_args()
+
+    out = {}
+    for which in ("tiny_add", "row_sha256", "merkle"):
+        out[which] = probe(which, ns.timeout)
+        print(f"# probe {which}: {out[which]}", file=sys.stderr, flush=True)
+        if which == "tiny_add" and out[which] == "timeout":
+            # Mosaic is dead on this backend; larger rungs can only hang too
+            out["row_sha256"] = out["merkle"] = "skipped (tiny_add timed out)"
+            break
+    if not ns.skip_xla:
+        resident, dispatch = xla_resident_throughput()
+        out["xla_resident_mibs"] = round(resident, 2)
+        out["xla_dispatch_mibs"] = round(dispatch, 2)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

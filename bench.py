@@ -10,28 +10,36 @@ Baseline = the host pure-Python oracle (the reference's py_ecc analog)
 timed cold on a sample.
 
 Extra keys:
-- bls_warm_verifies_per_sec — the round-2 metric (cached messages),
-  for continuity.
 - hash_tree_root MiB/s — fused device Merkleization of a 32 MiB chunk
-  tree (config #2). hash_vs_baseline compares against this repo's OWN
-  host backend (the SHA-NI C extension); hash_hashlib_ref_mibs /
-  hash_vs_hashlib_ref compare against plain hashlib — the reference
-  stack's rate (pycryptodome, utils/hash_function.py:8). The spec-path
-  rate is also reported.
+  tree (config #2); hash_vs_baseline vs this repo's own SHA-NI C
+  extension, hash_vs_hashlib_ref vs plain hashlib (the reference
+  stack's class of rate).
 - incremental_reroot_ms — 1M-leaf list root after a single mutation
   (the remerkleable-analog capability, dirty-tracked backing).
-- e2e generation (config #5): wall-clock of regenerating the phase0
-  minimal `operations/attestation` suite with device backends on
-  (BLS=jax + device hasher) vs the pure-host path, as a speedup.
+- block_128atts / sync_aggregate_512 — full mainnet state_transition /
+  process_sync_aggregate, host-synchronous vs deferred-flush device
+  (BASELINE configs #3/#4).
+- gen_operations (config #5): wall-clock of regenerating the phase0
+  minimal operations suites (5 handlers) with device backends on
+  (deferred batched BLS + calibrated device hasher) vs the pure-host
+  path, as a speedup.
 
-Prints ONE JSON line.
+Budget discipline (the round-4 lesson): every section runs under an
+internal wall-clock deadline (BENCH_DEADLINE_S, default 1260 s) with a
+per-section cost gate, and the ONE JSON line is emitted by an atexit +
+SIGTERM/SIGALRM handler — a timeout can zero out a section, never the
+round. Section wall-clocks are reported in `section_seconds`.
+
+Prints ONE JSON line (the last line of stdout).
 """
 from __future__ import annotations
 
+import atexit
 import faulthandler
 import json
 import os
 import shutil
+import signal
 import sys
 import tempfile
 import time
@@ -40,6 +48,74 @@ import numpy as np
 
 faulthandler.enable()
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S", "1260"))
+_T0 = time.monotonic()
+
+# Filled in by sections as they complete; emitted as the final JSON line
+# exactly once, whatever happens. Headline keys first.
+RESULTS: dict = {
+    "metric": "bls_cold_fast_aggregate_verifies_per_sec",
+    "value": None,
+    "unit": "verifies/s",
+    "vs_baseline": None,
+    "section_seconds": {},
+}
+_EMITTED = False
+
+
+def _note(msg: str) -> None:
+    print(f"bench[{time.monotonic() - _T0:7.1f}s]: {msg}", file=sys.stderr, flush=True)
+
+
+def _emit() -> None:
+    global _EMITTED
+    if _EMITTED:
+        return
+    _EMITTED = True
+    print(json.dumps(RESULTS), flush=True)
+
+
+def _on_deadline_signal(signum, frame):
+    _note(f"signal {signum} — emitting partial results and exiting")
+    _emit()
+    sys.stdout.flush()
+    os._exit(0)
+
+
+atexit.register(_emit)
+signal.signal(signal.SIGTERM, _on_deadline_signal)
+signal.signal(signal.SIGALRM, _on_deadline_signal)
+signal.alarm(max(1, int(DEADLINE_S)))
+
+
+def _remaining() -> float:
+    return DEADLINE_S - (time.monotonic() - _T0)
+
+
+def _run_section(name: str, est_s: float, fn) -> None:
+    """Run one bench section under the budget: skip when the remaining
+    wall-clock can't cover the estimate, absorb failures, record timing."""
+    if _remaining() < est_s:
+        _note(f"SKIP {name}: remaining {_remaining():.0f}s < estimate {est_s:.0f}s")
+        RESULTS.setdefault("skipped_sections", []).append(name)
+        return
+    _note(f"{name} ...")
+    t0 = time.monotonic()
+    try:
+        fn()
+    except Exception as e:  # a broken section must not starve the rest
+        _note(f"{name} FAILED: {e!r}")
+        RESULTS.setdefault("section_errors", {})[name] = repr(e)
+    finally:
+        dt = time.monotonic() - t0
+        RESULTS["section_seconds"][name] = round(dt, 1)
+        _note(f"{name} done in {dt:.1f}s")
+
+
+# ---------------------------------------------------------------------------
+# sections
+# ---------------------------------------------------------------------------
 
 
 def _fresh_workload(host, sks, pks, rng, n_checks, keys_per_agg, tag):
@@ -61,29 +137,30 @@ def _fresh_workload(host, sks, pks, rng, n_checks, keys_per_agg, tag):
     return pubkey_lists, messages, signatures
 
 
-def bench_bls():
+def bench_bls() -> None:
     from consensus_specs_tpu.crypto.bls import ciphersuite as host
     from consensus_specs_tpu.ops import bls_jax
 
     n_checks = 128
     keys_per_agg = 64
     n_keys = 256
-    iterations = 3
+    iterations = 2  # timed cold passes (plus one warm-up set)
 
     sks = [i + 1 for i in range(n_keys)]
     pks = [host.SkToPk(sk) for sk in sks]
     rng = np.random.default_rng(1)
 
-    # pre-generate fresh workloads (signing is the signer's cost, not the
-    # verifier's — excluded from timing) + one warm-up set for compiles
+    t0 = time.monotonic()
     workloads = [
         _fresh_workload(host, sks, pks, rng, n_checks, keys_per_agg, tag)
         for tag in range(iterations + 1)
     ]
+    _note(f"bls: {iterations + 1} workloads prepared in {time.monotonic() - t0:.1f}s")
 
-    # warm-up: compiles all cold-path graphs; warm pubkey cache
+    # warm-up: compiles all cold-path graphs; warms pubkey cache
     ok = bls_jax.fast_aggregate_verify_batch_cold(*workloads[0])
     assert bool(np.all(ok)), "device cold batch verify failed on valid inputs"
+    _note(f"bls: cold-path graphs compiled at t+{time.monotonic() - t0:.1f}s")
 
     t0 = time.perf_counter()
     for w in workloads[1:]:
@@ -96,7 +173,7 @@ def bench_bls():
     ok = bls_jax.fast_aggregate_verify_batch(*warm)
     assert bool(np.all(ok))
     times = []
-    for _ in range(3):
+    for _ in range(2):
         t0 = time.perf_counter()
         ok = bls_jax.fast_aggregate_verify_batch(*warm)
         times.append(time.perf_counter() - t0)
@@ -104,29 +181,33 @@ def bench_bls():
 
     # host-oracle baseline, cold (fresh message + full verify)
     pubkey_lists, messages, signatures = workloads[1]
-    sample = 3
+    sample = 2
     t0 = time.perf_counter()
     for i in range(sample):
         assert host.FastAggregateVerify(pubkey_lists[i], messages[i], signatures[i])
     host_rate = sample / (time.perf_counter() - t0)
-    return cold_rate, warm_rate, host_rate
+
+    RESULTS["value"] = round(cold_rate, 2)
+    RESULTS["vs_baseline"] = round(cold_rate / host_rate, 2)
+    RESULTS["bls_warm_verifies_per_sec"] = round(warm_rate, 2)
+    RESULTS["bls_host_oracle_cold_rate"] = round(host_rate, 3)
 
 
 _HASH_LEVELS = 20  # 1M chunks = 32 MiB — mainnet-registry scale
 _HASH_SEED = 42  # probe child + bench_hash must hash the SAME tree
+_PALLAS: dict = {"status": "not_run", "mibs": None, "root_hex": None}
 
 
-def bench_pallas_probe(timeout_s: int = 300):
+def bench_pallas_probe(timeout_s: int = 60) -> None:
     """Pallas section, in a DISPOSABLE CHILD with a hard timeout.
 
-    Mosaic compilation can hang indefinitely on tunneled backends (the
-    axon TPU tunnel blocks in backend_compile rather than erroring), so
-    the probe must not share a process with the rest of the bench. Runs
-    before the parent opens the device; returns
-    {"status": ok|mismatch|unavailable|timeout, "mibs", "root_hex"}.
-    The child re-derives the same rng(42) chunk tree as bench_hash so
-    the parent can cross-check root_hex against the host root.
-    """
+    Mosaic compilation hangs indefinitely on the tunneled backend (the
+    axon TPU tunnel blocks in backend_compile rather than erroring — it
+    has failed identically every round; see README), so the probe must
+    not share a process with the rest of the bench and is capped at 60 s.
+    Runs before the parent opens the device. The child re-derives the
+    same rng(42) chunk tree as bench_hash so the parent can cross-check
+    root_hex against the host root."""
     import subprocess
 
     child = (
@@ -151,8 +232,6 @@ def bench_pallas_probe(timeout_s: int = 300):
         "    out['mibs'] = mib / min(times)\n"
         "print(json.dumps(out))\n"
     )
-    import signal
-
     # own session so the WHOLE process group can be killed — subprocess.run's
     # timeout only kills the direct child and then blocks on pipe EOF, which
     # a forked compile helper holding the pipe would defeat
@@ -171,18 +250,24 @@ def bench_pallas_probe(timeout_s: int = 300):
         except OSError:
             pass
         proc.wait()
-        return {"status": "timeout", "mibs": None, "root_hex": None}
-    if proc.returncode != 0:
-        # child died AFTER import (e.g. kernel aborted mid-timing): not a
-        # clean "unavailable" — surface as an error status in the output
-        return {"status": "error", "mibs": None, "root_hex": None}
-    try:
-        return json.loads(out.strip().splitlines()[-1])
-    except Exception:
-        return {"status": "error", "mibs": None, "root_hex": None}
+        _PALLAS.update(status="timeout")
+    else:
+        if proc.returncode != 0:
+            _PALLAS.update(status="error")
+        else:
+            try:
+                _PALLAS.update(json.loads(out.strip().splitlines()[-1]))
+            except Exception:
+                _PALLAS.update(status="error")
+    if _PALLAS["status"] == "mismatch":
+        raise AssertionError("pallas sha256 kernel digest mismatch")
+    RESULTS["hash_pallas_mibs"] = (
+        round(_PALLAS["mibs"], 2) if _PALLAS["mibs"] else None
+    )
+    RESULTS["hash_pallas_status"] = _PALLAS["status"]
 
 
-def bench_hash(pallas_root_hex):
+def bench_hash() -> None:
     import jax
     import jax.numpy as jnp
 
@@ -230,7 +315,7 @@ def bench_hash(pallas_root_hex):
         raise AssertionError("hashlib reference root mismatch")
     # a pallas kernel that RAN but produced a wrong root is a correctness
     # regression, not an unavailability — fail loudly
-    if pallas_root_hex is not None and pallas_root_hex != root_host.hex():
+    if _PALLAS["root_hex"] is not None and _PALLAS["root_hex"] != root_host.hex():
         raise AssertionError("pallas merkle root mismatch")
 
     # Spec-path: same data through ssz merkleize with the device backend on
@@ -245,10 +330,15 @@ def bench_hash(pallas_root_hex):
         dev.use_host_hasher()
     if root_spec != root_host:
         raise AssertionError("spec-path device root mismatch")
-    return dev_mbs, host_mbs, spec_mbs, hashlib_mbs
+
+    RESULTS["hash_tree_root_mibs"] = round(dev_mbs, 2)
+    RESULTS["hash_vs_baseline"] = round(dev_mbs / host_mbs, 2)
+    RESULTS["hash_hashlib_ref_mibs"] = round(hashlib_mbs, 2)
+    RESULTS["hash_vs_hashlib_ref"] = round(dev_mbs / hashlib_mbs, 2)
+    RESULTS["hash_spec_path_mibs"] = round(spec_mbs, 2)
 
 
-def bench_incremental_reroot():
+def bench_incremental_reroot() -> None:
     """1M-leaf List root after a single mutation — the structural-sharing
     capability the reference gets from remerkleable (ssz_impl.py:11-13)."""
     from consensus_specs_tpu.ssz import hash_tree_root
@@ -266,61 +356,7 @@ def bench_incremental_reroot():
         root2 = hash_tree_root(big)  # steady state: O(log n) dirty-path hashes
         times.append(time.perf_counter() - t0)
     assert bytes(root2) != b"\x00" * 32
-    return min(times) * 1e3
-
-
-def bench_generation():
-    """BASELINE config #5 (sliced): regenerate phase0-minimal
-    operations/attestation vectors, device path (batched-deferred BLS +
-    device hasher) vs the pure-host path."""
-    from consensus_specs_tpu.generators.gen_from_tests import run_state_test_generators
-    from consensus_specs_tpu.ops import sha256 as dev_hash
-
-    mods = {"phase0": {"attestation": "tests.spec.test_operations_attestation"}}
-
-    # the widened config-#5 slice: five handlers' worth of real-BLS cases
-    # flushing through the same deferred batches (the scaling story —
-    # the per-flush dispatch amortizes across every case in a provider)
-    ops_mods = {
-        "phase0": {
-            "attestation": "tests.spec.test_operations_attestation",
-            "attester_slashing": "tests.spec.test_operations_attester_slashing",
-            "proposer_slashing": "tests.spec.test_operations_proposer_slashing",
-            "voluntary_exit": "tests.spec.test_operations_voluntary_exit",
-            "deposit": "tests.spec.test_operations_deposit",
-        }
-    }
-
-    def run_once(backend: str, device_hasher: bool, defer: bool, which=None) -> float:
-        out = tempfile.mkdtemp(prefix=f"bench_gen_{backend}_")
-        saved = os.environ.get("CONSENSUS_SPECS_TPU_BLS_BACKEND")
-        os.environ["CONSENSUS_SPECS_TPU_BLS_BACKEND"] = backend
-        if device_hasher:
-            dev_hash.use_device_hasher()
-        try:
-            t0 = time.perf_counter()
-            run_state_test_generators(
-                "operations", which if which is not None else mods, presets=("minimal",),
-                args=["-o", out] + (["--bls-defer"] if defer else []),
-            )
-            return time.perf_counter() - t0
-        finally:
-            if device_hasher:
-                dev_hash.use_host_hasher()
-            if saved is None:
-                os.environ.pop("CONSENSUS_SPECS_TPU_BLS_BACKEND", None)
-            else:
-                os.environ["CONSENSUS_SPECS_TPU_BLS_BACKEND"] = saved
-            shutil.rmtree(out, ignore_errors=True)
-
-    # warm-up pass compiles the device graphs (untimed), then timed passes
-    run_once("jax", True, True)
-    t_dev = run_once("jax", True, True)
-    t_host = run_once("reference", False, False)
-    # widened slice: one timed run per path (graphs already warm)
-    t_dev_ops = run_once("jax", True, True, which=ops_mods)
-    t_host_ops = run_once("reference", False, False, which=ops_mods)
-    return t_dev, t_host, t_dev_ops, t_host_ops
+    RESULTS["incremental_reroot_ms"] = round(min(times) * 1e3, 3)
 
 
 def _deferred_transition(spec, state, signed_block):
@@ -381,7 +417,7 @@ def _block_with_attestations(spec, state):
         return state_transition_and_sign_block(spec, state.copy(), block)
 
 
-def bench_block_mainnet():
+def bench_block_mainnet() -> None:
     """BASELINE config #3: full mainnet-preset state_transition of a block
     carrying 128 attestation aggregate checks — synchronous host BLS vs
     the deferred single-flush device path. One warmup (compiles) + one
@@ -402,7 +438,9 @@ def bench_block_mainnet():
     next_epoch(spec, base)
     bls.bls_active = True
 
+    t0 = time.monotonic()
     signed_block = _block_with_attestations(spec, base)
+    _note(f"block_mainnet: 128-attestation block built in {time.monotonic() - t0:.1f}s")
 
     bls.use_jax()
     try:
@@ -416,10 +454,13 @@ def bench_block_mainnet():
     t0 = time.perf_counter()
     spec.state_transition(base.copy(), signed_block)
     t_host = time.perf_counter() - t0
-    return t_dev, t_host
+
+    RESULTS["block_128atts_mainnet_device_s"] = round(t_dev, 2)
+    RESULTS["block_128atts_mainnet_host_s"] = round(t_host, 2)
+    RESULTS["block_128atts_speedup"] = round(t_host / t_dev, 2) if t_dev else None
 
 
-def bench_sync_aggregate_mainnet():
+def bench_sync_aggregate_mainnet() -> None:
     """BASELINE config #4: altair-mainnet process_sync_aggregate with the
     512-key sync committee — host vs deferred-flush device."""
     from consensus_specs_tpu.crypto import bls
@@ -436,6 +477,7 @@ def bench_sync_aggregate_mainnet():
     )
     from consensus_specs_tpu.test_framework.state import next_slot, transition_to
 
+    t0 = time.monotonic()
     spec = build_spec("altair", "mainnet")
     bls.bls_active = False
     state = _prepare_state(default_balances, default_activation_threshold, spec).copy()
@@ -452,6 +494,7 @@ def bench_sync_aggregate_mainnet():
         ),
     )
     transition_to(spec, state, block.slot)
+    _note(f"sync_aggregate: altair-mainnet workload built in {time.monotonic() - t0:.1f}s")
 
     def run_sync(deferred: bool) -> float:
         work = state.copy()
@@ -469,75 +512,100 @@ def bench_sync_aggregate_mainnet():
     bls.use_jax()
     try:
         run_sync(True)  # warmup/compiles (k=512 bucket)
+        _note(f"sync_aggregate: k=512 graphs compiled at t+{time.monotonic() - t0:.1f}s")
         t_dev = run_sync(True)
     finally:
         bls.use_reference()
     t_host = run_sync(False)
-    return t_dev, t_host
+
+    RESULTS["sync_aggregate_512_device_s"] = round(t_dev, 3)
+    RESULTS["sync_aggregate_512_host_s"] = round(t_host, 3)
+    RESULTS["sync_aggregate_512_speedup"] = round(t_host / t_dev, 2) if t_dev else None
 
 
-def _note(msg: str) -> None:
-    print(msg, file=sys.stderr, flush=True)
+def bench_generation() -> None:
+    """BASELINE config #5 (sliced): regenerate the phase0-minimal
+    operations suites, device path (one cross-provider deferred BLS flush
+    + calibrated device hasher) vs the pure-host path. The attestation
+    suite alone is kept as a continuity metric (gen_suite_speedup,
+    r3's losing number); the 5-handler slice is the headline
+    (gen_operations_speedup)."""
+    from consensus_specs_tpu.generators.gen_from_tests import run_state_test_generators
+    from consensus_specs_tpu.ops import sha256 as dev_hash
+    from consensus_specs_tpu.ssz import hashing
+
+    att_mods = {"phase0": {"attestation": "tests.spec.test_operations_attestation"}}
+    ops_mods = {
+        "phase0": {
+            "attestation": "tests.spec.test_operations_attestation",
+            "attester_slashing": "tests.spec.test_operations_attester_slashing",
+            "proposer_slashing": "tests.spec.test_operations_proposer_slashing",
+            "voluntary_exit": "tests.spec.test_operations_voluntary_exit",
+            "deposit": "tests.spec.test_operations_deposit",
+        }
+    }
+
+    # calibrate the hasher routing thresholds ONCE; reuse for every pass
+    calib = dev_hash.use_device_hasher(calibrate=True)
+    thresholds = (hashing.DEVICE_MIN_BLOCKS, hashing.FUSED_ROOT_MIN_CHUNKS)
+    dev_hash.use_host_hasher()
+    _note(f"generation: hasher calibration {calib}")
+
+    def run_once(backend: str, device_hasher: bool, defer: bool, which) -> float:
+        out = tempfile.mkdtemp(prefix=f"bench_gen_{backend}_")
+        saved = os.environ.get("CONSENSUS_SPECS_TPU_BLS_BACKEND")
+        os.environ["CONSENSUS_SPECS_TPU_BLS_BACKEND"] = backend
+        if device_hasher:
+            dev_hash.use_device_hasher(calibrate=False)
+            hashing.DEVICE_MIN_BLOCKS, hashing.FUSED_ROOT_MIN_CHUNKS = thresholds
+        try:
+            t0 = time.perf_counter()
+            run_state_test_generators(
+                "operations", which, presets=("minimal",),
+                args=["-o", out] + (["--bls-defer"] if defer else []),
+            )
+            return time.perf_counter() - t0
+        finally:
+            if device_hasher:
+                dev_hash.use_host_hasher()
+            if saved is None:
+                os.environ.pop("CONSENSUS_SPECS_TPU_BLS_BACKEND", None)
+            else:
+                os.environ["CONSENSUS_SPECS_TPU_BLS_BACKEND"] = saved
+            shutil.rmtree(out, ignore_errors=True)
+
+    # warm-up pass compiles the device graphs (untimed), then timed passes
+    run_once("jax", True, True, att_mods)
+    t_dev = run_once("jax", True, True, att_mods)
+    t_host = run_once("reference", False, False, att_mods)
+    RESULTS["gen_attestation_suite_device_s"] = round(t_dev, 2)
+    RESULTS["gen_attestation_suite_host_s"] = round(t_host, 2)
+    RESULTS["gen_suite_speedup"] = round(t_host / t_dev, 2) if t_dev else None
+    _note(f"generation: attestation slice dev={t_dev:.2f}s host={t_host:.2f}s")
+
+    # widened slice: one timed run per path (graphs already warm)
+    t_dev_ops = run_once("jax", True, True, ops_mods)
+    t_host_ops = run_once("reference", False, False, ops_mods)
+    RESULTS["gen_operations_suite_device_s"] = round(t_dev_ops, 2)
+    RESULTS["gen_operations_suite_host_s"] = round(t_host_ops, 2)
+    RESULTS["gen_operations_speedup"] = (
+        round(t_host_ops / t_dev_ops, 2) if t_dev_ops else None
+    )
 
 
 def main() -> None:
-    _note("bench: pallas probe (subprocess) ...")
-    pallas = bench_pallas_probe()
-    _note(f"bench: pallas probe done status={pallas['status']} mibs={pallas['mibs']}")
-    if pallas["status"] == "mismatch":
-        raise AssertionError("pallas sha256 kernel digest mismatch")
-    pallas_mbs = pallas["mibs"]
-    _note("bench: hashing ...")
-    dev_mbs, host_mbs, spec_mbs, hashlib_mbs = bench_hash(pallas.get("root_hex"))
-    _note(
-        f"bench: hashing done dev={dev_mbs:.1f} host={host_mbs:.1f} "
-        f"spec={spec_mbs:.1f} hashlib={hashlib_mbs:.1f} pallas={pallas_mbs}"
-    )
-    _note("bench: incremental re-root ...")
-    reroot_ms = bench_incremental_reroot()
-    _note("bench: bls (cold + warm) ...")
-    cold_rate, warm_rate, host_rate = bench_bls()
-    _note(f"bench: bls done cold={cold_rate:.2f}/s warm={warm_rate:.2f}/s host={host_rate:.3f}/s")
-    _note("bench: config #3 (mainnet block, 128 atts) ...")
-    blk_dev, blk_host = bench_block_mainnet()
-    _note(f"bench: config #3 done dev={blk_dev:.2f}s host={blk_host:.2f}s")
-    _note("bench: config #4 (512-key sync aggregate) ...")
-    sa_dev, sa_host = bench_sync_aggregate_mainnet()
-    _note(f"bench: config #4 done dev={sa_dev:.2f}s host={sa_host:.2f}s")
-    _note("bench: e2e generation ...")
-    t_dev, t_host, t_dev_ops, t_host_ops = bench_generation()
-    print(
-        json.dumps(
-            {
-                "metric": "bls_cold_fast_aggregate_verifies_per_sec",
-                "value": round(cold_rate, 2),
-                "unit": "verifies/s",
-                "vs_baseline": round(cold_rate / host_rate, 2),
-                "bls_warm_verifies_per_sec": round(warm_rate, 2),
-                "bls_host_oracle_cold_rate": round(host_rate, 3),
-                "hash_tree_root_mibs": round(dev_mbs, 2),
-                "hash_vs_baseline": round(dev_mbs / host_mbs, 2),
-                "hash_hashlib_ref_mibs": round(hashlib_mbs, 2),
-                "hash_vs_hashlib_ref": round(dev_mbs / hashlib_mbs, 2),
-                "hash_spec_path_mibs": round(spec_mbs, 2),
-                "hash_pallas_mibs": round(pallas_mbs, 2) if pallas_mbs else None,
-                "hash_pallas_status": pallas["status"],
-                "incremental_reroot_ms": round(reroot_ms, 3),
-                "block_128atts_mainnet_device_s": round(blk_dev, 2),
-                "block_128atts_mainnet_host_s": round(blk_host, 2),
-                "block_128atts_speedup": round(blk_host / blk_dev, 2) if blk_dev else None,
-                "sync_aggregate_512_device_s": round(sa_dev, 3),
-                "sync_aggregate_512_host_s": round(sa_host, 3),
-                "sync_aggregate_512_speedup": round(sa_host / sa_dev, 2) if sa_dev else None,
-                "gen_attestation_suite_device_s": round(t_dev, 2),
-                "gen_attestation_suite_host_s": round(t_host, 2),
-                "gen_suite_speedup": round(t_host / t_dev, 2) if t_dev else None,
-                "gen_operations_suite_device_s": round(t_dev_ops, 2),
-                "gen_operations_suite_host_s": round(t_host_ops, 2),
-                "gen_operations_speedup": round(t_host_ops / t_dev_ops, 2) if t_dev_ops else None,
-            }
-        )
-    )
+    _note(f"deadline {DEADLINE_S:.0f}s")
+    # priority order: required scoreboard keys first (bls headline, then
+    # BASELINE configs #3 / #5 / #4), historical continuity keys after
+    _run_section("pallas_probe", 70, bench_pallas_probe)
+    _run_section("bls", 220, bench_bls)
+    _run_section("block_mainnet", 240, bench_block_mainnet)
+    _run_section("generation", 330, bench_generation)
+    _run_section("sync_aggregate", 280, bench_sync_aggregate_mainnet)
+    _run_section("hash", 140, bench_hash)
+    _run_section("incremental_reroot", 60, bench_incremental_reroot)
+    signal.alarm(0)
+    _emit()
 
 
 if __name__ == "__main__":

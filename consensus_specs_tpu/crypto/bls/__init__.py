@@ -124,14 +124,22 @@ class DeferredVerifier:
         if batch_rows:
             cold = getattr(_backend, "fast_aggregate_verify_batch_cold", None)
             if cold is not None:
-                ok = cold(
-                    [r[1] for r in batch_rows],
-                    [r[2] for r in batch_rows],
-                    [r[3] for r in batch_rows],
-                )
-                for (key, _, _, _), o in zip(batch_rows, ok):
-                    unique[key] = bool(o)
-            else:
+                try:
+                    ok = cold(
+                        [r[1] for r in batch_rows],
+                        [r[2] for r in batch_rows],
+                        [r[3] for r in batch_rows],
+                    )
+                except Exception:
+                    # a device/backend failure must degrade like every
+                    # synchronous facade path (exception -> False per
+                    # check), not abort the whole flush: fall back to the
+                    # per-row scalar path below
+                    cold = None
+                else:
+                    for (key, _, _, _), o in zip(batch_rows, ok):
+                        unique[key] = bool(o)
+            if cold is None:
                 for key, pks, msg, sig in batch_rows:
                     try:
                         unique[key] = bool(_backend.FastAggregateVerify(pks, msg, sig))

@@ -225,9 +225,14 @@ def run_generator(generator_name: str, test_providers: Iterable[TestProvider], a
         pending.clear()
 
     with (profiling.trace(generator_name) if ns.profile else contextlib.nullcontext()):
+      # ONE deferred-check population across every provider in the run:
+      # providers' prepare() only selects the BLS backend (idempotent) and
+      # each case_fn carries its own (fork, preset) context, so checks from
+      # all handlers can share a single flush dispatch — the per-flush
+      # device latency amortizes across the whole runner, not per handler
+      pending: List[_CaseOutcome] = []
       for provider in test_providers:
         provider.prepare()
-        pending: List[_CaseOutcome] = []
 
         for test_case in provider.make_cases():
             if ns.preset_list is not None and test_case.preset_name not in ns.preset_list:
@@ -270,8 +275,8 @@ def run_generator(generator_name: str, test_providers: Iterable[TestProvider], a
                         error = traceback.format_exc()
                     finalize_case(case_dir, encoded, meta, error, start)
 
-        if verifier is not None:
-            flush_pending(pending)
+      if verifier is not None:
+          flush_pending(pending)
 
     if ns.collect_only:
         print(f"collected {collected} test cases")

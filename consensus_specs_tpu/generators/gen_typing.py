@@ -10,6 +10,19 @@ TestCasePart = Tuple[str, str, Any]
 
 @dataclass
 class TestCase:
+    """One generated vector case.
+
+    Re-runnability contract: ``case_fn`` MUST be deterministic and free of
+    cross-case shared mutable state (seed your RNGs; no module-level
+    caches that change results between invocations). Deferred-BLS mode
+    (gen_runner --bls-defer) relies on this — a case whose optimistic
+    signature answers were wrong is executed a SECOND time under
+    ``bls.replaying`` and the replayed parts are committed; a case_fn
+    that diverges between runs would silently emit different vectors.
+    tests/test_gen_defer.py pins byte-identity across several handler
+    families to police this.
+    """
+
     fork_name: str
     preset_name: str
     runner_name: str

@@ -1069,7 +1069,11 @@ class Container(_Cached, SSZType):
             if name in kwargs:
                 object.__setattr__(self, name, _adopt(typ.coerce(kwargs.pop(name))))
             else:
-                object.__setattr__(self, name, typ.default())
+                # fresh defaults must pass the same ownership barrier as
+                # provided values: an unowned child assigned into a second
+                # parent would alias instead of snapshotting (_adopt on a
+                # brand-new object is a marking, not a copy)
+                object.__setattr__(self, name, _adopt(typ.default()))
         if kwargs:
             raise TypeError(f"{type(self).__name__}: unknown fields {sorted(kwargs)}")
 

@@ -27,29 +27,45 @@ def _tree(root: pathlib.Path) -> dict:
     }
 
 
+_HANDLERS = (
+    ("attestation", "tests.spec.test_operations_attestation"),
+    ("voluntary_exit", "tests.spec.test_operations_voluntary_exit"),
+)
+
+
 def _generate(out_dir: str, defer: bool) -> dict:
-    import tests.spec.test_operations_attestation as src
+    import importlib
 
-    def cases():
-        yield from generate_from_tests(
-            runner_name="operations",
-            handler_name="attestation",
-            src=src,
-            fork_name="phase0",
-            preset_name="minimal",
-            bls_active=True,
-        )
+    def make_cases(handler_name, mod_name):
+        def cases():
+            yield from generate_from_tests(
+                runner_name="operations",
+                handler_name=handler_name,
+                src=importlib.import_module(mod_name),
+                fork_name="phase0",
+                preset_name="minimal",
+                bls_active=True,
+            )
 
-    provider = TestProvider(prepare=lambda: None, make_cases=cases)
+        return cases
+
+    # TWO handler families in one run: the deferred queue spans providers
+    # (one flush per runner, not per handler) and both must replay clean
+    providers = [
+        TestProvider(prepare=lambda: None, make_cases=make_cases(h, m))
+        for h, m in _HANDLERS
+    ]
     args = ["-o", out_dir] + (["--bls-defer"] if defer else [])
-    run_generator("operations", [provider], args=args)
+    run_generator("operations", providers, args=args)
     return _tree(pathlib.Path(out_dir))
 
 
 @pytest.mark.bls
 def test_deferred_generation_is_byte_identical():
-    """Full attestation suite (valid + invalid-signature cases, real BLS)
-    generated twice; every emitted file must match bit-for-bit."""
+    """Attestation + voluntary_exit suites (valid + invalid-signature
+    cases, real BLS) generated twice — once synchronous, once deferred
+    with a single cross-provider flush; every emitted file must match
+    bit-for-bit."""
     bls.use_reference()
     with tempfile.TemporaryDirectory() as a, tempfile.TemporaryDirectory() as b:
         strict = _generate(a, defer=False)
@@ -57,9 +73,10 @@ def test_deferred_generation_is_byte_identical():
     assert strict.keys() == deferred.keys()
     mismatched = [k for k in strict if strict[k] != deferred[k]]
     assert mismatched == []
-    # the suite must actually contain a mispredicted (invalid-signature)
-    # case, otherwise this test proves nothing about the replay path
+    # the corpus must exercise the replay path (mispredicted cases) in
+    # BOTH families, otherwise this proves nothing about replay
     assert any("invalid_attestation_signature" in k for k in strict)
+    assert any("voluntary_exit" in k and "invalid" in k for k in strict)
 
 
 def test_deferred_verifier_bookkeeping():

@@ -337,3 +337,12 @@ def test_no_aliasing_between_parents():
     assert o4.x is not o3.x
     o3.x.b = uint64(1234)
     assert o4.x.b != 1234
+
+    # default-constructed children are owned too: a fresh default must
+    # pass the same barrier, or sharing it into a second parent aliases
+    d1 = Outer()
+    d2 = Outer(x=d1.x)
+    assert d2.x is not d1.x
+    d1.x.a = uint64(99)
+    assert d2.x.a == 0
+    assert d2.hash_tree_root() == Outer().hash_tree_root()

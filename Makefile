@@ -43,9 +43,20 @@ test-fast:
 test-device:
 	$(PYTHON) -m pytest $(DEVICE_TESTS) -q
 
+# preset-dependent behavior (shuffle caching, committee shapes, 512-key
+# sync paths, epoch accounting) only surfaces under mainnet: run the
+# operations + sanity + epoch-processing core there, phase0+altair
+MAINNET_TESTS = tests/spec/test_sanity_slots.py tests/spec/test_sanity_blocks.py \
+                tests/spec/test_sanity_multi_operations.py \
+                tests/spec/test_operations_attestation.py \
+                tests/spec/test_operations_proposer_slashing.py \
+                tests/spec/test_operations_voluntary_exit.py \
+                tests/spec/test_altair_sync_aggregate.py \
+                tests/spec/epoch_processing
+
 test-mainnet:
-	$(PYTHON) -m pytest -q --preset=mainnet tests/spec/test_sanity_slots.py \
-		tests/spec/test_operations_attestation.py tests/spec/test_altair_sync_aggregate.py
+	$(PYTHON) -m pytest -q --preset=mainnet --fork phase0 $(MAINNET_TESTS)
+	$(PYTHON) -m pytest -q --preset=mainnet --fork altair $(MAINNET_TESTS)
 
 lint:
 	$(PYTHON) -m compileall -q consensus_specs_tpu tests tools bench.py __graft_entry__.py

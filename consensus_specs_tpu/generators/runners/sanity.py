@@ -5,6 +5,7 @@ from ..gen_from_tests import run_state_test_generators
 mods = {
     "blocks": "tests.spec.test_sanity_blocks",
     "slots": "tests.spec.test_sanity_slots",
+    "multi_operations": "tests.spec.test_sanity_multi_operations",
 }
 
 all_mods = {fork: mods for fork in ("phase0", "altair", "bellatrix", "capella")}

@@ -14,6 +14,103 @@ UPGRADE_FNS = {
 }
 
 
+def _build_boundary_operation(spec, state, kind):
+    """(body_field, operation) built with `spec` against `state` — used
+    to plant one operation in the last pre-fork or first post-fork block."""
+    if kind == "proposer_slashing":
+        from .proposer_slashings import get_valid_proposer_slashing
+
+        victim = spec.get_active_validator_indices(state, spec.get_current_epoch(state))[-1]
+        return "proposer_slashings", get_valid_proposer_slashing(
+            spec, state, slashed_index=victim, signed_1=True, signed_2=True
+        )
+    if kind == "attester_slashing":
+        from .attester_slashings import get_valid_attester_slashing_by_indices
+
+        victim = spec.get_active_validator_indices(state, spec.get_current_epoch(state))[-2]
+        return "attester_slashings", get_valid_attester_slashing_by_indices(
+            spec, state, [victim], signed_1=True, signed_2=True
+        )
+    if kind == "deposit":
+        from .multi_operations import deposits_for
+
+        return "deposits", deposits_for(spec, state, 1)[0]
+    if kind == "voluntary_exit":
+        from .voluntary_exits import prepare_signed_exits
+
+        index = spec.get_active_validator_indices(state, spec.get_current_epoch(state))[-1]
+        return "voluntary_exits", prepare_signed_exits(spec, state, [index])[0]
+    if kind == "attestation":
+        from .attestations import get_valid_attestation
+
+        return "attestations", get_valid_attestation(
+            spec, state, slot=state.slot, signed=True
+        )
+    raise ValueError(f"unknown boundary operation {kind!r}")
+
+
+def run_fork_transition_with_operation(spec_pre, spec_post, state, kind, before_fork=False):
+    """Cross a fork boundary with one operation planted AT the boundary:
+    in the LAST pre-fork block (before_fork) or the FIRST post-fork block.
+    The attestation kind always comes from the pre-fork context, so the
+    post-fork inclusion path must handle a pre-fork vote (signature
+    domain resolved against the previous fork version). Voluntary exits
+    age the state first (the service-window slot-bump idiom)."""
+    yield "post_fork", "meta", spec_post.fork
+    if kind == "voluntary_exit":
+        state.slot += spec_pre.config.SHARD_COMMITTEE_PERIOD * spec_pre.SLOTS_PER_EPOCH
+    fork_epoch = int(spec_pre.get_current_epoch(state)) + 1
+    yield "fork_epoch", "meta", fork_epoch
+    yield "pre", state
+
+    blocks = []
+    fork_slot = fork_epoch * int(spec_pre.SLOTS_PER_EPOCH)
+    assert state.slot < fork_slot
+
+    # empty pre-fork chain up to (not including) the last pre-fork slot
+    while int(state.slot) + 2 < fork_slot:
+        block = build_empty_block_for_next_slot(spec_pre, state)
+        blocks.append(state_transition_and_sign_block(spec_pre, state, block))
+
+    # last pre-fork block — carries the op in the before_fork flavor
+    # (the op is built against the pre-block state; deposits also point
+    # the state's eth1_data at their tree, which is what processing reads)
+    block = build_empty_block_for_next_slot(spec_pre, state)
+    if before_fork:
+        field, operation = _build_boundary_operation(spec_pre, state, kind)
+        getattr(block.body, field).append(operation)
+    blocks.append(state_transition_and_sign_block(spec_pre, state, block))
+    yield "fork_block", "meta", len(blocks) - 1
+
+    # a cross-fork attestation is authored in the PRE-fork context
+    carried = None
+    if not before_fork and kind == "attestation":
+        carried = _build_boundary_operation(spec_pre, state, kind)
+
+    spec_pre.process_slots(state, fork_slot)
+    upgrade = getattr(spec_post, UPGRADE_FNS[spec_post.fork])
+    state = upgrade(state)
+
+    # first post-fork block at the fork-epoch start slot carries the op
+    # in the after flavor
+    if not before_fork and carried is None:
+        carried = _build_boundary_operation(spec_post, state, kind)
+    block = build_empty_block(spec_post, state, slot=state.slot)
+    if carried is not None:
+        field, operation = carried
+        getattr(block.body, field).append(operation)
+    spec_post.process_block(state, block)
+    block.state_root = spec_post.hash_tree_root(state)
+    blocks.append(sign_block(spec_post, state, block))
+
+    for _ in range(2):
+        block = build_empty_block_for_next_slot(spec_post, state)
+        blocks.append(state_transition_and_sign_block(spec_post, state, block))
+
+    yield "blocks", blocks
+    yield "post", state
+
+
 def run_fork_transition(
     spec_pre,
     spec_post,

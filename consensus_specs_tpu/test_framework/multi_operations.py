@@ -1,0 +1,263 @@
+"""Blocks carrying MANY operation kinds at once.
+
+Single-operation suites can't catch cross-operation interactions (a
+slashing invalidating a same-block exit, deposits growing the registry
+while attestations index the old one, sync aggregates over a registry
+mid-churn). This module builds such blocks two ways:
+
+- `run_slash_and_exit` — the minimal adversarial pair: slash and exit
+  in one block, valid when they hit different validators, invalid when
+  the same one (an exit check runs against the already-slashed record);
+- `build_full_house_block` / `run_full_house_test` — one deterministic
+  block carrying every phase0 operation family simultaneously (plus a
+  sync aggregate post-altair);
+- `random_operations_block` / `run_random_operations_test` — the
+  seeded-random matrix hook used by the sanity/random suites.
+
+Scenario parity target: ref test/helpers/multi_operations.py (242 LoC)
+— `run_slash_and_exit`, the per-kind random samplers, and
+`run_test_full_random_operations`. The pool-partitioning design here
+(disjoint validator draws per operation family, then per-family
+builders) is this repo's own.
+"""
+from __future__ import annotations
+
+from .attestations import get_valid_attestation
+from .attester_slashings import get_valid_attester_slashing_by_indices
+from .block import build_empty_block_for_next_slot
+from .block_processing import state_transition_and_sign_block
+from .constants import is_post_altair
+from .deposits import build_deposit_data, deposit_from_context
+from .keys import privkeys, pubkeys
+from .proposer_slashings import get_valid_proposer_slashing
+from .state import next_epoch
+from .sync_committee import (
+    compute_aggregate_sync_committee_signature,
+    compute_committee_indices,
+)
+from .voluntary_exits import prepare_signed_exits
+
+
+def age_for_exits(spec, state) -> None:
+    """Jump the clock far enough that genesis validators pass the
+    minimum-service exit check (no epoch processing — slot bump only,
+    the established cheap idiom)."""
+    state.slot += spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH
+
+
+def draw_pools(spec, state, rng, sizes):
+    """Partition a random sample of active validators into DISJOINT
+    pools, one per requested size — so each operation family targets
+    validators no other family touches in the same block."""
+    active = list(spec.get_active_validator_indices(state, spec.get_current_epoch(state)))
+    need = sum(sizes)
+    assert need <= len(active), f"state too small: need {need} of {len(active)}"
+    drawn = sorted(rng.sample(active, need))
+    pools, cursor = [], 0
+    for size in sizes:
+        pools.append(drawn[cursor:cursor + size])
+        cursor += size
+    return pools
+
+
+# ---------------------------------------------------------------------------
+# per-family builders (each consumes its own pool)
+# ---------------------------------------------------------------------------
+
+def proposer_slashings_for(spec, state, pool):
+    return [
+        get_valid_proposer_slashing(
+            spec, state, slashed_index=index, signed_1=True, signed_2=True
+        )
+        for index in pool
+    ]
+
+
+def attester_slashings_for(spec, state, pool, max_slashings=None):
+    """Split the pool into one double-vote slashing per chunk; chunk
+    sizes stay small so minimal-preset committees can host them."""
+    limit = int(max_slashings if max_slashings is not None else spec.MAX_ATTESTER_SLASHINGS)
+    chunks = [pool[i::limit] for i in range(limit)]
+    return [
+        get_valid_attester_slashing_by_indices(
+            spec, state, sorted(chunk), signed_1=True, signed_2=True
+        )
+        for chunk in chunks
+        if chunk
+    ]
+
+
+def attestations_for(spec, state, count, rng=None):
+    """`count` distinct signed attestations over recent attestable slots
+    (inclusion delay respected; slots chosen deterministically unless an
+    rng is supplied)."""
+    lo = max(0, int(state.slot) - int(spec.SLOTS_PER_EPOCH) + 1)
+    hi = int(state.slot) - int(spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    assert hi >= lo, "state too young to attest"
+    slots = list(range(lo, hi + 1))
+    picks = (
+        [slots[i % len(slots)] for i in range(count)]
+        if rng is None
+        else [rng.choice(slots) for _ in range(count)]
+    )
+    return [
+        get_valid_attestation(spec, state, slot=slot, signed=True) for slot in sorted(picks)
+    ]
+
+
+def deposits_for(spec, state, count, first_new_index=None):
+    """`count` fresh full deposits in ONE tree; points state.eth1_data at
+    the final tree root so every proof verifies in block order."""
+    if first_new_index is None:
+        first_new_index = len(state.validators)
+    data_list = []
+    for i in range(count):
+        idx = first_new_index + i
+        withdrawal = bytes(spec.BLS_WITHDRAWAL_PREFIX) + spec.hash(pubkeys[idx])[1:]
+        data_list.append(
+            build_deposit_data(
+                spec, pubkeys[idx], privkeys[idx], spec.MAX_EFFECTIVE_BALANCE,
+                withdrawal, signed=True,
+            )
+        )
+    # proofs must all be against the FINAL tree (the block processes them
+    # under one eth1_data), so derive them after the list is complete
+    deposits = []
+    root = None
+    for i in range(count):
+        deposit, root, _ = deposit_from_context(spec, data_list, i)
+        deposits.append(deposit)
+    state.eth1_deposit_index = 0
+    state.eth1_data.deposit_root = root
+    state.eth1_data.deposit_count = count
+    return deposits
+
+
+def sync_aggregate_for(spec, state, block_slot, participation=1.0, rng=None):
+    """A valid SyncAggregate for a block at `block_slot` with the given
+    participation fraction (altair+ only)."""
+    committee = compute_committee_indices(spec, state)
+    seats = len(committee)
+    live = int(seats * participation)
+    chosen = sorted(rng.sample(range(seats), live)) if rng is not None else list(range(live))
+    bits = [False] * seats
+    for seat in chosen:
+        bits[seat] = True
+    return spec.SyncAggregate(
+        sync_committee_bits=bits,
+        sync_committee_signature=compute_aggregate_sync_committee_signature(
+            spec, state, block_slot - 1, [committee[s] for s in chosen]
+        ),
+    )
+
+
+# ---------------------------------------------------------------------------
+# scenario drivers
+# ---------------------------------------------------------------------------
+
+def run_slash_and_exit(spec, state, slash_index, exit_index, valid=True):
+    """One block: attester-slash `slash_index` AND voluntary-exit
+    `exit_index`. With slash_index == exit_index the block must fail —
+    initiate_validator_exit inside the slashing already set an exit
+    epoch, and the exit's own processing re-checks it. Yields the
+    pre/blocks/post vector parts."""
+    yield "pre", state
+
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.attester_slashings.append(
+        get_valid_attester_slashing_by_indices(
+            spec, state, [slash_index], signed_1=True, signed_2=True
+        )
+    )
+    block.body.voluntary_exits.append(prepare_signed_exits(spec, state, [exit_index])[0])
+
+    signed = state_transition_and_sign_block(spec, state, block, expect_fail=not valid)
+    yield "blocks", [signed]
+    yield "post", state if valid else None
+
+
+def build_full_house_block(spec, state, rng):
+    """A next-slot block carrying: 1 proposer slashing, 1 attester
+    slashing, attestations, `MAX_DEPOSITS` deposits, and 1 voluntary
+    exit — every family at once, targeting disjoint validators. Returns
+    (block, touched) where `touched` maps family -> validator indices."""
+    (ps_pool, as_pool, exit_pool) = draw_pools(spec, state, rng, [1, 1, 1])
+
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.proposer_slashings = proposer_slashings_for(spec, state, ps_pool)
+    block.body.attester_slashings = attester_slashings_for(spec, state, as_pool)
+    for attestation in attestations_for(spec, state, 2):
+        block.body.attestations.append(attestation)
+    for deposit in deposits_for(spec, state, int(spec.MAX_DEPOSITS)):
+        block.body.deposits.append(deposit)
+    block.body.voluntary_exits = prepare_signed_exits(spec, state, exit_pool)
+    if is_post_altair(spec):
+        block.body.sync_aggregate = sync_aggregate_for(spec, state, block.slot)
+    touched = {"proposer_slashing": ps_pool, "attester_slashing": as_pool, "exit": exit_pool}
+    return block, touched
+
+
+def run_full_house_test(spec, state, rng):
+    """Apply a full-house block and check every family took effect."""
+    age_for_exits(spec, state)
+    next_epoch(spec, state)  # gives attestations a full epoch to target
+    pre_validators = len(state.validators)
+
+    yield "pre", state
+    block, touched = build_full_house_block(spec, state, rng)
+    signed = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed]
+    yield "post", state
+
+    for index in touched["proposer_slashing"] + touched["attester_slashing"]:
+        assert state.validators[index].slashed
+    for index in touched["exit"]:
+        assert state.validators[index].exit_epoch < spec.FAR_FUTURE_EPOCH
+        assert not state.validators[index].slashed
+    assert len(state.validators) == pre_validators + int(spec.MAX_DEPOSITS)
+    # attestations landed in the pending queue (phase0) or flipped
+    # participation flags (altair+)
+    if is_post_altair(spec):
+        assert any(int(flag) != 0 for flag in state.current_epoch_participation) or any(
+            int(flag) != 0 for flag in state.previous_epoch_participation
+        )
+    else:
+        assert len(state.current_epoch_attestations) + len(state.previous_epoch_attestations) > 0
+
+
+def random_operations_block(spec, state, rng):
+    """The randomized matrix hook: sample how much of each family to
+    carry (possibly zero), honoring block capacity limits."""
+    n_ps = rng.randint(0, min(2, int(spec.MAX_PROPOSER_SLASHINGS)))
+    n_as_targets = rng.randint(0, 2)
+    n_att = rng.randint(0, 3)
+    n_dep = rng.randint(0, int(spec.MAX_DEPOSITS))
+    n_exit = rng.randint(0, 1)
+
+    ps_pool, as_pool, exit_pool = draw_pools(spec, state, rng, [n_ps, n_as_targets, n_exit])
+
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.proposer_slashings = proposer_slashings_for(spec, state, ps_pool)
+    block.body.attester_slashings = attester_slashings_for(spec, state, as_pool)
+    for attestation in attestations_for(spec, state, n_att, rng=rng):
+        block.body.attestations.append(attestation)
+    if n_dep:
+        for deposit in deposits_for(spec, state, n_dep):
+            block.body.deposits.append(deposit)
+    block.body.voluntary_exits = prepare_signed_exits(spec, state, exit_pool)
+    if is_post_altair(spec):
+        block.body.sync_aggregate = sync_aggregate_for(
+            spec, state, block.slot, participation=rng.random(), rng=rng
+        )
+    return block
+
+
+def run_random_operations_test(spec, state, rng):
+    """A seeded random full-mix block applied as a sanity transition."""
+    age_for_exits(spec, state)
+    next_epoch(spec, state)
+    yield "pre", state
+    block = random_operations_block(spec, state, rng)
+    signed = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed]
+    yield "post", state

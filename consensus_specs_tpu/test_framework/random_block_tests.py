@@ -64,15 +64,19 @@ def _random_attestations(spec, state, rng, max_count=2):
     return atts
 
 
-def _maybe_attester_slashing(spec, state, rng, slashed: set):
-    """Occasionally double-vote-slash a not-yet-slashed validator."""
-    if rng.random() > 0.2:
-        return None
-    candidates = [
+def _slashable_candidates(spec, state, slashed: set):
+    return [
         i
         for i in spec.get_active_validator_indices(state, spec.get_current_epoch(state))
         if i not in slashed and not state.validators[i].slashed
     ]
+
+
+def _maybe_attester_slashing(spec, state, rng, slashed: set):
+    """Occasionally double-vote-slash a not-yet-slashed validator."""
+    if rng.random() > 0.2:
+        return None
+    candidates = _slashable_candidates(spec, state, slashed)
     if not candidates:
         return None
     victim = rng.choice(candidates)
@@ -81,6 +85,53 @@ def _maybe_attester_slashing(spec, state, rng, slashed: set):
     )
     slashed.add(victim)
     return slashing
+
+
+def _maybe_proposer_slashing(spec, state, rng, slashed: set):
+    """Occasionally double-propose-slash a not-yet-slashed validator."""
+    if rng.random() > 0.2:
+        return None
+    candidates = _slashable_candidates(spec, state, slashed)
+    if not candidates:
+        return None
+    from .proposer_slashings import get_valid_proposer_slashing
+
+    victim = rng.choice(candidates)
+    slashing = get_valid_proposer_slashing(
+        spec, state, slashed_index=victim, signed_1=True, signed_2=True
+    )
+    slashed.add(victim)
+    return slashing
+
+
+def _maybe_voluntary_exit(spec, state, rng, slashed: set):
+    """Occasionally exit a validator that has served long enough (only
+    possible in scenarios whose state has aged past the minimum-service
+    window — near-genesis scenarios simply never draw one)."""
+    if rng.random() > 0.2:
+        return None
+    from .voluntary_exits import prepare_signed_exits
+
+    current_epoch = spec.get_current_epoch(state)
+    eligible = [
+        i
+        for i in spec.get_active_validator_indices(state, current_epoch)
+        if current_epoch >= state.validators[i].activation_epoch + spec.config.SHARD_COMMITTEE_PERIOD
+        and state.validators[i].exit_epoch == spec.FAR_FUTURE_EPOCH
+        and i not in slashed
+    ]
+    if not eligible:
+        return None
+    return prepare_signed_exits(spec, state, [rng.choice(eligible)])[0]
+
+
+def _maybe_deposits(spec, state, rng):
+    """Occasionally add fresh full deposits (new registry entries)."""
+    if rng.random() > 0.2:
+        return []
+    from .multi_operations import deposits_for
+
+    return deposits_for(spec, state, rng.randint(1, 2))
 
 
 def _advance_past_slashed_proposers(spec, state):
@@ -97,14 +148,30 @@ def _advance_past_slashed_proposers(spec, state):
 
 
 def build_random_block(spec, state, rng, slashed: set):
-    """A valid block with a random operation mix."""
+    """A valid block with a random operation mix: attestations plus
+    (probabilistically) attester/proposer slashings, fresh deposits, a
+    voluntary exit, and a random-participation sync aggregate (altair+)."""
     _advance_past_slashed_proposers(spec, state)
     block = build_empty_block_for_next_slot(spec, state)
     for att in _random_attestations(spec, state, rng):
         block.body.attestations.append(att)
-    slashing = _maybe_attester_slashing(spec, state, rng, slashed)
-    if slashing is not None:
-        block.body.attester_slashings.append(slashing)
+    att_slashing = _maybe_attester_slashing(spec, state, rng, slashed)
+    if att_slashing is not None:
+        block.body.attester_slashings.append(att_slashing)
+    prop_slashing = _maybe_proposer_slashing(spec, state, rng, slashed)
+    if prop_slashing is not None:
+        block.body.proposer_slashings.append(prop_slashing)
+    for deposit in _maybe_deposits(spec, state, rng):
+        block.body.deposits.append(deposit)
+    exit_op = _maybe_voluntary_exit(spec, state, rng, slashed)
+    if exit_op is not None:
+        block.body.voluntary_exits.append(exit_op)
+    if is_post_altair(spec) and rng.random() < 0.5:
+        from .multi_operations import sync_aggregate_for
+
+        block.body.sync_aggregate = sync_aggregate_for(
+            spec, state, int(block.slot), participation=rng.random(), rng=rng
+        )
     return block
 
 
@@ -119,6 +186,10 @@ SCENARIOS = {
     "random_3": ["block", "random_slots", "block", "random_slots", "block"],
     "leak_0": ["leak", "block", "next_epoch", "block"],
     "leak_1": ["leak", "random_slots", "block", "block"],
+    # aged states: past the minimum-service window, so the random op mix
+    # can draw voluntary exits too
+    "aged_0": ["age", "next_epoch", "block", "block", "next_epoch", "block"],
+    "aged_1": ["age", "next_epoch", "random_slots", "block", "block"],
 }
 
 
@@ -176,6 +247,10 @@ def run_random_scenario(spec, state, scenario_name, seed):
             for _ in range(int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 3):
                 next_epoch(spec, state)
             assert spec.is_in_inactivity_leak(state)
+        elif step == "age":
+            # jump past the minimum-service window (cheap slot bump, the
+            # established idiom) so voluntary exits become drawable
+            state.slot += spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH
         elif step == "block":
             block = build_random_block(spec, state, rng, slashed)
             signed = state_transition_and_sign_block(spec, state, block)

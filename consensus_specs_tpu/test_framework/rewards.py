@@ -244,6 +244,71 @@ def run_test_partial_participation(spec, state, fraction):
     yield from run_deltas(spec, state)
 
 
+def degrade_vote_correctness(spec, state, rng, wrong_target_prob=0.0, wrong_head_prob=0.0):
+    """Make some previous-epoch votes INCORRECT after the fact.
+
+    Phase0 stores PendingAttestations (no signatures), so vote quality is
+    revisable in place: corrupting `target.root` drops the vote from the
+    target AND head matching sets (head matching is evaluated within the
+    matching-target subset); corrupting only `beacon_block_root` spoils
+    just the head vote. Altair encodes correctness as participation
+    flags: a wrong target strips TIMELY_TARGET|TIMELY_HEAD, a wrong head
+    strips TIMELY_HEAD. Source votes stay correct (an incorrect-source
+    attestation would never have been included)."""
+    if is_post_altair(spec):
+        target_bit = 2 ** int(spec.TIMELY_TARGET_FLAG_INDEX)
+        head_bit = 2 ** int(spec.TIMELY_HEAD_FLAG_INDEX)
+        for index, flags in enumerate(state.previous_epoch_participation):
+            value = int(flags)
+            if value & target_bit and rng.random() < wrong_target_prob:
+                value &= ~(target_bit | head_bit)
+            elif value & head_bit and rng.random() < wrong_head_prob:
+                value &= ~head_bit
+            state.previous_epoch_participation[index] = spec.ParticipationFlags(value)
+    else:
+        for pending in state.previous_epoch_attestations:
+            if rng.random() < wrong_target_prob:
+                pending.data.target.root = b"\x66" * 32
+            elif rng.random() < wrong_head_prob:
+                pending.data.beacon_block_root = b"\x67" * 32
+
+
+def run_test_correct_source_incorrect_target(spec, state, rng=None):
+    """Everyone attested, but half the votes picked the wrong target:
+    those validators keep source rewards while paying target+head
+    penalties."""
+    rng = rng or Random(7700)
+    prepare_state_with_attestations(spec, state)
+    degrade_vote_correctness(spec, state, rng, wrong_target_prob=0.5)
+    yield from run_deltas(spec, state)
+
+
+def run_test_incorrect_head_only(spec, state, rng=None):
+    """Everyone attested with correct source+target but half voted a
+    wrong head: head component flips to penalty (phase0) / zero reward
+    (altair) for them, other components unaffected."""
+    rng = rng or Random(7701)
+    prepare_state_with_attestations(spec, state)
+    degrade_vote_correctness(spec, state, rng, wrong_head_prob=0.5)
+    yield from run_deltas(spec, state)
+
+
+def run_test_stretched_inclusion_delay(spec, state, rng=None):
+    """Every vote correct but included LATE: phase0's inclusion-delay
+    component shrinks by 1/delay (altair has no inclusion-delay deltas —
+    the mutation is a no-op there and the run degenerates to
+    full-correct, kept for the fork matrix's sake)."""
+    rng = rng or Random(7702)
+    prepare_state_with_attestations(spec, state)
+    if not is_post_altair(spec):
+        cap = int(spec.SLOTS_PER_EPOCH)
+        for pending in state.previous_epoch_attestations:
+            pending.inclusion_delay = max(
+                int(pending.inclusion_delay), rng.randint(2, cap)
+            )
+    yield from run_deltas(spec, state)
+
+
 def run_test_with_not_yet_activated_validators(spec, state, rng=None):
     rng = rng or Random(5555)
     set_some_activations_far_future(spec, state, rng)

@@ -101,3 +101,112 @@ class TestBlockProcessing:
         with pytest.raises(AssertionError):
             spec.process_blob_kzgs(state, block.body)
         yield "post", None
+
+
+class TestValidatorSurface:
+    """Honest-validator blob handling (ref: specs/eip4844/validator.md)."""
+
+    def _sidecar_fixture(self, spec):
+        blobs = [spec.Blob([4, 3, 2, 1]), spec.Blob([9, 9, 9, 9])]
+        kzgs = [spec.blob_to_kzg(b) for b in blobs]
+        sidecar = spec.BlobsSidecar(
+            beacon_block_root=spec.Root(b"\x42" * 32),
+            beacon_block_slot=spec.Slot(3),
+            blobs=blobs,
+        )
+        return blobs, kzgs, sidecar
+
+    def test_verify_blobs_sidecar_accepts_matching(self, spec):
+        _, kzgs, sidecar = self._sidecar_fixture(spec)
+        spec.verify_blobs_sidecar(spec.Slot(3), spec.Root(b"\x42" * 32), kzgs, sidecar)
+
+    def test_verify_blobs_sidecar_rejects_mismatches(self, spec):
+        _, kzgs, sidecar = self._sidecar_fixture(spec)
+        with pytest.raises(AssertionError):  # wrong slot
+            spec.verify_blobs_sidecar(spec.Slot(4), spec.Root(b"\x42" * 32), kzgs, sidecar)
+        with pytest.raises(AssertionError):  # wrong block root
+            spec.verify_blobs_sidecar(spec.Slot(3), spec.Root(b"\x43" * 32), kzgs, sidecar)
+        with pytest.raises(AssertionError):  # commitment count mismatch
+            spec.verify_blobs_sidecar(spec.Slot(3), spec.Root(b"\x42" * 32), kzgs[:1], sidecar)
+        wrong = [kzgs[1], kzgs[0]]
+        with pytest.raises(AssertionError):  # commitment/blob pairing mismatch
+            spec.verify_blobs_sidecar(spec.Slot(3), spec.Root(b"\x42" * 32), wrong, sidecar)
+
+    def test_is_data_available_requires_retrievable_sidecar(self, spec, monkeypatch):
+        _, kzgs, sidecar = self._sidecar_fixture(spec)
+        # default stub: nothing retrievable -> not available
+        assert not spec.is_data_available(spec.Slot(3), spec.Root(b"\x42" * 32), kzgs)
+        monkeypatch.setattr(spec, "retrieve_blobs_sidecar", lambda slot, root: sidecar)
+        assert spec.is_data_available(spec.Slot(3), spec.Root(b"\x42" * 32), kzgs)
+        # retrievable but inconsistent -> still unavailable
+        assert not spec.is_data_available(spec.Slot(3), spec.Root(b"\x42" * 32), kzgs[:1])
+
+    def test_validate_blobs_and_kzg_commitments(self, spec):
+        blobs, kzgs, _ = self._sidecar_fixture(spec)
+        payload = spec.ExecutionPayload()
+        payload.transactions.append(
+            make_blob_tx(spec, [spec.kzg_to_versioned_hash(k) for k in kzgs])
+        )
+        spec.validate_blobs_and_kzg_commitments(payload, blobs, kzgs)
+        with pytest.raises(AssertionError):  # blob/commitment count mismatch
+            spec.validate_blobs_and_kzg_commitments(payload, blobs[:1], kzgs)
+        with pytest.raises(AssertionError):  # commitments vs transactions mismatch
+            spec.validate_blobs_and_kzg_commitments(payload, blobs[:1], kzgs[:1])
+
+    @with_phases([EIP4844])
+    @spec_state_test
+    def test_signed_sidecar_gossip_roundtrip(self, spec, state):
+        """get_blobs_sidecar -> get_signed_blobs_sidecar must satisfy the
+        blobs_sidecar topic REJECT conditions, and fail them for a wrong
+        proposer key or an out-of-field blob element."""
+        from consensus_specs_tpu.test_framework.keys import privkeys, pubkeys
+
+        blobs = [spec.Blob([4, 3, 2, 1])]
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.blob_kzgs.append(spec.blob_to_kzg(blobs[0]))
+        sidecar = spec.get_blobs_sidecar(block, blobs)
+        assert sidecar.beacon_block_slot == block.slot
+        assert sidecar.beacon_block_root == block.hash_tree_root()
+
+        proposer = spec.get_beacon_proposer_index(state)
+        signed = spec.get_signed_blobs_sidecar(state, sidecar, privkeys[proposer])
+        yield "pre", state
+        assert spec.validate_gossip_blobs_sidecar(state, signed, pubkeys[proposer])
+        # wrong proposer key
+        assert not spec.validate_gossip_blobs_sidecar(state, signed, pubkeys[proposer + 1])
+        # corrupt signature
+        bad = signed.copy()
+        bad.signature = spec.BLSSignature(bytes(96))
+        assert not spec.validate_gossip_blobs_sidecar(state, bad, pubkeys[proposer])
+        yield "post", None
+
+    @with_phases([EIP4844])
+    @spec_state_test
+    def test_gossip_beacon_block_kzg_conditions(self, spec, state):
+        blob = spec.Blob([4, 3, 2, 1])
+        commitment = spec.blob_to_kzg(blob)
+        tx = make_blob_tx(spec, [spec.kzg_to_versioned_hash(commitment)])
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.execution_payload.transactions.append(tx)
+        block.body.blob_kzgs.append(commitment)
+        yield "pre", state
+        assert spec.validate_gossip_beacon_block_kzgs(block)
+        # a commitment that is not a valid compressed G1 point
+        garbage = block.copy()
+        garbage.body.blob_kzgs[0] = spec.KZGCommitment(b"\xff" * 48)
+        assert not spec.validate_gossip_beacon_block_kzgs(garbage)
+        # commitments inconsistent with the payload's blob transactions
+        mismatched = block.copy()
+        mismatched.body.blob_kzgs[0] = spec.blob_to_kzg(spec.Blob([1, 1, 1, 1]))
+        assert not spec.validate_gossip_beacon_block_kzgs(mismatched)
+        yield "post", None
+
+    def test_blobs_serve_range(self, spec):
+        lo, hi = spec.compute_blobs_serve_range(spec.Epoch(5))
+        assert (int(lo), int(hi)) == (0, 5)  # floored at genesis
+        far = 2**13 + 100
+        lo, hi = spec.compute_blobs_serve_range(spec.Epoch(far))
+        assert int(lo) == 100 and int(hi) == far
+        req = spec.BlobsSidecarsByRangeRequest(start_slot=spec.Slot(8), count=4)
+        assert int(req.start_slot) == 8 and int(req.count) == 4
+        assert spec.MAX_REQUEST_BLOBS_SIDECARS == 128

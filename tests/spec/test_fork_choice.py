@@ -271,3 +271,115 @@ def test_on_attester_slashing_equivocation(spec, state):
     for i in participants[:2]:
         assert i in store.latest_messages  # message retained
     yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_filtered_block_tree(spec, state):
+    """get_head only walks the justified-compatible subtree: a side
+    branch whose leaf states never saw the store's justified checkpoint
+    is invisible to head selection even when it holds ALL the live LMD
+    votes."""
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+    on_tick_and_append_step(
+        spec, store, store.genesis_time + state.slot * spec.config.SECONDS_PER_SLOT, test_steps
+    )
+
+    # the rival branch seed, forked at genesis and kept silent
+    rival_state = state.copy()
+    rival_block = build_empty_block_for_next_slot(spec, rival_state)
+    rival_block.body.graffiti = b"\x52" * 32
+    signed_rival = state_transition_and_sign_block(spec, rival_state, rival_block)
+    rival_root = spec.hash_tree_root(rival_block)
+
+    # canonical chain justifies an epoch through the store
+    next_epoch(spec, state)
+    state, store, last_canonical = yield from apply_next_epoch_with_attestations(
+        spec, state, store, True, True, test_steps=test_steps
+    )
+    canonical_head = spec.hash_tree_root(last_canonical.message)
+    assert store.justified_checkpoint.epoch > 0
+    assert store.finalized_checkpoint.epoch == 0  # rival stays addable
+    assert spec.get_head(store) == canonical_head
+
+    # rival branch enters the store (clock is already past its slot)
+    yield from add_block(spec, store, signed_rival, test_steps)
+
+    # every live vote goes to the rival: advance its (empty) chain to the
+    # store's clock and attest its tip
+    next_slots(spec, rival_state, int(state.slot) - int(rival_state.slot))
+    attestation = get_valid_attestation(
+        spec, rival_state, slot=rival_state.slot - 1, signed=True
+    )
+    assert attestation.data.beacon_block_root == rival_root
+    next_slots(spec, state, 1)
+    on_tick_and_append_step(
+        spec, store, store.genesis_time + state.slot * spec.config.SECONDS_PER_SLOT, test_steps
+    )
+    yield from add_attestation(spec, store, attestation, test_steps)
+    assert len(store.latest_messages) > 0  # the votes landed
+
+    # ...but the rival subtree is filtered out: head stays canonical
+    assert rival_root in store.blocks
+    assert rival_root not in spec.get_filtered_block_tree(store)
+    assert spec.get_head(store) == canonical_head
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_discard_equivocations_flips_head(spec, state):
+    """Votes that tipped a two-way split are nullified by an equivocation
+    slashing; the head falls back to the tie-break winner."""
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+    on_tick_and_append_step(
+        spec, store, store.genesis_time + state.slot * spec.config.SECONDS_PER_SLOT, test_steps
+    )
+
+    # two siblings at slot 1
+    state_a, state_b = state.copy(), state.copy()
+    block_a = build_empty_block_for_next_slot(spec, state_a)
+    signed_a = state_transition_and_sign_block(spec, state_a, block_a)
+    block_b = build_empty_block_for_next_slot(spec, state_b)
+    block_b.body.graffiti = b"\x42" * 32
+    signed_b = state_transition_and_sign_block(spec, state_b, block_b)
+    root_a, root_b = spec.hash_tree_root(block_a), spec.hash_tree_root(block_b)
+
+    yield from tick_and_add_block(spec, store, signed_a, test_steps)
+    yield from tick_and_add_block(spec, store, signed_b, test_steps)
+
+    # clear the proposer boost; the split is now a pure root tie-break
+    on_tick_and_append_step(
+        spec, store,
+        store.genesis_time + (block_a.slot + 1) * spec.config.SECONDS_PER_SLOT,
+        test_steps,
+    )
+    tiebreak_winner = max(root_a, root_b, key=bytes)
+    tiebreak_loser = root_b if tiebreak_winner == root_a else root_a
+    assert spec.get_head(store) == tiebreak_winner
+
+    # one committee votes the LOSER into the lead
+    loser_state = state_b if tiebreak_winner == root_a else state_a
+    attestation = get_valid_attestation(spec, loser_state, slot=block_a.slot, signed=True)
+    assert attestation.data.beacon_block_root == tiebreak_loser
+    voters = sorted(
+        spec.get_attesting_indices(loser_state, attestation.data, attestation.aggregation_bits)
+    )
+    yield from add_attestation(spec, store, attestation, test_steps)
+    assert spec.get_head(store) == tiebreak_loser
+
+    # the voters all equivocate; their weight must vanish and the
+    # tie-break verdict must return
+    slashing = get_valid_attester_slashing_by_indices(
+        spec, loser_state, voters, signed_1=True, signed_2=True
+    )
+    yield from add_attester_slashing(spec, store, slashing, test_steps)
+    assert set(voters) <= store.equivocating_indices
+    assert spec.get_head(store) == tiebreak_winner
+    yield "steps", test_steps

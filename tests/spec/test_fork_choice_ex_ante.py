@@ -232,3 +232,56 @@ def test_ex_ante_sandwich_with_honest_attestations_sticks(spec, state):
     assert spec.get_head(store) == spec.hash_tree_root(block_c)
 
     yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_ex_ante_sandwich_single_honest_vote_insufficient(spec, state):
+    """One lone honest vote for C is below the boost weight: the sandwich
+    closer D (timely, on B) still reorgs C out — the complement of the
+    sticks case above, bounding exactly where the defense gives way."""
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+
+    state_a, signed_a = yield from _setup_A(spec, state, store, test_steps)
+
+    state_b = state_a.copy()
+    block_b = build_empty_block_for_next_slot(spec, state_b)
+    signed_b = state_transition_and_sign_block(spec, state_b, block_b)
+
+    state_c = state_a.copy()
+    block_c = build_empty_block(spec, state_c, slot=state_a.slot + 2)
+    signed_c = state_transition_and_sign_block(spec, state_c, block_c)
+
+    # exactly one honest vote for C — weight strictly below the boost
+    att_c = get_valid_attestation(
+        spec, state_c, slot=block_c.slot, index=0, signed=True,
+        filter_participant_set=_single_attester,
+    )
+    lone_weight = sum(
+        state_c.validators[i].effective_balance
+        for i in spec.get_attesting_indices(state_c, att_c.data, att_c.aggregation_bits)
+    )
+    assert lone_weight < _boost_weight(spec, state_c)
+
+    state_d = state_b.copy()
+    block_d = build_empty_block(spec, state_d, slot=state_b.slot + 2)
+    signed_d = state_transition_and_sign_block(spec, state_d, block_d)
+
+    time = int(state.genesis_time + block_c.slot * spec.config.SECONDS_PER_SLOT)
+    on_tick_and_append_step(spec, store, time, test_steps)
+    yield from add_block(spec, store, signed_b, test_steps)
+    yield from add_block(spec, store, signed_c, test_steps)
+    assert spec.get_head(store) == spec.hash_tree_root(block_c)
+
+    time = int(state.genesis_time + block_d.slot * spec.config.SECONDS_PER_SLOT)
+    on_tick_and_append_step(spec, store, time, test_steps)
+    yield from add_attestation(spec, store, att_c, test_steps)
+    yield from add_block(spec, store, signed_d, test_steps)
+
+    assert store.proposer_boost_root == spec.hash_tree_root(block_d)
+    assert spec.get_head(store) == spec.hash_tree_root(block_d)
+
+    yield "steps", test_steps

@@ -382,3 +382,136 @@ def test_new_finalized_slot_is_not_justified_checkpoint_ancestor(spec, state):
     assert store.finalized_checkpoint == another_state.finalized_checkpoint
     assert store.justified_checkpoint == another_state.current_justified_checkpoint
     yield "steps", test_steps
+
+
+# -- justified-checkpoint races (ref test_on_block.py safe-slots cases) ------
+
+@with_all_phases
+@spec_state_test
+def test_justified_update_within_safe_slots(spec, state):
+    """A boundary block whose post-state justifies a NEW epoch, arriving
+    in the first SAFE_SLOTS_TO_UPDATE_JUSTIFIED slots of the store's
+    epoch, updates store.justified_checkpoint immediately (no deferral
+    through best_justified)."""
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+    on_tick_and_append_step(
+        spec, store, store.genesis_time + state.slot * spec.config.SECONDS_PER_SLOT, test_steps
+    )
+
+    # epoch 1 fully attested -> store justifies epoch 1 (no finality yet)
+    next_epoch(spec, state)
+    state, store, _ = yield from apply_next_epoch_with_attestations(
+        spec, state, store, True, True, test_steps=test_steps
+    )
+    base_epoch = store.justified_checkpoint.epoch
+    assert base_epoch > 0
+    assert store.finalized_checkpoint.epoch == 0
+    assert store.best_justified_checkpoint.epoch == base_epoch
+
+    # a silent (attestation-free) epoch breaks justification adjacency,
+    # so the NEXT justification bump cannot drag finality with it
+    next_epoch(spec, state)
+
+    # build the justifying epoch offline; its final block crosses the
+    # epoch boundary, so only that block's post-state carries the bump
+    _, offline_blocks, state = next_epoch_with_attestations(spec, state, True, False)
+    bump_block = offline_blocks[-1]
+    assert bump_block.message.slot % spec.SLOTS_PER_EPOCH == 0
+    for signed_block in offline_blocks[:-1]:
+        yield from tick_and_add_block(spec, store, signed_block, test_steps)
+        assert store.justified_checkpoint.epoch == base_epoch
+
+    # deliver the boundary block AT the boundary slot: zero slots into
+    # the epoch < SAFE_SLOTS_TO_UPDATE_JUSTIFIED -> immediate adoption
+    yield from tick_and_add_block(spec, store, bump_block, test_steps)
+    new_justified = store.block_states[
+        spec.hash_tree_root(bump_block.message)
+    ].current_justified_checkpoint
+    assert new_justified.epoch > base_epoch
+    assert store.justified_checkpoint == new_justified
+    assert store.best_justified_checkpoint == new_justified
+    assert store.finalized_checkpoint.epoch == 0  # isolated from finality path
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_justified_race_outside_safe_slots_deferred(spec, state):
+    """A conflicting fork justifies a LATER epoch, but its justified root
+    does not descend through the store's current justified checkpoint and
+    it arrives outside the safe-slot window: on_block must park it in
+    best_justified_checkpoint, and the next epoch-boundary tick pulls it
+    up (it does descend from the finalized root)."""
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+    on_tick_and_append_step(
+        spec, store, store.genesis_time + state.slot * spec.config.SECONDS_PER_SLOT, test_steps
+    )
+
+    # the fork seed: a distinct block at slot 1 kept OFF the main chain
+    fork_state = state.copy()
+    fork_seed = build_empty_block_for_next_slot(spec, fork_state)
+    fork_seed.body.graffiti = b"\x64" * 32
+    signed_fork_seed = state_transition_and_sign_block(spec, fork_state, fork_seed)
+
+    # main chain: justify epoch 1 through the store (checkpoint root is
+    # the genesis block -- the fork seed is NOT in its history)
+    next_epoch(spec, state)
+    state, store, _ = yield from apply_next_epoch_with_attestations(
+        spec, state, store, True, True, test_steps=test_steps
+    )
+    main_justified = store.justified_checkpoint
+    assert main_justified.epoch == 2 or main_justified.epoch == 1
+    assert store.finalized_checkpoint.epoch == 0
+
+    # fork chain (offline): silent epoch, then a fully-attested epoch --
+    # its boundary block justifies a later epoch rooted at the fork seed
+    yield from add_block(spec, store, signed_fork_seed, test_steps)
+    next_epoch(spec, fork_state)
+    next_epoch(spec, fork_state)
+    while spec.get_current_epoch(fork_state) <= main_justified.epoch:
+        next_epoch(spec, fork_state)
+    _, fork_blocks, fork_state = next_epoch_with_attestations(spec, fork_state, True, False)
+    bump_block = fork_blocks[-1]
+    for signed_block in fork_blocks[:-1]:
+        yield from tick_and_add_block(spec, store, signed_block, test_steps)
+
+    # hold the boundary block back until the store clock is PAST the
+    # safe-slot window of the boundary's epoch
+    held_until = int(bump_block.message.slot) + int(spec.SAFE_SLOTS_TO_UPDATE_JUSTIFIED)
+    on_tick_and_append_step(
+        spec, store,
+        store.genesis_time + held_until * spec.config.SECONDS_PER_SLOT,
+        test_steps,
+    )
+    yield from add_block(spec, store, bump_block, test_steps)
+    fork_justified = store.block_states[
+        spec.hash_tree_root(bump_block.message)
+    ].current_justified_checkpoint
+    assert fork_justified.epoch > main_justified.epoch
+    assert spec.get_ancestor(
+        store, fork_justified.root,
+        spec.compute_start_slot_at_epoch(main_justified.epoch),
+    ) != main_justified.root  # genuinely conflicting lineage
+
+    # deferred: justified unchanged, best_justified advanced
+    assert store.justified_checkpoint == main_justified
+    assert store.best_justified_checkpoint == fork_justified
+
+    # the next epoch-boundary tick reconciles (fork descends from the
+    # finalized root, which is still genesis)
+    next_boundary = spec.compute_start_slot_at_epoch(
+        spec.compute_epoch_at_slot(held_until) + 1
+    )
+    on_tick_and_append_step(
+        spec, store,
+        store.genesis_time + int(next_boundary) * spec.config.SECONDS_PER_SLOT,
+        test_steps,
+    )
+    assert store.justified_checkpoint == fork_justified
+    yield "steps", test_steps

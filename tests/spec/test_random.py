@@ -44,6 +44,18 @@ def test_randomized_leak_1(spec, state):
     yield from run_random_scenario(spec, state, "leak_1", seed=445)
 
 
+@with_all_phases
+@spec_state_test
+def test_randomized_aged_0(spec, state):
+    yield from run_random_scenario(spec, state, "aged_0", seed=446)
+
+
+@with_all_phases
+@spec_state_test
+def test_randomized_aged_1(spec, state):
+    yield from run_random_scenario(spec, state, "aged_1", seed=447)
+
+
 # -- scenario-matrix tests: generated from the same table that defines
 # the scenarios (random_block_tests._expand_matrix) so the two can
 # never drift; seeds are positional (500 + index)

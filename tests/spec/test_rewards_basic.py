@@ -59,3 +59,21 @@ def test_with_slashed_validators(spec, state):
 @spec_state_test
 def test_some_very_low_effective_balances_that_attested(spec, state):
     yield from rewards.run_test_some_very_low_effective_balances_that_attested(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_correct_source_incorrect_target(spec, state):
+    yield from rewards.run_test_correct_source_incorrect_target(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_incorrect_head_only(spec, state):
+    yield from rewards.run_test_incorrect_head_only(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_stretched_inclusion_delay(spec, state):
+    yield from rewards.run_test_stretched_inclusion_delay(spec, state)

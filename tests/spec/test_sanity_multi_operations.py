@@ -1,0 +1,69 @@
+"""Sanity blocks carrying several operation families at once
+(scenario parity: ref test/helpers/multi_operations.py and its
+sanity/random users — cross-operation interactions that single-op
+suites cannot see)."""
+import random
+
+from consensus_specs_tpu.test_framework.context import (
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.test_framework.multi_operations import (
+    age_for_exits,
+    run_full_house_test,
+    run_random_operations_test,
+    run_slash_and_exit,
+)
+
+
+@with_all_phases
+@spec_state_test
+def test_slash_and_exit_same_index(spec, state):
+    """Slashing a validator and exiting it in the SAME block must fail:
+    the slashing already initiated its exit, so the voluntary exit's
+    process-time check rejects."""
+    age_for_exits(spec, state)
+    index = spec.get_active_validator_indices(state, spec.get_current_epoch(state))[-1]
+    yield from run_slash_and_exit(spec, state, index, index, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_slash_and_exit_separate_indices(spec, state):
+    """Slashing one validator while another exits coexists in a block."""
+    age_for_exits(spec, state)
+    active = spec.get_active_validator_indices(state, spec.get_current_epoch(state))
+    yield from run_slash_and_exit(spec, state, active[-1], active[-2], valid=True)
+
+
+@with_all_phases
+@spec_state_test
+def test_full_house_block(spec, state):
+    """One block with proposer slashing + attester slashing +
+    attestations + MAX_DEPOSITS deposits + voluntary exit (+ sync
+    aggregate post-altair), each family taking effect."""
+    yield from run_full_house_test(spec, state, random.Random(1402))
+
+
+@with_all_phases
+@spec_state_test
+def test_random_operations_seed_101(spec, state):
+    yield from run_random_operations_test(spec, state, random.Random(101))
+
+
+@with_all_phases
+@spec_state_test
+def test_random_operations_seed_202(spec, state):
+    yield from run_random_operations_test(spec, state, random.Random(202))
+
+
+@with_all_phases
+@spec_state_test
+def test_random_operations_seed_303(spec, state):
+    yield from run_random_operations_test(spec, state, random.Random(303))
+
+
+@with_all_phases
+@spec_state_test
+def test_random_operations_seed_404(spec, state):
+    yield from run_random_operations_test(spec, state, random.Random(404))

@@ -12,7 +12,10 @@ from consensus_specs_tpu.test_framework.context import (
     with_custom_state,
     with_phases,
 )
-from consensus_specs_tpu.test_framework.fork_transition import run_fork_transition
+from consensus_specs_tpu.test_framework.fork_transition import (
+    run_fork_transition,
+    run_fork_transition_with_operation,
+)
 
 
 def _make_tests(pre, post):
@@ -64,3 +67,40 @@ def _make_tests(pre, post):
     test_transition_to_capella_short,
     test_transition_to_capella_no_pre_blocks,
 ) = _make_tests(BELLATRIX, CAPELLA)
+
+
+# -- operations at the fork boundary (ref test_transition.py's
+# operation-timing scenarios: each family crossing in both directions) --
+
+_OP_KINDS = ("proposer_slashing", "attester_slashing", "deposit", "voluntary_exit", "attestation")
+
+
+def _make_operation_tests(pre, post):
+    made = {}
+    for kind in _OP_KINDS:
+        for before in (False, True):
+            flavor = "before_fork" if before else "after_fork"
+
+            def make(kind=kind, before=before):
+                @with_phases([pre], other_phases=[post])
+                @spec_test
+                @with_custom_state(default_balances, default_activation_threshold)
+                def test_fn(spec, state, phases):
+                    yield from run_fork_transition_with_operation(
+                        spec, phases[post], state, kind, before_fork=before
+                    )
+                return test_fn
+
+            fn = make()
+            fn.__name__ = f"test_transition_to_{post}_{kind}_{flavor}"
+            made[fn.__name__] = fn
+    return made
+
+
+for _name, _fn in {
+    **_make_operation_tests(PHASE0, ALTAIR),
+    **_make_operation_tests(ALTAIR, BELLATRIX),
+    **_make_operation_tests(BELLATRIX, CAPELLA),
+}.items():
+    globals()[_name] = _fn
+del _name, _fn

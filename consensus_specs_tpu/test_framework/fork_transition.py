@@ -72,13 +72,16 @@ def run_fork_transition_with_operation(spec_pre, spec_post, state, kind, before_
         block = build_empty_block_for_next_slot(spec_pre, state)
         blocks.append(state_transition_and_sign_block(spec_pre, state, block))
 
-    # last pre-fork block — carries the op in the before_fork flavor
-    # (the op is built against the pre-block state; deposits also point
-    # the state's eth1_data at their tree, which is what processing reads)
-    block = build_empty_block_for_next_slot(spec_pre, state)
+    # last pre-fork block — carries the op in the before_fork flavor.
+    # The op is built BEFORE the block: deposits re-point state.eth1_data
+    # at their tree, and the block's parent root snapshots the state root
+    # at build time (a later state mutation would poison it)
     if before_fork:
         field, operation = _build_boundary_operation(spec_pre, state, kind)
+        block = build_empty_block_for_next_slot(spec_pre, state)
         getattr(block.body, field).append(operation)
+    else:
+        block = build_empty_block_for_next_slot(spec_pre, state)
     blocks.append(state_transition_and_sign_block(spec_pre, state, block))
     yield "fork_block", "meta", len(blocks) - 1
 
@@ -92,7 +95,7 @@ def run_fork_transition_with_operation(spec_pre, spec_post, state, kind, before_
     state = upgrade(state)
 
     # first post-fork block at the fork-epoch start slot carries the op
-    # in the after flavor
+    # in the after flavor (op built before the block — see above)
     if not before_fork and carried is None:
         carried = _build_boundary_operation(spec_post, state, kind)
     block = build_empty_block(spec_post, state, slot=state.slot)

@@ -183,12 +183,15 @@ def build_full_house_block(spec, state, rng):
     (block, touched) where `touched` maps family -> validator indices."""
     (ps_pool, as_pool, exit_pool) = draw_pools(spec, state, rng, [1, 1, 1])
 
+    # deposits FIRST: they re-point state.eth1_data, and the block's
+    # parent root snapshots the state root at build time
+    deposits = deposits_for(spec, state, int(spec.MAX_DEPOSITS))
     block = build_empty_block_for_next_slot(spec, state)
     block.body.proposer_slashings = proposer_slashings_for(spec, state, ps_pool)
     block.body.attester_slashings = attester_slashings_for(spec, state, as_pool)
     for attestation in attestations_for(spec, state, 2):
         block.body.attestations.append(attestation)
-    for deposit in deposits_for(spec, state, int(spec.MAX_DEPOSITS)):
+    for deposit in deposits:
         block.body.deposits.append(deposit)
     block.body.voluntary_exits = prepare_signed_exits(spec, state, exit_pool)
     if is_post_altair(spec):
@@ -236,14 +239,14 @@ def random_operations_block(spec, state, rng):
 
     ps_pool, as_pool, exit_pool = draw_pools(spec, state, rng, [n_ps, n_as_targets, n_exit])
 
+    deposits = deposits_for(spec, state, n_dep) if n_dep else []
     block = build_empty_block_for_next_slot(spec, state)
     block.body.proposer_slashings = proposer_slashings_for(spec, state, ps_pool)
     block.body.attester_slashings = attester_slashings_for(spec, state, as_pool)
     for attestation in attestations_for(spec, state, n_att, rng=rng):
         block.body.attestations.append(attestation)
-    if n_dep:
-        for deposit in deposits_for(spec, state, n_dep):
-            block.body.deposits.append(deposit)
+    for deposit in deposits:
+        block.body.deposits.append(deposit)
     block.body.voluntary_exits = prepare_signed_exits(spec, state, exit_pool)
     if is_post_altair(spec):
         block.body.sync_aggregate = sync_aggregate_for(

@@ -152,6 +152,9 @@ def build_random_block(spec, state, rng, slashed: set):
     (probabilistically) attester/proposer slashings, fresh deposits, a
     voluntary exit, and a random-participation sync aggregate (altair+)."""
     _advance_past_slashed_proposers(spec, state)
+    # deposits FIRST: they re-point state.eth1_data, and the block's
+    # parent root snapshots the state root at build time
+    deposits = _maybe_deposits(spec, state, rng)
     block = build_empty_block_for_next_slot(spec, state)
     for att in _random_attestations(spec, state, rng):
         block.body.attestations.append(att)
@@ -161,7 +164,7 @@ def build_random_block(spec, state, rng, slashed: set):
     prop_slashing = _maybe_proposer_slashing(spec, state, rng, slashed)
     if prop_slashing is not None:
         block.body.proposer_slashings.append(prop_slashing)
-    for deposit in _maybe_deposits(spec, state, rng):
+    for deposit in deposits:
         block.body.deposits.append(deposit)
     exit_op = _maybe_voluntary_exit(spec, state, rng, slashed)
     if exit_op is not None:

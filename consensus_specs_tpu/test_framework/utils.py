@@ -42,6 +42,18 @@ def vector_test():
                             continue
                         if isinstance(value, (SSZType, bytes, bytearray)):
                             yield key, "ssz", snapshot("ssz", value)
+                        elif (
+                            isinstance(value, (list, tuple))
+                            and value
+                            and all(isinstance(v, SSZType) for v in value)
+                        ):
+                            # an SSZ *list part* (e.g. "blocks") expands to
+                            # the reference vector shape: a {key}_count meta
+                            # entry plus one {key}_<i>.ssz_snappy per element
+                            # (ref utils.py list handling; formats/sanity)
+                            yield f"{key}_count", "meta", len(value)
+                            for i, item in enumerate(value):
+                                yield f"{key}_{i}", "ssz", snapshot("ssz", item)
                         else:
                             yield key, "data", snapshot("data", value)
                     else:

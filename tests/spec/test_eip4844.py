@@ -8,7 +8,7 @@ import pytest
 from consensus_specs_tpu.crypto import fr, kzg
 from consensus_specs_tpu.specs import build_spec
 from consensus_specs_tpu.test_framework.constants import EIP4844
-from consensus_specs_tpu.test_framework.context import spec_state_test, with_phases
+from consensus_specs_tpu.test_framework.context import always_bls, spec_state_test, with_phases
 from consensus_specs_tpu.test_framework.block import build_empty_block_for_next_slot
 
 
@@ -155,6 +155,7 @@ class TestValidatorSurface:
 
     @with_phases([EIP4844])
     @spec_state_test
+    @always_bls
     def test_signed_sidecar_gossip_roundtrip(self, spec, state):
         """get_blobs_sidecar -> get_signed_blobs_sidecar must satisfy the
         blobs_sidecar topic REJECT conditions, and fail them for a wrong

@@ -42,6 +42,7 @@ import shutil
 import signal
 import sys
 import tempfile
+import threading
 import time
 
 import numpy as np
@@ -49,7 +50,7 @@ import numpy as np
 faulthandler.enable()
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S", "1260"))
+DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S", "1380"))
 _T0 = time.monotonic()
 
 # Filled in by sections as they complete; emitted as the final JSON line
@@ -87,6 +88,47 @@ atexit.register(_emit)
 signal.signal(signal.SIGTERM, _on_deadline_signal)
 signal.signal(signal.SIGALRM, _on_deadline_signal)
 signal.alarm(max(1, int(DEADLINE_S)))
+
+
+def _watchdog() -> None:
+    """Deadline enforcement that signals cannot provide: a handler only
+    runs between Python bytecodes, and a tunnel RPC (device dispatch or
+    server-side compile) can block the main thread for tens of minutes —
+    observed in round 4 (rc=124, no JSON) and round 5 calibration. A
+    daemon thread keeps running while the main thread is wedged in C,
+    emits whatever metrics exist, and hard-exits."""
+    while True:
+        remaining = DEADLINE_S - (time.monotonic() - _T0)
+        if remaining <= 0:
+            break
+        time.sleep(min(remaining, 5.0))
+    _note("watchdog: deadline reached — emitting partial results")
+    _emit()
+    sys.stdout.flush()
+    os._exit(0)
+
+
+threading.Thread(target=_watchdog, daemon=True, name="bench-deadline").start()
+
+
+def _maybe_enable_compile_cache() -> None:
+    """Persist XLA executables across bench runs (jax compilation cache)
+    so the ~12-minute cold BLS graph compile is paid once per MACHINE,
+    not once per process. Device backends only: writing the large pairing
+    executable from the CPU backend's cache path was observed to
+    segfault (see ops/__init__.py), so CPU keeps cold compiles."""
+    try:
+        import jax
+
+        if jax.default_backend() == "cpu":
+            return
+        cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        _note(f"compile cache enabled at {cache_dir}")
+    except Exception as e:  # cache is an optimization, never a requirement
+        _note(f"compile cache unavailable: {e!r}")
 
 
 def _remaining() -> float:
@@ -596,16 +638,29 @@ def bench_generation() -> None:
 def main() -> None:
     _note(f"deadline {DEADLINE_S:.0f}s")
     # priority order: required scoreboard keys first (bls headline, then
-    # BASELINE configs #3 / #5 / #4), historical continuity keys after
+    # BASELINE configs #3 / #5 / #4), historical continuity keys after.
+    # Estimates from the round-5 calibration run: the BLS cold-graph
+    # compile dominates (~700 s cold, seconds when the persistent cache
+    # hits); all later sections reuse its shapes (ops/bls_jax canonical
+    # buckets), so their cost is dispatches + host passes.
     _run_section("pallas_probe", 70, bench_pallas_probe)
-    _run_section("bls", 220, bench_bls)
-    _run_section("block_mainnet", 240, bench_block_mainnet)
-    _run_section("generation", 330, bench_generation)
-    _run_section("sync_aggregate", 280, bench_sync_aggregate_mainnet)
-    _run_section("hash", 140, bench_hash)
-    _run_section("incremental_reroot", 60, bench_incremental_reroot)
+    _maybe_enable_compile_cache()
+    _run_section("bls", 200 if _cache_is_warm() else 780, bench_bls)
+    _run_section("block_mainnet", 120, bench_block_mainnet)
+    _run_section("generation", 180, bench_generation)
+    _run_section("sync_aggregate", 200, bench_sync_aggregate_mainnet)
+    _run_section("hash", 100, bench_hash)
+    _run_section("incremental_reroot", 45, bench_incremental_reroot)
     signal.alarm(0)
     _emit()
+
+
+def _cache_is_warm() -> bool:
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+    try:
+        return any(os.scandir(cache_dir))
+    except OSError:
+        return False
 
 
 if __name__ == "__main__":

@@ -173,6 +173,27 @@ def _max_rows() -> int:
     return _MIN_BUCKET if jax.default_backend() == "cpu" else 128
 
 
+def _cold_min_rows() -> int:
+    """Row-bucket floor for the cold pipeline. On a real device every
+    batch pads up to the chunk cap, so ALL workloads (block flush, vector
+    generation, sync aggregates) share ONE set of compiled shapes —
+    over a tunneled backend a fresh shape means a multi-minute (or
+    hanging) server-side compile mid-run. On CPU small buckets keep test
+    compiles cheap."""
+    import jax
+
+    return _MIN_BUCKET if jax.default_backend() == "cpu" else _max_rows()
+
+
+def _cold_min_keys() -> int:
+    """Key-bucket floor for the cold pipeline's aggregation stage: pad to
+    the 64-key block shape on device (shapes {64, 512} cover everything);
+    tiny buckets on CPU."""
+    import jax
+
+    return 2 if jax.default_backend() == "cpu" else 64
+
+
 def _run_checks(checks: Sequence[Optional[List[_Pair]]]) -> np.ndarray:
     out = np.zeros(len(checks), dtype=bool)
     # pre-filter only sizes the chunks; _pack_checks re-applies the
@@ -466,8 +487,8 @@ def fast_aggregate_verify_batch_cold(pubkey_lists, messages, signatures) -> np.n
     if not rows:
         return out
 
-    b = _bucket(len(rows))
-    k = _bucket(kmax, minimum=2)
+    b = _bucket(len(rows), minimum=_cold_min_rows())
+    k = _bucket(kmax, minimum=_cold_min_keys())
 
     # -- signatures: batched decompress + subgroup --
     pad_x, pad_flag = _parse_g2_x(_sig_pad_bytes())

@@ -397,3 +397,41 @@ def upgrade_to_capella(pre) -> "BeaconState":
 def get_expected_withdrawals(state: "BeaconState"):
     num_withdrawals = min(int(MAX_WITHDRAWALS_PER_PAYLOAD), len(state.withdrawals_queue))  # noqa: F821
     return [state.withdrawals_queue[i] for i in range(num_withdrawals)]
+
+
+@_dataclass
+class PayloadAttributes:  # noqa: F811 (capella delta: + withdrawals)
+    timestamp: "uint64"  # noqa: F821
+    prev_randao: "Bytes32"  # noqa: F821
+    suggested_fee_recipient: "ExecutionAddress"  # noqa: F821
+    withdrawals: list  # [New in Capella] Sequence[Withdrawal]
+
+
+def prepare_execution_payload(state: "BeaconState", pow_chain, safe_block_hash,
+                              finalized_block_hash, suggested_fee_recipient,
+                              execution_engine) -> "_Optional[PayloadId]":  # noqa: F821
+    """Bellatrix flow, except the slot's expected withdrawals ride the
+    PayloadAttributes into the engine (capella/validator.md:72-108)."""
+    if not is_merge_transition_complete(state):  # noqa: F821
+        is_terminal_block_hash_set = config.TERMINAL_BLOCK_HASH != Hash32()  # noqa: F821
+        is_activation_epoch_reached = (
+            get_current_epoch(state) >= config.TERMINAL_BLOCK_HASH_ACTIVATION_EPOCH  # noqa: F821
+        )
+        if is_terminal_block_hash_set and not is_activation_epoch_reached:
+            return None
+        terminal_pow_block = get_terminal_pow_block(pow_chain)  # noqa: F821
+        if terminal_pow_block is None:
+            return None  # pre-merge, no payload yet
+        parent_hash = terminal_pow_block.block_hash
+    else:
+        parent_hash = state.latest_execution_payload_header.block_hash
+
+    payload_attributes = PayloadAttributes(
+        timestamp=compute_timestamp_at_slot(state, state.slot),  # noqa: F821
+        prev_randao=get_randao_mix(state, get_current_epoch(state)),  # noqa: F821
+        suggested_fee_recipient=suggested_fee_recipient,
+        withdrawals=get_expected_withdrawals(state),  # [New in Capella]
+    )
+    return execution_engine.notify_forkchoice_updated(
+        parent_hash, safe_block_hash, finalized_block_hash, payload_attributes
+    )

@@ -1,0 +1,88 @@
+"""prepare_execution_payload across the merge boundary and the Capella
+withdrawals delta (ref: specs/bellatrix/validator.md:140-184,
+specs/capella/validator.md:72-108)."""
+from consensus_specs_tpu.test_framework.constants import BELLATRIX, CAPELLA
+from consensus_specs_tpu.test_framework.context import (
+    spec_state_test,
+    with_phases,
+)
+
+
+class _RecordingEngine:
+    """Engine stub that records the forkchoice-updated call."""
+
+    def __init__(self):
+        self.calls = []
+
+    def notify_forkchoice_updated(self, head, safe, finalized, attributes):
+        self.calls.append((bytes(head), bytes(safe), bytes(finalized), attributes))
+        return b"\x01" * 8  # a PayloadId
+
+
+def _run_post_merge(spec, state):
+    """Drive the post-merge branch: header hash set -> attributes built
+    from the state and passed through."""
+    state.latest_execution_payload_header.block_hash = spec.Hash32(b"\x0a" * 32)
+    assert spec.is_merge_transition_complete(state)
+    engine = _RecordingEngine()
+    payload_id = spec.prepare_execution_payload(
+        state,
+        pow_chain={},
+        safe_block_hash=spec.Hash32(b"\x0b" * 32),
+        finalized_block_hash=spec.Hash32(b"\x0c" * 32),
+        suggested_fee_recipient=b"\x0d" * 20,
+        execution_engine=engine,
+    )
+    assert payload_id is not None
+    (head, safe, fin, attributes) = engine.calls[0]
+    assert head == b"\x0a" * 32 and safe == b"\x0b" * 32 and fin == b"\x0c" * 32
+    assert int(attributes.timestamp) == int(
+        spec.compute_timestamp_at_slot(state, state.slot)
+    )
+    return attributes
+
+
+@with_phases([BELLATRIX])
+@spec_state_test
+def test_prepare_execution_payload_post_merge(spec, state):
+    attributes = _run_post_merge(spec, state)
+    assert not hasattr(attributes, "withdrawals")
+    yield "pre", None
+
+
+@with_phases([BELLATRIX])
+@spec_state_test
+def test_prepare_execution_payload_pre_merge_no_terminal(spec, state):
+    # pre-merge with an empty PoW view: no payload build is initiated
+    assert not spec.is_merge_transition_complete(state)
+    engine = _RecordingEngine()
+    payload_id = spec.prepare_execution_payload(
+        state,
+        pow_chain={},
+        safe_block_hash=spec.Hash32(),
+        finalized_block_hash=spec.Hash32(),
+        suggested_fee_recipient=b"\x00" * 20,
+        execution_engine=engine,
+    )
+    assert payload_id is None and engine.calls == []
+    yield "pre", None
+
+
+@with_phases([CAPELLA])
+@spec_state_test
+def test_prepare_execution_payload_carries_withdrawals(spec, state):
+    # queue two withdrawals; the engine must receive exactly the slot's
+    # expected prefix in the attributes [New in Capella]
+    for i in range(2):
+        state.withdrawals_queue.append(
+            spec.Withdrawal(
+                index=spec.WithdrawalIndex(i),
+                address=b"\x22" * 20,
+                amount=spec.Gwei(1000 + i),
+            )
+        )
+    attributes = _run_post_merge(spec, state)
+    expected = spec.get_expected_withdrawals(state)
+    assert [int(w.index) for w in attributes.withdrawals] == [int(w.index) for w in expected]
+    assert len(attributes.withdrawals) == 2
+    yield "pre", None

@@ -1,0 +1,38 @@
+"""Inactivity-score state fixtures (altair+), shared by the
+epoch-processing, rewards, and randomized-scenario suites
+(ref: test/helpers/inactivity_scores.py)."""
+from __future__ import annotations
+
+from .constants import is_post_altair
+
+
+def set_uniform_inactivity_scores(spec, state, value=0):
+    """Every validator at the same score (0 = the steady healthy state)."""
+    if is_post_altair(spec):
+        state.inactivity_scores = [spec.uint64(value)] * len(state.validators)
+
+
+def randomize_inactivity_scores(spec, state, rng, minimum=0, maximum=None):
+    """Scores drawn uniformly from [minimum, maximum]; the default ceiling
+    spans a few leak-recovery half-lives around INACTIVITY_SCORE_BIAS so
+    both the decrement and penalty branches get exercised."""
+    if not is_post_altair(spec):
+        return
+    if maximum is None:
+        maximum = 2 * int(spec.config.INACTIVITY_SCORE_BIAS) + 2
+    state.inactivity_scores = [
+        spec.uint64(rng.randint(minimum, maximum)) for _ in range(len(state.validators))
+    ]
+
+
+def saturate_inactivity_scores(spec, state, indices=None, value=None):
+    """Push (selected) validators deep into leak territory — the shape
+    where quadratic penalties dominate."""
+    if not is_post_altair(spec):
+        return
+    if value is None:
+        value = 100 * int(spec.config.INACTIVITY_SCORE_BIAS)
+    if indices is None:
+        indices = range(len(state.validators))
+    for index in indices:
+        state.inactivity_scores[index] = spec.uint64(value)

@@ -23,11 +23,9 @@ from .state import next_epoch, next_slot, next_slots, state_transition_and_sign_
 # -- state randomizers --------------------------------------------------------
 
 def randomize_inactivity_scores(spec, state, rng):
-    if is_post_altair(spec):
-        state.inactivity_scores = [
-            spec.uint64(rng.randrange(0, 2 * int(spec.config.INACTIVITY_SCORE_BIAS) + 3))
-            for _ in range(len(state.validators))
-        ]
+    from .inactivity_scores import randomize_inactivity_scores as _randomize
+
+    _randomize(spec, state, rng)
 
 
 def randomize_balances(spec, state, rng):

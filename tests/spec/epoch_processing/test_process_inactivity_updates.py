@@ -8,6 +8,9 @@ from consensus_specs_tpu.test_framework.context import (
     with_altair_and_later,
 )
 from consensus_specs_tpu.test_framework.epoch_processing import run_epoch_processing_with
+from consensus_specs_tpu.test_framework.inactivity_scores import (
+    randomize_inactivity_scores,
+)
 from consensus_specs_tpu.test_framework.rewards import transition_to_leaking
 from consensus_specs_tpu.test_framework.state import next_epoch
 
@@ -17,8 +20,7 @@ def run_inactivity_updates(spec, state):
 
 
 def randomize_scores(spec, state, rng):
-    for i in range(len(state.validators)):
-        state.inactivity_scores[i] = rng.randint(0, 100)
+    randomize_inactivity_scores(spec, state, rng, maximum=100)
 
 
 def set_full_participation(spec, state):
@@ -217,3 +219,25 @@ def test_full_participation_after_leak_recovers(spec, state):
         if i in participating:
             # -1 decrement for participating, then recovery decay
             assert int(state.inactivity_scores[i]) == 100 - 1 - rec
+
+
+@with_altair_and_later
+@spec_state_test
+def test_saturated_scores_grow_by_bias_while_leaking(spec, state):
+    """Validators already deep in leak territory with NO participation
+    keep accruing exactly INACTIVITY_SCORE_BIAS per epoch (no recovery
+    while the leak is on)."""
+    from consensus_specs_tpu.test_framework.inactivity_scores import (
+        saturate_inactivity_scores,
+    )
+
+    transition_to_leaking(spec, state)
+    saturate_inactivity_scores(spec, state)
+    start = int(state.inactivity_scores[0])
+    assert spec.is_in_inactivity_leak(state)
+
+    yield from run_inactivity_updates(spec, state)
+
+    bias = int(spec.config.INACTIVITY_SCORE_BIAS)
+    for i in spec.get_eligible_validator_indices(state):
+        assert int(state.inactivity_scores[i]) == start + bias

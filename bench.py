@@ -635,6 +635,74 @@ def bench_generation() -> None:
     )
 
 
+def _device_alive(timeout_s: int = 90) -> bool:
+    """Open the device in a DISPOSABLE CHILD first: a wedged tunnel (hung
+    server-side compile / dead worker) blocks `jax.devices()` forever,
+    and once the parent is inside a device call not even SIGTERM can
+    reach it. The child is killable; the parent then knows whether to
+    run the device sections at all."""
+    import subprocess
+
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import jax; jax.devices()"],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        start_new_session=True,
+    )
+    try:
+        proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        proc.wait()
+        return False
+    return proc.returncode == 0
+
+
+def bench_host_fallback() -> None:
+    """Device unreachable: record the host-side rates so the round still
+    has real numbers (SHA-NI + hashlib hashing, host-oracle BLS)."""
+    import hashlib
+
+    from consensus_specs_tpu.crypto.bls import ciphersuite as host_bls
+    from consensus_specs_tpu.ssz import merkle
+
+    levels = 18  # 256k chunks = 8 MiB: enough for a stable rate
+    n_chunks = 1 << levels
+    mib = n_chunks * 32 / (1 << 20)
+    rng = np.random.default_rng(_HASH_SEED)
+    chunk_bytes = rng.integers(0, 2**32, size=(n_chunks, 8), dtype=np.uint32).astype(">u4").tobytes()
+
+    t0 = time.perf_counter()
+    root_host = merkle.merkleize_chunks(chunk_bytes, limit=n_chunks)
+    host_mbs = mib / (time.perf_counter() - t0)
+
+    nodes = chunk_bytes
+    t0 = time.perf_counter()
+    for _ in range(levels):
+        nodes = b"".join(
+            hashlib.sha256(nodes[i : i + 64]).digest() for i in range(0, len(nodes), 64)
+        )
+    hashlib_mbs = mib / (time.perf_counter() - t0)
+    assert nodes == root_host
+
+    sks = [i + 1 for i in range(64)]
+    pks = [host_bls.SkToPk(sk) for sk in sks]
+    from consensus_specs_tpu.crypto.bls.fields import R as _R
+
+    msg = b"\x5f" * 32
+    sig = host_bls.Sign(sum(sks) % _R, msg)
+    t0 = time.perf_counter()
+    assert host_bls.FastAggregateVerify(pks, msg, sig)
+    host_rate = 1.0 / (time.perf_counter() - t0)
+
+    RESULTS["hash_host_shani_mibs"] = round(host_mbs, 2)
+    RESULTS["hash_hashlib_ref_mibs"] = round(hashlib_mbs, 2)
+    RESULTS["bls_host_oracle_cold_rate"] = round(host_rate, 3)
+
+
 def main() -> None:
     _note(f"deadline {DEADLINE_S:.0f}s")
     # priority order: required scoreboard keys first (bls headline, then
@@ -643,6 +711,17 @@ def main() -> None:
     # compile dominates (~700 s cold, seconds when the persistent cache
     # hits); all later sections reuse its shapes (ops/bls_jax canonical
     # buckets), so their cost is dispatches + host passes.
+    if not _device_alive():
+        # the tunnel is wedged (hung server compile / dead worker): no
+        # device section can run AND no device call can be interrupted —
+        # record the host-side truth and say so honestly
+        _note("device UNREACHABLE — host-only fallback")
+        RESULTS["device_unreachable"] = True
+        _run_section("host_fallback", 240, bench_host_fallback)
+        _run_section("incremental_reroot", 45, bench_incremental_reroot)
+        signal.alarm(0)
+        _emit()
+        return
     _run_section("pallas_probe", 70, bench_pallas_probe)
     _maybe_enable_compile_cache()
     _run_section("bls", 200 if _cache_is_warm() else 780, bench_bls)

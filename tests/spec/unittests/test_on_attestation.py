@@ -1,6 +1,8 @@
-"""on_attestation unit tests: validation windows, target/head topology,
-LMD vote recording (ref: test/phase0/unittests/fork_choice/
-test_on_attestation.py)."""
+"""on_attestation unit tests: clock windows, target/head topology checks,
+LMD vote recording (scenario parity with ref test/phase0/unittests/
+fork_choice/test_on_attestation.py; structured here as a seeded-store
+fixture + delivery oracle that checks the FULL latest-message effect —
+every attester recorded on accept, store untouched on reject)."""
 from consensus_specs_tpu.test_framework.attestations import (
     get_valid_attestation,
     sign_attestation,
@@ -16,233 +18,221 @@ from consensus_specs_tpu.test_framework.state import (
 )
 
 
-def run_on_attestation(spec, state, store, attestation, valid=True):
-    if not valid:
-        try:
-            spec.on_attestation(store, attestation)
-        except AssertionError:
-            return
-        raise AssertionError("on_attestation unexpectedly accepted")
+def _seed_store(spec, state, tick_slots):
+    """Store ticked `tick_slots` ahead with one applied head block."""
+    store = get_genesis_forkchoice_store(spec, state)
+    spec.on_tick(store, store.time + int(spec.config.SECONDS_PER_SLOT) * tick_slots)
+    block = build_empty_block_for_next_slot(spec, state)
+    spec.on_block(store, state_transition_and_sign_block(spec, state, block))
+    return store, block
 
-    indexed_attestation = spec.get_indexed_attestation(state, attestation)
+
+def _deliver(spec, store, attestation, voters_from=None):
+    """Accepting delivery: every attester's latest message must point at
+    the attestation's (target epoch, head root)."""
     spec.on_attestation(store, attestation)
-    sample_index = indexed_attestation.attesting_indices[0]
-    assert store.latest_messages[sample_index] == spec.LatestMessage(
+    expected = spec.LatestMessage(
         epoch=attestation.data.target.epoch,
         root=attestation.data.beacon_block_root,
     )
+    voters = spec.get_attesting_indices(
+        voters_from, attestation.data, attestation.aggregation_bits
+    )
+    assert voters, "fixture bug: empty attestation"
+    for index in voters:
+        assert store.latest_messages[index] == expected
 
+
+def _reject(spec, store, attestation):
+    """Rejecting delivery: the assertion fires AND no vote is recorded."""
+    before = dict(store.latest_messages)
+    try:
+        spec.on_attestation(store, attestation)
+    except AssertionError:
+        assert dict(store.latest_messages) == before
+        return
+    raise AssertionError("on_attestation unexpectedly accepted")
+
+
+# -- clock-window cases ------------------------------------------------------
 
 @with_all_phases
 @spec_state_test
 def test_on_attestation_current_epoch(spec, state):
-    store = get_genesis_forkchoice_store(spec, state)
-    spec.on_tick(store, store.time + spec.config.SECONDS_PER_SLOT * 2)
-    block = build_empty_block_for_next_slot(spec, state)
-    signed_block = state_transition_and_sign_block(spec, state, block)
-    spec.on_block(store, signed_block)
-
+    store, block = _seed_store(spec, state, tick_slots=2)
     attestation = get_valid_attestation(spec, state, slot=block.slot, signed=True)
-    assert attestation.data.target.epoch == spec.GENESIS_EPOCH
-    assert spec.compute_epoch_at_slot(spec.get_current_slot(store)) == spec.GENESIS_EPOCH
-    run_on_attestation(spec, state, store, attestation)
+    assert spec.compute_epoch_at_slot(spec.get_current_slot(store)) == attestation.data.target.epoch
+    _deliver(spec, store, attestation, voters_from=state)
 
 
 @with_all_phases
 @spec_state_test
 def test_on_attestation_previous_epoch(spec, state):
-    store = get_genesis_forkchoice_store(spec, state)
-    spec.on_tick(store, store.time + spec.config.SECONDS_PER_SLOT * spec.SLOTS_PER_EPOCH)
-    block = build_empty_block_for_next_slot(spec, state)
-    signed_block = state_transition_and_sign_block(spec, state, block)
-    spec.on_block(store, signed_block)
-
+    store, block = _seed_store(spec, state, tick_slots=int(spec.SLOTS_PER_EPOCH))
     attestation = get_valid_attestation(spec, state, slot=block.slot, signed=True)
-    assert attestation.data.target.epoch == spec.GENESIS_EPOCH
-    assert spec.compute_epoch_at_slot(spec.get_current_slot(store)) == spec.GENESIS_EPOCH + 1
-    run_on_attestation(spec, state, store, attestation)
+    assert (
+        spec.compute_epoch_at_slot(spec.get_current_slot(store))
+        == attestation.data.target.epoch + 1
+    )
+    _deliver(spec, store, attestation, voters_from=state)
 
 
 @with_all_phases
 @spec_state_test
 def test_on_attestation_past_epoch(spec, state):
-    store = get_genesis_forkchoice_store(spec, state)
-    spec.on_tick(store, store.time + 2 * spec.config.SECONDS_PER_SLOT * spec.SLOTS_PER_EPOCH)
-    block = build_empty_block_for_next_slot(spec, state)
-    signed_block = state_transition_and_sign_block(spec, state, block)
-    spec.on_block(store, signed_block)
-
-    attestation = get_valid_attestation(spec, state, slot=state.slot, signed=True)
+    # two epochs of clock: a genesis-epoch target is now out of window
+    store, block = _seed_store(spec, state, tick_slots=2 * int(spec.SLOTS_PER_EPOCH))
+    attestation = get_valid_attestation(spec, state, slot=block.slot, signed=True)
     assert attestation.data.target.epoch == spec.GENESIS_EPOCH
-    assert spec.compute_epoch_at_slot(spec.get_current_slot(store)) == spec.GENESIS_EPOCH + 2
-    run_on_attestation(spec, state, store, attestation, False)
+    _reject(spec, store, attestation)
 
 
 @with_all_phases
 @spec_state_test
-def test_on_attestation_mismatched_target_and_slot(spec, state):
-    store = get_genesis_forkchoice_store(spec, state)
-    spec.on_tick(store, store.time + spec.config.SECONDS_PER_SLOT * spec.SLOTS_PER_EPOCH)
-    block = build_empty_block_for_next_slot(spec, state)
-    signed_block = state_transition_and_sign_block(spec, state, block)
-    spec.on_block(store, signed_block)
+def test_on_attestation_future_epoch(spec, state):
+    store, _ = _seed_store(spec, state, tick_slots=3)
+    next_epoch(spec, state)  # author far ahead of the store clock
+    attestation = get_valid_attestation(spec, state, slot=state.slot, signed=True)
+    _reject(spec, store, attestation)
 
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_same_slot(spec, state):
+    # must wait one slot past the attestation slot before counting it
+    store, block = _seed_store(spec, state, tick_slots=1)
+    attestation = get_valid_attestation(spec, state, slot=block.slot, signed=True)
+    _reject(spec, store, attestation)
+
+
+# -- data-consistency cases --------------------------------------------------
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_mismatched_target_and_slot(spec, state):
+    store, block = _seed_store(spec, state, tick_slots=int(spec.SLOTS_PER_EPOCH))
     attestation = get_valid_attestation(spec, state, slot=block.slot)
-    attestation.data.target.epoch += 1
+    attestation.data.target.epoch += 1  # epoch no longer matches the slot
     sign_attestation(spec, state, attestation)
-    assert attestation.data.target.epoch == spec.GENESIS_EPOCH + 1
-    assert spec.compute_epoch_at_slot(attestation.data.slot) == spec.GENESIS_EPOCH
-    run_on_attestation(spec, state, store, attestation, False)
+    _reject(spec, store, attestation)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_invalid_attestation(spec, state):
+    store, block = _seed_store(spec, state, tick_slots=3)
+    attestation = get_valid_attestation(spec, state, slot=block.slot, signed=True)
+    attestation.data.index = spec.MAX_COMMITTEES_PER_SLOT * spec.SLOTS_PER_EPOCH
+    _reject(spec, store, attestation)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_future_block(spec, state):
+    # LMD vote naming a block NEWER than the attestation's own slot
+    store, block = _seed_store(spec, state, tick_slots=5)
+    attestation = get_valid_attestation(spec, state, slot=block.slot - 1, signed=False)
+    attestation.data.beacon_block_root = block.hash_tree_root()
+    sign_attestation(spec, state, attestation)
+    _reject(spec, store, attestation)
 
 
 @with_all_phases
 @spec_state_test
 def test_on_attestation_inconsistent_target_and_head(spec, state):
+    """FFG target on one branch, LMD head on another: the target must be
+    the head's ancestor at the target boundary, so this is refused."""
     store = get_genesis_forkchoice_store(spec, state)
-    spec.on_tick(store, store.time + 2 * spec.config.SECONDS_PER_SLOT * spec.SLOTS_PER_EPOCH)
+    spec.on_tick(
+        store, store.time + 2 * int(spec.config.SECONDS_PER_SLOT) * int(spec.SLOTS_PER_EPOCH)
+    )
 
-    # chain 1: empty through epoch 1
-    target_state_1 = state.copy()
-    next_epoch(spec, target_state_1)
+    # branch A: stays empty through epoch 1, then produces the head block
+    branch_a = state.copy()
+    next_epoch(spec, branch_a)
 
-    # chain 2: one different block, then to epoch 1
-    target_state_2 = state.copy()
-    diff_block = build_empty_block_for_next_slot(spec, target_state_2)
-    signed_diff_block = state_transition_and_sign_block(spec, target_state_2, diff_block)
-    spec.on_block(store, signed_diff_block)
-    next_epoch(spec, target_state_2)
-    next_slot(spec, target_state_2)
+    # branch B: one distinct genesis-child block, then into epoch 1
+    branch_b = state.copy()
+    fork_block = build_empty_block_for_next_slot(spec, branch_b)
+    spec.on_block(store, state_transition_and_sign_block(spec, branch_b, fork_block))
+    next_epoch(spec, branch_b)
+    next_slot(spec, branch_b)
 
-    head_block = build_empty_block_for_next_slot(spec, target_state_1)
-    signed_head_block = state_transition_and_sign_block(spec, target_state_1, head_block)
-    spec.on_block(store, signed_head_block)
+    head_block = build_empty_block_for_next_slot(spec, branch_a)
+    spec.on_block(store, state_transition_and_sign_block(spec, branch_a, head_block))
 
-    attestation = get_valid_attestation(spec, target_state_1, slot=head_block.slot, signed=False)
-    epoch = spec.compute_epoch_at_slot(attestation.data.slot)
+    attestation = get_valid_attestation(spec, branch_a, slot=head_block.slot, signed=False)
+    target_epoch = spec.compute_epoch_at_slot(attestation.data.slot)
+    # graft branch B's boundary root in as the target
     attestation.data.target = spec.Checkpoint(
-        epoch=epoch, root=spec.get_block_root(target_state_2, epoch)
+        epoch=target_epoch, root=spec.get_block_root(branch_b, target_epoch)
     )
     sign_attestation(spec, state, attestation)
-    assert spec.get_block_root(target_state_1, epoch) != attestation.data.target.root
-    run_on_attestation(spec, state, store, attestation, False)
+    assert attestation.data.target.root != spec.get_block_root(branch_a, target_epoch)
+    _reject(spec, store, attestation)
 
 
-def _to_next_epoch_boundary_block(spec, state, store, offset=1):
-    """Tick one epoch + 1 slot, transition to just before the next epoch,
-    and build the would-be target block."""
-    spec.on_tick(store, store.time + spec.config.SECONDS_PER_SLOT * (spec.SLOTS_PER_EPOCH + 1))
-    next_epoch_num = spec.get_current_epoch(state) + 1
-    transition_to(spec, state, spec.compute_start_slot_at_epoch(next_epoch_num) - offset)
-    target_block = build_empty_block_for_next_slot(spec, state)
-    signed = state_transition_and_sign_block(spec, state, target_block)
-    return target_block, signed
+# -- store-topology cases ----------------------------------------------------
+
+def _stage_epoch_boundary_target(spec, state, store, back_off=1):
+    """Advance the clock one epoch + a slot and produce the block sitting
+    `back_off` slots before the next epoch boundary — the natural target
+    of attestations in that epoch."""
+    spec.on_tick(
+        store,
+        store.time + int(spec.config.SECONDS_PER_SLOT) * (int(spec.SLOTS_PER_EPOCH) + 1),
+    )
+    boundary = spec.compute_start_slot_at_epoch(spec.get_current_epoch(state) + 1)
+    transition_to(spec, state, boundary - back_off)
+    block = build_empty_block_for_next_slot(spec, state)
+    return block, state_transition_and_sign_block(spec, state, block)
 
 
 @with_all_phases
 @spec_state_test
 def test_on_attestation_target_block_not_in_store(spec, state):
     store = get_genesis_forkchoice_store(spec, state)
-    target_block, _ = _to_next_epoch_boundary_block(spec, state, store)
-    # target block never added to store
+    target_block, _withheld = _stage_epoch_boundary_target(spec, state, store)
     attestation = get_valid_attestation(spec, state, slot=target_block.slot, signed=True)
     assert attestation.data.target.root == target_block.hash_tree_root()
-    run_on_attestation(spec, state, store, attestation, False)
+    _reject(spec, store, attestation)  # the target block was never delivered
 
 
 @with_all_phases
 @spec_state_test
 def test_on_attestation_target_checkpoint_not_in_store(spec, state):
     store = get_genesis_forkchoice_store(spec, state)
-    target_block, signed_target_block = _to_next_epoch_boundary_block(spec, state, store)
-    spec.on_block(store, signed_target_block)
-    # checkpoint state derived on demand
+    target_block, signed = _stage_epoch_boundary_target(spec, state, store)
+    spec.on_block(store, signed)
+    # checkpoint state is derived on demand (store_target_checkpoint_state)
     attestation = get_valid_attestation(spec, state, slot=target_block.slot, signed=True)
     assert attestation.data.target.root == target_block.hash_tree_root()
-    run_on_attestation(spec, state, store, attestation)
+    _deliver(spec, store, attestation, voters_from=state)
 
 
 @with_all_phases
 @spec_state_test
 def test_on_attestation_target_checkpoint_not_in_store_diff_slot(spec, state):
     store = get_genesis_forkchoice_store(spec, state)
-    target_block, signed_target_block = _to_next_epoch_boundary_block(spec, state, store, offset=2)
-    spec.on_block(store, signed_target_block)
-
-    attestation_slot = target_block.slot + 1
-    transition_to(spec, state, attestation_slot)
-    attestation = get_valid_attestation(spec, state, slot=attestation_slot, signed=True)
+    target_block, signed = _stage_epoch_boundary_target(spec, state, store, back_off=2)
+    spec.on_block(store, signed)
+    # attest one slot after the target block: same derived checkpoint
+    transition_to(spec, state, target_block.slot + 1)
+    attestation = get_valid_attestation(spec, state, slot=state.slot, signed=True)
     assert attestation.data.target.root == target_block.hash_tree_root()
-    run_on_attestation(spec, state, store, attestation)
+    _deliver(spec, store, attestation, voters_from=state)
 
 
 @with_all_phases
 @spec_state_test
 def test_on_attestation_beacon_block_not_in_store(spec, state):
     store = get_genesis_forkchoice_store(spec, state)
-    target_block, signed_target_block = _to_next_epoch_boundary_block(spec, state, store)
-    spec.on_block(store, signed_target_block)
+    target_block, signed = _stage_epoch_boundary_target(spec, state, store)
+    spec.on_block(store, signed)
 
-    head_block = build_empty_block_for_next_slot(spec, state)
-    state_transition_and_sign_block(spec, state, head_block)
-    # head block NOT added to store
-    attestation = get_valid_attestation(spec, state, slot=head_block.slot, signed=True)
+    withheld_head = build_empty_block_for_next_slot(spec, state)
+    state_transition_and_sign_block(spec, state, withheld_head)
+    attestation = get_valid_attestation(spec, state, slot=withheld_head.slot, signed=True)
     assert attestation.data.target.root == target_block.hash_tree_root()
-    assert attestation.data.beacon_block_root == head_block.hash_tree_root()
-    run_on_attestation(spec, state, store, attestation, False)
-
-
-@with_all_phases
-@spec_state_test
-def test_on_attestation_future_epoch(spec, state):
-    store = get_genesis_forkchoice_store(spec, state)
-    spec.on_tick(store, store.time + 3 * spec.config.SECONDS_PER_SLOT)
-    block = build_empty_block_for_next_slot(spec, state)
-    signed_block = state_transition_and_sign_block(spec, state, block)
-    spec.on_block(store, signed_block)
-
-    next_epoch(spec, state)  # state ahead of store clock
-    attestation = get_valid_attestation(spec, state, slot=state.slot, signed=True)
-    run_on_attestation(spec, state, store, attestation, False)
-
-
-@with_all_phases
-@spec_state_test
-def test_on_attestation_future_block(spec, state):
-    store = get_genesis_forkchoice_store(spec, state)
-    spec.on_tick(store, store.time + spec.config.SECONDS_PER_SLOT * 5)
-    block = build_empty_block_for_next_slot(spec, state)
-    signed_block = state_transition_and_sign_block(spec, state, block)
-    spec.on_block(store, signed_block)
-
-    # attestation points at a block newer than its own slot
-    attestation = get_valid_attestation(spec, state, slot=block.slot - 1, signed=False)
-    attestation.data.beacon_block_root = block.hash_tree_root()
-    sign_attestation(spec, state, attestation)
-    run_on_attestation(spec, state, store, attestation, False)
-
-
-@with_all_phases
-@spec_state_test
-def test_on_attestation_same_slot(spec, state):
-    store = get_genesis_forkchoice_store(spec, state)
-    spec.on_tick(store, store.time + spec.config.SECONDS_PER_SLOT)
-    block = build_empty_block_for_next_slot(spec, state)
-    signed_block = state_transition_and_sign_block(spec, state, block)
-    spec.on_block(store, signed_block)
-
-    attestation = get_valid_attestation(spec, state, slot=block.slot, signed=True)
-    run_on_attestation(spec, state, store, attestation, False)
-
-
-@with_all_phases
-@spec_state_test
-def test_on_attestation_invalid_attestation(spec, state):
-    store = get_genesis_forkchoice_store(spec, state)
-    spec.on_tick(store, store.time + 3 * spec.config.SECONDS_PER_SLOT)
-    block = build_empty_block_for_next_slot(spec, state)
-    signed_block = state_transition_and_sign_block(spec, state, block)
-    spec.on_block(store, signed_block)
-
-    attestation = get_valid_attestation(spec, state, slot=block.slot, signed=True)
-    attestation.data.index = spec.MAX_COMMITTEES_PER_SLOT * spec.SLOTS_PER_EPOCH
-    run_on_attestation(spec, state, store, attestation, False)
+    assert attestation.data.beacon_block_root == withheld_head.hash_tree_root()
+    _reject(spec, store, attestation)  # LMD head unknown to the store

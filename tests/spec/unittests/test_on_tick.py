@@ -1,159 +1,143 @@
-"""on_tick unit tests: justified-checkpoint promotion mechanics at epoch
-boundaries (ref: test/phase0/unittests/fork_choice/test_on_tick.py)."""
-from consensus_specs_tpu.test_framework.block import build_empty_block_for_next_slot
+"""on_tick unit tests: clock advance + justified-checkpoint promotion at
+epoch rollover (scenario parity with ref test/phase0/unittests/
+fork_choice/test_on_tick.py; the mechanics here are this repo's own —
+on_tick reads only store.blocks, so ancestry is modeled with fabricated
+header-only chains instead of full state transitions)."""
 from consensus_specs_tpu.test_framework.context import spec_state_test, with_all_phases
-from consensus_specs_tpu.test_framework.fork_choice import get_genesis_forkchoice_store
-from consensus_specs_tpu.test_framework.state import (
-    next_epoch,
-    state_transition_and_sign_block,
-    transition_to,
+from consensus_specs_tpu.test_framework.fork_choice import (
+    get_anchor_root,
+    get_genesis_forkchoice_store,
 )
 
 
-def run_on_tick(spec, store, time, new_justified_checkpoint=False):
-    previous_justified_checkpoint = store.justified_checkpoint
+def _graft_header_chain(spec, store, parent_root, slots, salt):
+    """Thread fabricated blocks (header data only) into store.blocks so
+    get_ancestor can walk them; returns the chain's roots in order."""
+    roots = []
+    for slot in slots:
+        block = spec.BeaconBlock(
+            slot=spec.Slot(slot),
+            proposer_index=0,
+            parent_root=parent_root,
+            state_root=bytes([salt]) * 32,
+        )
+        root = spec.Root(block.hash_tree_root())
+        store.blocks[root] = block
+        roots.append(root)
+        parent_root = root
+    return roots
+
+
+def _epoch_boundary_time(spec, store, epoch):
+    slot = int(spec.compute_start_slot_at_epoch(epoch))
+    return int(store.genesis_time) + slot * int(spec.config.SECONDS_PER_SLOT)
+
+
+def _tick_expecting(spec, store, time, promoted):
+    """Tick and assert whether the best->justified promotion happened."""
+    before = store.justified_checkpoint.copy()
     spec.on_tick(store, time)
     assert store.time == time
-    if new_justified_checkpoint:
+    if promoted:
         assert store.justified_checkpoint == store.best_justified_checkpoint
-        assert store.justified_checkpoint.epoch > previous_justified_checkpoint.epoch
-        assert store.justified_checkpoint.root != previous_justified_checkpoint.root
+        assert store.justified_checkpoint != before
     else:
-        assert store.justified_checkpoint == previous_justified_checkpoint
+        assert store.justified_checkpoint == before
 
 
 @with_all_phases
 @spec_state_test
 def test_basic(spec, state):
+    # a plain clock advance inside the slot changes nothing but time
     store = get_genesis_forkchoice_store(spec, state)
-    run_on_tick(spec, store, store.time + 1)
-
-
-def _mock_best_justified_chain(spec, state, store):
-    """Build a 2-block chain whose head state claims the epoch-1 block as
-    current-justified, and adopt that claim as best_justified_checkpoint."""
-    next_epoch(spec, state)
-    block = build_empty_block_for_next_slot(spec, state)
-    state_transition_and_sign_block(spec, state, block)
-    store.blocks[block.hash_tree_root()] = block.copy()
-    store.block_states[block.hash_tree_root()] = state.copy()
-    parent_block = block.copy()
-    # epoch-boundary alignment: end the epoch so the tick lands on slot 0
-    slot = state.slot + spec.SLOTS_PER_EPOCH - state.slot % spec.SLOTS_PER_EPOCH - 1
-    transition_to(spec, state, slot)
-    block = build_empty_block_for_next_slot(spec, state)
-    state.current_justified_checkpoint = spec.Checkpoint(
-        epoch=spec.compute_epoch_at_slot(parent_block.slot),
-        root=parent_block.hash_tree_root(),
-    )
-    state_transition_and_sign_block(spec, state, block)
-    store.blocks[block.hash_tree_root()] = block.copy()
-    store.block_states[block.hash_tree_root()] = state.copy()
-    store.best_justified_checkpoint = state.current_justified_checkpoint.copy()
+    _tick_expecting(spec, store, store.time + 1, promoted=False)
 
 
 @with_all_phases
 @spec_state_test
 def test_update_justified_single_on_store_finalized_chain(spec, state):
+    """Pending best_justified whose root descends from the finalized root
+    is promoted by the first epoch-rollover tick."""
     store = get_genesis_forkchoice_store(spec, state)
-    _mock_best_justified_chain(spec, state, store)
-    run_on_tick(
-        spec,
-        store,
-        store.genesis_time + state.slot * spec.config.SECONDS_PER_SLOT,
-        new_justified_checkpoint=True,
+    anchor = get_anchor_root(spec, state)
+    # a descendant chain through epoch 1; its boundary block is the claim
+    chain = _graft_header_chain(
+        spec, store, anchor, range(1, int(spec.SLOTS_PER_EPOCH) + 2), salt=0x0A
     )
+    store.best_justified_checkpoint = spec.Checkpoint(
+        epoch=spec.Epoch(1), root=chain[int(spec.SLOTS_PER_EPOCH) - 1]
+    )
+    _tick_expecting(spec, store, _epoch_boundary_time(spec, store, 2), promoted=True)
 
 
 @with_all_phases
 @spec_state_test
 def test_update_justified_single_not_on_store_finalized_chain(spec, state):
-    """best_justified does NOT descend from the (mocked) store finalized
-    root: promotion must be refused."""
+    """Pending best_justified on a SIDE chain that does not pass through
+    the store's finalized root: the rollover tick must refuse it."""
     store = get_genesis_forkchoice_store(spec, state)
-    init_state = state.copy()
-
-    # chain A: a block at epoch 1 becomes the mocked finalized root
-    next_epoch(spec, state)
-    block = build_empty_block_for_next_slot(spec, state)
-    block.body.graffiti = b"\x11" * 32
-    state_transition_and_sign_block(spec, state, block)
-    store.blocks[block.hash_tree_root()] = block.copy()
-    store.block_states[block.hash_tree_root()] = state.copy()
-    store.finalized_checkpoint = spec.Checkpoint(
-        epoch=spec.compute_epoch_at_slot(block.slot),
-        root=block.hash_tree_root(),
+    anchor = get_anchor_root(spec, state)
+    main = _graft_header_chain(
+        spec, store, anchor, range(1, int(spec.SLOTS_PER_EPOCH) + 1), salt=0x0B
     )
-
-    # chain B (from genesis): carries the best_justified claim
-    state = init_state.copy()
-    next_epoch(spec, state)
-    block = build_empty_block_for_next_slot(spec, state)
-    block.body.graffiti = b"\x22" * 32
-    state_transition_and_sign_block(spec, state, block)
-    store.blocks[block.hash_tree_root()] = block.copy()
-    store.block_states[block.hash_tree_root()] = state.copy()
-    parent_block = block.copy()
-    slot = state.slot + spec.SLOTS_PER_EPOCH - state.slot % spec.SLOTS_PER_EPOCH - 1
-    transition_to(spec, state, slot)
-    block = build_empty_block_for_next_slot(spec, state)
-    state.current_justified_checkpoint = spec.Checkpoint(
-        epoch=spec.compute_epoch_at_slot(parent_block.slot),
-        root=parent_block.hash_tree_root(),
+    rival = _graft_header_chain(
+        spec, store, anchor, range(1, int(spec.SLOTS_PER_EPOCH) + 1), salt=0x0C
     )
-    state_transition_and_sign_block(spec, state, block)
-    store.blocks[block.hash_tree_root()] = block.copy()
-    store.block_states[block.hash_tree_root()] = state.copy()
-    store.best_justified_checkpoint = state.current_justified_checkpoint.copy()
-
-    run_on_tick(spec, store, store.genesis_time + state.slot * spec.config.SECONDS_PER_SLOT)
+    # finalized on main's epoch-1 boundary block; claim on rival's
+    store.finalized_checkpoint = spec.Checkpoint(epoch=spec.Epoch(1), root=main[-1])
+    store.best_justified_checkpoint = spec.Checkpoint(epoch=spec.Epoch(1), root=rival[-1])
+    _tick_expecting(spec, store, _epoch_boundary_time(spec, store, 2), promoted=False)
 
 
 @with_all_phases
 @spec_state_test
 def test_no_update_same_slot_at_epoch_boundary(spec, state):
+    """Already standing on the boundary slot: a sub-slot tick is not a
+    rollover, so the pending claim stays pending."""
     store = get_genesis_forkchoice_store(spec, state)
-    seconds_per_epoch = spec.config.SECONDS_PER_SLOT * spec.SLOTS_PER_EPOCH
     store.best_justified_checkpoint = spec.Checkpoint(
-        epoch=store.justified_checkpoint.epoch + 1, root=b"\x55" * 32
+        epoch=store.justified_checkpoint.epoch + 1, root=spec.Root(b"\x5a" * 32)
     )
-    store.time = seconds_per_epoch  # already at the boundary
-    run_on_tick(spec, store, store.time + 1)
+    store.time = _epoch_boundary_time(spec, store, 1)
+    _tick_expecting(spec, store, store.time + 1, promoted=False)
 
 
 @with_all_phases
 @spec_state_test
 def test_no_update_not_epoch_boundary(spec, state):
+    # one slot forward, mid-epoch: no promotion consideration at all
     store = get_genesis_forkchoice_store(spec, state)
     store.best_justified_checkpoint = spec.Checkpoint(
-        epoch=store.justified_checkpoint.epoch + 1, root=b"\x55" * 32
+        epoch=store.justified_checkpoint.epoch + 1, root=spec.Root(b"\x5a" * 32)
     )
-    run_on_tick(spec, store, store.time + spec.config.SECONDS_PER_SLOT)
+    _tick_expecting(
+        spec, store, store.time + int(spec.config.SECONDS_PER_SLOT), promoted=False
+    )
 
 
 @with_all_phases
 @spec_state_test
 def test_no_update_new_justified_equal_epoch(spec, state):
+    """best == justified in epoch: nothing newer to adopt at rollover."""
     store = get_genesis_forkchoice_store(spec, state)
-    seconds_per_epoch = spec.config.SECONDS_PER_SLOT * spec.SLOTS_PER_EPOCH
     store.best_justified_checkpoint = spec.Checkpoint(
-        epoch=store.justified_checkpoint.epoch + 1, root=b"\x55" * 32
+        epoch=spec.Epoch(1), root=spec.Root(b"\x5a" * 32)
     )
     store.justified_checkpoint = spec.Checkpoint(
-        epoch=store.best_justified_checkpoint.epoch, root=b"\x44" * 32
+        epoch=spec.Epoch(1), root=spec.Root(b"\x4b" * 32)
     )
-    run_on_tick(spec, store, store.time + seconds_per_epoch)
+    _tick_expecting(spec, store, _epoch_boundary_time(spec, store, 2), promoted=False)
 
 
 @with_all_phases
 @spec_state_test
 def test_no_update_new_justified_later_epoch(spec, state):
+    """justified already AHEAD of best (stale claim): rollover keeps it."""
     store = get_genesis_forkchoice_store(spec, state)
-    seconds_per_epoch = spec.config.SECONDS_PER_SLOT * spec.SLOTS_PER_EPOCH
     store.best_justified_checkpoint = spec.Checkpoint(
-        epoch=store.justified_checkpoint.epoch + 1, root=b"\x55" * 32
+        epoch=spec.Epoch(1), root=spec.Root(b"\x5a" * 32)
     )
     store.justified_checkpoint = spec.Checkpoint(
-        epoch=store.best_justified_checkpoint.epoch + 1, root=b"\x44" * 32
+        epoch=spec.Epoch(2), root=spec.Root(b"\x4b" * 32)
     )
-    run_on_tick(spec, store, store.time + seconds_per_epoch)
+    _tick_expecting(spec, store, _epoch_boundary_time(spec, store, 2), promoted=False)

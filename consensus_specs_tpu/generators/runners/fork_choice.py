@@ -9,6 +9,11 @@ all_mods = {
     }
     for fork in ("phase0", "altair", "bellatrix", "capella")
 }
+# merge-transition store scenarios exist from bellatrix on
+for _fork in ("bellatrix", "capella"):
+    all_mods[_fork] = dict(
+        all_mods[_fork], on_merge_block="tests.spec.test_fork_choice_on_merge_block"
+    )
 
 
 def run(args=None):

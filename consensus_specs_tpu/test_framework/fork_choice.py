@@ -121,6 +121,19 @@ def add_attester_slashing(spec, store, attester_slashing, test_steps, valid=True
     test_steps.append({"attester_slashing": slashing_file_name})
 
 
+def add_pow_block(spec, pow_block, test_steps):
+    """Publish a PowBlock into the replay stream (bellatrix+): clients
+    register it so later `get_pow_block` lookups during on_block's
+    merge-transition validation can resolve it."""
+    file_name = get_pow_block_file_name(pow_block)
+    yield file_name, pow_block
+    test_steps.append({"pow_block": file_name})
+
+
+def get_pow_block_file_name(pow_block):
+    return f"pow_block_{bytes(pow_block.block_hash).hex()[:16]}"
+
+
 def get_block_file_name(signed_block):
     return f"block_{bytes(signed_block.message.hash_tree_root()).hex()[:16]}"
 

@@ -1,0 +1,136 @@
+"""on_block at the merge transition: terminal-PoW-parent validation
+driven through the Store (scenario parity: ref test/bellatrix/
+fork_choice/test_on_merge_block.py; emits pow_block steps per
+docs/formats/fork_choice)."""
+from consensus_specs_tpu.test_framework.context import (
+    spec_state_test,
+    with_phases,
+)
+from consensus_specs_tpu.test_framework.constants import BELLATRIX
+from consensus_specs_tpu.test_framework.execution_payload import (
+    build_empty_execution_payload,
+    compute_el_block_hash,
+)
+from consensus_specs_tpu.test_framework.fork_choice import (
+    add_block,
+    add_pow_block,
+    get_genesis_forkchoice_store_and_block,
+    on_tick_and_append_step,
+)
+from consensus_specs_tpu.test_framework.pow_block import (
+    patch_pow_chain,
+    prepare_pow_block,
+)
+
+
+_POW_TIP = b"\xa1" * 32
+_POW_PARENT = b"\xa0" * 32
+
+
+def _pow_chain(spec, tip_td, parent_td):
+    """Two-block PoW chain with chosen total difficulties."""
+    parent = prepare_pow_block(
+        spec, block_hash=_POW_PARENT, total_difficulty=parent_td
+    )
+    tip = prepare_pow_block(
+        spec, block_hash=_POW_TIP, parent_hash=_POW_PARENT, total_difficulty=tip_td
+    )
+    return [parent, tip]
+
+
+def _merge_block_over(spec, state, pow_chain):
+    """The transition block: first non-empty execution payload, anchored
+    on the PoW tip. The payload's timestamp/randao bind to the BLOCK's
+    slot, so advance the state there first, then apply manually."""
+    from consensus_specs_tpu.test_framework.block import build_empty_block, sign_block
+
+    with patch_pow_chain(spec, pow_chain):
+        spec.process_slots(state, state.slot + 1)
+        block = build_empty_block(spec, state, slot=state.slot)
+        payload = build_empty_execution_payload(spec, state)
+        payload.parent_hash = _POW_TIP
+        payload.block_hash = compute_el_block_hash(spec, payload)
+        block.body.execution_payload = payload
+        spec.process_block(state, block)
+        block.state_root = spec.hash_tree_root(state)
+        return sign_block(spec, state, block)
+
+
+def _run_merge_block_scenario(spec, state, tip_td, parent_td, valid):
+    assert not spec.is_merge_transition_complete(state)
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+
+    pow_chain = _pow_chain(spec, tip_td, parent_td)
+    for pow_block in pow_chain:
+        yield from add_pow_block(spec, pow_block, test_steps)
+
+    signed_block = _merge_block_over(spec, state, pow_chain)
+    on_tick_and_append_step(
+        spec, store,
+        store.genesis_time + int(signed_block.message.slot) * spec.config.SECONDS_PER_SLOT,
+        test_steps,
+    )
+    with patch_pow_chain(spec, pow_chain):
+        yield from add_block(spec, store, signed_block, test_steps, valid=valid)
+    if valid:
+        assert spec.get_head(store) == signed_block.message.hash_tree_root()
+    yield "steps", test_steps
+
+
+@with_phases([BELLATRIX])
+@spec_state_test
+def test_all_valid(spec, state):
+    """Terminal conditions met: tip crossed TTD, its parent had not."""
+    ttd = int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
+    yield from _run_merge_block_scenario(
+        spec, state, tip_td=ttd, parent_td=max(ttd - 1, 0), valid=True
+    )
+
+
+@with_phases([BELLATRIX])
+@spec_state_test
+def test_too_early_for_merge(spec, state):
+    """The claimed terminal block has NOT reached TTD: reject."""
+    ttd = int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
+    yield from _run_merge_block_scenario(
+        spec, state, tip_td=max(ttd - 1, 0), parent_td=max(ttd - 2, 0), valid=False
+    )
+
+
+@with_phases([BELLATRIX])
+@spec_state_test
+def test_too_late_for_merge(spec, state):
+    """The terminal boundary was crossed one block EARLIER (the parent
+    already met TTD): this block is not the transition block — reject."""
+    ttd = int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
+    yield from _run_merge_block_scenario(
+        spec, state, tip_td=ttd + 1, parent_td=ttd, valid=False
+    )
+
+
+@with_phases([BELLATRIX])
+@spec_state_test
+def test_block_lookup_failed(spec, state):
+    """The PoW parent is unknown to the node: reject (delay) the block."""
+    assert not spec.is_merge_transition_complete(state)
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+
+    ttd = int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
+    full_chain = _pow_chain(spec, tip_td=ttd, parent_td=max(ttd - 1, 0))
+    # build the block with full PoW knowledge, then serve the store an
+    # EMPTY PoW view at delivery time
+    signed_block = _merge_block_over(spec, state, full_chain)
+    on_tick_and_append_step(
+        spec, store,
+        store.genesis_time + int(signed_block.message.slot) * spec.config.SECONDS_PER_SLOT,
+        test_steps,
+    )
+    with patch_pow_chain(spec, []):
+        yield from add_block(spec, store, signed_block, test_steps, valid=False)
+    yield "steps", test_steps

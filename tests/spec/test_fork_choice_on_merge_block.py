@@ -4,9 +4,8 @@ fork_choice/test_on_merge_block.py; emits pow_block steps per
 docs/formats/fork_choice)."""
 from consensus_specs_tpu.test_framework.context import (
     spec_state_test,
-    with_phases,
+    with_bellatrix_and_later,
 )
-from consensus_specs_tpu.test_framework.constants import BELLATRIX
 from consensus_specs_tpu.test_framework.execution_payload import (
     build_empty_execution_payload,
     compute_el_block_hash,
@@ -80,7 +79,7 @@ def _run_merge_block_scenario(spec, state, tip_td, parent_td, valid):
     yield "steps", test_steps
 
 
-@with_phases([BELLATRIX])
+@with_bellatrix_and_later
 @spec_state_test
 def test_all_valid(spec, state):
     """Terminal conditions met: tip crossed TTD, its parent had not."""
@@ -90,7 +89,7 @@ def test_all_valid(spec, state):
     )
 
 
-@with_phases([BELLATRIX])
+@with_bellatrix_and_later
 @spec_state_test
 def test_too_early_for_merge(spec, state):
     """The claimed terminal block has NOT reached TTD: reject."""
@@ -100,7 +99,7 @@ def test_too_early_for_merge(spec, state):
     )
 
 
-@with_phases([BELLATRIX])
+@with_bellatrix_and_later
 @spec_state_test
 def test_too_late_for_merge(spec, state):
     """The terminal boundary was crossed one block EARLIER (the parent
@@ -111,7 +110,7 @@ def test_too_late_for_merge(spec, state):
     )
 
 
-@with_phases([BELLATRIX])
+@with_bellatrix_and_later
 @spec_state_test
 def test_block_lookup_failed(spec, state):
     """The PoW parent is unknown to the node: reject (delay) the block."""

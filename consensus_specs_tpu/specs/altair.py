@@ -887,6 +887,21 @@ def get_sync_committee_message(state: "BeaconState", block_root, validator_index
     )
 
 
+def get_sync_subcommittee_pubkeys(state: "BeaconState", subcommittee_index):
+    """The pubkey slice a gossip subnet's contributions must come from
+    (altair/p2p-interface.md:125-137): committees assigned to a slot sign
+    for slot-1, hence the period-boundary next-committee exception."""
+    next_slot_epoch = compute_epoch_at_slot(Slot(state.slot + 1))  # noqa: F821
+    if compute_sync_committee_period(get_current_epoch(state)) == compute_sync_committee_period(next_slot_epoch):  # noqa: F821
+        sync_committee = state.current_sync_committee
+    else:
+        sync_committee = state.next_sync_committee
+
+    sync_subcommittee_size = SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT  # noqa: F821
+    start = int(subcommittee_index) * sync_subcommittee_size
+    return [sync_committee.pubkeys[i] for i in range(start, start + sync_subcommittee_size)]
+
+
 def compute_subnets_for_sync_committee(state: "BeaconState", validator_index):
     next_slot_epoch = compute_epoch_at_slot(Slot(state.slot + 1))  # noqa: F821
     if compute_sync_committee_period(get_current_epoch(state)) == compute_sync_committee_period(next_slot_epoch):  # noqa: F821

@@ -1,0 +1,121 @@
+"""Config/preset invariant checks: relations the spec assumes but never
+re-states (scenario parity: ref test/phase0/unittests/
+test_config_invariants.py + altair/unittests/test_config_invariants.py;
+grouped here as relation tables per domain)."""
+from consensus_specs_tpu.test_framework.context import (
+    spec_state_test,
+    with_all_phases,
+    with_altair_and_later,
+)
+
+
+def _is_power_of_two(value: int) -> bool:
+    return value > 0 and value & (value - 1) == 0
+
+
+@with_all_phases
+@spec_state_test
+def test_validators(spec, state):
+    # committee sizing must be satisfiable at both registry extremes
+    assert spec.config.MIN_PER_EPOCH_CHURN_LIMIT >= 1
+    assert spec.config.CHURN_LIMIT_QUOTIENT >= 1
+    assert int(spec.TARGET_COMMITTEE_SIZE) * int(spec.MAX_COMMITTEES_PER_SLOT) <= (
+        int(spec.MAX_VALIDATORS_PER_COMMITTEE) * int(spec.MAX_COMMITTEES_PER_SLOT)
+    )
+    assert int(spec.SHUFFLE_ROUND_COUNT) >= 1
+    # the registry limit must fit the balance/validator list types
+    assert int(spec.VALIDATOR_REGISTRY_LIMIT) >= len(state.validators)
+
+
+@with_all_phases
+@spec_state_test
+def test_balances(spec, state):
+    increment = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    assert int(spec.MIN_DEPOSIT_AMOUNT) > 0
+    assert int(spec.MAX_EFFECTIVE_BALANCE) % increment == 0
+    assert int(spec.MAX_EFFECTIVE_BALANCE) >= int(spec.config.EJECTION_BALANCE)
+    # every genesis validator was funded to a representable balance
+    for validator in state.validators:
+        assert int(validator.effective_balance) % increment == 0
+
+
+@with_all_phases
+@spec_state_test
+def test_hysteresis_quotient(spec, state):
+    q = int(spec.HYSTERESIS_QUOTIENT)
+    assert q > 0
+    assert int(spec.HYSTERESIS_DOWNWARD_MULTIPLIER) < q
+    assert q <= int(spec.HYSTERESIS_UPWARD_MULTIPLIER) <= 2 * q
+
+
+@with_all_phases
+@spec_state_test
+def test_incentives(spec, state):
+    # penalties must never be SOFTER than the reward scale they police
+    assert int(spec.WHISTLEBLOWER_REWARD_QUOTIENT) > 0
+    assert int(spec.PROPOSER_REWARD_QUOTIENT) > 0 or spec.fork != "phase0"
+    assert int(spec.MIN_SLASHING_PENALTY_QUOTIENT) > 0
+    assert int(spec.BASE_REWARD_FACTOR) > 0
+
+
+@with_all_phases
+@spec_state_test
+def test_time(spec, state):
+    assert int(spec.SLOTS_PER_EPOCH) <= int(spec.SLOTS_PER_HISTORICAL_ROOT)
+    assert int(spec.MIN_SEED_LOOKAHEAD) < int(spec.MAX_SEED_LOOKAHEAD)
+    assert int(spec.SLOTS_PER_HISTORICAL_ROOT) % int(spec.SLOTS_PER_EPOCH) == 0
+    assert int(spec.config.SECONDS_PER_SLOT) > 0
+    assert _is_power_of_two(int(spec.SLOTS_PER_EPOCH))
+    assert int(spec.MIN_ATTESTATION_INCLUSION_DELAY) >= 1
+    assert int(spec.MIN_ATTESTATION_INCLUSION_DELAY) <= int(spec.SLOTS_PER_EPOCH)
+    assert int(spec.EPOCHS_PER_HISTORICAL_VECTOR) > int(spec.MIN_SEED_LOOKAHEAD)
+    assert int(spec.EPOCHS_PER_HISTORICAL_VECTOR) >= int(spec.EPOCHS_PER_SLASHINGS_VECTOR)
+
+
+@with_all_phases
+@spec_state_test
+def test_networking(spec, state):
+    assert int(spec.MAX_COMMITTEES_PER_SLOT) <= int(spec.ATTESTATION_SUBNET_COUNT)
+    # a served-blocks window shorter than withdrawability would strand
+    # exits without their proofs of inclusion
+    assert int(spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY) >= 1
+
+
+@with_all_phases
+@spec_state_test
+def test_fork_choice(spec, state):
+    assert int(spec.INTERVALS_PER_SLOT) > 0
+    assert int(spec.config.SECONDS_PER_SLOT) % int(spec.INTERVALS_PER_SLOT) == 0
+    assert int(spec.SAFE_SLOTS_TO_UPDATE_JUSTIFIED) <= int(spec.SLOTS_PER_EPOCH)
+    assert 0 < int(spec.config.PROPOSER_SCORE_BOOST) <= 100
+
+
+@with_altair_and_later
+@spec_state_test
+def test_weight_denominator(spec, state):
+    # the per-flag weights plus proposer/sync weights must recompose the
+    # denominator EXACTLY, or rewards leak rounding dust systematically
+    total = (
+        int(spec.TIMELY_HEAD_WEIGHT)
+        + int(spec.TIMELY_SOURCE_WEIGHT)
+        + int(spec.TIMELY_TARGET_WEIGHT)
+        + int(spec.SYNC_REWARD_WEIGHT)
+        + int(spec.PROPOSER_WEIGHT)
+    )
+    assert total == int(spec.WEIGHT_DENOMINATOR)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_inactivity_score(spec, state):
+    assert int(spec.config.INACTIVITY_SCORE_BIAS) > 0
+    assert int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE) > 0
+
+
+@with_altair_and_later
+@spec_state_test
+def test_sync_committee_shape(spec, state):
+    # subcommittees must tile the committee exactly (p2p subnet slicing)
+    assert int(spec.SYNC_COMMITTEE_SIZE) % int(spec.SYNC_COMMITTEE_SUBNET_COUNT) == 0
+    assert int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD) >= 1
+    assert int(spec.TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE) >= 1

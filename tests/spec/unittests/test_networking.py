@@ -1,0 +1,51 @@
+"""Altair networking unit tests: sync-subcommittee pubkey slicing across
+the committee-period boundary (scenario parity: ref altair/unittests/
+networking/test_networking.py; altair/p2p-interface.md:125-137)."""
+from consensus_specs_tpu.test_framework.context import (
+    spec_state_test,
+    with_altair_and_later,
+)
+from consensus_specs_tpu.test_framework.state import transition_to
+
+
+def _period_slots(spec):
+    return int(spec.SLOTS_PER_EPOCH) * int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD)
+
+
+def _expected_slice(spec, committee, subcommittee_index):
+    width = int(spec.SYNC_COMMITTEE_SIZE) // int(spec.SYNC_COMMITTEE_SUBNET_COUNT)
+    lo = subcommittee_index * width
+    return [bytes(pk) for pk in committee.pubkeys[lo:lo + width]]
+
+
+@with_altair_and_later
+@spec_state_test
+def test_get_sync_subcommittee_pubkeys_current_sync_committee(spec, state):
+    # mid-period: the NEXT slot stays in the same committee period, so
+    # the slice comes from the CURRENT committee
+    transition_to(spec, state, _period_slots(spec))
+    next_slot_epoch = spec.compute_epoch_at_slot(state.slot + 1)
+    assert spec.compute_sync_committee_period(
+        spec.get_current_epoch(state)
+    ) == spec.compute_sync_committee_period(next_slot_epoch)
+
+    for subcommittee_index in range(int(spec.SYNC_COMMITTEE_SUBNET_COUNT)):
+        got = [bytes(pk) for pk in spec.get_sync_subcommittee_pubkeys(state, subcommittee_index)]
+        assert got == _expected_slice(spec, state.current_sync_committee, subcommittee_index)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_get_sync_subcommittee_pubkeys_next_sync_committee(spec, state):
+    # final slot of the period: slot+1 crosses into the next period, and
+    # committees assigned there sign for THIS slot — the slice must come
+    # from the NEXT committee
+    transition_to(spec, state, _period_slots(spec) - 1)
+    next_slot_epoch = spec.compute_epoch_at_slot(state.slot + 1)
+    assert spec.compute_sync_committee_period(
+        spec.get_current_epoch(state)
+    ) != spec.compute_sync_committee_period(next_slot_epoch)
+
+    for subcommittee_index in range(int(spec.SYNC_COMMITTEE_SUBNET_COUNT)):
+        got = [bytes(pk) for pk in spec.get_sync_subcommittee_pubkeys(state, subcommittee_index)]
+        assert got == _expected_slice(spec, state.next_sync_committee, subcommittee_index)

@@ -4,7 +4,10 @@ from ..gen_from_tests import run_state_test_generators
 # Transition tests declare their own pre-fork via with_phases; register
 # them under every pre-fork that has a successor.
 all_mods = {
-    fork: {"core": "tests.spec.test_transition"}
+    fork: {
+        "core": "tests.spec.test_transition",
+        "shapes": "tests.spec.test_transition_shapes",
+    }
     for fork in ("phase0", "altair", "bellatrix")
 }
 

@@ -1,0 +1,173 @@
+"""Cross-fork transitions over NON-TRIVIAL registry shapes: exit queues,
+activation queues, inactivity leaks, and slashed fractions crossing the
+boundary (scenario parity: ref test/altair/transition/
+{test_activations_and_exits,test_leaking,test_slashing}.py — the upgrade
+functions must translate these states faithfully, and the post-fork
+epoch machinery must keep processing them)."""
+from consensus_specs_tpu.test_framework.constants import ALTAIR, BELLATRIX, CAPELLA, PHASE0
+from consensus_specs_tpu.test_framework.context import (
+    default_activation_threshold,
+    default_balances,
+    spec_test,
+    with_custom_state,
+    with_phases,
+)
+from consensus_specs_tpu.test_framework.fork_transition import run_fork_transition
+from consensus_specs_tpu.test_framework.keys import pubkeys
+
+
+def _quarter(state):
+    return max(1, len(state.validators) // 4)
+
+
+def _stage_exiting_validators(spec, state, exit_epoch):
+    """A quarter of the registry has an exit scheduled for `exit_epoch`."""
+    for index in range(_quarter(state)):
+        validator = state.validators[index]
+        validator.exit_epoch = exit_epoch
+        validator.withdrawable_epoch = exit_epoch + spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+    return list(range(_quarter(state)))
+
+
+def _stage_activation_queue(spec, state, activation_epoch, eligibility_epoch=None):
+    """Fresh registry entries waiting on (or scheduled for) activation."""
+    if eligibility_epoch is None:
+        eligibility_epoch = spec.Epoch(0)
+    added = []
+    for i in range(_quarter(state)):
+        index = len(state.validators)
+        key = pubkeys[index]
+        state.validators.append(
+            spec.Validator(
+                pubkey=key,
+                withdrawal_credentials=spec.BLS_WITHDRAWAL_PREFIX + spec.hash(key)[1:],
+                effective_balance=spec.MAX_EFFECTIVE_BALANCE,
+                activation_eligibility_epoch=eligibility_epoch,
+                activation_epoch=activation_epoch,
+                exit_epoch=spec.FAR_FUTURE_EPOCH,
+                withdrawable_epoch=spec.FAR_FUTURE_EPOCH,
+            )
+        )
+        state.balances.append(spec.MAX_EFFECTIVE_BALANCE)
+        added.append(index)
+    return added
+
+
+def _future_proposers(spec, spec_post, state, fork_epoch):
+    """Proposer indices the transition's block chain will draw — found by
+    dry-running the SAME driver on a scratch copy (slashing flags change
+    neither seeds nor effective balances, so the draw is identical)."""
+    scratch = state.copy()
+    proposers = set()
+    for part in run_fork_transition(spec, spec_post, scratch, fork_epoch=fork_epoch):
+        if part[0] == "blocks":
+            for signed in part[1]:
+                proposers.add(int(signed.message.proposer_index))
+    return proposers
+
+
+def _stage_slashed_validators(spec, state, avoid):
+    """A quarter of the registry carrying the slashed mark — skipping
+    `avoid` (upcoming proposers: a slashed proposer cannot produce the
+    chain's blocks). Exit epochs stay untouched so the ACTIVE set — and
+    with it the proposer draw the dry-run predicted — is unchanged."""
+    staged = []
+    for index in range(len(state.validators)):
+        if index in avoid:
+            continue
+        state.validators[index].slashed = True
+        staged.append(index)
+        if len(staged) >= _quarter(state):
+            break
+    return staged
+
+
+def _make_shape_tests(pre, post):
+    made = {}
+
+    def register(name, fn):
+        fn.__name__ = f"test_transition_to_{post}_{name}"
+        made[fn.__name__] = fn
+
+    def shape_test(name):
+        def deco(body):
+            @with_phases([pre], other_phases=[post])
+            @spec_test
+            @with_custom_state(default_balances, default_activation_threshold)
+            def test_fn(spec, state, phases):
+                yield from body(spec, phases[post], state)
+
+            register(name, test_fn)
+            return body
+
+        return deco
+
+    @shape_test("one_fourth_exiting_post_fork")
+    def _exits_post(spec, spec_post, state):
+        staged = _stage_exiting_validators(spec, state, exit_epoch=spec.Epoch(4))
+        yield from run_fork_transition(spec, spec_post, state, fork_epoch=2)
+        for index in staged:  # still pending at fork; honored after it
+            assert state.validators[index].exit_epoch == 4
+
+    @shape_test("one_fourth_exiting_at_fork")
+    def _exits_at(spec, spec_post, state):
+        staged = _stage_exiting_validators(spec, state, exit_epoch=spec.Epoch(2))
+        yield from run_fork_transition(spec, spec_post, state, fork_epoch=2)
+        epoch = spec_post.get_current_epoch(state)
+        for index in staged:  # exited exactly when the new fork began
+            assert not spec_post.is_active_validator(state.validators[index], epoch)
+
+    @shape_test("non_empty_activation_queue")
+    def _queue(spec, spec_post, state):
+        staged = _stage_activation_queue(
+            spec, state, spec.FAR_FUTURE_EPOCH, eligibility_epoch=spec.Epoch(1)
+        )
+        yield from run_fork_transition(spec, spec_post, state, fork_epoch=2)
+        for index in staged:
+            # eligibility (1) stays beyond the stalled finality (0), so
+            # the queue must cross the fork intact: registered, eligible,
+            # still waiting
+            assert state.validators[index].activation_epoch == spec.FAR_FUTURE_EPOCH
+            assert state.validators[index].activation_eligibility_epoch == 1
+
+    @shape_test("activation_at_fork_epoch")
+    def _act_at_fork(spec, spec_post, state):
+        staged = _stage_activation_queue(spec, state, activation_epoch=spec.Epoch(2))
+        yield from run_fork_transition(spec, spec_post, state, fork_epoch=2)
+        epoch = spec_post.get_current_epoch(state)
+        for index in staged:  # first active in the post-fork world
+            assert spec_post.is_active_validator(state.validators[index], epoch)
+
+    @shape_test("leaking_pre_fork")
+    def _leak_pre(spec, spec_post, state):
+        # an attestation-free chain: the leak begins BEFORE this late fork
+        fork_epoch = int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 4
+        yield from run_fork_transition(spec, spec_post, state, fork_epoch=fork_epoch)
+        assert spec_post.is_in_inactivity_leak(state)
+
+    @shape_test("leaking_at_fork")
+    def _leak_at(spec, spec_post, state):
+        # the fork lands exactly as the finality delay crosses the
+        # inactivity threshold
+        fork_epoch = int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 2
+        yield from run_fork_transition(spec, spec_post, state, fork_epoch=fork_epoch)
+        assert spec_post.is_in_inactivity_leak(state)
+
+    @shape_test("one_fourth_slashed_pre_fork")
+    def _slashed(spec, spec_post, state):
+        avoid = _future_proposers(spec, spec_post, state, fork_epoch=2)
+        staged = _stage_slashed_validators(spec, state, avoid)
+        yield from run_fork_transition(spec, spec_post, state, fork_epoch=2)
+        for index in staged:  # the slash mark must survive the upgrade
+            assert state.validators[index].slashed
+
+    return made
+
+
+for _name, _fn in {
+    **_make_shape_tests(PHASE0, ALTAIR),
+    **_make_shape_tests(ALTAIR, BELLATRIX),
+    **_make_shape_tests(BELLATRIX, CAPELLA),
+}.items():
+    globals()[_name] = _fn
+del _name, _fn

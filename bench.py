@@ -144,6 +144,7 @@ def _run_section(name: str, est_s: float, fn) -> None:
         return
     _note(f"{name} ...")
     t0 = time.monotonic()
+    before = set(RESULTS)
     try:
         fn()
     except Exception as e:  # a broken section must not starve the rest
@@ -152,7 +153,10 @@ def _run_section(name: str, est_s: float, fn) -> None:
     finally:
         dt = time.monotonic() - t0
         RESULTS["section_seconds"][name] = round(dt, 1)
-        _note(f"{name} done in {dt:.1f}s")
+        # forensic stderr record: a later hard-kill must not erase what
+        # this section measured
+        new_keys = {k: RESULTS[k] for k in RESULTS if k not in before and k != "section_seconds"}
+        _note(f"{name} done in {dt:.1f}s {json.dumps(new_keys) if new_keys else ''}")
 
 
 # ---------------------------------------------------------------------------

@@ -1,6 +1,7 @@
 """Genesis initialization/validity tests
 (ref: test/phase0/genesis/{test_initialization,test_validity}.py)."""
 from consensus_specs_tpu.test_framework.context import (
+    BELLATRIX,
     PHASE0,
     spec_test,
     single_phase,
@@ -286,4 +287,75 @@ def test_is_valid_genesis_state_true_one_more_validator(spec, phases=None):
     eth1_timestamp = spec.config.MIN_GENESIS_TIME
     state = spec.initialize_beacon_state_from_eth1(eth1_block_hash, eth1_timestamp, deposits)
     assert spec.is_valid_genesis_state(state)
+    yield "state", state
+
+
+# -- bellatrix genesis: pre- vs post-merged starts (ref: bellatrix/
+# genesis/test_initialization.py — the execution header parameter
+# decides whether the chain is born merged) ---------------------------
+
+def _bellatrix_genesis_inputs(spec):
+    deposit_count = spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT
+    deposits, deposit_root, _ = prepare_full_genesis_deposits(
+        spec, spec.MAX_EFFECTIVE_BALANCE, deposit_count, signed=True
+    )
+    return deposits, deposit_root, b"\x12" * 32, spec.config.MIN_GENESIS_TIME
+
+
+@with_phases([BELLATRIX])
+@spec_test
+@single_phase
+@with_presets([MINIMAL], reason="too slow")
+def test_initialize_pre_transition_no_param(spec, phases=None):
+    """No header passed: the chain starts pre-merge."""
+    deposits, deposit_root, eth1_hash, eth1_time = _bellatrix_genesis_inputs(spec)
+    yield "eth1_block_hash", eth1_hash
+    yield "eth1_timestamp", "meta", int(eth1_time)
+    state = spec.initialize_beacon_state_from_eth1(eth1_hash, eth1_time, deposits)
+    assert state.fork.current_version == spec.config.BELLATRIX_FORK_VERSION
+    assert not spec.is_merge_transition_complete(state)
+    assert state.eth1_data.deposit_root == deposit_root
+    yield "state", state
+
+
+@with_phases([BELLATRIX])
+@spec_test
+@single_phase
+@with_presets([MINIMAL], reason="too slow")
+def test_initialize_pre_transition_empty_payload(spec, phases=None):
+    """An explicitly DEFAULT header is the same pre-merge start."""
+    deposits, _, eth1_hash, eth1_time = _bellatrix_genesis_inputs(spec)
+    yield "eth1_block_hash", eth1_hash
+    yield "eth1_timestamp", "meta", int(eth1_time)
+    state = spec.initialize_beacon_state_from_eth1(
+        eth1_hash, eth1_time, deposits,
+        execution_payload_header=spec.ExecutionPayloadHeader(),
+    )
+    assert not spec.is_merge_transition_complete(state)
+    yield "execution_payload_header", "meta", False
+    yield "state", state
+
+
+@with_phases([BELLATRIX])
+@spec_test
+@single_phase
+@with_presets([MINIMAL], reason="too slow")
+def test_initialize_post_transition(spec, phases=None):
+    """A real header seeds a born-merged chain."""
+    deposits, _, eth1_hash, eth1_time = _bellatrix_genesis_inputs(spec)
+    yield "eth1_block_hash", eth1_hash
+    yield "eth1_timestamp", "meta", int(eth1_time)
+    genesis_header = spec.ExecutionPayloadHeader(
+        block_hash=b"\x30" * 32,
+        parent_hash=b"\x29" * 32,
+        block_number=0,
+        gas_limit=30_000_000,
+        timestamp=eth1_time,
+    )
+    state = spec.initialize_beacon_state_from_eth1(
+        eth1_hash, eth1_time, deposits, execution_payload_header=genesis_header
+    )
+    assert spec.is_merge_transition_complete(state)
+    assert state.latest_execution_payload_header == genesis_header
+    yield "execution_payload_header", "meta", True
     yield "state", state

@@ -1,0 +1,76 @@
+"""Bellatrix merge-transition predicate unit tests
+(scenario parity: ref bellatrix/unittests/test_transition.py +
+test_is_valid_terminal_pow_block.py — predicate truth tables over
+payload/header shapes)."""
+from consensus_specs_tpu.test_framework.context import (
+    spec_state_test,
+    with_bellatrix_and_later,
+)
+from consensus_specs_tpu.test_framework.execution_payload import (
+    build_empty_execution_payload,
+    build_state_with_complete_transition,
+    build_state_with_incomplete_transition,
+)
+
+
+def _body_with_payload(spec, payload):
+    body = spec.BeaconBlockBody()
+    body.execution_payload = payload
+    return body
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_merge_complete_predicate(spec, state):
+    assert not spec.is_merge_transition_complete(state)  # default header
+    complete = build_state_with_complete_transition(spec, state.copy())
+    assert spec.is_merge_transition_complete(complete)
+    incomplete = build_state_with_incomplete_transition(spec, state.copy())
+    assert not spec.is_merge_transition_complete(incomplete)
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_is_merge_block_and_is_execution_enabled(spec, state):
+    """Truth table over (transition-complete?, payload-empty?):
+    - the MERGE block is exactly [incomplete, non-empty payload];
+    - execution is enabled for any non-empty payload OR once complete."""
+    incomplete = build_state_with_incomplete_transition(spec, state.copy())
+    complete = build_state_with_complete_transition(spec, state.copy())
+
+    empty_body = _body_with_payload(spec, spec.ExecutionPayload())
+    real_body = _body_with_payload(spec, build_empty_execution_payload(spec, incomplete))
+
+    assert spec.is_merge_transition_block(incomplete, real_body)
+    assert not spec.is_merge_transition_block(incomplete, empty_body)
+    assert not spec.is_merge_transition_block(complete, real_body)
+    assert not spec.is_merge_transition_block(complete, empty_body)
+
+    assert spec.is_execution_enabled(incomplete, real_body)
+    assert not spec.is_execution_enabled(incomplete, empty_body)
+    assert spec.is_execution_enabled(complete, real_body)
+    assert spec.is_execution_enabled(complete, empty_body)
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_is_valid_terminal_pow_block(spec, state):
+    """The terminal block is the FIRST to cross TTD: itself at/above,
+    its parent strictly below."""
+    ttd = int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
+
+    def pow_block(td):
+        return spec.PowBlock(
+            block_hash=b"\x01" * 32, parent_hash=b"\x02" * 32,
+            total_difficulty=spec.uint256(td),
+        )
+
+    cases = [
+        (ttd, max(ttd - 1, 0), True),    # crossed exactly here
+        (ttd + 1, max(ttd - 1, 0), True),
+        (max(ttd - 1, 0), max(ttd - 2, 0), False),  # not crossed yet
+        (ttd + 1, ttd, False),           # crossed one block earlier
+    ]
+    for tip_td, parent_td, expected in cases:
+        got = spec.is_valid_terminal_pow_block(pow_block(tip_td), pow_block(parent_td))
+        assert got == expected, (tip_td, parent_td)

@@ -1,0 +1,200 @@
+"""Altair honest-validator sync-committee duty unit tests: assignment
+discovery, message/proof production, subnet mapping, aggregation folding
+(scenario parity: ref altair/unittests/validator/test_validator.py;
+structured as duty-pipeline checks in this repo's idiom)."""
+from consensus_specs_tpu.test_framework.context import (
+    always_bls,
+    spec_state_test,
+    with_altair_and_later,
+)
+from consensus_specs_tpu.test_framework.keys import privkeys, pubkeys
+from consensus_specs_tpu.test_framework.state import transition_to
+from consensus_specs_tpu.test_framework.sync_committee import compute_committee_indices
+
+
+@with_altair_and_later
+@spec_state_test
+def test_is_assigned_to_sync_committee(spec, state):
+    # assignment must agree exactly with committee membership, for the
+    # current period and the (discoverable) next period
+    epoch = spec.get_current_epoch(state)
+    members = set(compute_committee_indices(spec, state))
+    for index in range(len(state.validators)):
+        assert spec.is_assigned_to_sync_committee(state, epoch, index) == (index in members)
+
+    lookahead_epoch = epoch + spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+    next_members = set(
+        compute_committee_indices(spec, state, committee=state.next_sync_committee)
+    )
+    for index in range(len(state.validators)):
+        assert spec.is_assigned_to_sync_committee(state, lookahead_epoch, index) == (
+            index in next_members
+        )
+
+
+@with_altair_and_later
+@spec_state_test
+@always_bls
+def test_get_sync_committee_message(spec, state):
+    # the duty message signs the head root under DOMAIN_SYNC_COMMITTEE
+    root = spec.Root(b"\x31" * 32)
+    message = spec.get_sync_committee_message(state, root, spec.ValidatorIndex(3), privkeys[3])
+    assert message.slot == state.slot
+    assert message.beacon_block_root == root
+    assert message.validator_index == 3
+    domain = spec.get_domain(
+        state, spec.DOMAIN_SYNC_COMMITTEE, spec.compute_epoch_at_slot(state.slot)
+    )
+    signing_root = spec.compute_signing_root(spec.Root(root), domain)
+    assert spec.bls.Verify(pubkeys[3], signing_root, message.signature)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_compute_subnets_for_sync_committee(spec, state):
+    # mid-period: each member's subnets are exactly the subcommittees
+    # holding its seats in the CURRENT committee
+    width = int(spec.SYNC_COMMITTEE_SIZE) // int(spec.SYNC_COMMITTEE_SUBNET_COUNT)
+    committee = compute_committee_indices(spec, state)
+    for index in set(committee):
+        seats = [s for s, member in enumerate(committee) if member == index]
+        expected = {s // width for s in seats}
+        assert set(map(int, spec.compute_subnets_for_sync_committee(state, index))) == expected
+
+
+@with_altair_and_later
+@spec_state_test
+def test_compute_subnets_for_sync_committee_slot_period_boundary(spec, state):
+    # last slot of the period: duties point at the NEXT committee
+    transition_to(
+        spec, state,
+        int(spec.SLOTS_PER_EPOCH) * int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD) - 1,
+    )
+    width = int(spec.SYNC_COMMITTEE_SIZE) // int(spec.SYNC_COMMITTEE_SUBNET_COUNT)
+    committee = compute_committee_indices(spec, state, committee=state.next_sync_committee)
+    for index in set(committee):
+        seats = [s for s, member in enumerate(committee) if member == index]
+        expected = {s // width for s in seats}
+        assert set(map(int, spec.compute_subnets_for_sync_committee(state, index))) == expected
+
+
+@with_altair_and_later
+@spec_state_test
+@always_bls
+def test_get_sync_committee_selection_proof(spec, state):
+    slot, subcommittee = spec.Slot(4), 1
+    proof = spec.get_sync_committee_selection_proof(state, slot, subcommittee, privkeys[7])
+    data = spec.SyncAggregatorSelectionData(slot=slot, subcommittee_index=subcommittee)
+    domain = spec.get_domain(
+        state, spec.DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF, spec.compute_epoch_at_slot(slot)
+    )
+    assert spec.bls.Verify(
+        pubkeys[7], spec.compute_signing_root(data, domain), proof
+    )
+
+
+@with_altair_and_later
+@spec_state_test
+@always_bls
+def test_is_sync_committee_aggregator(spec, state):
+    # selection is a hash lottery over the proof; across enough draws
+    # roughly 1/modulo hit — at minimum the function must be a pure
+    # deterministic predicate
+    proof = spec.get_sync_committee_selection_proof(state, spec.Slot(1), 0, privkeys[0])
+    first = spec.is_sync_committee_aggregator(proof)
+    assert spec.is_sync_committee_aggregator(proof) == first
+    # SOME slot/subcommittee/key combination must select an aggregator
+    found = any(
+        spec.is_sync_committee_aggregator(
+            spec.get_sync_committee_selection_proof(state, spec.Slot(s), sc, privkeys[k])
+        )
+        for s in range(4)
+        for sc in range(int(spec.SYNC_COMMITTEE_SUBNET_COUNT))
+        for k in range(4)
+    )
+    assert found
+
+
+@with_altair_and_later
+@spec_state_test
+@always_bls
+def test_get_contribution_and_proof(spec, state):
+    contribution = spec.SyncCommitteeContribution(
+        slot=state.slot, beacon_block_root=b"\x77" * 32, subcommittee_index=2
+    )
+    wrapped = spec.get_contribution_and_proof(
+        state, spec.ValidatorIndex(5), contribution, privkeys[5]
+    )
+    assert wrapped.aggregator_index == 5
+    assert wrapped.contribution == contribution
+    assert wrapped.selection_proof == spec.get_sync_committee_selection_proof(
+        state, contribution.slot, contribution.subcommittee_index, privkeys[5]
+    )
+
+
+@with_altair_and_later
+@spec_state_test
+@always_bls
+def test_get_contribution_and_proof_signature(spec, state):
+    contribution = spec.SyncCommitteeContribution(
+        slot=state.slot, beacon_block_root=b"\x78" * 32, subcommittee_index=1
+    )
+    wrapped = spec.get_contribution_and_proof(
+        state, spec.ValidatorIndex(5), contribution, privkeys[5]
+    )
+    signature = spec.get_contribution_and_proof_signature(state, wrapped, privkeys[5])
+    domain = spec.get_domain(
+        state, spec.DOMAIN_CONTRIBUTION_AND_PROOF,
+        spec.compute_epoch_at_slot(contribution.slot),
+    )
+    assert spec.bls.Verify(
+        pubkeys[5], spec.compute_signing_root(wrapped, domain), signature
+    )
+
+
+@with_altair_and_later
+@spec_state_test
+@always_bls
+def test_process_sync_committee_contributions(spec, state):
+    """Folding per-subnet contributions must set exactly the union of the
+    seat bits and aggregate the signatures."""
+    from consensus_specs_tpu.test_framework.block import build_empty_block_for_next_slot
+    from consensus_specs_tpu.test_framework.sync_committee import (
+        compute_aggregate_sync_committee_signature,
+    )
+
+    block = build_empty_block_for_next_slot(spec, state)
+    committee = compute_committee_indices(spec, state)
+    width = int(spec.SYNC_COMMITTEE_SIZE) // int(spec.SYNC_COMMITTEE_SUBNET_COUNT)
+
+    contributions = []
+    expected_seats = set()
+    for subcommittee_index in (0, int(spec.SYNC_COMMITTEE_SUBNET_COUNT) - 1):
+        bits = [False] * width
+        seats = [0, width - 1]
+        participants = []
+        for seat in seats:
+            bits[seat] = True
+            global_seat = subcommittee_index * width + seat
+            expected_seats.add(global_seat)
+            participants.append(committee[global_seat])
+        contributions.append(
+            spec.SyncCommitteeContribution(
+                slot=block.slot,
+                beacon_block_root=block.parent_root,
+                subcommittee_index=subcommittee_index,
+                aggregation_bits=bits,
+                signature=compute_aggregate_sync_committee_signature(
+                    spec, state, block.slot - 1, participants,
+                    block_root=block.parent_root,
+                ),
+            )
+        )
+
+    spec.process_sync_committee_contributions(block, contributions)
+    got_seats = {i for i, bit in enumerate(block.body.sync_aggregate.sync_committee_bits) if bit}
+    assert got_seats == expected_seats
+    # the folded signature is exactly the aggregate of the contributions
+    assert block.body.sync_aggregate.sync_committee_signature == spec.bls.Aggregate(
+        [contribution.signature for contribution in contributions]
+    )

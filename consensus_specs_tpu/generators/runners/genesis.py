@@ -3,6 +3,8 @@ from ..gen_from_tests import run_state_test_generators
 
 all_mods = {
     "phase0": {"genesis": "tests.spec.test_genesis"},
+    # bellatrix genesis adds the execution-payload-header parameter cases
+    "bellatrix": {"genesis": "tests.spec.test_genesis"},
 }
 
 

@@ -119,3 +119,21 @@ def test_sync_committee_shape(spec, state):
     assert int(spec.SYNC_COMMITTEE_SIZE) % int(spec.SYNC_COMMITTEE_SUBNET_COUNT) == 0
     assert int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD) >= 1
     assert int(spec.TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE) >= 1
+
+
+@with_all_phases
+@spec_state_test
+def test_config_override_isolation(spec, state):
+    """A config-overridden spec build must carry the override without
+    leaking into the cached base build (ref altair/unittests/
+    test_config_override.py, generalized to every fork)."""
+    from consensus_specs_tpu.specs.build import build_spec
+
+    overridden = build_spec(
+        spec.fork, "minimal", config_overrides={"MIN_GENESIS_TIME": 12345}
+    )
+    assert int(overridden.config.MIN_GENESIS_TIME) == 12345
+    base = build_spec(spec.fork, "minimal")
+    assert int(base.config.MIN_GENESIS_TIME) != 12345
+    # unrelated knobs are untouched by the override
+    assert overridden.config.SECONDS_PER_SLOT == base.config.SECONDS_PER_SLOT

@@ -100,3 +100,46 @@ def test_ssz_static_beacon_block_root_pinned():
         rng, spec.BeaconBlock, 1000, 10, RandomizationMode.mode_random, False
     )
     assert bytes(value.hash_tree_root()).hex() == SSZ_STATIC_BEACON_BLOCK_ROOT
+
+
+# SHA-256 of every file of the sanity/multi_operations `full_house_block`
+# case (real BLS): pins the multi-family block construction AND the
+# blocks_count/blocks_<i> list-part emission contract
+FULL_HOUSE_BLOCK_FILES = {
+    "blocks_0.ssz_snappy": "8bcfef5c566982e202b69249f431bbbabfdac08e4146ced4ef8e5b4410081191",
+    "meta.yaml": "4588ab38526fcf529b5c25a6600efeaaa60d07432961d551e5ad4de968a7a59e",
+    "post.ssz_snappy": "5ce8af86bb40591bf2d36be52186e07aaeaad0e9506e3412c820eba700523377",
+    "pre.ssz_snappy": "7bde517b21b4b31d0b56cfae22070e3d2b974002036c28498dec5c7240066749",
+}
+
+
+@pytest.mark.bls
+def test_full_house_block_case_bytes_pinned():
+    import tests.spec.test_sanity_multi_operations as mo_src
+
+    bls.use_reference()
+
+    def cases():
+        for case in generate_from_tests(
+            runner_name="sanity",
+            handler_name="multi_operations",
+            src=mo_src,
+            fork_name="phase0",
+            preset_name="minimal",
+            bls_active=True,
+        ):
+            if case.case_name == "full_house_block":
+                yield case
+
+    with tempfile.TemporaryDirectory() as out:
+        provider = TestProvider(prepare=lambda: None, make_cases=cases)
+        run_generator("sanity", [provider], args=["-o", out])
+        d = (
+            pathlib.Path(out)
+            / "minimal/phase0/sanity/multi_operations/pyspec_tests/full_house_block"
+        )
+        got = {
+            p.name: hashlib.sha256(p.read_bytes()).hexdigest()
+            for p in sorted(d.iterdir())
+        }
+    assert got == FULL_HOUSE_BLOCK_FILES

@@ -235,3 +235,111 @@ class TestCustodyEpochSteps:
         spec.process_custody_final_updates(state)
         yield "post", state
         assert len(state.exposed_derived_secrets[loc]) == 0
+
+
+def _signed_early_reveal(spec, state, revealed_index, masker_index, epoch):
+    """An EarlyDerivedSecretReveal whose aggregate [epoch, mask] signature
+    verifies: the revealed validator signs the epoch (the derived secret),
+    the masker signs the mask."""
+    domain = spec.get_domain(state, spec.DOMAIN_RANDAO, epoch)
+    mask = spec.Bytes32(b"\x77" * 32)
+    sig_secret = spec.bls.Sign(
+        privkeys[revealed_index],
+        spec.compute_signing_root(spec.Epoch(epoch), domain),
+    )
+    sig_mask = spec.bls.Sign(
+        privkeys[masker_index], spec.compute_signing_root(mask, domain)
+    )
+    return spec.EarlyDerivedSecretReveal(
+        revealed_index=revealed_index,
+        epoch=epoch,
+        reveal=spec.bls.Aggregate([sig_secret, sig_mask]),
+        masker_index=masker_index,
+        mask=mask,
+    )
+
+
+class TestEarlyDerivedSecretReveal:
+    """process_early_derived_secret_reveal: the two penalty regimes and
+    the replay guard (ref custody_game/block_processing/
+    test_process_early_derived_secret_reveal.py scenarios)."""
+
+    @with_phases([CUSTODY_GAME])
+    @spec_state_test
+    @always_bls
+    def test_near_future_reveal_minor_penalty(self, spec, state):
+        """A reveal less than CUSTODY_PERIOD_TO_RANDAO_PADDING ahead is
+        premature gossip, not a custody break: balance dent + exposure
+        record, no slashing."""
+        epoch = spec.get_current_epoch(state) + spec.RANDAO_PENALTY_EPOCHS
+        reveal = _signed_early_reveal(spec, state, 1, 2, epoch)
+        pre_balance = int(state.balances[1])
+
+        yield "pre", state
+        yield "early_derived_secret_reveal", reveal
+        spec.process_early_derived_secret_reveal(state, reveal)
+        yield "post", state
+
+        assert not state.validators[1].slashed
+        assert int(state.balances[1]) < pre_balance
+        location = int(epoch) % int(spec.EARLY_DERIVED_SECRET_PENALTY_MAX_FUTURE_EPOCHS)
+        assert 1 in [int(i) for i in state.exposed_derived_secrets[location]]
+
+    @with_phases([CUSTODY_GAME])
+    @spec_state_test
+    @always_bls
+    def test_far_future_reveal_slashes(self, spec, state):
+        """Revealing a key far enough ahead to be a usable custody round
+        key is a full custody break: the revealer is slashed."""
+        epoch = spec.get_current_epoch(state) + spec.CUSTODY_PERIOD_TO_RANDAO_PADDING
+        reveal = _signed_early_reveal(spec, state, 1, 2, epoch)
+
+        yield "pre", state
+        yield "early_derived_secret_reveal", reveal
+        spec.process_early_derived_secret_reveal(state, reveal)
+        yield "post", state
+
+        assert state.validators[1].slashed
+
+    @with_phases([CUSTODY_GAME])
+    @spec_state_test
+    @always_bls
+    def test_double_reveal_rejected(self, spec, state):
+        """The same validator's secret for the same epoch can only be
+        exposed once per penalty window."""
+        epoch = spec.get_current_epoch(state) + spec.RANDAO_PENALTY_EPOCHS
+        reveal = _signed_early_reveal(spec, state, 1, 2, epoch)
+        spec.process_early_derived_secret_reveal(state, reveal)
+        second = _signed_early_reveal(spec, state, 1, 3, epoch)
+        yield "pre", state
+        with pytest.raises(AssertionError):
+            spec.process_early_derived_secret_reveal(state, second)
+        yield "post", None
+
+    @with_phases([CUSTODY_GAME])
+    @spec_state_test
+    def test_reveal_too_soon_rejected(self, spec, state):
+        """An epoch inside the RANDAO_PENALTY_EPOCHS floor is not 'early'
+        — it is ordinary revelation, not processable here."""
+        reveal = spec.EarlyDerivedSecretReveal(
+            revealed_index=1, epoch=spec.get_current_epoch(state),
+            reveal=b"\x00" * 96, masker_index=2, mask=b"\x00" * 32,
+        )
+        yield "pre", state
+        with pytest.raises(AssertionError):
+            spec.process_early_derived_secret_reveal(state, reveal)
+        yield "post", None
+
+    @with_phases([CUSTODY_GAME])
+    @spec_state_test
+    def test_reveal_too_far_future_rejected(self, spec, state):
+        """Beyond the penalty window nothing is provable: reject."""
+        epoch = spec.get_current_epoch(state) + spec.EARLY_DERIVED_SECRET_PENALTY_MAX_FUTURE_EPOCHS
+        reveal = spec.EarlyDerivedSecretReveal(
+            revealed_index=1, epoch=epoch,
+            reveal=b"\x00" * 96, masker_index=2, mask=b"\x00" * 32,
+        )
+        yield "pre", state
+        with pytest.raises(AssertionError):
+            spec.process_early_derived_secret_reveal(state, reveal)
+        yield "post", None

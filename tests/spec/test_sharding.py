@@ -268,3 +268,38 @@ class TestShardWorkEpoch:
         work = state.shard_buffer[buffer_index][start_shard]
         assert work.status.selector in (spec.SHARD_WORK_UNCONFIRMED, spec.SHARD_WORK_PENDING)
         yield "post", state
+
+
+class TestStartShardWalk:
+    """get_start_shard across slot distances (scenario parity: ref
+    sharding/unittests/test_get_start_shard.py — the start-shard walk
+    must be self-consistent in both directions)."""
+
+    @with_phases([SHARDING])
+    @spec_state_test
+    def test_get_start_shard_next_slot(self, spec, state):
+        # one slot ahead of current: start shard advances by the current
+        # slot's committee count (mod active shards)
+        current = state.slot
+        shards = int(spec.get_active_shard_count(state, spec.get_current_epoch(state)))
+        expected = (
+            int(spec.get_start_shard(state, current))
+            + int(spec.get_committee_count_per_slot(state, spec.compute_epoch_at_slot(current)))
+        ) % shards
+        assert int(spec.get_start_shard(state, current + 1)) == expected
+        yield "post", state
+
+    @with_phases([SHARDING])
+    @spec_state_test
+    def test_get_start_shard_previous_slot(self, spec, state):
+        from consensus_specs_tpu.test_framework.state import next_slots
+
+        next_slots(spec, state, 3)
+        current = state.slot
+        shards = int(spec.get_active_shard_count(state, spec.get_current_epoch(state)))
+        expected = (
+            int(spec.get_start_shard(state, current))
+            - int(spec.get_committee_count_per_slot(state, spec.compute_epoch_at_slot(current - 1)))
+        ) % shards
+        assert int(spec.get_start_shard(state, current - 1)) == expected
+        yield "post", state

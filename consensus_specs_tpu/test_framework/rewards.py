@@ -244,7 +244,9 @@ def run_test_partial_participation(spec, state, fraction):
     yield from run_deltas(spec, state)
 
 
-def degrade_vote_correctness(spec, state, rng, wrong_target_prob=0.0, wrong_head_prob=0.0):
+def degrade_vote_correctness(
+    spec, state, rng, wrong_target_prob=0.0, wrong_head_prob=0.0, target_also_spoils_head=False
+):
     """Make some previous-epoch votes INCORRECT after the fact.
 
     Phase0 stores PendingAttestations (no signatures), so vote quality is
@@ -269,6 +271,8 @@ def degrade_vote_correctness(spec, state, rng, wrong_target_prob=0.0, wrong_head
         for pending in state.previous_epoch_attestations:
             if rng.random() < wrong_target_prob:
                 pending.data.target.root = b"\x66" * 32
+                if target_also_spoils_head:
+                    pending.data.beacon_block_root = b"\x67" * 32
             elif rng.random() < wrong_head_prob:
                 pending.data.beacon_block_root = b"\x67" * 32
 
@@ -309,6 +313,124 @@ def run_test_stretched_inclusion_delay(spec, state, rng=None):
     yield from run_deltas(spec, state)
 
 
+def run_test_full_incorrect_head(spec, state, rng=None):
+    """Every vote has correct source+target but a wrong head."""
+    rng = rng or Random(7703)
+    prepare_state_with_attestations(spec, state)
+    degrade_vote_correctness(spec, state, rng, wrong_head_prob=1.0)
+    yield from run_deltas(spec, state)
+
+
+def run_test_half_incorrect_target_incorrect_head(spec, state, rng=None):
+    """Half the votes spoil BOTH the target and head fields (distinct
+    input shape from target-only corruption even though the delta effect
+    coincides: head matching is scoped to the matching-target set)."""
+    rng = rng or Random(7704)
+    prepare_state_with_attestations(spec, state)
+    degrade_vote_correctness(
+        spec, state, rng, wrong_target_prob=0.5, target_also_spoils_head=True
+    )
+    yield from run_deltas(spec, state)
+
+
+def run_test_one_attestation_one_correct(spec, state):
+    """Every vote made it on chain but only one aggregate kept a correct
+    target: its participants alone earn target/head credit."""
+    prepare_state_with_attestations(spec, state)
+    if is_post_altair(spec):
+        source_only = spec.ParticipationFlags(2 ** int(spec.TIMELY_SOURCE_FLAG_INDEX))
+        first_slot = spec.compute_start_slot_at_epoch(spec.get_previous_epoch(state))
+        keepers = {int(i) for i in spec.get_beacon_committee(state, first_slot, 0)}
+        for index in range(len(state.validators)):
+            if index not in keepers and int(state.previous_epoch_participation[index]):
+                state.previous_epoch_participation[index] = source_only
+    else:
+        for pending in list(state.previous_epoch_attestations)[1:]:
+            pending.data.target.root = b"\x66" * 32
+    yield from run_deltas(spec, state)
+
+
+def _drop_votes_of(spec, state, indices):
+    """Erase the given validators' previous-epoch votes in place (clear
+    their aggregation bits per committee / zero their flags)."""
+    drop = {int(i) for i in indices}
+    if is_post_altair(spec):
+        for index in drop:
+            state.previous_epoch_participation[index] = spec.ParticipationFlags(0)
+    else:
+        for pending in state.previous_epoch_attestations:
+            committee = spec.get_beacon_committee(
+                state, pending.data.slot, pending.data.index
+            )
+            for pos, validator_index in enumerate(committee):
+                if int(validator_index) in drop:
+                    pending.aggregation_bits[pos] = False
+
+
+def run_test_some_very_low_effective_balances_that_did_not_attest(spec, state):
+    prepare_state_with_attestations(spec, state)
+    lows = range(3)
+    _drop_votes_of(spec, state, lows)
+    for i in lows:
+        state.validators[i].effective_balance = spec.EFFECTIVE_BALANCE_INCREMENT
+    yield from run_deltas(spec, state)
+
+
+def run_test_all_balances_too_low_for_reward(spec, state):
+    """Every effective balance rounds to a zero base reward (the
+    registry floor in get_total_active_balance keeps the denominator at
+    one full increment, so 10 gwei of stake earns nothing)."""
+    prepare_state_with_attestations(spec, state)
+    for v in state.validators:
+        v.effective_balance = 10
+    yield from run_deltas(spec, state)
+
+
+def run_test_full_delay_one_slot(spec, state):
+    """All votes correct, all included one slot late (phase0
+    inclusion-delay component halves; altair has no delay deltas)."""
+    prepare_state_with_attestations(spec, state)
+    if not is_post_altair(spec):
+        for pending in state.previous_epoch_attestations:
+            pending.inclusion_delay = int(pending.inclusion_delay) + 1
+    yield from run_deltas(spec, state)
+
+
+def run_test_full_delay_max_slots(spec, state):
+    prepare_state_with_attestations(spec, state)
+    if not is_post_altair(spec):
+        for pending in state.previous_epoch_attestations:
+            pending.inclusion_delay = int(spec.SLOTS_PER_EPOCH)
+    yield from run_deltas(spec, state)
+
+
+def run_test_proposer_not_in_attestations(spec, state):
+    """The proposer who included the first aggregate did not itself
+    attest: it keeps its inclusion micro-rewards while paying the
+    non-participation penalties (phase0-specific shape)."""
+    prepare_state_with_attestations(spec, state)
+    if not is_post_altair(spec):
+        proposer = int(state.previous_epoch_attestations[0].proposer_index)
+        _drop_votes_of(spec, state, [proposer])
+    yield from run_deltas(spec, state)
+
+
+def run_test_duplicate_attestations_at_later_slots(spec, state):
+    """Each aggregate also appears a second time with a larger inclusion
+    delay; the delay component must credit the EARLIEST inclusion only
+    (phase0-specific shape)."""
+    prepare_state_with_attestations(spec, state)
+    if not is_post_altair(spec):
+        late = []
+        for pending in state.previous_epoch_attestations:
+            dup = pending.copy()
+            dup.inclusion_delay = int(dup.inclusion_delay) + 2
+            late.append(dup)
+        for dup in late:
+            state.previous_epoch_attestations.append(dup)
+    yield from run_deltas(spec, state)
+
+
 def run_test_with_not_yet_activated_validators(spec, state, rng=None):
     rng = rng or Random(5555)
     set_some_activations_far_future(spec, state, rng)
@@ -340,10 +462,11 @@ def run_test_some_very_low_effective_balances_that_attested(spec, state):
     yield from run_deltas(spec, state)
 
 
-def transition_to_leaking(spec, state):
+def transition_to_leaking(spec, state, extra_epochs=0):
     """Advance past MIN_EPOCHS_TO_INACTIVITY_PENALTY without finality so
-    is_in_inactivity_leak flips on."""
-    target = spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY + 2
+    is_in_inactivity_leak flips on; extra_epochs deepens the leak (the
+    inactivity-score / finality-delay term grows with its duration)."""
+    target = spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY + 2 + extra_epochs
     for _ in range(int(target) + 1):
         next_epoch(spec, state)
     assert spec.is_in_inactivity_leak(state)
@@ -369,6 +492,16 @@ def run_test_empty_leak(spec, state):
     _seed_inactivity_scores(spec, state, Random(78))
     next_epoch(spec, state)
     yield from run_deltas(spec, state)
+
+
+def run_with_leak(spec, state, scenario_fn, extra_epochs=0, seed=79, **kw):
+    """Compose any scenario builder with a leaking pre-state: enter the
+    leak first (epoch advancement precedes the scenario's own registry
+    mutations and attestation prep, preserving each builder's ordering
+    contract), seed inactivity scores, then delegate."""
+    transition_to_leaking(spec, state, extra_epochs=extra_epochs)
+    _seed_inactivity_scores(spec, state, Random(seed))
+    yield from scenario_fn(spec, state, **kw)
 
 
 def run_test_random_leak(spec, state, rng=None):

@@ -75,5 +75,61 @@ def test_incorrect_head_only(spec, state):
 
 @with_all_phases
 @spec_state_test
+def test_full_incorrect_head(spec, state):
+    yield from rewards.run_test_full_incorrect_head(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_half_incorrect_target_incorrect_head(spec, state):
+    yield from rewards.run_test_half_incorrect_target_incorrect_head(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_one_attestation_one_correct(spec, state):
+    yield from rewards.run_test_one_attestation_one_correct(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_some_very_low_effective_balances_that_did_not_attest(spec, state):
+    yield from rewards.run_test_some_very_low_effective_balances_that_did_not_attest(
+        spec, state
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_all_balances_too_low_for_reward(spec, state):
+    yield from rewards.run_test_all_balances_too_low_for_reward(spec, state)
+
+
+@with_all_phases
+@spec_state_test
 def test_stretched_inclusion_delay(spec, state):
     yield from rewards.run_test_stretched_inclusion_delay(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_full_delay_one_slot(spec, state):
+    yield from rewards.run_test_full_delay_one_slot(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_full_delay_max_slots(spec, state):
+    yield from rewards.run_test_full_delay_max_slots(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_not_in_attestations(spec, state):
+    yield from rewards.run_test_proposer_not_in_attestations(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_duplicate_attestations_at_later_slots(spec, state):
+    yield from rewards.run_test_duplicate_attestations_at_later_slots(spec, state)

@@ -4,6 +4,8 @@ from consensus_specs_tpu.test_framework.context import (
     spec_state_test,
     with_all_phases,
 )
+from random import Random
+
 from consensus_specs_tpu.test_framework import rewards
 
 
@@ -23,3 +25,133 @@ def test_empty_leak(spec, state):
 @spec_state_test
 def test_random_leak(spec, state):
     yield from rewards.run_test_random_leak(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_half_full_leak(spec, state):
+    yield from rewards.run_with_leak(
+        spec, state, rewards.run_test_partial_participation, fraction=0.5
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_quarter_full_leak(spec, state):
+    yield from rewards.run_with_leak(
+        spec, state, rewards.run_test_partial_participation, fraction=0.25
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_full_but_partial_participation_leak(spec, state):
+    yield from rewards.run_with_leak(
+        spec, state, rewards.run_test_full_but_partial_participation
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_one_attestation_one_correct_leak(spec, state):
+    yield from rewards.run_with_leak(
+        spec, state, rewards.run_test_one_attestation_one_correct
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_with_not_yet_activated_validators_leak(spec, state):
+    yield from rewards.run_with_leak(
+        spec, state, rewards.run_test_with_not_yet_activated_validators
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_with_exited_validators_leak(spec, state):
+    yield from rewards.run_with_leak(
+        spec, state, rewards.run_test_with_exited_validators
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_with_slashed_validators_leak(spec, state):
+    yield from rewards.run_with_leak(
+        spec, state, rewards.run_test_with_slashed_validators
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_some_very_low_effective_balances_that_attested_leak(spec, state):
+    yield from rewards.run_with_leak(
+        spec, state, rewards.run_test_some_very_low_effective_balances_that_attested
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_some_very_low_effective_balances_that_did_not_attest_leak(spec, state):
+    yield from rewards.run_with_leak(
+        spec,
+        state,
+        rewards.run_test_some_very_low_effective_balances_that_did_not_attest,
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_incorrect_target_leak(spec, state):
+    yield from rewards.run_with_leak(
+        spec, state, rewards.run_test_correct_source_incorrect_target
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_incorrect_head_leak(spec, state):
+    yield from rewards.run_with_leak(spec, state, rewards.run_test_incorrect_head_only)
+
+
+@with_all_phases
+@spec_state_test
+def test_full_incorrect_head_leak(spec, state):
+    yield from rewards.run_with_leak(spec, state, rewards.run_test_full_incorrect_head)
+
+
+@with_all_phases
+@spec_state_test
+def test_half_incorrect_target_incorrect_head_leak(spec, state):
+    yield from rewards.run_with_leak(
+        spec, state, rewards.run_test_half_incorrect_target_incorrect_head
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_random_seven_epoch_leak(spec, state):
+    # partial participation so the depth-scaled inactivity term is live
+    # for the non-participants (full participation would zero it out)
+    yield from rewards.run_with_leak(
+        spec,
+        state,
+        rewards.run_test_full_but_partial_participation,
+        extra_epochs=3,
+        seed=91,
+        rng=Random(9107),
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_random_ten_epoch_leak(spec, state):
+    yield from rewards.run_with_leak(
+        spec,
+        state,
+        rewards.run_test_full_but_partial_participation,
+        extra_epochs=6,
+        seed=92,
+        rng=Random(9110),
+    )

@@ -121,14 +121,23 @@ def run_fork_transition(
     fork_epoch,
     blocks_before=True,
     blocks_after=2,
+    attested_before=False,
+    attested_after=False,
+    participation_fn=None,
+    skip_last_pre_fork_block=False,
 ):
     """Drive a chain of blocks across the fork boundary at fork_epoch.
 
-    The last pre-fork slot gets a pre-fork block (when blocks_before),
-    epoch processing rolls into fork_epoch, the state is upgraded, and
-    the first post-fork block lands at the fork-epoch start slot —
-    matching the reference's transition semantics
-    (test/altair/transition/test_transition.py)."""
+    The last pre-fork slot gets a pre-fork block (when blocks_before,
+    unless skip_last_pre_fork_block leaves that slot empty), epoch
+    processing rolls into fork_epoch, the state is upgraded, and the
+    first post-fork block lands at the fork-epoch start slot — matching
+    the reference's transition semantics
+    (test/altair/transition/test_transition.py). attested_before/_after
+    fill each side's blocks with the usual cur+prev epoch attestation
+    load (optionally thinned by participation_fn), so finality can keep
+    advancing across the boundary."""
+    from .attestations import state_transition_with_full_block
     yield "post_fork", "meta", spec_post.fork
     yield "fork_epoch", "meta", int(fork_epoch)
     yield "pre", state
@@ -138,9 +147,17 @@ def run_fork_transition(
     assert state.slot < fork_slot
 
     if blocks_before:
-        while int(state.slot) + 1 < fork_slot:
-            block = build_empty_block_for_next_slot(spec_pre, state)
-            blocks.append(state_transition_and_sign_block(spec_pre, state, block))
+        last_gap = 2 if skip_last_pre_fork_block else 1
+        while int(state.slot) + last_gap < fork_slot:
+            if attested_before:
+                blocks.append(
+                    state_transition_with_full_block(
+                        spec_pre, state, True, True, participation_fn
+                    )
+                )
+            else:
+                block = build_empty_block_for_next_slot(spec_pre, state)
+                blocks.append(state_transition_and_sign_block(spec_pre, state, block))
     if blocks:
         yield "fork_block", "meta", len(blocks) - 1  # index of last pre-fork block
 
@@ -160,8 +177,15 @@ def run_fork_transition(
     block.state_root = spec_post.hash_tree_root(state)
     blocks.append(sign_block(spec_post, state, block))
     for _ in range(int(blocks_after)):
-        block = build_empty_block_for_next_slot(spec_post, state)
-        blocks.append(state_transition_and_sign_block(spec_post, state, block))
+        if attested_after:
+            blocks.append(
+                state_transition_with_full_block(
+                    spec_post, state, True, True, participation_fn
+                )
+            )
+        else:
+            block = build_empty_block_for_next_slot(spec_post, state)
+            blocks.append(state_transition_and_sign_block(spec_post, state, block))
 
     yield "blocks", blocks
     yield "post", state

@@ -69,6 +69,131 @@ def _make_tests(pre, post):
 ) = _make_tests(BELLATRIX, CAPELLA)
 
 
+def _fraction_participation(fraction):
+    """Keep the lowest-indexed ~fraction of every committee attesting."""
+
+    def fn(epoch, slot, index, comm):
+        comm = sorted(comm)
+        return set(comm[: max(int(len(comm) * fraction), 1)])
+
+    return fn
+
+
+def _make_attested_tests(pre, post):
+    """Scenario shapes that drive ATTESTED chains across the boundary
+    (ref test_transition.py's finality/participation family)."""
+    made = {}
+
+    def register(name, fn):
+        fn.__name__ = f"test_transition_to_{post}_{name}"
+        made[fn.__name__] = fn
+
+    def shape_test(name):
+        def deco(body):
+            @with_phases([pre], other_phases=[post])
+            @spec_test
+            @with_custom_state(default_balances, default_activation_threshold)
+            def test_fn(spec, state, phases):
+                yield from body(spec, phases[post], state)
+
+            register(name, test_fn)
+            return body
+
+        return deco
+
+    def run_capturing(spec, spec_post, state, **kw):
+        """Run the transition, re-yield every part, return the post state
+        (the caller's `state` stops at the pre-upgrade object)."""
+        post = None
+        for part in run_fork_transition(spec, spec_post, state, **kw):
+            if part[0] == "post":
+                post = part[1]
+            yield part
+        assert post is not None
+        return post
+
+    @shape_test("missing_last_pre_fork_block")
+    def _missing_last(spec, spec_post, state):
+        yield from run_fork_transition(
+            spec, spec_post, state, fork_epoch=2, skip_last_pre_fork_block=True
+        )
+
+    @shape_test("with_finality")
+    def _with_finality(spec, spec_post, state):
+        post_state = yield from run_capturing(
+            spec,
+            spec_post,
+            state,
+            fork_epoch=3,
+            attested_before=True,
+            attested_after=True,
+            blocks_after=2 * int(spec.SLOTS_PER_EPOCH),
+        )
+        # full participation through the fork: finality keeps marching —
+        # the finalized epoch must have crossed into the post-fork world
+        assert int(post_state.finalized_checkpoint.epoch) >= 3
+        assert int(post_state.current_justified_checkpoint.epoch) >= 4
+
+    @shape_test("random_three_quarters_participation")
+    def _three_quarters(spec, spec_post, state):
+        post_state = yield from run_capturing(
+            spec,
+            spec_post,
+            state,
+            fork_epoch=3,
+            attested_before=True,
+            attested_after=True,
+            participation_fn=_fraction_participation(0.75),
+            blocks_after=2 * int(spec.SLOTS_PER_EPOCH),
+        )
+        # 3/4 > 2/3: justification keeps advancing through the fork (the
+        # finalization lag differs per fork family — altair's flag-based
+        # accounting finalizes one epoch later than phase0's here)
+        assert int(post_state.finalized_checkpoint.epoch) >= 1
+        assert int(post_state.current_justified_checkpoint.epoch) >= 3
+
+    @shape_test("random_half_participation")
+    def _half(spec, spec_post, state):
+        post_state = yield from run_capturing(
+            spec,
+            spec_post,
+            state,
+            fork_epoch=3,
+            attested_before=True,
+            attested_after=True,
+            participation_fn=_fraction_participation(0.5),
+            blocks_after=2 * int(spec.SLOTS_PER_EPOCH),
+        )
+        # 1/2 < 2/3: no target supermajority on either side of the fork
+        assert int(post_state.finalized_checkpoint.epoch) == 0
+
+    @shape_test("no_attestations_until_after_fork")
+    def _silent_then_live(spec, spec_post, state):
+        post_state = yield from run_capturing(
+            spec,
+            spec_post,
+            state,
+            fork_epoch=2,
+            attested_before=False,
+            attested_after=True,
+            blocks_after=3 * int(spec.SLOTS_PER_EPOCH),
+        )
+        # a dead pre-fork network comes alive after the upgrade:
+        # justification restarts from the post-fork epochs
+        assert int(post_state.current_justified_checkpoint.epoch) >= 2
+
+    return made
+
+
+for _name, _fn in {
+    **_make_attested_tests(PHASE0, ALTAIR),
+    **_make_attested_tests(ALTAIR, BELLATRIX),
+    **_make_attested_tests(BELLATRIX, CAPELLA),
+}.items():
+    globals()[_name] = _fn
+del _name, _fn
+
+
 # -- operations at the fork boundary (ref test_transition.py's
 # operation-timing scenarios: each family crossing in both directions) --
 

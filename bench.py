@@ -24,13 +24,19 @@ Extra keys:
   (deferred batched BLS + calibrated device hasher) vs the pure-host
   path, as a speedup.
 
-Budget discipline (the round-4 lesson): every section runs under an
-internal wall-clock deadline (BENCH_DEADLINE_S, default 1260 s) with a
-per-section cost gate, and the ONE JSON line is emitted by an atexit +
-SIGTERM/SIGALRM handler — a timeout can zero out a section, never the
-round. Section wall-clocks are reported in `section_seconds`.
-
-Prints ONE JSON line (the last line of stdout).
+Budget discipline (the round-4 AND round-5 lesson): the parent process
+is a pure-stdlib SUPERVISOR that never imports jax and never opens the
+device — every section runs in its own killable child process
+(`bench.py --section NAME`) under a per-section cap within the global
+deadline (BENCH_DEADLINE_S, default 1380 s). Round 5 calibration proved
+why: a wedged tunnel blocks `make_c_api_client` while HOLDING THE GIL,
+so no in-process signal handler or watchdog thread can ever run — the
+only deadline that works is one enforced from a process that stays out
+of jax entirely. Children get SIGTERM (their handler dumps whatever
+they measured) then SIGKILL; the pallas probe runs LAST because killing
+a Mosaic compile mid-flight can wedge the tunnel server for every
+subsequent connection. The parent always emits the ONE JSON line (the
+last line of stdout), whatever happens.
 """
 from __future__ import annotations
 
@@ -40,12 +46,12 @@ import json
 import os
 import shutil
 import signal
+import subprocess
 import sys
 import tempfile
-import threading
 import time
 
-import numpy as np
+import numpy as np  # no jax: safe in the supervisor
 
 faulthandler.enable()
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -69,16 +75,37 @@ def _note(msg: str) -> None:
     print(f"bench[{time.monotonic() - _T0:7.1f}s]: {msg}", file=sys.stderr, flush=True)
 
 
+_IS_CHILD = False  # set in _child_main; children must emit private keys
+
+
 def _emit() -> None:
     global _EMITTED
     if _EMITTED:
         return
     _EMITTED = True
+    if not _IS_CHILD:
+        # strip bookkeeping keys + run the pallas/host root cross-check on
+        # EVERY parent exit path (normal, SIGTERM/SIGALRM, atexit) — a
+        # pallas kernel that ran but produced a wrong root is a
+        # correctness regression, not an unavailability
+        pallas_root = RESULTS.pop("_pallas_root_hex", None)
+        hash_root = RESULTS.pop("_hash_root_hex", None)
+        if pallas_root is not None and hash_root is not None and pallas_root != hash_root:
+            RESULTS["hash_pallas_status"] = "mismatch"
+            RESULTS["hash_pallas_mibs"] = None
     print(json.dumps(RESULTS), flush=True)
+
+
+_CURRENT_CHILD: list = []  # pid of the running section child, if any
 
 
 def _on_deadline_signal(signum, frame):
     _note(f"signal {signum} — emitting partial results and exiting")
+    for pid in _CURRENT_CHILD:
+        try:
+            os.killpg(pid, signal.SIGKILL)
+        except OSError:
+            pass
     _emit()
     sys.stdout.flush()
     os._exit(0)
@@ -88,27 +115,6 @@ atexit.register(_emit)
 signal.signal(signal.SIGTERM, _on_deadline_signal)
 signal.signal(signal.SIGALRM, _on_deadline_signal)
 signal.alarm(max(1, int(DEADLINE_S)))
-
-
-def _watchdog() -> None:
-    """Deadline enforcement that signals cannot provide: a handler only
-    runs between Python bytecodes, and a tunnel RPC (device dispatch or
-    server-side compile) can block the main thread for tens of minutes —
-    observed in round 4 (rc=124, no JSON) and round 5 calibration. A
-    daemon thread keeps running while the main thread is wedged in C,
-    emits whatever metrics exist, and hard-exits."""
-    while True:
-        remaining = DEADLINE_S - (time.monotonic() - _T0)
-        if remaining <= 0:
-            break
-        time.sleep(min(remaining, 5.0))
-    _note("watchdog: deadline reached — emitting partial results")
-    _emit()
-    sys.stdout.flush()
-    os._exit(0)
-
-
-threading.Thread(target=_watchdog, daemon=True, name="bench-deadline").start()
 
 
 def _maybe_enable_compile_cache() -> None:
@@ -135,28 +141,62 @@ def _remaining() -> float:
     return DEADLINE_S - (time.monotonic() - _T0)
 
 
-def _run_section(name: str, est_s: float, fn) -> None:
-    """Run one bench section under the budget: skip when the remaining
-    wall-clock can't cover the estimate, absorb failures, record timing."""
-    if _remaining() < est_s:
-        _note(f"SKIP {name}: remaining {_remaining():.0f}s < estimate {est_s:.0f}s")
-        RESULTS.setdefault("skipped_sections", []).append(name)
-        return
-    _note(f"{name} ...")
+def _run_child(name: str, cap_s: float) -> None:
+    """Run one section in a killable child process: SIGTERM at the cap
+    (the child's handler dumps whatever it measured), SIGKILL as the
+    backstop, merge the child's last-line JSON into RESULTS."""
+    _note(f"{name} ... (child, cap {cap_s:.0f}s)")
     t0 = time.monotonic()
-    before = set(RESULTS)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--section", name],
+        stdout=subprocess.PIPE,
+        text=True,
+        start_new_session=True,
+    )
+    _CURRENT_CHILD.append(proc.pid)
+    out = ""
+    timed_out = False
     try:
-        fn()
-    except Exception as e:  # a broken section must not starve the rest
-        _note(f"{name} FAILED: {e!r}")
-        RESULTS.setdefault("section_errors", {})[name] = repr(e)
+        out, _ = proc.communicate(timeout=cap_s)
+    except subprocess.TimeoutExpired:
+        timed_out = True
+        try:
+            os.killpg(proc.pid, signal.SIGTERM)
+        except OSError:
+            pass
+        try:
+            out, _ = proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except OSError:
+                pass
+            out, _ = proc.communicate()
     finally:
-        dt = time.monotonic() - t0
-        RESULTS["section_seconds"][name] = round(dt, 1)
-        # forensic stderr record: a later hard-kill must not erase what
-        # this section measured
-        new_keys = {k: RESULTS[k] for k in RESULTS if k not in before and k != "section_seconds"}
-        _note(f"{name} done in {dt:.1f}s {json.dumps(new_keys) if new_keys else ''}")
+        _CURRENT_CHILD.remove(proc.pid)
+    dt = time.monotonic() - t0
+
+    merged: dict = {}
+    for line in reversed((out or "").strip().splitlines()):
+        try:
+            merged = json.loads(line)
+            break
+        except (json.JSONDecodeError, ValueError):
+            continue
+    for k, v in merged.items():
+        if k == "section_seconds":
+            RESULTS["section_seconds"].update(v)
+        elif k == "section_errors":
+            RESULTS.setdefault("section_errors", {}).update(v)
+        elif v is not None or k not in RESULTS:
+            RESULTS[k] = v
+    RESULTS["section_seconds"][name] = round(dt, 1)
+    if timed_out:
+        RESULTS.setdefault("section_errors", {})[name] = f"timeout>{cap_s:.0f}s"
+    elif proc.returncode != 0:
+        RESULTS.setdefault("section_errors", {}).setdefault(name, f"rc={proc.returncode}")
+    new_keys = {k: v for k, v in merged.items() if k not in ("section_seconds", "section_errors") and v is not None}
+    _note(f"{name} child done in {dt:.1f}s rc={proc.returncode} {json.dumps(new_keys) if new_keys else ''}")
 
 
 # ---------------------------------------------------------------------------
@@ -251,9 +291,11 @@ def bench_pallas_probe(timeout_s: int = 60) -> None:
     axon TPU tunnel blocks in backend_compile rather than erroring — it
     has failed identically every round; see README), so the probe must
     not share a process with the rest of the bench and is capped at 60 s.
-    Runs before the parent opens the device. The child re-derives the
-    same rng(42) chunk tree as bench_hash so the parent can cross-check
-    root_hex against the host root."""
+    The section child hosting this function never opens the device
+    itself (HOST_ONLY_SECTIONS) — only the disposable grandchild does.
+    The grandchild re-derives the same rng(42) chunk tree as bench_hash
+    so the supervisor can cross-check root_hex against the host root
+    (in _emit). Off by default: see main()."""
     import subprocess
 
     child = (
@@ -288,16 +330,24 @@ def bench_pallas_probe(timeout_s: int = 60) -> None:
         text=True,
         start_new_session=True,
     )
+    # register so the SIGTERM/SIGALRM handler reaps the grandchild too:
+    # an orphaned Mosaic compile is exactly the tunnel-wedging hazard
+    # this probe is quarantined for
+    _CURRENT_CHILD.append(proc.pid)
     try:
-        out, _ = proc.communicate(timeout=timeout_s)
-    except subprocess.TimeoutExpired:
+        try:
+            out, _ = proc.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            _PALLAS.update(status="timeout")
+            out = None
+    finally:
         try:
             os.killpg(proc.pid, signal.SIGKILL)
         except OSError:
             pass
         proc.wait()
-        _PALLAS.update(status="timeout")
-    else:
+        _CURRENT_CHILD.remove(proc.pid)
+    if out is not None:
         if proc.returncode != 0:
             _PALLAS.update(status="error")
         else:
@@ -311,6 +361,7 @@ def bench_pallas_probe(timeout_s: int = 60) -> None:
         round(_PALLAS["mibs"], 2) if _PALLAS["mibs"] else None
     )
     RESULTS["hash_pallas_status"] = _PALLAS["status"]
+    RESULTS["_pallas_root_hex"] = _PALLAS["root_hex"]
 
 
 def bench_hash() -> None:
@@ -359,10 +410,8 @@ def bench_hash() -> None:
     hashlib_mbs = mib / (time.perf_counter() - t0)
     if nodes != root_host:
         raise AssertionError("hashlib reference root mismatch")
-    # a pallas kernel that RAN but produced a wrong root is a correctness
-    # regression, not an unavailability — fail loudly
-    if _PALLAS["root_hex"] is not None and _PALLAS["root_hex"] != root_host.hex():
-        raise AssertionError("pallas merkle root mismatch")
+    # for the parent's cross-check against the pallas child's root
+    RESULTS["_hash_root_hex"] = root_host.hex()
 
     # Spec-path: same data through ssz merkleize with the device backend on
     from consensus_specs_tpu.ops import sha256 as dev
@@ -707,33 +756,96 @@ def bench_host_fallback() -> None:
     RESULTS["bls_host_oracle_cold_rate"] = round(host_rate, 3)
 
 
+SECTIONS = {
+    "bls": bench_bls,
+    "block_mainnet": bench_block_mainnet,
+    "generation": bench_generation,
+    "sync_aggregate": bench_sync_aggregate_mainnet,
+    "hash": bench_hash,
+    "incremental_reroot": bench_incremental_reroot,
+    "pallas_probe": bench_pallas_probe,
+    "host_fallback": bench_host_fallback,
+}
+# sections that must not pay tunnel init in their own process: the two
+# host-side sections, plus the pallas probe — its DISPOSABLE GRANDCHILD
+# is the only process allowed to touch the device (opening the backend
+# in the section child first would block uninterruptibly if the tunnel
+# wedged mid-run, and the grandchild inherits no per-process cache
+# config anyway)
+HOST_ONLY_SECTIONS = {"incremental_reroot", "host_fallback", "pallas_probe"}
+
+
+def _child_main(name: str) -> None:
+    """One section, in-process (we ARE the killable child)."""
+    global _IS_CHILD
+    _IS_CHILD = True
+    fn = SECTIONS[name]
+    if name not in HOST_ONLY_SECTIONS:
+        _maybe_enable_compile_cache()
+    try:
+        fn()
+    except Exception as e:
+        _note(f"{name} FAILED: {e!r}")
+        RESULTS.setdefault("section_errors", {})[name] = repr(e)
+    _emit()
+
+
 def main() -> None:
-    _note(f"deadline {DEADLINE_S:.0f}s")
+    if "--section" in sys.argv:
+        _child_main(sys.argv[sys.argv.index("--section") + 1])
+        return
+
+    _note(
+        f"supervisor: deadline {DEADLINE_S:.0f}s; every section in a "
+        "killable child — this process never opens the device"
+    )
+    reserve = 15.0
+
+    def run(name: str, est_s, cap_s: float) -> None:
+        if isinstance(est_s, tuple):  # (warm, cold) — the bls child warms
+            est_s = est_s[0] if _cache_is_warm() else est_s[1]  # the cache for everyone after
+        rem = _remaining() - reserve
+        if rem < est_s:
+            _note(f"SKIP {name}: remaining {rem:.0f}s < estimate {est_s:.0f}s")
+            RESULTS.setdefault("skipped_sections", []).append(name)
+            return
+        _run_child(name, min(cap_s, rem))
+
     # priority order: required scoreboard keys first (bls headline, then
-    # BASELINE configs #3 / #5 / #4), historical continuity keys after.
-    # Estimates from the round-5 calibration run: the BLS cold-graph
-    # compile dominates (~700 s cold, seconds when the persistent cache
-    # hits); all later sections reuse its shapes (ops/bls_jax canonical
-    # buckets), so their cost is dispatches + host passes.
+    # BASELINE configs #3 / #5 / #4), continuity keys after, the pallas
+    # probe LAST — killing its Mosaic compile can wedge the tunnel server
+    # for every later connection (observed in round-5 calibration).
+    # Estimates: the BLS cold-graph compile dominates (~700 s cold,
+    # seconds when the persistent .jax_cache hits); later sections reuse
+    # its canonical bucket shapes, so their cost is dispatches + host
+    # passes + ~20 s child startup each.
     if not _device_alive():
         # the tunnel is wedged (hung server compile / dead worker): no
-        # device section can run AND no device call can be interrupted —
-        # record the host-side truth and say so honestly
+        # device section can run — record the host-side truth and say so
         _note("device UNREACHABLE — host-only fallback")
         RESULTS["device_unreachable"] = True
-        _run_section("host_fallback", 240, bench_host_fallback)
-        _run_section("incremental_reroot", 45, bench_incremental_reroot)
-        signal.alarm(0)
-        _emit()
-        return
-    _run_section("pallas_probe", 70, bench_pallas_probe)
-    _maybe_enable_compile_cache()
-    _run_section("bls", 200 if _cache_is_warm() else 780, bench_bls)
-    _run_section("block_mainnet", 120, bench_block_mainnet)
-    _run_section("generation", 180, bench_generation)
-    _run_section("sync_aggregate", 200, bench_sync_aggregate_mainnet)
-    _run_section("hash", 100, bench_hash)
-    _run_section("incremental_reroot", 45, bench_incremental_reroot)
+        run("host_fallback", 60, 300)
+        run("incremental_reroot", 30, 90)
+    else:
+        run("bls", (220, 800), 950)
+        run("block_mainnet", (90, 150), 280)
+        run("generation", (150, 260), 420)
+        run("sync_aggregate", (90, 220), 320)
+        run("hash", (70, 120), 200)
+        run("incremental_reroot", 30, 90)
+        if os.environ.get("BENCH_PALLAS") == "1":
+            run("pallas_probe", 75, 85)
+        else:
+            # round-5 finding: SIGKILLing the probe's Mosaic compile
+            # leaves the TUNNEL SERVER wedged — the next process to call
+            # make_c_api_client blocks forever holding the GIL (observed
+            # twice, 90 s and 27 min). A probe that can kill every
+            # subsequent device connection is not worth a status line;
+            # opt back in with BENCH_PALLAS=1 on a non-tunneled TPU.
+            RESULTS["hash_pallas_status"] = "disabled_tunnel_hazard"
+
+    # (the pallas/host root cross-check + private-key strip live in
+    # _emit so they run on EVERY parent exit path)
     signal.alarm(0)
     _emit()
 

@@ -108,3 +108,42 @@ def test_transition_with_attestations_translation(spec, state, phases):
     altair_spec = phases[ALTAIR]
     _, _, cont = next_epoch_with_attestations(altair_spec, post_state, True, True)
     assert cont.finalized_checkpoint.epoch >= state.finalized_checkpoint.epoch
+
+
+# -- randomized pre-state upgrades (ref: test/altair/fork/test_altair_fork_random.py
+# — the upgrade function must be total over any reachable registry shape) -----
+
+def _install_random_fork_tests():
+    from random import Random
+
+    from consensus_specs_tpu.test_framework.attestations import (
+        prepare_state_with_attestations,
+    )
+    from consensus_specs_tpu.test_framework.random_block_tests import randomize_state
+
+    def make(name, seed, with_attestations=False):
+        @with_phases([PHASE0], other_phases=[ALTAIR])
+        @spec_test
+        @with_custom_state(default_balances, default_activation_threshold)
+        def test_fn(spec, state, phases):
+            rng = Random(seed)
+            # registry randomization FIRST: retroactive exits reshape
+            # historical committees, so the attestation history must be
+            # built against the already-mutated registry
+            randomize_state(spec, state, rng)
+            if with_attestations:
+                # a full previous epoch of votes over the randomized
+                # registry: the upgrade's participation translation runs
+                # over every committee shape
+                prepare_state_with_attestations(spec, state)
+            yield from run_fork_test(phases[ALTAIR], state)
+
+        test_fn.__name__ = name
+        globals()[name] = test_fn
+
+    for i, seed in enumerate((1010, 2020, 3030, 4040)):
+        make(f"test_fork_random_{{i}}", seed)
+    make("test_fork_random_with_attestation_history", 5050, with_attestations=True)
+
+
+_install_random_fork_tests()

@@ -194,6 +194,182 @@ def test_some_slashed_zero_scores_full_participation_leaking(spec, state):
             assert int(state.inactivity_scores[i]) == 0
 
 
+def _run_checked(spec, state):
+    """Run the sub-transition and hold every eligible validator's score to
+    the closed-form update: participants pay a saturating -1, absentees
+    gain the bias, and outside a leak everyone decays by the recovery
+    rate (floored at zero)."""
+    pre_scores = [int(s) for s in state.inactivity_scores]
+    leaking = spec.is_in_inactivity_leak(state)
+    participating = {
+        int(i)
+        for i in spec.get_unslashed_participating_indices(
+            state, spec.TIMELY_TARGET_FLAG_INDEX, spec.get_previous_epoch(state)
+        )
+    }
+    bias = int(spec.config.INACTIVITY_SCORE_BIAS)
+    rec = int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE)
+
+    yield from run_inactivity_updates(spec, state)
+
+    for i in spec.get_eligible_validator_indices(state):
+        expected = pre_scores[i]
+        expected = max(0, expected - 1) if int(i) in participating else expected + bias
+        if not leaking:
+            expected = max(0, expected - rec)
+        assert int(state.inactivity_scores[i]) == expected, f"validator {i}"
+
+
+def set_random_participation(spec, state, rng):
+    target = 1 << spec.TIMELY_TARGET_FLAG_INDEX
+    for i in range(len(state.validators)):
+        state.previous_epoch_participation[i] = rng.choice([0, target])
+
+
+@with_altair_and_later
+@spec_state_test
+def test_genesis_random_scores(spec, state):
+    rng = Random(10102)
+    randomize_scores(spec, state, rng)
+    pre_scores = [int(s) for s in state.inactivity_scores]
+    yield from run_inactivity_updates(spec, state)
+    assert [int(s) for s in state.inactivity_scores] == pre_scores
+
+
+@with_altair_and_later
+@spec_state_test
+def test_all_zero_inactivity_scores_random_participation(spec, state):
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    set_random_participation(spec, state, Random(5522))
+    yield from _run_checked(spec, state)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_all_zero_inactivity_scores_random_participation_leaking(spec, state):
+    transition_to_leaking(spec, state)
+    for i in range(len(state.validators)):
+        state.inactivity_scores[i] = 0
+    set_random_participation(spec, state, Random(5523))
+    assert spec.is_in_inactivity_leak(state)
+    yield from _run_checked(spec, state)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_random_inactivity_scores_empty_participation_leaking(spec, state):
+    transition_to_leaking(spec, state)
+    randomize_scores(spec, state, Random(5524))
+    clear_participation(spec, state)
+    assert spec.is_in_inactivity_leak(state)
+    yield from _run_checked(spec, state)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_random_inactivity_scores_random_participation(spec, state):
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    randomize_scores(spec, state, Random(5525))
+    set_random_participation(spec, state, Random(5526))
+    yield from _run_checked(spec, state)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_random_inactivity_scores_full_participation_leaking(spec, state):
+    transition_to_leaking(spec, state)
+    randomize_scores(spec, state, Random(5527))
+    set_full_participation(spec, state)
+    assert spec.is_in_inactivity_leak(state)
+    yield from _run_checked(spec, state)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_some_slashed_zero_scores_full_participation(spec, state):
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    set_full_participation(spec, state)
+    for i in range(len(state.validators) // 4):
+        state.validators[i].slashed = True
+    yield from _run_checked(spec, state)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_some_slashed_full_random(spec, state):
+    rng = Random(5528)
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    randomize_scores(spec, state, rng)
+    set_random_participation(spec, state, rng)
+    for i in range(len(state.validators)):
+        if rng.random() < 0.25:
+            state.validators[i].slashed = True
+    yield from _run_checked(spec, state)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_some_slashed_full_random_leaking(spec, state):
+    rng = Random(5529)
+    transition_to_leaking(spec, state)
+    randomize_scores(spec, state, rng)
+    set_random_participation(spec, state, rng)
+    for i in range(len(state.validators)):
+        if rng.random() < 0.25:
+            state.validators[i].slashed = True
+    assert spec.is_in_inactivity_leak(state)
+    yield from _run_checked(spec, state)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_some_exited_full_random_leaking(spec, state):
+    rng = Random(5530)
+    transition_to_leaking(spec, state)
+    randomize_scores(spec, state, rng)
+    set_random_participation(spec, state, rng)
+    epoch = spec.get_current_epoch(state)
+    for i in range(len(state.validators)):
+        if rng.random() < 0.2:
+            v = state.validators[i]
+            v.exit_epoch = rng.choice([epoch - 1, epoch, epoch + 1])
+            v.withdrawable_epoch = (
+                v.exit_epoch + spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+            )
+    assert spec.is_in_inactivity_leak(state)
+    yield from _run_checked(spec, state)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_randomized_state(spec, state):
+    """Full registry randomization (exits + slashes + balances + scores)
+    through the generic oracle — the non-leaking flavor."""
+    from consensus_specs_tpu.test_framework.random_block_tests import randomize_state
+
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    randomize_state(spec, state, Random(5531))
+    set_random_participation(spec, state, Random(5532))
+    yield from _run_checked(spec, state)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_randomized_state_leaking(spec, state):
+    from consensus_specs_tpu.test_framework.random_block_tests import randomize_state
+
+    transition_to_leaking(spec, state)
+    randomize_state(spec, state, Random(5533))
+    set_random_participation(spec, state, Random(5534))
+    assert spec.is_in_inactivity_leak(state)
+    yield from _run_checked(spec, state)
+
+
 @with_altair_and_later
 @spec_state_test
 def test_full_participation_after_leak_recovers(spec, state):

@@ -85,6 +85,80 @@ def test_random_1(spec, state):
 
 @with_altair_and_later
 @spec_state_test
+def test_random_2(spec, state):
+    next_epoch(spec, state)
+    next_epoch(spec, state)  # deeper history than random_0/1
+    _random_flags(spec, state, Random(103))
+    yield from run_flag_updates(spec, state)
+
+
+@with_altair_and_later
+@spec_state_test
 def test_random_genesis(spec, state):
     _random_flags(spec, state, Random(102))
+    yield from run_flag_updates(spec, state)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_current_epoch_zeroed(spec, state):
+    next_epoch(spec, state)
+    rng = Random(104)
+    for i in range(len(state.validators)):
+        state.previous_epoch_participation[i] = rng.randint(0, FULL_FLAGS)
+        state.current_epoch_participation[i] = 0
+    yield from run_flag_updates(spec, state)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_previous_epoch_zeroed(spec, state):
+    next_epoch(spec, state)
+    rng = Random(105)
+    for i in range(len(state.validators)):
+        state.previous_epoch_participation[i] = 0
+        state.current_epoch_participation[i] = rng.randint(0, FULL_FLAGS)
+    yield from run_flag_updates(spec, state)
+
+
+def _grow_registry(spec, state, count):
+    """Fresh registry rows so the two participation lists are LONGER than
+    at genesis — the rotation must preserve list length, not just values."""
+    from consensus_specs_tpu.test_framework.keys import pubkeys
+
+    for _ in range(count):
+        index = len(state.validators)
+        key = pubkeys[index]
+        state.validators.append(
+            spec.Validator(
+                pubkey=key,
+                withdrawal_credentials=spec.BLS_WITHDRAWAL_PREFIX + spec.hash(key)[1:],
+                effective_balance=spec.MAX_EFFECTIVE_BALANCE,
+                activation_eligibility_epoch=spec.get_current_epoch(state),
+                activation_epoch=spec.FAR_FUTURE_EPOCH,
+                exit_epoch=spec.FAR_FUTURE_EPOCH,
+                withdrawable_epoch=spec.FAR_FUTURE_EPOCH,
+            )
+        )
+        state.balances.append(spec.MAX_EFFECTIVE_BALANCE)
+        state.previous_epoch_participation.append(0)
+        state.current_epoch_participation.append(0)
+        state.inactivity_scores.append(0)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_slightly_larger_random(spec, state):
+    next_epoch(spec, state)
+    _grow_registry(spec, state, 4)
+    _random_flags(spec, state, Random(106))
+    yield from run_flag_updates(spec, state)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_large_random(spec, state):
+    next_epoch(spec, state)
+    _grow_registry(spec, state, len(state.validators))  # double it
+    _random_flags(spec, state, Random(107))
     yield from run_flag_updates(spec, state)

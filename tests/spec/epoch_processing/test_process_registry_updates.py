@@ -157,6 +157,143 @@ def test_activation_queue_efficiency_scaled(spec, state):
 
 @with_all_phases
 @spec_state_test
+def test_activation_queue_efficiency_min(spec, state):
+    """Minimum-churn twin of the scaled test: two processing rounds must
+    activate exactly 2x the (minimum) churn limit."""
+    epoch = spec.get_current_epoch(state)
+    pre_churn = spec.get_validator_churn_limit(state)
+    assert pre_churn == spec.config.MIN_PER_EPOCH_CHURN_LIMIT
+    mock_activations = int(pre_churn) * 2
+    for i in range(mock_activations):
+        mock_deposit_eligibility(spec, state, i)
+        state.validators[i].activation_eligibility_epoch = epoch + 1
+    state.finalized_checkpoint.epoch = epoch + 2
+    churn_limit = spec.get_validator_churn_limit(state)
+
+    next_epoch(spec, state)
+    activated_first = sum(
+        1
+        for i in range(mock_activations)
+        if state.validators[i].activation_epoch != spec.FAR_FUTURE_EPOCH
+    )
+    assert activated_first == churn_limit
+
+    yield from run_epoch_processing_with(spec, state, "process_registry_updates")
+
+    activated = sum(
+        1
+        for i in range(mock_activations)
+        if state.validators[i].activation_epoch != spec.FAR_FUTURE_EPOCH
+    )
+    assert activated == min(mock_activations, int(churn_limit) * 2)
+
+
+def _run_ejection_past_churn_limit(spec, state):
+    """Eject 2x churn at once: every ejection is initiated immediately —
+    the churn shows up as the exit QUEUE spreading across two epochs,
+    not as deferred initiations."""
+    churn = int(spec.get_validator_churn_limit(state))
+    count = churn * 2
+    for i in range(count):
+        state.validators[i].effective_balance = spec.config.EJECTION_BALANCE
+
+    yield from run_epoch_processing_with(spec, state, "process_registry_updates")
+
+    exit_epochs = [int(state.validators[i].exit_epoch) for i in range(count)]
+    assert all(e != int(spec.FAR_FUTURE_EPOCH) for e in exit_epochs)
+    first = min(exit_epochs)
+    assert exit_epochs.count(first) == churn
+    assert exit_epochs.count(first + 1) == count - churn
+
+
+@with_all_phases
+@spec_state_test
+def test_ejection_past_churn_limit_min(spec, state):
+    assert spec.get_validator_churn_limit(state) == spec.config.MIN_PER_EPOCH_CHURN_LIMIT
+    yield from _run_ejection_past_churn_limit(spec, state)
+
+
+@with_all_phases
+@spec_test
+@with_custom_state(balances_fn=scaled_churn_balances, threshold_fn=default_activation_threshold)
+@single_phase
+def test_ejection_past_churn_limit_scaled(spec, state):
+    assert spec.get_validator_churn_limit(state) > spec.config.MIN_PER_EPOCH_CHURN_LIMIT
+    yield from _run_ejection_past_churn_limit(spec, state)
+
+
+def _run_activation_and_ejection(spec, state, count):
+    """`count` fresh activations queued AND `count` simultaneous
+    ejections in one processing round: activations respect the churn cap,
+    every ejection is initiated."""
+    epoch = spec.get_current_epoch(state)
+    activating = list(range(count))
+    ejecting = list(range(count, 2 * count))
+    for i in activating:
+        mock_deposit_eligibility(spec, state, i)
+        state.validators[i].activation_eligibility_epoch = epoch
+    for i in ejecting:
+        state.validators[i].effective_balance = spec.config.EJECTION_BALANCE
+    state.finalized_checkpoint.epoch = epoch + 1
+    churn = int(spec.get_validator_churn_limit(state))
+
+    yield from run_epoch_processing_with(spec, state, "process_registry_updates")
+
+    activated = sum(
+        1
+        for i in activating
+        if state.validators[i].activation_epoch != spec.FAR_FUTURE_EPOCH
+    )
+    assert activated == min(count, churn)
+    assert all(
+        state.validators[i].exit_epoch != spec.FAR_FUTURE_EPOCH for i in ejecting
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_activation_queue_activation_and_ejection_1(spec, state):
+    yield from _run_activation_and_ejection(spec, state, 1)
+
+
+@with_all_phases
+@spec_state_test
+def test_activation_queue_activation_and_ejection_churn_limit(spec, state):
+    yield from _run_activation_and_ejection(
+        spec, state, int(spec.get_validator_churn_limit(state))
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_activation_queue_activation_and_ejection_exceed_churn_limit(spec, state):
+    yield from _run_activation_and_ejection(
+        spec, state, int(spec.get_validator_churn_limit(state)) + 1
+    )
+
+
+@with_all_phases
+@spec_test
+@with_custom_state(balances_fn=scaled_churn_balances, threshold_fn=default_activation_threshold)
+@single_phase
+def test_activation_queue_activation_and_ejection_scaled_churn_limit(spec, state):
+    churn = int(spec.get_validator_churn_limit(state))
+    assert churn > spec.config.MIN_PER_EPOCH_CHURN_LIMIT
+    yield from _run_activation_and_ejection(spec, state, churn)
+
+
+@with_all_phases
+@spec_test
+@with_custom_state(balances_fn=scaled_churn_balances, threshold_fn=default_activation_threshold)
+@single_phase
+def test_activation_queue_activation_and_ejection_exceed_scaled_churn_limit(spec, state):
+    churn = int(spec.get_validator_churn_limit(state))
+    assert churn > spec.config.MIN_PER_EPOCH_CHURN_LIMIT
+    yield from _run_activation_and_ejection(spec, state, churn + 1)
+
+
+@with_all_phases
+@spec_state_test
 def test_ejection(spec, state):
     index = 0
     assert spec.is_active_validator(state.validators[index], spec.get_current_epoch(state))

@@ -11,9 +11,14 @@ from consensus_specs_tpu.test_framework.attestations import (
 )
 from consensus_specs_tpu.test_framework.context import (
     PHASE0,
+    misc_balances,
+    single_phase,
     spec_state_test,
+    spec_test,
     with_all_phases,
+    with_custom_state,
     with_phases,
+    zero_activation_threshold,
 )
 from consensus_specs_tpu.test_framework.epoch_processing import (
     run_epoch_processing_to,
@@ -166,6 +171,219 @@ def test_attestations_some_slashed(spec, state):
     for i in range(slashed_count):
         # a slashed validator can only be penalized, never rewarded
         assert int(state.balances[i]) <= pre_balances[i]
+
+
+def _run_and_snapshot(spec, state):
+    """Stage to the sub-transition, emit pre/post, return pre-balances."""
+    run_epoch_processing_to(spec, state, "process_rewards_and_penalties")
+    pre_balances = [int(b) for b in state.balances]
+    yield "pre", state
+    spec.process_rewards_and_penalties(state)
+    yield "post", state
+    return pre_balances
+
+
+@with_all_phases
+@spec_state_test
+def test_full_attestations_random_incorrect_fields(spec, state):
+    """Everyone attested, but a third of the votes carry a wrong target
+    and another third a wrong head: mixed winners and losers."""
+    from consensus_specs_tpu.test_framework.rewards import degrade_vote_correctness
+
+    next_epoch(spec, state)
+    prepare_state_with_attestations(spec, state)
+    degrade_vote_correctness(
+        spec, state, Random(9001), wrong_target_prob=0.33, wrong_head_prob=0.33
+    )
+
+    pre_balances = yield from _run_and_snapshot(spec, state)
+    changed = sum(1 for b, pb in zip(state.balances, pre_balances) if int(b) != pb)
+    assert changed > 0
+
+
+def _misc_balances_fn(spec):
+    return misc_balances(spec)
+
+
+@with_all_phases
+@spec_test
+@with_custom_state(balances_fn=_misc_balances_fn, threshold_fn=zero_activation_threshold)
+@single_phase
+def test_full_attestations_misc_balances(spec, state):
+    """Full participation over a registry with scattered effective
+    balances: reward magnitudes scale with balance, zero-reward rounding
+    included."""
+    next_epoch(spec, state)
+    prepare_state_with_attestations(spec, state)
+
+    pre_balances = yield from _run_and_snapshot(spec, state)
+    gained = sum(1 for b, pb in zip(state.balances, pre_balances) if int(b) > pb)
+    assert gained > 0
+
+
+def _one_gwei_first_balance(spec):
+    return [spec.Gwei(1)] + [spec.MAX_EFFECTIVE_BALANCE] * (
+        int(spec.SLOTS_PER_EPOCH) * 8 - 1
+    )
+
+
+@with_all_phases
+@spec_test
+@with_custom_state(balances_fn=_one_gwei_first_balance, threshold_fn=zero_activation_threshold)
+@single_phase
+def test_full_attestations_one_validator_one_gwei(spec, state):
+    """A 1-gwei validator participates fully: its base reward rounds to
+    zero, so its balance must not move while everyone else's grows."""
+    next_epoch(spec, state)
+    prepare_state_with_attestations(spec, state)
+
+    pre_balances = yield from _run_and_snapshot(spec, state)
+    assert int(state.balances[0]) == pre_balances[0]
+    assert any(int(b) > pb for b, pb in zip(state.balances, pre_balances))
+
+
+def _participation_sampler(rng, count_fn):
+    def participation_fn(epoch, slot, index, comm):
+        comm = sorted(comm)
+        return rng.sample(comm, count_fn(len(comm)))
+
+    return participation_fn
+
+
+def _leaking_with_participation(spec, state, rng, count_fn):
+    transition_to_leaking(spec, state)
+    prepare_state_with_attestations(
+        spec, state, participation_fn=_participation_sampler(rng, count_fn)
+    )
+    assert spec.is_in_inactivity_leak(state)
+
+
+@with_all_phases
+@spec_state_test
+def test_almost_empty_attestations_with_leak(spec, state):
+    _leaking_with_participation(spec, state, Random(1235), lambda n: 1)
+    pre_balances = yield from _run_and_snapshot(spec, state)
+    losers = sum(1 for b, pb in zip(state.balances, pre_balances) if int(b) < pb)
+    assert losers > len(state.validators) // 2
+
+
+@with_all_phases
+@spec_state_test
+def test_random_fill_attestations_with_leak(spec, state):
+    _leaking_with_participation(spec, state, Random(4568), lambda n: n // 3)
+    pre_balances = yield from _run_and_snapshot(spec, state)
+    lost = sum(1 for b, pb in zip(state.balances, pre_balances) if int(b) < pb)
+    assert lost > 0
+
+
+@with_all_phases
+@spec_state_test
+def test_almost_full_attestations(spec, state):
+    next_epoch(spec, state)
+    rng = Random(8901)
+    prepare_state_with_attestations(
+        spec, state, participation_fn=_participation_sampler(rng, lambda n: max(n - 1, 1))
+    )
+    pre_balances = yield from _run_and_snapshot(spec, state)
+    gained = sum(1 for b, pb in zip(state.balances, pre_balances) if int(b) > pb)
+    assert gained > len(state.validators) // 2
+
+
+@with_all_phases
+@spec_state_test
+def test_almost_full_attestations_with_leak(spec, state):
+    _leaking_with_participation(spec, state, Random(8902), lambda n: max(n - 1, 1))
+    pre_balances = yield from _run_and_snapshot(spec, state)
+    assert any(int(b) != pb for b, pb in zip(state.balances, pre_balances))
+
+
+# -- duplicate participants across DIFFERENT attestations (phase0 pending-
+# attestation accounting; ref test_process_rewards_and_penalties.py) ---------
+
+def _apply_attestations_at(spec, state, attestations, slot):
+    from consensus_specs_tpu.test_framework.state import transition_to
+
+    if state.slot < slot:
+        transition_to(spec, state, slot)
+    for attestation in attestations:
+        spec.process_attestation(state, attestation)
+
+
+def _run_duplicate_participants(spec, state, dup_plan):
+    """Same attesters on chain twice via two different attestations (a
+    correct one and a head-corrupted twin — slashable but includable).
+    dup_plan(correct, incorrect, inclusion_slot) returns the
+    [(attestations, slot)] schedule for the duplicated state. The
+    duplicated state must pay participants exactly what the
+    single-correct state pays (earliest inclusion wins; inclusion-delay
+    rewards ignore vote correctness)."""
+    from consensus_specs_tpu.test_framework.attestations import (
+        get_valid_attestation,
+        sign_attestation,
+    )
+
+    correct = get_valid_attestation(spec, state, signed=True)
+    incorrect = correct.copy()
+    incorrect.data.beacon_block_root = b"\x42" * 32
+    sign_attestation(spec, state, incorrect)
+
+    participants = [
+        int(i) for i in spec.get_attesting_indices(state, correct.data, correct.aggregation_bits)
+    ]
+    assert participants
+
+    single_state = state.copy()
+    dup_state = state.copy()
+    inclusion_slot = int(state.slot) + int(spec.MIN_ATTESTATION_INCLUSION_DELAY)
+
+    _apply_attestations_at(spec, single_state, [correct], inclusion_slot)
+    for attestations, slot in dup_plan(correct, incorrect, inclusion_slot):
+        _apply_attestations_at(spec, dup_state, attestations, slot)
+
+    next_epoch(spec, single_state)
+    next_epoch(spec, dup_state)
+
+    # comparison run (no vector parts emitted for the single twin)
+    run_epoch_processing_to(spec, single_state, "process_rewards_and_penalties")
+    spec.process_rewards_and_penalties(single_state)
+
+    run_epoch_processing_to(spec, dup_state, "process_rewards_and_penalties")
+    yield "pre", dup_state
+    spec.process_rewards_and_penalties(dup_state)
+    yield "post", dup_state
+
+    for index in participants:
+        assert int(dup_state.balances[index]) == int(single_state.balances[index])
+
+
+@with_phases([PHASE0])
+@spec_state_test
+def test_duplicate_participants_different_attestation_1(spec, state):
+    """Correct first, head-corrupted twin second, same inclusion slot."""
+    yield from _run_duplicate_participants(
+        spec, state, lambda c, i, slot: [([c, i], slot)]
+    )
+
+
+@with_phases([PHASE0])
+@spec_state_test
+def test_duplicate_participants_different_attestation_2(spec, state):
+    """Head-corrupted twin FIRST in list order: inclusion-delay credit
+    ignores correctness, so rewards still match the single-correct run."""
+    yield from _run_duplicate_participants(
+        spec, state, lambda c, i, slot: [([i, c], slot)]
+    )
+
+
+@with_phases([PHASE0])
+@spec_state_test
+def test_duplicate_participants_different_attestation_3(spec, state):
+    """Corrupted twin lands a slot EARLIER than the correct vote: the
+    earliest inclusion sets the delay reward, correctness comes from the
+    matching-set union."""
+    yield from _run_duplicate_participants(
+        spec, state, lambda c, i, slot: [([i], slot), ([c], slot + 1)]
+    )
 
 
 @with_all_phases

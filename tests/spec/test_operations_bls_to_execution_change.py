@@ -46,6 +46,50 @@ def test_success_exited(spec, state):
 
 @with_capella_and_later
 @spec_state_test
+def test_success_in_activation_queue(spec, state):
+    validator_index = 5
+    validator = state.validators[validator_index]
+    validator.activation_eligibility_epoch = spec.get_current_epoch(state)
+    validator.activation_epoch = spec.compute_activation_exit_epoch(
+        spec.get_current_epoch(state)
+    )
+    assert not spec.is_active_validator(validator, spec.get_current_epoch(state))
+    signed_address_change = get_signed_address_change(
+        spec, state, validator_index=validator_index
+    )
+    yield from run_bls_to_execution_change_processing(spec, state, signed_address_change)
+
+
+@with_capella_and_later
+@spec_state_test
+def test_success_in_exit_queue(spec, state):
+    validator_index = 6
+    spec.initiate_validator_exit(state, validator_index)
+    assert spec.is_active_validator(
+        state.validators[validator_index], spec.get_current_epoch(state)
+    )
+    assert state.validators[validator_index].exit_epoch < spec.FAR_FUTURE_EPOCH
+    signed_address_change = get_signed_address_change(
+        spec, state, validator_index=validator_index
+    )
+    yield from run_bls_to_execution_change_processing(spec, state, signed_address_change)
+
+
+@with_capella_and_later
+@spec_state_test
+def test_success_withdrawable(spec, state):
+    validator_index = 7
+    validator = state.validators[validator_index]
+    validator.exit_epoch = max(int(spec.get_current_epoch(state)) - 2, 0)
+    validator.withdrawable_epoch = spec.get_current_epoch(state)
+    signed_address_change = get_signed_address_change(
+        spec, state, validator_index=validator_index
+    )
+    yield from run_bls_to_execution_change_processing(spec, state, signed_address_change)
+
+
+@with_capella_and_later
+@spec_state_test
 def test_invalid_out_of_range_validator_index(spec, state):
     signed_address_change = get_signed_address_change(
         spec, state, validator_index=len(state.validators)

@@ -115,6 +115,110 @@ def test_invalid_withdrawal_address_mismatch(spec, state):
 
 @with_capella_and_later
 @spec_state_test
+def test_success_a_lot_in_queue(spec, state):
+    """4x the per-payload cap staged: the payload drains exactly the cap,
+    the rest stay queued in order."""
+    count = int(spec.MAX_WITHDRAWALS_PER_PAYLOAD) * 4
+    for i in range(count):
+        _queue_withdrawal(spec, state, i, 1_000_000 + i)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    assert len(payload.withdrawals) == spec.MAX_WITHDRAWALS_PER_PAYLOAD
+    yield from run_withdrawals_processing(spec, state, payload)
+    assert len(state.withdrawals_queue) == count - int(spec.MAX_WITHDRAWALS_PER_PAYLOAD)
+
+
+@with_capella_and_later
+@spec_state_test
+def test_invalid_empty_queue_nonempty_withdrawals(spec, state):
+    """A payload inventing a withdrawal the queue never staged."""
+    assert len(state.withdrawals_queue) == 0
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.withdrawals.append(
+        spec.Withdrawal(
+            index=0,
+            address=spec.ExecutionAddress(b"\x77" * 20),
+            amount=1_000_000,
+        )
+    )
+    yield from run_withdrawals_processing(spec, state, payload, valid=False)
+
+
+@with_capella_and_later
+@spec_state_test
+def test_invalid_one_in_queue_two_in_withdrawals(spec, state):
+    """One staged, two claimed: the extra claim must fail the match."""
+    _queue_withdrawal(spec, state, 0, 1_000_000)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    extra = payload.withdrawals[0].copy()
+    extra.index += 1
+    payload.withdrawals.append(extra)
+    yield from run_withdrawals_processing(spec, state, payload, valid=False)
+
+
+@with_capella_and_later
+@spec_state_test
+def test_invalid_max_in_queue_one_less_in_withdrawals(spec, state):
+    """A full cap staged but the payload under-claims by one."""
+    for i in range(int(spec.MAX_WITHDRAWALS_PER_PAYLOAD)):
+        _queue_withdrawal(spec, state, i, 1_000_000)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.withdrawals = payload.withdrawals[:-1]
+    yield from run_withdrawals_processing(spec, state, payload, valid=False)
+
+
+@with_capella_and_later
+@spec_state_test
+def test_invalid_a_lot_in_queue_too_few_in_withdrawals(spec, state):
+    """Queue deeper than the cap: the payload must still claim a full cap."""
+    for i in range(int(spec.MAX_WITHDRAWALS_PER_PAYLOAD) * 4):
+        _queue_withdrawal(spec, state, i, 1_000_000)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.withdrawals = payload.withdrawals[: len(payload.withdrawals) // 2]
+    yield from run_withdrawals_processing(spec, state, payload, valid=False)
+
+
+@with_capella_and_later
+@spec_state_test
+def test_invalid_one_of_many_dequeued_incorrectly(spec, state):
+    """A single corrupted row in an otherwise-correct full-cap claim."""
+    for i in range(int(spec.MAX_WITHDRAWALS_PER_PAYLOAD)):
+        _queue_withdrawal(spec, state, i, 1_000_000)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    middle = len(payload.withdrawals) // 2
+    wd = payload.withdrawals[middle]
+    wd.amount += 7
+    payload.withdrawals[middle] = wd
+    yield from run_withdrawals_processing(spec, state, payload, valid=False)
+
+
+@with_capella_and_later
+@spec_state_test
+def test_invalid_many_dequeued_incorrectly(spec, state):
+    """Every row corrupted a different way."""
+    for i in range(int(spec.MAX_WITHDRAWALS_PER_PAYLOAD)):
+        _queue_withdrawal(spec, state, i, 1_000_000)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    for pos in range(len(payload.withdrawals)):
+        wd = payload.withdrawals[pos]
+        if pos % 3 == 0:
+            wd.index += 1
+        elif pos % 3 == 1:
+            wd.address = spec.ExecutionAddress(b"\x88" * 20)
+        else:
+            wd.amount += 1
+        payload.withdrawals[pos] = wd
+    yield from run_withdrawals_processing(spec, state, payload, valid=False)
+
+
+@with_capella_and_later
+@spec_state_test
 def test_full_withdrawals_at_epoch_boundary(spec, state):
     # make validator 0 fully withdrawable with eth1 credentials
     index = 0

@@ -1,0 +1,90 @@
+"""Weak-subjectivity helpers pinned to the PUBLISHED period table
+(ref: specs/phase0/weak-subjectivity.md — the table of computed
+`weak_subjectivity_period` values for mainnet constants is a normative,
+externally-produced known-answer set; neither repo ships executable
+tests for it, so these pins are an anchor the reference itself lacks)."""
+import pytest
+
+from consensus_specs_tpu.specs.build import build_spec
+from consensus_specs_tpu.test_framework.context import (
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.test_framework.fork_choice import get_genesis_forkchoice_store
+
+
+def _mainnet_state(spec, n_validators, eth_balance):
+    """A minimal-content mainnet BeaconState: n active validators with
+    the given effective balance (only the accessors ws-period reads need
+    to be populated)."""
+    gwei = int(eth_balance) * 10**9
+    validators = [
+        spec.Validator(
+            pubkey=b"\xaa" * 48,
+            withdrawal_credentials=b"\x00" * 32,
+            effective_balance=gwei,
+            activation_eligibility_epoch=0,
+            activation_epoch=0,
+            exit_epoch=spec.FAR_FUTURE_EPOCH,
+            withdrawable_epoch=spec.FAR_FUTURE_EPOCH,
+        )
+        for _ in range(n_validators)
+    ]
+    return spec.BeaconState(
+        validators=validators, balances=[gwei] * n_validators
+    )
+
+
+# rows from the normative table (SAFETY_DECAY=10): (avg ETH, validator
+# count, expected period in epochs)
+_TABLE = [
+    (28, 32768, 504),
+    (28, 65536, 752),
+    (32, 32768, 665),
+    (32, 65536, 1075),
+]
+
+
+@pytest.mark.parametrize("avg_eth,count,expected", _TABLE, ids=[
+    f"t{t}_n{n}" for t, n, _ in _TABLE
+])
+def test_ws_period_matches_published_table(avg_eth, count, expected):
+    spec = build_spec("phase0", "mainnet")
+    state = _mainnet_state(spec, count, avg_eth)
+    assert int(spec.compute_weak_subjectivity_period(state)) == expected
+
+
+@with_all_phases
+@spec_state_test
+def test_is_within_ws_period_boundary(spec, state):
+    """The inclusive boundary: current epoch == ws epoch + period is
+    still inside; one epoch later is out."""
+    ws_checkpoint = spec.Checkpoint(
+        epoch=spec.compute_epoch_at_slot(state.slot),
+        root=state.latest_block_header.state_root,
+    )
+    store = get_genesis_forkchoice_store(spec, state)
+    period = int(spec.compute_weak_subjectivity_period(state))
+    seconds_per_epoch = int(spec.config.SECONDS_PER_SLOT) * int(spec.SLOTS_PER_EPOCH)
+
+    store.time = store.genesis_time + period * seconds_per_epoch
+    assert spec.is_within_weak_subjectivity_period(store, state, ws_checkpoint)
+
+    store.time = store.genesis_time + (period + 1) * seconds_per_epoch
+    assert not spec.is_within_weak_subjectivity_period(store, state, ws_checkpoint)
+    yield None
+
+
+@with_all_phases
+@spec_state_test
+def test_is_within_ws_period_rejects_mismatched_checkpoint(spec, state):
+    from consensus_specs_tpu.test_framework.context import expect_assertion_error
+
+    store = get_genesis_forkchoice_store(spec, state)
+    bad = spec.Checkpoint(
+        epoch=spec.compute_epoch_at_slot(state.slot), root=b"\x13" * 32
+    )
+    expect_assertion_error(
+        lambda: spec.is_within_weak_subjectivity_period(store, state, bad)
+    )
+    yield None

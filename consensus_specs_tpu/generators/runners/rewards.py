@@ -9,6 +9,11 @@ all_mods = {
     }
     for fork in ("phase0", "altair", "bellatrix", "capella")
 }
+for _fork in ("altair", "bellatrix", "capella"):  # score-distribution cases
+    all_mods[_fork] = dict(
+        all_mods[_fork],
+        inactivity_scores="tests.spec.test_rewards_inactivity_scores",
+    )
 
 
 def run(args=None):

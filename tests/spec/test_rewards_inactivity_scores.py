@@ -1,0 +1,139 @@
+"""Inactivity-penalty deltas under varied score distributions — altair+
+(ref: test/altair/rewards/test_inactivity_scores.py). Every case runs
+the full component-delta oracle (rewards.run_deltas), so the
+score-distribution input shapes stress get_inactivity_penalty_deltas
+specifically."""
+from random import Random
+
+from consensus_specs_tpu.test_framework import rewards
+from consensus_specs_tpu.test_framework.attestations import (
+    prepare_state_with_attestations,
+)
+from consensus_specs_tpu.test_framework.context import (
+    default_activation_threshold,
+    low_balances,
+    misc_balances,
+    single_phase,
+    spec_state_test,
+    spec_test,
+    with_altair_and_later,
+    with_custom_state,
+    zero_activation_threshold,
+)
+
+
+def _seed_scores(spec, state, rng, maximum, half_zero=False):
+    for index in range(len(state.validators)):
+        if half_zero and index % 2 == 0:
+            state.inactivity_scores[index] = 0
+        else:
+            state.inactivity_scores[index] = spec.uint64(rng.randrange(0, maximum))
+
+
+def _run_scored(spec, state, rng, maximum, half_zero=False, participation=1.0):
+    prepare_state_with_attestations(spec, state)
+    _seed_scores(spec, state, rng, maximum, half_zero=half_zero)
+    if participation < 1.0:
+        for index in range(len(state.validators)):
+            if rng.random() > participation:
+                state.previous_epoch_participation[index] = spec.ParticipationFlags(0)
+    yield from rewards.run_deltas(spec, state)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_random_inactivity_scores_0(spec, state):
+    yield from _run_scored(spec, state, Random(9820), maximum=100)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_random_inactivity_scores_1(spec, state):
+    yield from _run_scored(spec, state, Random(9821), maximum=100, participation=0.6)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_half_zero_half_random_inactivity_scores(spec, state):
+    yield from _run_scored(spec, state, Random(9822), maximum=100, half_zero=True)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_random_high_inactivity_scores(spec, state):
+    """Scores around the leak-quotient scale: penalties become material
+    even outside a leak."""
+    yield from _run_scored(spec, state, Random(9823), maximum=50_000, participation=0.7)
+
+
+@with_altair_and_later
+@spec_test
+@with_custom_state(balances_fn=low_balances, threshold_fn=zero_activation_threshold)
+@single_phase
+def test_random_inactivity_scores_low_balances_0(spec, state):
+    yield from _run_scored(spec, state, Random(9824), maximum=100)
+
+
+@with_altair_and_later
+@spec_test
+@with_custom_state(balances_fn=low_balances, threshold_fn=zero_activation_threshold)
+@single_phase
+def test_random_inactivity_scores_low_balances_1(spec, state):
+    yield from _run_scored(spec, state, Random(9825), maximum=5_000, participation=0.5)
+
+
+@with_altair_and_later
+@spec_test
+@with_custom_state(balances_fn=misc_balances, threshold_fn=default_activation_threshold)
+@single_phase
+def test_full_random_misc_balances(spec, state):
+    yield from _run_scored(spec, state, Random(9826), maximum=10_000, participation=0.8)
+
+
+def _run_scored_leaking(spec, state, rng, maximum, half_zero=False,
+                        participation=1.0, extra_epochs=0):
+    rewards.transition_to_leaking(spec, state, extra_epochs=extra_epochs)
+    assert spec.is_in_inactivity_leak(state)
+    yield from _run_scored(
+        spec, state, rng, maximum, half_zero=half_zero, participation=participation
+    )
+
+
+@with_altair_and_later
+@spec_state_test
+def test_random_inactivity_scores_leaking_0(spec, state):
+    yield from _run_scored_leaking(spec, state, Random(9827), maximum=100)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_random_inactivity_scores_leaking_1(spec, state):
+    yield from _run_scored_leaking(
+        spec, state, Random(9828), maximum=100, participation=0.6
+    )
+
+
+@with_altair_and_later
+@spec_state_test
+def test_half_zero_half_random_inactivity_scores_leaking(spec, state):
+    yield from _run_scored_leaking(
+        spec, state, Random(9829), maximum=100, half_zero=True, participation=0.7
+    )
+
+
+@with_altair_and_later
+@spec_state_test
+def test_random_high_inactivity_scores_leaking(spec, state):
+    yield from _run_scored_leaking(
+        spec, state, Random(9830), maximum=50_000, participation=0.7
+    )
+
+
+@with_altair_and_later
+@spec_state_test
+def test_random_high_inactivity_scores_leaking_8_epochs(spec, state):
+    """A deep leak (8 extra epochs of missed finality) with saturated
+    scores: the penalty quotient term dominates the deltas."""
+    yield from _run_scored_leaking(
+        spec, state, Random(9831), maximum=50_000, participation=0.7, extra_epochs=8
+    )

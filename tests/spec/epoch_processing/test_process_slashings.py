@@ -165,3 +165,65 @@ def test_no_slashings_out_of_window(spec, state):
     yield "post", state
 
     assert state.balances[0] == pre_balance
+
+
+@with_all_phases
+@spec_state_test
+def test_slashings_with_random_state(spec, state):
+    """Correlated penalties over a RANDOMIZED registry: exited-but-
+    unslashed validators skew the active-balance denominator, and every
+    slashed-at-midpoint validator must pay exactly the quotient
+    formula's amount."""
+    from random import Random
+
+    from consensus_specs_tpu.test_framework.random_block_tests import randomize_state
+
+    rng = Random(9998)
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    randomize_state(spec, state, rng)
+    epoch = spec.get_current_epoch(state)
+
+    # the differential the scenario exists for: exited yet unslashed rows
+    exited_unslashed = [
+        i
+        for i, v in enumerate(state.validators)
+        if not v.slashed and v.exit_epoch <= epoch < v.withdrawable_epoch
+    ]
+    if not exited_unslashed:  # rng drift guard: force the shape
+        v = state.validators[0]
+        v.exit_epoch = epoch
+        v.withdrawable_epoch = epoch + spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+        exited_unslashed = [0]
+
+    # slash a batch of active unslashed validators at the window midpoint
+    candidates = [
+        i
+        for i in spec.get_active_validator_indices(state, epoch)
+        if not state.validators[i].slashed and i not in exited_unslashed
+    ]
+    victims = candidates[: max(2, len(candidates) // 8)]
+    midpoint = epoch + spec.EPOCHS_PER_SLASHINGS_VECTOR // 2
+    slash_validators(spec, state, victims, [midpoint] * len(victims))
+
+    total_balance = int(spec.get_total_active_balance(state))
+    total_penalties = sum(int(s) for s in state.slashings)
+    multiplier = int(_slashing_multiplier(spec))
+    adjusted = min(total_penalties * multiplier, total_balance)
+
+    run_epoch_processing_to(spec, state, "process_slashings")
+    pre_balances = [int(b) for b in state.balances]
+
+    yield "pre", state
+    spec.process_slashings(state)
+    yield "post", state
+
+    increment = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    for i in victims:
+        eb = int(state.validators[i].effective_balance)
+        expected_penalty = eb // increment * adjusted // total_balance * increment
+        assert int(state.balances[i]) == max(pre_balances[i] - expected_penalty, 0), i
+    # the protected shape survived untouched by this sub-transition
+    for i in exited_unslashed:
+        assert not state.validators[i].slashed
+        assert int(state.balances[i]) == pre_balances[i]

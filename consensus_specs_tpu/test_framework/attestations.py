@@ -171,6 +171,34 @@ def state_transition_with_full_block(spec, state, fill_cur_epoch, fill_prev_epoc
     return state_transition_and_sign_block(spec, state, block)
 
 
+def state_transition_with_epoch_sweep_block(spec, state, fill_cur_epoch, fill_prev_epoch):
+    """Build + apply a block sweeping attestations over the attestable
+    slots of the current epoch so far (and the still-includable tail of
+    the previous epoch) — the many-slot analog of
+    state_transition_with_full_block, used to justify an epoch with a
+    single late block. The epoch's start slot itself is left out of the
+    current-epoch sweep (ref attestations.py:280-313 behavior)."""
+    block = build_empty_block_for_next_slot(spec, state)
+    epoch_start = spec.compute_start_slot_at_epoch(spec.get_current_epoch(state))
+    if fill_cur_epoch:
+        # epoch_start+1 .. the newest slot the block's inclusion delay
+        # still admits
+        target = int(block.slot) - int(spec.MIN_ATTESTATION_INCLUSION_DELAY)
+        while target > epoch_start:
+            for attestation in get_valid_attestation_at_slot(state, spec, target):
+                block.body.attestations.append(attestation)
+            target -= 1
+    if fill_prev_epoch:
+        # the previous epoch's tail still inside the inclusion window
+        target = epoch_start - 1
+        floor = max(int(block.slot) - int(spec.SLOTS_PER_EPOCH), 0)
+        while int(target) >= floor:
+            for attestation in get_valid_attestation_at_slot(state, spec, target):
+                block.body.attestations.append(attestation)
+            target -= 1
+    return state_transition_and_sign_block(spec, state, block)
+
+
 def next_slots_with_attestations(spec, state, slot_count, fill_cur_epoch, fill_prev_epoch,
                                  participation_fn=None):
     post_state = state.copy()

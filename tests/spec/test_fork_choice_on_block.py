@@ -11,6 +11,7 @@ from consensus_specs_tpu.test_framework.context import (
 )
 from consensus_specs_tpu.test_framework.attestations import (
     next_epoch_with_attestations,
+    state_transition_with_epoch_sweep_block,
 )
 from consensus_specs_tpu.test_framework.fork_choice import (
     add_block,
@@ -518,4 +519,85 @@ def test_justified_race_outside_safe_slots_deferred(spec, state):
         test_steps,
     )
     assert store.justified_checkpoint == fork_justified
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_justified_update_outside_safe_slots_via_finality(spec, state):
+    """A justification bump arriving OUTSIDE the safe-slot window is still
+    adopted immediately when its lineage runs through the store's current
+    justified root (the non-conflicting branch of
+    should_update_justified_checkpoint) — and the same block advances
+    finality, which re-asserts the justified adoption unconditionally.
+    Single chain throughout, so no checkpoint conflict is possible
+    (ref test_on_block.py:343-421 behavior, own construction)."""
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+    on_tick_and_append_step(
+        spec, store, store.genesis_time + state.slot * spec.config.SECONDS_PER_SLOT, test_steps
+    )
+
+    # establish finality deep in the past: epochs 1-3 fully attested
+    next_epoch(spec, state)
+    for _ in range(3):
+        state, store, _ = yield from apply_next_epoch_with_attestations(
+            spec, state, store, True, False, test_steps=test_steps
+        )
+    assert store.finalized_checkpoint.epoch == 2
+    assert store.justified_checkpoint.epoch == 3
+
+    # three silent epochs: the next justification cannot be adjacent to
+    # the old one, so finality stalls while justification advances
+    for _ in range(3):
+        next_epoch(spec, state)
+
+    # epoch 7 fully attested -> justified 7, finalized still 2
+    state, store, _ = yield from apply_next_epoch_with_attestations(
+        spec, state, store, True, True, test_steps=test_steps
+    )
+    assert store.finalized_checkpoint.epoch == 2
+    assert store.justified_checkpoint.epoch == 7
+
+    # most of epoch 8 attested slot-by-slot through the store
+    state, store, _ = yield from apply_next_slots_with_attestations(
+        spec, state, store, 5, True, True, test_steps
+    )
+    assert store.justified_checkpoint.epoch == 7
+
+    # a mid-epoch-9 sweep block carries the rest of epoch 8: justified
+    # stays at 7 until the next epoch boundary processes those votes
+    next_epoch(spec, state)
+    next_slots(spec, state, 4)
+    signed_block = state_transition_with_epoch_sweep_block(spec, state, True, True)
+    yield from tick_and_add_block(spec, store, signed_block, test_steps)
+    assert store.justified_checkpoint.epoch == 7
+    assert store.finalized_checkpoint.epoch == 2
+
+    # the epoch-10 boundary processing justifies 8 (adjacent to 7 ->
+    # finalizes 7); deliver the carrying block 4+ slots into epoch 10,
+    # past SAFE_SLOTS_TO_UPDATE_JUSTIFIED
+    next_epoch(spec, state)
+    next_slots(spec, state, 4)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    assert state.finalized_checkpoint.epoch == 7
+    assert state.current_justified_checkpoint.epoch == 8
+
+    on_tick_and_append_step(
+        spec, store,
+        store.genesis_time + signed_block.message.slot * spec.config.SECONDS_PER_SLOT,
+        test_steps,
+    )
+    assert (
+        spec.compute_slots_since_epoch_start(spec.get_current_slot(store))
+        >= spec.SAFE_SLOTS_TO_UPDATE_JUSTIFIED
+    )
+    yield from add_block(spec, store, signed_block, test_steps)
+
+    # adopted despite the late arrival: same-lineage AND finality advance
+    assert store.finalized_checkpoint == state.finalized_checkpoint
+    assert store.justified_checkpoint == state.current_justified_checkpoint
     yield "steps", test_steps

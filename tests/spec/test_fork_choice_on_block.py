@@ -11,7 +11,9 @@ from consensus_specs_tpu.test_framework.context import (
 )
 from consensus_specs_tpu.test_framework.attestations import (
     next_epoch_with_attestations,
+    next_slots_with_attestations,
     state_transition_with_epoch_sweep_block,
+    state_transition_with_full_block,
 )
 from consensus_specs_tpu.test_framework.fork_choice import (
     add_block,
@@ -600,4 +602,124 @@ def test_justified_update_outside_safe_slots_via_finality(spec, state):
     # adopted despite the late arrival: same-lineage AND finality advance
     assert store.finalized_checkpoint == state.finalized_checkpoint
     assert store.justified_checkpoint == state.current_justified_checkpoint
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_justified_and_best_justified_diverge_across_forks(spec, state):
+    """Three competing forks drive store.justified_checkpoint and
+    store.best_justified_checkpoint PERMANENTLY apart:
+
+    - fork A (through the store) justifies epoch 3;
+    - fork B, split off at epoch 2 with a conflicting lineage, justifies
+      epoch 5 and delivers it outside the safe-slot window -> parked in
+      best_justified_checkpoint only;
+    - fork C, split off at genesis, finalizes epoch 3 / justifies epoch 4
+      -> the finality advance adopts justified=4 unconditionally, while
+      best_justified stays at fork B's 5.
+
+    End state: justified(4) < best_justified(5), on different branches
+    (ref test_on_block.py:422-563 behavior, own construction)."""
+    fork_c_state = state.copy()
+
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+    on_tick_and_append_step(
+        spec, store, store.genesis_time + state.slot * spec.config.SECONDS_PER_SLOT, test_steps
+    )
+
+    # ---- fork A (canonical, through the store): justify epoch 3 --------
+    next_epoch(spec, state)
+    state, store, _ = yield from apply_next_epoch_with_attestations(
+        spec, state, store, False, True, test_steps=test_steps
+    )
+    fork_b_state = state.copy()
+    assert spec.get_current_epoch(fork_b_state) == 2
+
+    next_epoch(spec, state)  # epoch 2 silent on fork A
+    for _ in range(2):
+        state, store, _ = yield from apply_next_epoch_with_attestations(
+            spec, state, store, False, True, test_steps=test_steps
+        )
+    assert store.finalized_checkpoint.epoch == 0
+    assert store.justified_checkpoint.epoch == 3
+    assert store.best_justified_checkpoint.epoch == 3
+
+    # ---- fork B (conflicting lineage): justify epoch 5, arrive late ----
+    # its seed block at epoch 2's first slot is the root of every fork-B
+    # checkpoint, so fork-B justifications can never thread through fork
+    # A's epoch-3 checkpoint
+    seed = build_empty_block_for_next_slot(spec, fork_b_state)
+    signed_seed = state_transition_and_sign_block(spec, fork_b_state, seed)
+    yield from tick_and_add_block(spec, store, signed_seed, test_steps)
+
+    for _ in range(2):  # epochs 3-4 silent on fork B
+        next_epoch(spec, fork_b_state)
+        assert fork_b_state.current_justified_checkpoint.epoch == 0
+
+    # two sweep rounds seed the epoch-5 vote supply; justification only
+    # materializes at the 6->7 boundary inside the LAST next_epoch
+    for _ in range(2):
+        next_epoch(spec, fork_b_state)
+        next_slots(spec, fork_b_state, 4)
+        signed_block = state_transition_with_epoch_sweep_block(spec, fork_b_state, True, True)
+        yield from tick_and_add_block(spec, store, signed_block, test_steps)
+        assert fork_b_state.current_justified_checkpoint.epoch == 0
+
+    next_epoch(spec, fork_b_state)
+    next_slots(spec, fork_b_state, spec.SAFE_SLOTS_TO_UPDATE_JUSTIFIED + 2)
+    late_block = state_transition_with_epoch_sweep_block(spec, fork_b_state, True, True)
+    assert fork_b_state.finalized_checkpoint.epoch == 0
+    assert fork_b_state.current_justified_checkpoint.epoch == 5
+
+    on_tick_and_append_step(
+        spec, store,
+        store.genesis_time + late_block.message.slot * spec.config.SECONDS_PER_SLOT,
+        test_steps,
+    )
+    assert (
+        spec.compute_slots_since_epoch_start(spec.get_current_slot(store))
+        >= spec.SAFE_SLOTS_TO_UPDATE_JUSTIFIED
+    )
+    yield from add_block(spec, store, late_block, test_steps)
+    # conflicting + late -> parked, not adopted
+    assert store.finalized_checkpoint.epoch == 0
+    assert store.justified_checkpoint.epoch == 3
+    assert store.best_justified_checkpoint.epoch == 5
+
+    # ---- fork C (from genesis): finalize 3, justify 4 ------------------
+    all_blocks = []
+    for _ in range(3):
+        next_epoch(spec, fork_c_state)
+    _, signed_blocks, fork_c_state = next_epoch_with_attestations(
+        spec, fork_c_state, True, True
+    )
+    all_blocks += signed_blocks
+    _, signed_blocks, fork_c_state = next_slots_with_attestations(
+        spec, fork_c_state, 5, True, True
+    )
+    all_blocks += signed_blocks
+    assert fork_c_state.finalized_checkpoint.epoch == 0
+
+    for _ in range(2):
+        next_epoch(spec, fork_c_state)
+        next_slots(spec, fork_c_state, 4)
+        all_blocks.append(state_transition_with_full_block(spec, fork_c_state, True, True))
+    assert fork_c_state.finalized_checkpoint.epoch == 3
+    assert fork_c_state.current_justified_checkpoint.epoch == 4
+
+    # the store clock is already past every fork-C slot: no ticks, so no
+    # epoch-boundary reconciliation can fire between these on_blocks
+    for signed_block in all_blocks:
+        yield from add_block(spec, store, signed_block, test_steps)
+
+    # finality advance adopted fork C's checkpoints; fork B's later
+    # justification stays parked on its own branch
+    assert store.finalized_checkpoint == fork_c_state.finalized_checkpoint
+    assert store.justified_checkpoint == fork_c_state.current_justified_checkpoint
+    assert store.best_justified_checkpoint.epoch == 5
+    assert store.justified_checkpoint.epoch < store.best_justified_checkpoint.epoch
     yield "steps", test_steps

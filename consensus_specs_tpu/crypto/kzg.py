@@ -98,15 +98,21 @@ def insecure_setup(size: int = SETUP_SIZE) -> TrustedSetup:
 # -- commitments (coefficient form) ------------------------------------------
 
 
-def commit(coeffs: Sequence[int], setup: TrustedSetup) -> bytes:
-    """C = sum coeffs[i] * G1*s^i (the MSM; specs/sharding degree check
-    pairs this with G2_SETUP entries)."""
+def commit_point(coeffs: Sequence[int], setup: TrustedSetup) -> Point:
+    """C = sum coeffs[i] * G1*s^i as a Point (ops/kzg_jax builds pairing
+    rows from this without a bytes round-trip)."""
     assert len(coeffs) <= len(setup.g1_powers)
     acc = g1_infinity()
     for c, p in zip(coeffs, setup.g1_powers):
         if c % fr.MODULUS:
             acc = acc.add(p.mul(c % fr.MODULUS))
-    return g1_to_bytes(acc)
+    return acc
+
+
+def commit(coeffs: Sequence[int], setup: TrustedSetup) -> bytes:
+    """C = sum coeffs[i] * G1*s^i (the MSM; specs/sharding degree check
+    pairs this with G2_SETUP entries)."""
+    return g1_to_bytes(commit_point(coeffs, setup))
 
 
 def commit_to_evaluations(evals: Sequence[int], setup: TrustedSetup) -> bytes:

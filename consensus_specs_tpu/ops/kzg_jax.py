@@ -1,0 +1,231 @@
+"""Device-batched KZG proof verification — the eip4844/DAS/sharding hot
+path (ref surface: specs/eip4844/beacon-chain.md:105-133 blob
+commitment checks; specs/das/das-core.md:131 check_multi_kzg_proof;
+specs/sharding/beacon-chain.md:675-766 shard-header commitment checks —
+the reference ships only prose + a "TBD" setup, no batch verifier at
+all; this module is the TPU-first design for that workload).
+
+Fixed-G2 rearrangement. The host oracle checks (crypto/kzg.py:132-143)
+
+    e(C - [y]G1, G2) * e(-W, [s-x]G2) == 1
+
+whose second G2 point varies per proof, forcing a per-check G2 scalar
+multiplication AND a distinct pairing argument per row. Bilinearity
+moves the variable part across to the G1 side:
+
+    e(-W, [s-x]G2) = e(-W, [s]G2) * e([x]W, G2)
+
+so the check becomes
+
+    e(C - [y]G1 + [x]W, G2) * e(-W, [s]G2) == 1
+
+where BOTH G2 points (the generator and [s]G2 = setup.g2_powers[1]) are
+the same for every (commitment, x, y, proof) tuple. A batch of N checks
+is then N rows of the fixed-Q 2-pairing shape that bls_jax's batched
+Miller-loop/final-exp kernel already compiles for signature
+verification — per-row host work is three cheap G1 operations, and all
+pairing FLOPs ride one device dispatch.
+
+The same trick covers the DAS sample check (a coset multi-proof,
+crypto/kzg.py:187-198): a size-m coset {x0*w^j} has vanishing
+polynomial Z(X) = X^m - x0^m, so [Z(s)]G2 = [s^m]G2 - [x0^m]G2 and
+
+    e(C - [I(s)]G1 + [x0^m]W, G2) * e(-W, [s^m]G2) == 1
+
+again with per-m FIXED G2 points. Per-row host work is the size-m
+interpolation commitment (an m-term G1 MSM — m is the per-sample field
+element count, 8-32) plus one G1 scalar mul.
+
+Subgroup discipline: rows whose commitment or proof decodes to a point
+outside the r-torsion are answered False WITHOUT touching the device —
+the rearrangement relies on bilinearity of the reduced ate pairing,
+which only holds on the proper subgroups (and eip4844's
+validate_kzg_g1 demands the subgroup check anyway). The host oracle
+`verify_single` accepts such points; feeding it one is a caller bug,
+not a conformance surface.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..crypto import fr
+from ..crypto.bls.curve import (
+    DeserializationError,
+    Point,
+    g1_from_bytes,
+    g1_generator,
+    g2_to_bytes,
+)
+from ..crypto.kzg import TrustedSetup, commit_point
+from . import tower
+from .bls_jax import _run_checks, run_checks_sharded
+
+__all__ = [
+    "verify_kzg_proof_batch",
+    "verify_kzg_proof_batch_sharded",
+    "check_multi_kzg_proof_batch",
+    "check_multi_kzg_proof_batch_sharded",
+    "clear_caches",
+]
+
+
+@functools.lru_cache(maxsize=16384)
+def _g1_checked(data: bytes) -> Optional[Point]:
+    """Compressed G1 -> validated Point (curve + r-torsion), or None.
+    Infinity decodes to the infinity Point (legal, handled per-row)."""
+    try:
+        pt = g1_from_bytes(data)
+    except DeserializationError:
+        return None
+    if not pt.is_infinity and not pt.in_subgroup():
+        return None
+    return pt
+
+
+@functools.lru_cache(maxsize=64)
+def _g2_limbs_cached(g2_bytes: bytes):
+    """Fixed-Q limb form, keyed by the canonical compressed encoding so
+    distinct TrustedSetup instances with equal points share an entry."""
+    from ..crypto.bls.curve import g2_from_bytes
+
+    pt = g2_from_bytes(g2_bytes)
+    x, y = pt.affine()
+    return tower.fq2_to_limbs_mont(x), tower.fq2_to_limbs_mont(y)
+
+
+def _g1_limbs(pt: Point):
+    x, y = pt.affine()
+    return tower.fq_to_limbs_mont(int(x)), tower.fq_to_limbs_mont(int(y))
+
+
+def clear_caches() -> None:
+    _g1_checked.cache_clear()
+    _g2_limbs_cached.cache_clear()
+
+
+_Check = Optional[List[Tuple[object, object]]]
+
+
+def _fixed_q_row(lhs: Point, w_pt: Point, s_g2_limbs, forced: dict, idx: int,
+                 rows: List[_Check]) -> None:
+    """Append the row [(lhs, G2), (-W, [s^k]G2)] — or resolve it on the
+    host when a point at infinity degenerates a pair (nondegeneracy of
+    the reduced pairing on the subgroups makes both cases exact):
+
+    - W infinite: the second pair contributes 1, so the check holds iff
+      lhs is infinite (e(lhs, G2) == 1 iff lhs == inf for subgroup lhs).
+    - lhs infinite, W not: e(-W, [s^k]G2) != 1 always (s^k != 0), False.
+    """
+    from ..crypto.bls.curve import g2_generator
+
+    if w_pt.is_infinity:
+        forced[idx] = lhs.is_infinity
+        rows.append(None)
+        return
+    if lhs.is_infinity:
+        forced[idx] = False
+        rows.append(None)
+        return
+    g2x, g2y = _g2_limbs_cached(g2_to_bytes(g2_generator()))
+    rows.append([
+        (_g1_limbs(lhs), (g2x, g2y)),
+        (_g1_limbs(w_pt.neg()), s_g2_limbs),
+    ])
+
+
+def _single_rows(commitments: Sequence[bytes], proofs: Sequence[bytes],
+                 xs: Sequence[int], ys: Sequence[int],
+                 setup: TrustedSetup) -> Tuple[List[_Check], dict]:
+    assert len(commitments) == len(proofs) == len(xs) == len(ys)
+    s_g2 = _g2_limbs_cached(g2_to_bytes(setup.g2_powers[1]))
+    g1 = g1_generator()
+    rows: List[_Check] = []
+    forced: dict = {}
+    for i, (c_b, w_b, x, y) in enumerate(zip(commitments, proofs, xs, ys)):
+        c_pt = _g1_checked(bytes(c_b))
+        w_pt = _g1_checked(bytes(w_b))
+        if c_pt is None or w_pt is None:
+            rows.append(None)  # malformed/off-curve/out-of-subgroup
+            continue
+        x, y = x % fr.MODULUS, y % fr.MODULUS
+        lhs = c_pt.add(g1.mul((fr.MODULUS - y) % fr.MODULUS)).add(w_pt.mul(x))
+        _fixed_q_row(lhs, w_pt, s_g2, forced, i, rows)
+    return rows, forced
+
+
+def _coset_rows(commitments: Sequence[bytes], proofs: Sequence[bytes],
+                x0s: Sequence[int], yss: Sequence[Sequence[int]],
+                setup: TrustedSetup) -> Tuple[List[_Check], dict]:
+    assert len(commitments) == len(proofs) == len(x0s) == len(yss)
+    if not yss:
+        return [], {}
+    m = len(yss[0])
+    assert m and m & (m - 1) == 0, "coset size must be a power of two"
+    assert all(len(ys) == m for ys in yss), "one coset size per dispatch"
+    s_m_g2 = _g2_limbs_cached(g2_to_bytes(setup.g2_powers[m]))
+    w = fr.root_of_unity(m)
+    rows: List[_Check] = []
+    forced: dict = {}
+    for i, (c_b, w_b, x0, ys) in enumerate(zip(commitments, proofs, x0s, yss)):
+        c_pt = _g1_checked(bytes(c_b))
+        w_pt = _g1_checked(bytes(w_b))
+        if c_pt is None or w_pt is None:
+            rows.append(None)
+            continue
+        x0 = x0 % fr.MODULUS
+        xs, acc = [], x0
+        for _ in range(m):
+            xs.append(acc)
+            acc = acc * w % fr.MODULUS
+        i_poly = fr.interpolate_on_domain(xs, [y % fr.MODULUS for y in ys])
+        lhs = c_pt.add(commit_point(i_poly, setup).neg()).add(w_pt.mul(pow(x0, m, fr.MODULUS)))
+        _fixed_q_row(lhs, w_pt, s_m_g2, forced, i, rows)
+    return rows, forced
+
+
+def _apply_forced(out: np.ndarray, forced: dict) -> np.ndarray:
+    for i, v in forced.items():
+        out[i] = v
+    return out
+
+
+def verify_kzg_proof_batch(commitments: Sequence[bytes], proofs: Sequence[bytes],
+                           xs: Sequence[int], ys: Sequence[int],
+                           setup: TrustedSetup) -> np.ndarray:
+    """Batched `crypto.kzg.verify_single`: one bool per (C, W, x, y)
+    row, all pairing work in one fixed-shape device dispatch."""
+    rows, forced = _single_rows(commitments, proofs, xs, ys, setup)
+    return _apply_forced(_run_checks(rows), forced)
+
+
+def verify_kzg_proof_batch_sharded(commitments, proofs, xs, ys, setup, mesh,
+                                   axis_name: str = "dp") -> Tuple[np.ndarray, int]:
+    """Mesh-sharded variant: rows split over `axis_name`, per-row mask
+    plus a psum'd accepted-count, like bls_jax.run_checks_sharded — the
+    count covers only device-adjudicated rows; host-resolved rows
+    (infinities, malformed bytes) appear in the mask alone."""
+    rows, forced = _single_rows(commitments, proofs, xs, ys, setup)
+    mask, count = run_checks_sharded(rows, mesh, axis_name)
+    return _apply_forced(mask, forced), count
+
+
+def check_multi_kzg_proof_batch(commitments: Sequence[bytes], proofs: Sequence[bytes],
+                                x0s: Sequence[int], yss: Sequence[Sequence[int]],
+                                setup: TrustedSetup) -> np.ndarray:
+    """Batched `crypto.kzg.check_multi_kzg_proof` (the DAS sample check):
+    every row verifies a size-m coset opening; all rows of a dispatch
+    must share m (DAS fixes m per config, das-core.md:131)."""
+    rows, forced = _coset_rows(commitments, proofs, x0s, yss, setup)
+    return _apply_forced(_run_checks(rows), forced)
+
+
+def check_multi_kzg_proof_batch_sharded(commitments, proofs, x0s, yss, setup, mesh,
+                                        axis_name: str = "dp") -> Tuple[np.ndarray, int]:
+    """Sharded coset batch; returns (mask, device_accepted_count) like
+    the single-point sharded variant."""
+    rows, forced = _coset_rows(commitments, proofs, x0s, yss, setup)
+    mask, count = run_checks_sharded(rows, mesh, axis_name)
+    return _apply_forced(mask, forced), count

@@ -162,18 +162,52 @@ def sample_data(slot, shard, extended_data):
     ]
 
 
-def verify_sample(sample, sample_count, commitment):
-    """(das-core.md:177-184). The sample's coset starts at
-    x = w_n^rbo(index): rbo_list(sample.data)[j] is the evaluation at
-    natural domain index j*sample_count + rbo(index) — exactly the coset
-    x·<w_n^sample_count> that check_multi_kzg_proof walks."""
+def sample_coset_opening(sample, sample_count):
+    """(x0, ys) claimed by `sample`: its coset starts at
+    x0 = w_n^rbo(index) — rbo_list(sample.data)[j] is the evaluation at
+    natural domain index j*sample_count + rbo(index), exactly the coset
+    x0·<w_n^sample_count> that check_multi_kzg_proof walks. ONE
+    derivation shared by the scalar and batched verifiers (they must
+    never disagree on the coset convention)."""
     from consensus_specs_tpu.crypto import fr as _fr
 
     n = int(sample_count) * int(POINTS_PER_SAMPLE)  # noqa: F821
     domain_pos = reverse_bit_order(int(sample.index), int(sample_count))
-    x = pow(_fr.root_of_unity(n), domain_pos, _fr.MODULUS)
-    ys = reverse_bit_order_list(sample.data)
+    x0 = pow(_fr.root_of_unity(n), domain_pos, _fr.MODULUS)
+    return x0, reverse_bit_order_list(sample.data)
+
+
+def verify_sample(sample, sample_count, commitment):
+    """(das-core.md:177-184)"""
+    x, ys = sample_coset_opening(sample, sample_count)
     assert check_multi_kzg_proof(commitment.point, sample.proof, x, ys)
+
+
+def verify_samples(samples, sample_count, commitment):
+    """Batched verify_sample — a validator's whole per-slot sampling
+    responsibility (das-core.md:177-184 specifies only the scalar check)
+    adjudicated in ONE fixed-shape device pairing dispatch
+    (ops/kzg_jax.check_multi_kzg_proof_batch): per-sample host work is a
+    size-m interpolation commitment, all pairing FLOPs are batched.
+    Raises AssertionError if any sample fails (matching verify_sample)."""
+    from consensus_specs_tpu.ops import kzg_jax as _kzg_jax
+
+    samples = list(samples)
+    if not samples:
+        return
+    x0s, yss = [], []
+    for sample in samples:
+        x0, ys = sample_coset_opening(sample, sample_count)
+        x0s.append(x0)
+        yss.append(ys)
+    ok = _kzg_jax.check_multi_kzg_proof_batch(
+        [bytes(commitment.point)] * len(samples),
+        [bytes(sample.proof) for sample in samples],
+        x0s,
+        yss,
+        _setup(),
+    )
+    assert bool(ok.all()), f"samples failed verification: {[i for i, v in enumerate(ok) if not v]}"
 
 
 def reconstruct_extended_data(samples):

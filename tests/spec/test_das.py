@@ -61,6 +61,27 @@ class TestSamples:
         with pytest.raises(AssertionError):
             spec.verify_sample(bad, len(samples), comm)
 
+    def test_verify_samples_batched_all(self, spec, extended):
+        """verify_samples: the whole sample set through ONE batched
+        device pairing dispatch (TPU-first; scalar path above)."""
+        _, ext = extended
+        samples = spec.sample_data(3, 1, ext)
+        poly = spec.ifft(spec.reverse_bit_order_list(ext))
+        comm = spec.DataCommitment(point=spec.commit_to_data(poly), samples_count=len(samples))
+        spec.verify_samples(samples, len(samples), comm)
+        spec.verify_samples([], len(samples), comm)  # vacuous batch
+
+    def test_verify_samples_batched_names_bad_row(self, spec, extended):
+        _, ext = extended
+        samples = spec.sample_data(3, 1, ext)
+        poly = spec.ifft(spec.reverse_bit_order_list(ext))
+        comm = spec.DataCommitment(point=spec.commit_to_data(poly), samples_count=len(samples))
+        bad = samples[1].copy()
+        bad.data[0] = (int(bad.data[0]) + 1) % spec.MODULUS
+        batch = [samples[0], bad] + list(samples[2:])
+        with pytest.raises(AssertionError, match=r"\[1\]"):
+            spec.verify_samples(batch, len(samples), comm)
+
     def test_wrong_proof_rejected(self, spec, extended):
         # NOTE: swapping two samples' proofs is NOT a negative test here —
         # for extended data of degree < 2*POINTS_PER_SAMPLE every coset

@@ -1,0 +1,184 @@
+"""Device-batched KZG verification tests (ops/kzg_jax): parity with the
+host oracle (crypto/kzg.verify_single / check_multi_kzg_proof), edge
+and adversarial rows, and the mesh-sharded variant on the virtual
+8-device CPU mesh. The reference ships no KZG batch verifier at all
+(its sharding/DAS specs leave the setup "TBD"); these tests pin the
+TPU-first design: every pairing rides the fixed-Q 2-pairing kernel."""
+import numpy as np
+import jax
+import pytest
+from jax.sharding import Mesh
+
+from consensus_specs_tpu.crypto import fr, kzg
+from consensus_specs_tpu.crypto.bls.curve import (
+    g1_generator,
+    g1_to_bytes,
+    g1_infinity,
+)
+from consensus_specs_tpu.ops import kzg_jax
+
+RNG = np.random.default_rng(0x5E7)
+SETUP = kzg.insecure_setup(64)
+
+
+def _rand_poly(deg):
+    return [int.from_bytes(RNG.bytes(32), "big") % fr.MODULUS for _ in range(deg)]
+
+
+def _single_workload(n, deg=8):
+    """n valid (commitment, proof, x, y) rows over random polynomials."""
+    commitments, proofs, xs, ys = [], [], [], []
+    for _ in range(n):
+        coeffs = _rand_poly(deg)
+        c = kzg.commit(coeffs, SETUP)
+        x = int.from_bytes(RNG.bytes(32), "big") % fr.MODULUS
+        y, w = kzg.open_single(coeffs, x, SETUP)
+        commitments.append(c)
+        proofs.append(w)
+        xs.append(x)
+        ys.append(y)
+    return commitments, proofs, xs, ys
+
+
+# -- single-point batch -------------------------------------------------------
+
+def test_valid_batch_all_true_and_host_parity():
+    commitments, proofs, xs, ys = _single_workload(6)
+    out = kzg_jax.verify_kzg_proof_batch(commitments, proofs, xs, ys, SETUP)
+    assert out.shape == (6,) and bool(np.all(out))
+    for c, w, x, y in zip(commitments, proofs, xs, ys):
+        assert kzg.verify_single(c, w, x, y, SETUP)
+
+
+def test_tampered_rows_false_exactly():
+    commitments, proofs, xs, ys = _single_workload(5)
+    ys[1] = (ys[1] + 1) % fr.MODULUS                # wrong claimed value
+    proofs[2] = proofs[0]                           # proof for another poly
+    commitments[3] = kzg.commit(_rand_poly(4), SETUP)  # wrong commitment
+    out = kzg_jax.verify_kzg_proof_batch(commitments, proofs, xs, ys, SETUP)
+    assert out.tolist() == [True, False, False, False, True]
+    # host oracle agrees row-by-row
+    for i, (c, w, x, y) in enumerate(zip(commitments, proofs, xs, ys)):
+        assert kzg.verify_single(c, w, x, y, SETUP) == bool(out[i])
+
+
+def test_malformed_and_offcurve_rows_false_without_raising():
+    commitments, proofs, xs, ys = _single_workload(4)
+    commitments[0] = b"\x00" * 48          # no compression flag
+    proofs[1] = b"\xc0" + b"\x11" * 47     # infinity flag with set body bits
+    commitments[2] = b"\x8f" + b"\xff" * 47  # x not in field
+    out = kzg_jax.verify_kzg_proof_batch(commitments, proofs, xs, ys, SETUP)
+    assert out.tolist() == [False, False, False, True]
+
+
+def test_constant_polynomial_infinity_proof():
+    """p(X) = c: the witness (p - y)/(X - x) is the zero polynomial, so
+    the proof is the point at infinity and the check degenerates to
+    C == [y]G1 — the host-resolved row (kzg_jax._fixed_q_row)."""
+    c_val = int.from_bytes(RNG.bytes(32), "big") % fr.MODULUS
+    commitment = kzg.commit([c_val], SETUP)
+    x = 12345
+    y, proof = kzg.open_single([c_val], x, SETUP)
+    assert y == c_val and kzg.verify_single(commitment, proof, x, y, SETUP)
+    out = kzg_jax.verify_kzg_proof_batch(
+        [commitment, commitment], [proof, proof], [x, x], [y, (y + 1) % fr.MODULUS], SETUP
+    )
+    assert out.tolist() == [True, False]
+
+
+def test_infinity_commitment_zero_polynomial():
+    """The zero polynomial commits to infinity; any x with y=0 and an
+    infinity proof verifies (lhs and W both infinite)."""
+    inf = g1_to_bytes(g1_infinity())
+    out = kzg_jax.verify_kzg_proof_batch([inf, inf], [inf, inf], [7, 7], [0, 1], SETUP)
+    assert out.tolist() == [True, False]
+
+
+def test_out_of_subgroup_point_rejected():
+    """An on-curve point outside the r-torsion: the device path must
+    refuse it (bilinearity doesn't hold off-subgroup) — row False."""
+    # cofactor-search: x with a curve point whose order isn't r
+    from consensus_specs_tpu.crypto.bls.curve import g1_point
+    from consensus_specs_tpu.crypto.bls.fields import Fq, P as FP
+
+    pt = None
+    x_try = 1
+    while pt is None:
+        x = Fq(x_try)
+        rhs = x * x.square() + Fq(4)
+        y = rhs.sqrt()
+        if y is not None:
+            cand = g1_point(x, y)
+            if not cand.in_subgroup():
+                pt = cand
+        x_try += 1
+    bad = g1_to_bytes(pt)
+    commitments, proofs, xs, ys = _single_workload(1)
+    out = kzg_jax.verify_kzg_proof_batch(
+        [bad, commitments[0]], [proofs[0], bad], [xs[0], xs[0]], [ys[0], ys[0]], SETUP
+    )
+    assert out.tolist() == [False, False]
+
+
+def test_single_batch_sharded_matches_unsharded():
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip("needs the virtual 8-device mesh")
+    mesh = Mesh(np.array(devices[:8]), ("dp",))
+    commitments, proofs, xs, ys = _single_workload(5)
+    ys[2] = (ys[2] + 3) % fr.MODULUS
+    want = kzg_jax.verify_kzg_proof_batch(commitments, proofs, xs, ys, SETUP)
+    got, count = kzg_jax.verify_kzg_proof_batch_sharded(commitments, proofs, xs, ys, SETUP, mesh)
+    assert np.array_equal(np.asarray(got), want)
+    assert want.tolist() == [True, True, False, True, True]
+    assert count == 4  # the psum'd accepted-count over the mesh axis
+
+
+# -- coset multi-proof batch (the DAS sample shape) ---------------------------
+
+def _coset_workload(n, m=8, deg=16):
+    commitments, proofs, x0s, yss = [], [], [], []
+    for _ in range(n):
+        coeffs = _rand_poly(deg)
+        c = kzg.commit(coeffs, SETUP)
+        x0 = int.from_bytes(RNG.bytes(32), "big") % fr.MODULUS
+        w = fr.root_of_unity(m)
+        xs, acc = [], x0
+        for _ in range(m):
+            xs.append(acc)
+            acc = acc * w % fr.MODULUS
+        ys, proof = kzg.open_multi(coeffs, xs, SETUP)
+        commitments.append(c)
+        proofs.append(proof)
+        x0s.append(x0)
+        yss.append(ys)
+    return commitments, proofs, x0s, yss
+
+
+def test_coset_batch_valid_and_tampered():
+    commitments, proofs, x0s, yss = _coset_workload(4)
+    out = kzg_jax.check_multi_kzg_proof_batch(commitments, proofs, x0s, yss, SETUP)
+    assert bool(np.all(out))
+    # host oracle parity on the same rows
+    for c, w, x0, ys in zip(commitments, proofs, x0s, yss):
+        assert kzg.check_multi_kzg_proof(c, w, x0, ys, SETUP)
+    yss[0] = [(yss[0][0] + 1) % fr.MODULUS] + list(yss[0][1:])
+    proofs[3] = proofs[1]
+    out = kzg_jax.check_multi_kzg_proof_batch(commitments, proofs, x0s, yss, SETUP)
+    assert out.tolist() == [False, True, True, False]
+    assert not kzg.check_multi_kzg_proof(commitments[0], proofs[0], x0s[0], yss[0], SETUP)
+
+
+def test_coset_batch_sharded_matches_unsharded():
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip("needs the virtual 8-device mesh")
+    mesh = Mesh(np.array(devices[:8]), ("dp",))
+    commitments, proofs, x0s, yss = _coset_workload(3, m=4)
+    want = kzg_jax.check_multi_kzg_proof_batch(commitments, proofs, x0s, yss, SETUP)
+    got, count = kzg_jax.check_multi_kzg_proof_batch_sharded(
+        commitments, proofs, x0s, yss, SETUP, mesh
+    )
+    assert np.array_equal(np.asarray(got), want)
+    assert bool(np.all(want))
+    assert count == 3

@@ -687,6 +687,49 @@ def bench_generation() -> None:
     )
 
 
+def bench_kzg() -> None:
+    """Device-batched KZG proof verification (ops/kzg_jax) — the
+    eip4844/DAS/sharding workload the reference doesn't implement at all
+    (its trusted setups are "TBD"): 128 single-point proofs adjudicated
+    in one fixed-Q pairing dispatch vs the host pairing oracle sampled
+    per-proof. The fixed-G2 rearrangement buckets the rows into the SAME
+    compiled (B, K) pairing shapes the BLS sections use, so with a warm
+    cache this section is pure dispatch + host row prep."""
+    from consensus_specs_tpu.crypto import fr, kzg
+    from consensus_specs_tpu.ops import kzg_jax
+
+    n = 128
+    setup = kzg.insecure_setup(64)
+    rng = np.random.default_rng(11)
+    t0 = time.monotonic()
+    commitments, proofs, xs, ys = [], [], [], []
+    for _ in range(n):
+        coeffs = [int.from_bytes(rng.bytes(32), "big") % fr.MODULUS for _ in range(8)]
+        commitments.append(kzg.commit(coeffs, setup))
+        x = int.from_bytes(rng.bytes(32), "big") % fr.MODULUS
+        y, w = kzg.open_single(coeffs, x, setup)
+        xs.append(x)
+        ys.append(y)
+        proofs.append(w)
+    _note(f"kzg: {n} proofs prepared in {time.monotonic() - t0:.1f}s")
+
+    ok = kzg_jax.verify_kzg_proof_batch(commitments, proofs, xs, ys, setup)  # warm-up
+    assert bool(np.all(ok)), "device kzg batch verify failed on valid proofs"
+    t0 = time.perf_counter()
+    ok = kzg_jax.verify_kzg_proof_batch(commitments, proofs, xs, ys, setup)
+    t_dev = time.perf_counter() - t0
+    assert bool(np.all(ok))
+    RESULTS["kzg_batch_verifies_per_sec"] = round(n / t_dev, 2)
+
+    sample = 2
+    t0 = time.perf_counter()
+    for i in range(sample):
+        assert kzg.verify_single(commitments[i], proofs[i], xs[i], ys[i], setup)
+    host_rate = sample / (time.perf_counter() - t0)
+    RESULTS["kzg_host_verifies_per_sec"] = round(host_rate, 3)
+    RESULTS["kzg_batch_speedup"] = round((n / t_dev) / host_rate, 2) if t_dev else None
+
+
 def _device_alive(timeout_s: int = 90) -> bool:
     """Open the device in a DISPOSABLE CHILD first: a wedged tunnel (hung
     server-side compile / dead worker) blocks `jax.devices()` forever,
@@ -761,6 +804,7 @@ SECTIONS = {
     "generation": bench_generation,
     "sync_aggregate": bench_sync_aggregate_mainnet,
     "hash": bench_hash,
+    "kzg": bench_kzg,
     "incremental_reroot": bench_incremental_reroot,
     "pallas_probe": bench_pallas_probe,
     "host_fallback": bench_host_fallback,
@@ -827,10 +871,48 @@ def main() -> None:
         run("incremental_reroot", 30, 90)
     else:
         run("bls", (220, 800), 950)
-        run("block_mainnet", (90, 150), 280)
-        run("generation", (150, 260), 420)
-        run("sync_aggregate", (90, 220), 320)
-        run("hash", (70, 120), 200)
+        # transient tunnel errors (e.g. `remote_compile: response body
+        # closed`) kill the cold compile mid-flight and leave the cache
+        # cold, which would doom EVERY later device section to a cold
+        # compile inside a warm-sized cap (the round-5 calibration run
+        # died exactly this way). One retry of the headline section —
+        # budget permitting — both recovers the metric and warms the
+        # cache for everyone after. Attempt-1 diagnostics move to
+        # *_attempt1 keys so the retry can't erase them (and so the time
+        # accounting keeps both attempts).
+        if RESULTS.get("value") is None and "bls" not in RESULTS.get("skipped_sections", []):
+            err1 = RESULTS.get("section_errors", {}).pop("bls", None)
+            dt1 = RESULTS["section_seconds"].pop("bls", None)
+            if err1 is not None:
+                RESULTS.setdefault("section_errors", {})["bls_attempt1"] = err1
+            if dt1 is not None:
+                RESULTS["section_seconds"]["bls_attempt1"] = dt1
+            _note("bls produced no headline value — retrying once")
+            # force the COLD estimate: after a mid-compile death the
+            # cache holds partial entries, so _cache_is_warm() would
+            # admit a doomed retry under the warm estimate and burn the
+            # budget host_fallback needs (the whole-run failure mode).
+            # A skipped retry still leaves budget for host-side truth.
+            run("bls", 800, 950)
+        # gate on the headline value, NOT on _cache_is_warm(): a compile
+        # that died mid-flight leaves PARTIAL cache entries, so a
+        # non-empty .jax_cache does not mean the big pairing graphs are
+        # in it — only a successful bls section proves that
+        if RESULTS.get("value") is not None:
+            run("block_mainnet", (90, 150), 280)
+            run("generation", (150, 260), 420)
+            run("sync_aggregate", (90, 220), 320)
+            run("hash", (70, 120), 200)
+            run("kzg", (40, 90), 150)
+        else:
+            # no successful device BLS pass (failed attempts and/or a
+            # budget-skipped retry — section_errors/skipped_sections say
+            # which): a cold block_mainnet/generation pass cannot fit its
+            # warm-sized cap, so don't burn the remaining budget on
+            # doomed sections — record the host-side truth instead.
+            _note("no headline BLS value after retry — host-only numbers")
+            RESULTS["device_compile_failed"] = True
+            run("host_fallback", 60, 300)
         run("incremental_reroot", 30, 90)
         if os.environ.get("BENCH_PALLAS") == "1":
             run("pallas_probe", 75, 85)

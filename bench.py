@@ -844,10 +844,14 @@ def main() -> None:
     )
     reserve = 15.0
 
-    def run(name: str, est_s, cap_s: float) -> None:
+    def run(name: str, est_s, cap_s: float, keep_s: float = 0.0) -> None:
+        """keep_s: budget this section may NOT consume — reserved so a
+        failing device section can never starve the host-side fallback
+        (the round-5 failure: two blown bls attempts left -10s and the
+        run ended with an empty scoreboard)."""
         if isinstance(est_s, tuple):  # (warm, cold) — the bls child warms
             est_s = est_s[0] if _cache_is_warm() else est_s[1]  # the cache for everyone after
-        rem = _remaining() - reserve
+        rem = _remaining() - reserve - keep_s
         if rem < est_s:
             _note(f"SKIP {name}: remaining {rem:.0f}s < estimate {est_s:.0f}s")
             RESULTS.setdefault("skipped_sections", []).append(name)
@@ -870,7 +874,8 @@ def main() -> None:
         run("host_fallback", 60, 300)
         run("incremental_reroot", 30, 90)
     else:
-        run("bls", (220, 800), 950)
+        host_keep = 150.0  # host_fallback + incremental_reroot stay fundable
+        run("bls", (220, 800), 950, keep_s=host_keep)
         # transient tunnel errors (e.g. `remote_compile: response body
         # closed`) kill the cold compile mid-flight and leave the cache
         # cold, which would doom EVERY later device section to a cold
@@ -893,7 +898,7 @@ def main() -> None:
             # admit a doomed retry under the warm estimate and burn the
             # budget host_fallback needs (the whole-run failure mode).
             # A skipped retry still leaves budget for host-side truth.
-            run("bls", 800, 950)
+            run("bls", 800, 950, keep_s=host_keep)
         # gate on the headline value, NOT on _cache_is_warm(): a compile
         # that died mid-flight leaves PARTIAL cache entries, so a
         # non-empty .jax_cache does not mean the big pairing graphs are

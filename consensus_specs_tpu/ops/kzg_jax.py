@@ -68,6 +68,7 @@ __all__ = [
     "verify_kzg_proof_batch_sharded",
     "check_multi_kzg_proof_batch",
     "check_multi_kzg_proof_batch_sharded",
+    "pairing_product_is_one_batch",
     "clear_caches",
 ]
 
@@ -229,3 +230,29 @@ def check_multi_kzg_proof_batch_sharded(commitments, proofs, x0s, yss, setup, me
     rows, forced = _coset_rows(commitments, proofs, x0s, yss, setup)
     mask, count = run_checks_sharded(rows, mesh, axis_name)
     return _apply_forced(mask, forced), count
+
+
+def pairing_product_is_one_batch(checks: Sequence[Sequence[Tuple[Point, Point]]]) -> np.ndarray:
+    """Generic batched `pairing_product(pairs).is_one()` (the host form,
+    crypto/bls/pairing.py): one bool per check, each check a list of
+    (G1 Point, G2 Point) pairs, all Miller loops and final
+    exponentiations in bucketed device dispatches. Pairs with an
+    infinity member contribute 1 (exactly like the host pairing's
+    infinity short-circuit); a check whose every pair degenerates is
+    True. Used by the sharding degree-proof batch
+    (specs/sharding.py verify_degree_proofs); callers own subgroup
+    validation of their inputs, as with the host pairing."""
+    rows: List[_Check] = []
+    forced: dict = {}
+    for i, pairs in enumerate(checks):
+        row = []
+        for p, q in pairs:
+            if p.is_infinity or q.is_infinity:
+                continue  # contributes the identity
+            row.append((_g1_limbs(p), _g2_limbs_cached(g2_to_bytes(q))))
+        if not row:
+            forced[i] = True  # empty product == 1
+            rows.append(None)
+        else:
+            rows.append(row)
+    return _apply_forced(_run_checks(rows), forced)

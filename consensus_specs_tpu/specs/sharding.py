@@ -512,15 +512,11 @@ def process_attested_shard_work(state: "BeaconState", attestation: "Attestation"
             )
 
 
-def verify_degree_proof(body_summary: "ShardBlobBodySummary") -> None:
-    """The KZG degree bound (sharding/beacon-chain.md:706-719 + prose at
-    :760-766): for points_count committed values, the degree proof commits
-    B(X)·X^(MAX_DEGREE+1-points_count), so pairing the proof with G2^0
-    must equal pairing the commitment with G2^(MAX_DEGREE+1-points_count)
-    = G2_SETUP[-points_count] — impossible to construct if deg(B) >=
-    points_count."""
+def _degree_proof_pairs(body_summary: "ShardBlobBodySummary"):
+    """The degree bound's pairing-product rows — ONE derivation shared by
+    the scalar and batched verifiers: e(proof, G2[0]) ==
+    e(commitment, G2[-points_count]) as a product-is-one check."""
     from consensus_specs_tpu.crypto.bls.curve import g1_from_bytes
-    from consensus_specs_tpu.crypto.bls.pairing import pairing_product
 
     points_count = int(body_summary.commitment.samples_count) * int(POINTS_PER_SAMPLE)
     if points_count == 0:
@@ -528,11 +524,55 @@ def verify_degree_proof(body_summary: "ShardBlobBodySummary") -> None:
     assert points_count <= len(G2_SETUP)
     proof_pt = g1_from_bytes(bytes(body_summary.degree_proof))
     commit_pt = g1_from_bytes(bytes(body_summary.commitment.point))
-    # e(proof, G2[0]) == e(commitment, G2[-points_count]) as a product check
-    assert pairing_product([
+    return [
         (proof_pt, G2_SETUP[0]),
         (commit_pt.neg(), G2_SETUP[len(G2_SETUP) - points_count] if points_count else G2_SETUP[0]),
-    ]).is_one()
+    ]
+
+
+def verify_degree_proof(body_summary: "ShardBlobBodySummary") -> None:
+    """The KZG degree bound (sharding/beacon-chain.md:706-719 + prose at
+    :760-766): for points_count committed values, the degree proof commits
+    B(X)·X^(MAX_DEGREE+1-points_count), so pairing the proof with G2^0
+    must equal pairing the commitment with G2^(MAX_DEGREE+1-points_count)
+    = G2_SETUP[-points_count] — impossible to construct if deg(B) >=
+    points_count."""
+    from consensus_specs_tpu.crypto.bls.pairing import pairing_product
+
+    assert pairing_product(_degree_proof_pairs(body_summary)).is_one()
+
+
+def verify_degree_proofs(body_summaries) -> None:
+    """Batched verify_degree_proof — every shard header of a block
+    adjudicated in one bucketed device pairing dispatch
+    (ops/kzg_jax.pairing_product_is_one_batch; TPU-first, the scalar
+    check above is the reference shape). Raises AssertionError naming
+    the failing rows. A row whose points are malformed (undecodable
+    bytes, failed structural asserts) or outside the r-torsion is
+    REJECTED as failing rather than aborting the batch — the device
+    kernel's fast final exponentiation is only exact on the subgroups,
+    so off-subgroup inputs never reach it."""
+    from consensus_specs_tpu.ops import kzg_jax as _kzg_jax
+
+    body_summaries = list(body_summaries)
+    if not body_summaries:
+        return
+    ok = [False] * len(body_summaries)
+    rows, live = [], []
+    for i, bs in enumerate(body_summaries):
+        try:
+            pairs = _degree_proof_pairs(bs)
+            for p, _q in pairs:
+                assert p.is_infinity or p.in_subgroup(), "G1 point outside the r-torsion"
+        except Exception:
+            continue  # malformed row: stays False, batch proceeds
+        rows.append(pairs)
+        live.append(i)
+    if rows:
+        res = _kzg_jax.pairing_product_is_one_batch(rows)
+        for j, i in enumerate(live):
+            ok[i] = bool(res[j])
+    assert all(ok), f"degree proofs failed: {[i for i, v in enumerate(ok) if not v]}"
 
 
 def process_shard_header(state: "BeaconState", signed_header: "SignedShardBlobHeader") -> None:  # noqa: F821

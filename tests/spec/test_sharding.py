@@ -108,6 +108,48 @@ class TestDegreeProof:
         summary = spec.ShardBlobBodySummary(commitment=commitment, degree_proof=degree_proof)
         spec.verify_degree_proof(summary)  # must not raise
 
+    def test_degree_proofs_batched(self):
+        """verify_degree_proofs: all headers' degree bounds in one
+        bucketed device pairing dispatch (TPU-first, scalar path above);
+        a lying row fails the batch and is named in the error."""
+        from consensus_specs_tpu.specs import build_spec
+
+        spec = build_spec(SHARDING, "minimal")
+        summaries = []
+        for n_samples in (1, 2, 2):
+            _, commitment, degree_proof = make_committed_blob(spec, n_samples=n_samples)
+            summaries.append(
+                spec.ShardBlobBodySummary(commitment=commitment, degree_proof=degree_proof)
+            )
+        spec.verify_degree_proofs(summaries)  # must not raise
+        spec.verify_degree_proofs([])  # vacuous batch
+
+        _, commitment2, degree_proof2 = make_committed_blob(spec, n_samples=2)
+        summaries.insert(
+            1,
+            spec.ShardBlobBodySummary(
+                commitment=spec.DataCommitment(point=commitment2.point, samples_count=1),
+                degree_proof=degree_proof2,
+            ),
+        )
+        with pytest.raises(AssertionError, match=r"\[1\]"):
+            spec.verify_degree_proofs(summaries)
+
+    def test_degree_proofs_batched_malformed_row_contained(self):
+        """Undecodable proof bytes fail THEIR row (named in the error)
+        without aborting adjudication of the rest of the batch."""
+        from consensus_specs_tpu.specs import build_spec
+
+        spec = build_spec(SHARDING, "minimal")
+        _, commitment, degree_proof = make_committed_blob(spec, n_samples=2)
+        good = spec.ShardBlobBodySummary(commitment=commitment, degree_proof=degree_proof)
+        bad = spec.ShardBlobBodySummary(
+            commitment=commitment, degree_proof=b"\x01" * 48  # no compression flag
+        )
+        spec.verify_degree_proofs([good])  # sanity: good row passes alone
+        with pytest.raises(AssertionError, match=r"\[0\]"):
+            spec.verify_degree_proofs([bad, good])
+
     def test_overdegree_rejected(self):
         from consensus_specs_tpu.specs import build_spec
 

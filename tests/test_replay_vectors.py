@@ -1,0 +1,102 @@
+"""Round-trip conformance: vectors emitted by the generator pipeline
+must replay clean through tools/replay_vectors (the in-tree client-side
+consumer), and a corrupted post state must be caught as a divergence —
+the emission→consumption loop validated end-to-end (the reference has
+no consumer at all; client teams roll their own)."""
+from __future__ import annotations
+
+import pathlib
+import tempfile
+
+import pytest
+
+from consensus_specs_tpu.generators.gen_from_tests import generate_from_tests
+from consensus_specs_tpu.generators.gen_runner import run_generator
+from consensus_specs_tpu.generators.gen_typing import TestProvider
+from consensus_specs_tpu.utils import snappy
+from tools.replay_vectors import replay_tree
+
+
+def _generate(out_dir: str) -> pathlib.Path:
+    """A small two-runner corpus: operations/attestation (ssz + meta
+    parts, expected-failure cases, always_bls cases) and sanity/slots
+    (yaml data part)."""
+    import tests.spec.test_operations_attestation as ops_src
+    import tests.spec.test_sanity_slots as slots_src
+
+    def cases(runner, handler, src):
+        def make():
+            yield from generate_from_tests(
+                runner_name=runner,
+                handler_name=handler,
+                src=src,
+                fork_name="phase0",
+                preset_name="minimal",
+                bls_active=False,
+            )
+        return make
+
+    run_generator(
+        "operations",
+        [TestProvider(prepare=lambda: None,
+                      make_cases=cases("operations", "attestation", ops_src))],
+        args=["-o", out_dir],
+    )
+    run_generator(
+        "sanity",
+        [TestProvider(prepare=lambda: None,
+                      make_cases=cases("sanity", "slots", slots_src))],
+        args=["-o", out_dir],
+    )
+    return pathlib.Path(out_dir)
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    with tempfile.TemporaryDirectory() as out:
+        yield _generate(out)
+
+
+def test_emitted_corpus_replays_clean(corpus):
+    ok, failed, unsupported, incomplete = replay_tree(corpus)
+    assert failed == [], failed
+    assert unsupported == 0 and incomplete == 0
+    # both runners contributed: attestation ops incl. expected-failure
+    # cases, and the yaml-part slots format
+    assert ok >= 10
+    assert any((corpus / "minimal/phase0/sanity/slots").rglob("slots.yaml"))
+
+
+def test_corrupted_post_is_caught(corpus):
+    d = corpus / "minimal/phase0/operations/attestation/pyspec_tests/success"
+    post_path = d / "post.ssz_snappy"
+    original = post_path.read_bytes()
+    raw = bytearray(snappy.decompress(original))
+    raw[-1] ^= 0xFF
+    post_path.write_bytes(snappy.compress(bytes(raw)))
+    try:
+        _ok, failed, _unsupported, _incomplete = replay_tree(corpus)
+        assert len(failed) == 1 and "success" in failed[0][0], failed
+        assert "mismatch" in failed[0][1]
+    finally:
+        post_path.write_bytes(original)
+
+
+def test_missing_expected_failure_is_caught(corpus):
+    """A vector that ships NO post but replays successfully must be
+    reported (the 'expected failure never happened' divergence)."""
+    base = corpus / "minimal/phase0/operations/attestation/pyspec_tests"
+    good = base / "success"
+    clone = base / "zz_tampered_no_post"
+    clone.mkdir()
+    try:
+        for part in good.iterdir():
+            if part.name != "post.ssz_snappy":
+                (clone / part.name).write_bytes(part.read_bytes())
+        _ok, failed, _unsupported, _incomplete = replay_tree(corpus)
+        assert len(failed) == 1 and "zz_tampered_no_post" in failed[0][0], failed
+        assert "no post" in failed[0][1]
+    finally:
+        import shutil
+
+        shutil.rmtree(clone)

@@ -1,0 +1,238 @@
+"""Conformance CONSUMER for generated test vectors — the client side of
+the test-format contract (docs/formats/). The reference project only
+EMITS vectors (gen_helpers/gen_base/gen_runner.py); client teams write
+the replayer themselves. This one closes the loop in-tree: it walks a
+generated output directory, decodes every part from bytes, re-runs the
+claimed transition through the spec's PUBLIC API, and demands
+bit-identity with the emitted post state (or failure where no post is
+shipped). Any spec bug frozen into a pinned vector at emission time
+surfaces here as a decode/replay divergence.
+
+Usage:
+    python tools/replay_vectors.py <output-dir> [--bls auto|on|off]
+
+Exit status 0 iff every supported case replays clean. Unsupported
+runner formats are counted and reported, never silently dropped.
+
+Format contract per runner (docs/formats/<runner>/README.md):
+- operations/<handler>: pre + <op-part> [+ post]; apply the handler's
+  process_* function; no post means the processor MUST raise.
+- epoch_processing/<handler>: pre + post; apply process_<handler>.
+- sanity/slots: pre + slots.yaml + post; process_slots.
+- sanity/blocks, sanity/multi_operations, finality/finality,
+  random/random: pre + blocks_<i> [+ post]; full state_transition per
+  block; no post => some block MUST be rejected.
+- forks/fork: pre (previous fork's state) + post (this fork's state);
+  apply upgrade_to_<fork>.
+
+bls_setting meta (docs/formats README): 1 = replay MUST verify
+signatures, 2 = must skip them, absent/0 = either (an explicit --bls
+on/off overrides only the optional cases).
+"""
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from consensus_specs_tpu.specs.build import build_spec  # noqa: E402
+from consensus_specs_tpu.utils import snappy  # noqa: E402
+
+# operations/<handler> -> (part name, spec container attr, processor attr)
+OPERATION_HANDLERS = {
+    "attestation": ("attestation", "Attestation", "process_attestation"),
+    "attester_slashing": ("attester_slashing", "AttesterSlashing", "process_attester_slashing"),
+    "block_header": ("block", "BeaconBlock", "process_block_header"),
+    "deposit": ("deposit", "Deposit", "process_deposit"),
+    "proposer_slashing": ("proposer_slashing", "ProposerSlashing", "process_proposer_slashing"),
+    "voluntary_exit": ("voluntary_exit", "SignedVoluntaryExit", "process_voluntary_exit"),
+    "sync_aggregate": ("sync_aggregate", "SyncAggregate", "process_sync_aggregate"),
+    "execution_payload": ("execution_payload", "ExecutionPayload", "process_execution_payload"),
+    "withdrawals": ("execution_payload", "ExecutionPayload", "process_withdrawals"),
+    "bls_to_execution_change": ("address_change", "SignedBLSToExecutionChange",
+                                "process_bls_to_execution_change"),
+}
+
+# forks/fork vectors: the path's <fork> is the POST fork; pre decodes
+# with its predecessor's BeaconState
+PREVIOUS_FORK = {"altair": "phase0", "bellatrix": "altair", "capella": "bellatrix"}
+
+
+def _read_part_ssz(case_dir: pathlib.Path, name: str, typ):
+    data = snappy.decompress((case_dir / f"{name}.ssz_snappy").read_bytes())
+    return typ.decode_bytes(data)
+
+
+def _read_yaml(path: pathlib.Path):
+    import yaml
+
+    with open(path) as f:
+        return yaml.safe_load(f)
+
+
+def _post_bytes(case_dir: pathlib.Path):
+    p = case_dir / "post.ssz_snappy"
+    return snappy.decompress(p.read_bytes()) if p.exists() else None
+
+
+class _ReplayEngine:
+    """ExecutionEngine stub honoring the execution.yaml meta part —
+    exactly what a client harness wires for bellatrix vectors."""
+
+    def __init__(self, valid: bool):
+        self.valid = valid
+
+    def notify_new_payload(self, payload) -> bool:
+        return self.valid
+
+
+# Spec REJECTION surface: what a conforming state transition raises on
+# invalid input (assert failures + uint/bounds errors from spec code).
+# Anything else escaping a replay is a HARNESS error (missing part,
+# undecodable pre state, corrupt corpus) and must never be mistaken
+# for the vector's expected failure.
+_REJECTION_ERRORS = (AssertionError, ValueError, IndexError, OverflowError)
+
+
+def _replay_case(runner, handler, fork, preset, case_dir, bls_mode):
+    """Returns None on success, an error string on divergence."""
+    from consensus_specs_tpu.crypto import bls
+
+    spec = build_spec(fork, preset)
+    meta = _read_yaml(case_dir / "meta.yaml") if (case_dir / "meta.yaml").exists() else {}
+
+    bls_setting = int(meta.get("bls_setting", 0))
+    bls_on = {1: True, 2: False}.get(bls_setting, bls_mode == "on")
+
+    post = _post_bytes(case_dir)
+
+    # ---- prepare: decode every input part. Errors here are HARNESS
+    # errors (corrupt/incomplete corpus, unknown handler), reported as
+    # failures or unsupported — never as the vector's expected rejection.
+    if runner == "operations":
+        if handler not in OPERATION_HANDLERS:
+            raise NotImplementedError(f"operations/{handler}")
+        part, typ_name, proc_name = OPERATION_HANDLERS[handler]
+        state = _read_part_ssz(case_dir, "pre", spec.BeaconState)
+        op = _read_part_ssz(case_dir, part, getattr(spec, typ_name))
+        proc = getattr(spec, proc_name)
+        if handler == "execution_payload":
+            engine = _ReplayEngine(bool(_read_yaml(case_dir / "execution.yaml")["execution_valid"]))
+            run = lambda: (proc(state, op, engine), state)[1]  # noqa: E731
+        else:
+            run = lambda: (proc(state, op), state)[1]  # noqa: E731
+    elif runner == "epoch_processing":
+        state = _read_part_ssz(case_dir, "pre", spec.BeaconState)
+        step = getattr(spec, f"process_{handler}")
+        run = lambda: (step(state), state)[1]  # noqa: E731
+    elif runner == "sanity" and handler == "slots":
+        state = _read_part_ssz(case_dir, "pre", spec.BeaconState)
+        slots = int(_read_yaml(case_dir / "slots.yaml"))
+        run = lambda: (spec.process_slots(state, state.slot + slots), state)[1]  # noqa: E731
+    elif (runner, handler) in (("sanity", "blocks"), ("sanity", "multi_operations"),
+                               ("finality", "finality"), ("random", "random")):
+        state = _read_part_ssz(case_dir, "pre", spec.BeaconState)
+        blocks = [
+            _read_part_ssz(case_dir, f"blocks_{i}", spec.SignedBeaconBlock)
+            for i in range(int(meta["blocks_count"]))
+        ]
+
+        def run(state=state, blocks=blocks):
+            for block in blocks:
+                spec.state_transition(state, block)
+            return state
+    elif runner == "forks":
+        if fork not in PREVIOUS_FORK:
+            raise NotImplementedError(f"forks/{fork}")
+        pre_spec = build_spec(PREVIOUS_FORK[fork], preset)
+        state = _read_part_ssz(case_dir, "pre", pre_spec.BeaconState)
+        run = lambda: getattr(spec, f"upgrade_to_{fork}")(state)  # noqa: E731
+    else:
+        raise NotImplementedError(f"{runner}/{handler}")
+
+    # ---- replay: only the spec's own rejection surface may count as
+    # the expected failure
+    prev = bls.bls_active
+    bls.bls_active = bls_on
+    try:
+        try:
+            out_state = run()
+        except _REJECTION_ERRORS as e:
+            if post is None:
+                return None  # failure expected and delivered
+            return f"replay raised {type(e).__name__}: {e} (post state was expected)"
+    finally:
+        bls.bls_active = prev
+
+    if post is None:
+        return "replay succeeded but the vector ships no post state"
+    got = out_state.encode_bytes()
+    if got != post:
+        offset = next(
+            (i for i, (a, b) in enumerate(zip(got, post)) if a != b),
+            min(len(got), len(post)),
+        )
+        return (f"post mismatch: first divergent byte at offset {offset} "
+                f"({len(got)} bytes replayed vs {len(post)} emitted; "
+                f"replayed hash_tree_root {bytes(out_state.hash_tree_root()).hex()})")
+    return None
+
+
+def replay_tree(root: pathlib.Path, bls_mode: str = "auto"):
+    """Walk <root>/<preset>/<fork>/<runner>/<handler>/<suite>/<case>/.
+    Returns (ok, failed_list, unsupported, incomplete). A part-bearing
+    directory at the wrong depth is a FAILURE (mispointed root or layout
+    drift must never read as an empty-but-green corpus), and a harness
+    error inside a case (missing part, undecodable pre) is that case's
+    failure, never its expected rejection."""
+    ok, failed, unsupported, incomplete = 0, [], 0, 0
+    case_dirs = {p.parent for p in root.rglob("meta.yaml")}
+    case_dirs |= {p.parent for p in root.rglob("*.ssz_snappy")}
+    for case_dir in sorted(case_dirs):
+        rel = case_dir.relative_to(root)
+        if len(rel.parts) != 6:
+            failed.append((str(rel), f"unexpected layout depth {len(rel.parts)} "
+                           "(want preset/fork/runner/handler/suite/case)"))
+            continue
+        preset, fork, runner, handler, _suite, _case = rel.parts
+        if (case_dir / "INCOMPLETE").exists():
+            incomplete += 1
+            continue
+        try:
+            err = _replay_case(runner, handler, fork, preset, case_dir, bls_mode)
+        except NotImplementedError:
+            unsupported += 1
+            continue
+        except Exception as e:
+            failed.append((str(rel), f"harness error {type(e).__name__}: {e}"))
+            continue
+        if err is None:
+            ok += 1
+        else:
+            failed.append((str(rel), err))
+    return ok, failed, unsupported, incomplete
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("output_dir", type=pathlib.Path)
+    parser.add_argument("--bls", choices=("auto", "on", "off"), default="auto",
+                        help="signature policy for cases whose bls_setting is optional")
+    ns = parser.parse_args()
+
+    ok, failed, unsupported, incomplete = replay_tree(ns.output_dir, ns.bls)
+    print(f"replayed OK: {ok}; failed: {len(failed)}; "
+          f"unsupported format: {unsupported}; incomplete skipped: {incomplete}")
+    for rel, err in failed:
+        print(f"FAIL {rel}: {err}")
+    if ok == 0 and not failed:
+        print("ERROR: no replayable cases found under the given directory")
+        return 1
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

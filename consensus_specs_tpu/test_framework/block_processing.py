@@ -17,10 +17,14 @@ def transition_unsigned_block(spec, state, block):
 def state_transition_and_sign_block(spec, state, block, expect_fail=False):
     """Apply the block to ``state``, fill in its state root, and return the
     signed block (ref state.py:60-90). With ``expect_fail`` the transition
-    must raise and state is left at the pre-block slot."""
+    must raise, state is left at the pre-block slot, and the SIGNED
+    invalid block is still returned — expected-failure vectors must ship
+    the block a replaying client is supposed to reject (returning None
+    here emitted block-less invalid sanity vectors; caught by
+    tools/replay_vectors)."""
     if expect_fail:
         expect_assertion_error(lambda: transition_unsigned_block(spec, state.copy(), block))
-        return None
+        return sign_block(spec, state, block)
     transition_unsigned_block(spec, state, block)
     block.state_root = spec.hash_tree_root(state)
     return sign_block(spec, state, block)

@@ -176,16 +176,17 @@ def run_slash_and_exit(spec, state, slash_index, exit_index, valid=True):
     yield "post", state if valid else None
 
 
-def build_full_house_block(spec, state, rng):
+def build_full_house_block(spec, state, rng, deposits):
     """A next-slot block carrying: 1 proposer slashing, 1 attester
-    slashing, attestations, `MAX_DEPOSITS` deposits, and 1 voluntary
-    exit — every family at once, targeting disjoint validators. Returns
-    (block, touched) where `touched` maps family -> validator indices."""
+    slashing, attestations, the pre-provisioned `deposits`, and 1
+    voluntary exit — every family at once, targeting disjoint
+    validators. Returns (block, touched) where `touched` maps family ->
+    validator indices. Deposits MUST be provisioned by the caller
+    BEFORE any vector part is emitted: deposits_for re-points
+    state.eth1_data, and a pre state snapshotted before that re-point
+    can never validate the block's deposit proofs (emission bug caught
+    by tools/replay_vectors)."""
     (ps_pool, as_pool, exit_pool) = draw_pools(spec, state, rng, [1, 1, 1])
-
-    # deposits FIRST: they re-point state.eth1_data, and the block's
-    # parent root snapshots the state root at build time
-    deposits = deposits_for(spec, state, int(spec.MAX_DEPOSITS))
     block = build_empty_block_for_next_slot(spec, state)
     block.body.proposer_slashings = proposer_slashings_for(spec, state, ps_pool)
     block.body.attester_slashings = attester_slashings_for(spec, state, as_pool)
@@ -206,8 +207,11 @@ def run_full_house_test(spec, state, rng):
     next_epoch(spec, state)  # gives attestations a full epoch to target
     pre_validators = len(state.validators)
 
+    # provision the deposit tree BEFORE the pre snapshot: the emitted
+    # pre state must carry the eth1_data the block's proofs verify under
+    deposits = deposits_for(spec, state, int(spec.MAX_DEPOSITS))
     yield "pre", state
-    block, touched = build_full_house_block(spec, state, rng)
+    block, touched = build_full_house_block(spec, state, rng, deposits)
     signed = state_transition_and_sign_block(spec, state, block)
     yield "blocks", [signed]
     yield "post", state
@@ -228,18 +232,18 @@ def run_full_house_test(spec, state, rng):
         assert len(state.current_epoch_attestations) + len(state.previous_epoch_attestations) > 0
 
 
-def random_operations_block(spec, state, rng):
+def random_operations_block(spec, state, rng, deposits):
     """The randomized matrix hook: sample how much of each family to
-    carry (possibly zero), honoring block capacity limits."""
+    carry (possibly zero), honoring block capacity limits. `deposits`
+    must be pre-provisioned by the caller before the pre snapshot (see
+    build_full_house_block)."""
     n_ps = rng.randint(0, min(2, int(spec.MAX_PROPOSER_SLASHINGS)))
     n_as_targets = rng.randint(0, 2)
     n_att = rng.randint(0, 3)
-    n_dep = rng.randint(0, int(spec.MAX_DEPOSITS))
     n_exit = rng.randint(0, 1)
 
     ps_pool, as_pool, exit_pool = draw_pools(spec, state, rng, [n_ps, n_as_targets, n_exit])
 
-    deposits = deposits_for(spec, state, n_dep) if n_dep else []
     block = build_empty_block_for_next_slot(spec, state)
     block.body.proposer_slashings = proposer_slashings_for(spec, state, ps_pool)
     block.body.attester_slashings = attester_slashings_for(spec, state, as_pool)
@@ -259,8 +263,11 @@ def run_random_operations_test(spec, state, rng):
     """A seeded random full-mix block applied as a sanity transition."""
     age_for_exits(spec, state)
     next_epoch(spec, state)
+    # deposit count drawn + tree provisioned BEFORE the pre snapshot
+    n_dep = rng.randint(0, int(spec.MAX_DEPOSITS))
+    deposits = deposits_for(spec, state, n_dep) if n_dep else []
     yield "pre", state
-    block = random_operations_block(spec, state, rng)
+    block = random_operations_block(spec, state, rng, deposits)
     signed = state_transition_and_sign_block(spec, state, block)
     yield "blocks", [signed]
     yield "post", state

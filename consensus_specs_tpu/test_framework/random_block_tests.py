@@ -123,8 +123,14 @@ def _maybe_voluntary_exit(spec, state, rng, slashed: set):
     return prepare_signed_exits(spec, state, [rng.choice(eligible)])[0]
 
 
-def _maybe_deposits(spec, state, rng):
-    """Occasionally add fresh full deposits (new registry entries)."""
+def provision_scenario_deposits(spec, state, rng):
+    """Occasionally provision fresh full deposits for the scenario —
+    called BEFORE the pre snapshot (deposits_for re-points
+    state.eth1_data; a pre state captured earlier could never validate
+    the proofs — emission bug caught by tools/replay_vectors). The
+    expected-deposit-count rule (process_operations) then REQUIRES the
+    next block to include them all, so the first block drains the
+    queue."""
     if rng.random() > 0.2:
         return []
     from .multi_operations import deposits_for
@@ -145,14 +151,15 @@ def _advance_past_slashed_proposers(spec, state):
     raise AssertionError("no unslashed proposer found in two epochs")
 
 
-def build_random_block(spec, state, rng, slashed: set):
+def build_random_block(spec, state, rng, slashed: set, deposit_queue: list):
     """A valid block with a random operation mix: attestations plus
-    (probabilistically) attester/proposer slashings, fresh deposits, a
-    voluntary exit, and a random-participation sync aggregate (altair+)."""
+    (probabilistically) attester/proposer slashings, any pending
+    pre-provisioned deposits (drained in full — the expected-count rule
+    demands it), a voluntary exit, and a random-participation sync
+    aggregate (altair+)."""
     _advance_past_slashed_proposers(spec, state)
-    # deposits FIRST: they re-point state.eth1_data, and the block's
-    # parent root snapshots the state root at build time
-    deposits = _maybe_deposits(spec, state, rng)
+    deposits = list(deposit_queue)
+    deposit_queue.clear()
     block = build_empty_block_for_next_slot(spec, state)
     for att in _random_attestations(spec, state, rng):
         block.body.attestations.append(att)
@@ -220,12 +227,23 @@ _expand_matrix()
 def run_random_scenario(spec, state, scenario_name, seed):
     rng = Random(seed)
     randomize_state(spec, state, rng)
+    steps = list(SCENARIOS[scenario_name])
+    # leading "age" steps are PRE-STATE SHAPING, not replayable chain
+    # history: the raw slot bump skips epoch processing, so it must
+    # happen before the pre snapshot or a replaying client (which runs
+    # real process_slots up to the first block) lands on a different
+    # state (caught by tools/replay_vectors)
+    while steps and steps[0] == "age":
+        state.slot += spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH
+        steps.pop(0)
+    # any deposit tree re-point must pre-date the pre snapshot too
+    deposit_queue = provision_scenario_deposits(spec, state, rng)
 
     yield "pre", state
 
     blocks = []
     slashed: set = set()
-    for step in SCENARIOS[scenario_name]:
+    for step in steps:
         if step == "next_slot":
             next_slot(spec, state)
         elif step == "next_epoch":
@@ -248,12 +266,10 @@ def run_random_scenario(spec, state, scenario_name, seed):
             for _ in range(int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 3):
                 next_epoch(spec, state)
             assert spec.is_in_inactivity_leak(state)
-        elif step == "age":
-            # jump past the minimum-service window (cheap slot bump, the
-            # established idiom) so voluntary exits become drawable
-            state.slot += spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH
+        elif step == "age":  # pragma: no cover - peeled before the pre yield
+            raise ValueError("'age' is only valid as a leading step (pre-state shaping)")
         elif step == "block":
-            block = build_random_block(spec, state, rng, slashed)
+            block = build_random_block(spec, state, rng, slashed, deposit_queue)
             signed = state_transition_and_sign_block(spec, state, block)
             blocks.append(signed)
         else:  # pragma: no cover

@@ -190,14 +190,14 @@ def test_bad_source_root(spec, state):
 def test_too_many_aggregation_bits(spec, state):
     attestation = get_valid_attestation(spec, state, signed=True)
     next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
-    # one too many bits
-    def add_bit():
-        attestation.aggregation_bits._bits.append(False)
-        spec.process_attestation(state, attestation)
+    # one too many bits — BEFORE the part yields: the vector must carry
+    # the malformed bitlist or a replaying client sees a valid attestation
+    # with no post state (caught by tools/replay_vectors)
+    attestation.aggregation_bits._bits.append(False)
 
     yield "pre", state
     yield "attestation", attestation
-    expect_assertion_error(add_bit)
+    expect_assertion_error(lambda: spec.process_attestation(state, attestation))
     yield "post", None
 
 
@@ -207,14 +207,12 @@ def test_too_few_aggregation_bits(spec, state):
     attestation = get_valid_attestation(spec, state)
     next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
     sign_attestation(spec, state, attestation)
-
-    def drop_bit():
-        attestation.aggregation_bits._bits.pop()
-        spec.process_attestation(state, attestation)
+    # drop a bit BEFORE the part yields (see test_too_many_aggregation_bits)
+    attestation.aggregation_bits._bits.pop()
 
     yield "pre", state
     yield "attestation", attestation
-    expect_assertion_error(drop_bit)
+    expect_assertion_error(lambda: spec.process_attestation(state, attestation))
     yield "post", None
 
 

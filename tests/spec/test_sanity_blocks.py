@@ -33,6 +33,7 @@ from consensus_specs_tpu.test_framework.proposer_slashings import (
 )
 from consensus_specs_tpu.test_framework.random_block_tests import (
     build_random_block,
+    provision_scenario_deposits,
     randomize_state,
 )
 from consensus_specs_tpu.test_framework.state import (
@@ -885,9 +886,12 @@ def _run_full_random_operations(spec, state, rng):
     # move out of the genesis slot and bury the randomization in history
     next_slot(spec, state)
     randomize_state(spec, state, rng)
+    # deposit provisioning re-points eth1_data: must pre-date the pre
+    # snapshot (tools/replay_vectors contract)
+    deposit_queue = provision_scenario_deposits(spec, state, rng)
     yield "pre", state
     slashed = {i for i, v in enumerate(state.validators) if v.slashed}
-    block = build_random_block(spec, state, rng, slashed)
+    block = build_random_block(spec, state, rng, slashed, deposit_queue)
     signed_block = state_transition_and_sign_block(spec, state, block)
     yield "blocks", [signed_block]
     yield "post", state

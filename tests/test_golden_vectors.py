@@ -109,7 +109,10 @@ FULL_HOUSE_BLOCK_FILES = {
     "blocks_0.ssz_snappy": "8bcfef5c566982e202b69249f431bbbabfdac08e4146ced4ef8e5b4410081191",
     "meta.yaml": "4588ab38526fcf529b5c25a6600efeaaa60d07432961d551e5ad4de968a7a59e",
     "post.ssz_snappy": "5ce8af86bb40591bf2d36be52186e07aaeaad0e9506e3412c820eba700523377",
-    "pre.ssz_snappy": "7bde517b21b4b31d0b56cfae22070e3d2b974002036c28498dec5c7240066749",
+    # pre re-pinned 2026-07-31: deposit-tree provisioning moved BEFORE the
+    # pre snapshot (the old pre could never validate the block's deposit
+    # proofs — found by tools/replay_vectors); blocks_0/meta/post unchanged
+    "pre.ssz_snappy": "f230a95d039fd64d76a430bc0dd334e5c95a42ab512f25d7d75ea68ffc5e8920",
 }
 
 

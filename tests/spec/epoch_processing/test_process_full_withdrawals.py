@@ -21,6 +21,7 @@ def set_validator_withdrawable(spec, state, index, withdrawable_epoch=None):
 def run_process_full_withdrawals(spec, state, num_expected_withdrawals):
     pre_withdrawal_index = int(state.withdrawal_index)
     pre_queue_len = len(state.withdrawals_queue)
+    pre_balances = {int(i): int(b) for i, b in enumerate(state.balances)}
     to_be_withdrawn = [
         index
         for index, validator in enumerate(state.validators)
@@ -35,6 +36,19 @@ def run_process_full_withdrawals(spec, state, num_expected_withdrawals):
         assert state.balances[index] == 0
     assert len(state.withdrawals_queue) == pre_queue_len + num_expected_withdrawals
     assert state.withdrawal_index == pre_withdrawal_index + num_expected_withdrawals
+    # the enqueued Withdrawal RECORDS must carry the full pre-balance and
+    # the execution address from the last 20 credential bytes — not just
+    # the right queue length. The sweep walks the registry in order, so
+    # records pair with to_be_withdrawn positionally.
+    new_records = list(state.withdrawals_queue)[pre_queue_len:]
+    for validator_index, wd in zip(to_be_withdrawn, new_records):
+        assert int(wd.amount) == pre_balances[validator_index]
+        assert bytes(wd.address) == bytes(
+            state.validators[validator_index].withdrawal_credentials
+        )[12:]
+    assert [int(wd.index) for wd in new_records] == list(
+        range(pre_withdrawal_index, pre_withdrawal_index + num_expected_withdrawals)
+    )
 
 
 @with_capella_and_later
@@ -77,3 +91,18 @@ def test_all_withdrawal(spec, state):
     for index in range(len(state.validators)):
         set_validator_withdrawable(spec, state, index)
     yield from run_process_full_withdrawals(spec, state, len(state.validators))
+
+
+@with_capella_and_later
+@spec_state_test
+def test_bls_credentials_not_withdrawable(spec, state):
+    """A withdrawable_epoch in the past is NOT sufficient: the sweep only
+    claims eth1-credentialed validators, so the default BLS-prefixed
+    credentials keep the balance untouched (moved here from the
+    operations module — this is epoch-processing format)."""
+    state.validators[0].withdrawable_epoch = spec.get_current_epoch(state)
+    assert not spec.is_fully_withdrawable_validator(
+        state.validators[0], spec.get_current_epoch(state)
+    )
+    yield from run_process_full_withdrawals(spec, state, 0)
+    assert state.balances[0] > 0

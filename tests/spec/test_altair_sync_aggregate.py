@@ -460,17 +460,8 @@ def test_random_with_exits_and_half_participation(spec, state):
     yield from _run_participation(spec, state, _random_bits(spec, state, rng, 0.5))
 
 
-@with_altair_and_later
-@spec_state_test
-def test_sync_committee_updates_at_period_boundary(spec, state):
-    # Advance to one slot before the sync committee period boundary
-    current_period = spec.get_current_epoch(state) // spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
-    boundary_epoch = (current_period + 1) * spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
-    transition_to(spec, state, boundary_epoch * spec.SLOTS_PER_EPOCH - 1)
-
-    pre_next = state.next_sync_committee.copy()
-    yield "pre", state
-    spec.process_sync_committee_updates(state)
-    yield "post", state
-
-    assert state.current_sync_committee == pre_next
+# NOTE: sync-committee ROTATION tests live in
+# tests/spec/epoch_processing/test_process_sync_committee_updates.py —
+# they are epoch-processing format (pre+post, no operation input) and
+# emitting them under operations/sync_aggregate broke the operations
+# vector contract (caught by tools/replay_vectors).

@@ -121,7 +121,7 @@ def _install_random_fork_tests():
         globals()[name] = test_fn
 
     for i, seed in enumerate((1010, 2020, 3030, 4040)):
-        make(f"test_fork_random_{{i}}", seed)
+        make(f"test_fork_random_{i}", seed)
     make("test_fork_random_with_attestation_history", 5050, with_attestations=True)
 
 

@@ -217,37 +217,8 @@ def test_invalid_many_dequeued_incorrectly(spec, state):
     yield from run_withdrawals_processing(spec, state, payload, valid=False)
 
 
-@with_capella_and_later
-@spec_state_test
-def test_full_withdrawals_at_epoch_boundary(spec, state):
-    # make validator 0 fully withdrawable with eth1 credentials
-    index = 0
-    state.validators[index].withdrawal_credentials = (
-        bytes(spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX) + b"\x00" * 11 + b"\x11" * 20
-    )
-    state.validators[index].withdrawable_epoch = spec.get_current_epoch(state)
-    pre_balance = state.balances[index]
-    assert pre_balance > 0
-
-    yield "pre", state
-    spec.process_full_withdrawals(state)
-    yield "post", state
-
-    assert state.balances[index] == 0
-    assert len(state.withdrawals_queue) == 1
-    wd = state.withdrawals_queue[0]
-    assert wd.amount == pre_balance
-    assert bytes(wd.address) == b"\x11" * 20
-    assert state.validators[index].fully_withdrawn_epoch == spec.get_current_epoch(state)
-
-
-@with_capella_and_later
-@spec_state_test
-def test_full_withdrawals_skips_bls_credentials(spec, state):
-    # default mock credentials are BLS-prefixed: nothing is withdrawable
-    state.validators[0].withdrawable_epoch = spec.get_current_epoch(state)
-    yield "pre", state
-    spec.process_full_withdrawals(state)
-    yield "post", state
-    assert len(state.withdrawals_queue) == 0
-    assert state.balances[0] > 0
+# NOTE: the full-withdrawal SWEEP tests live in
+# tests/spec/epoch_processing/test_process_full_withdrawals.py — they
+# are epoch-processing format (pre+post, no operation input) and
+# emitting them under operations/withdrawals broke the operations
+# vector contract (caught by tools/replay_vectors).

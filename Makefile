@@ -15,8 +15,8 @@ DEVICE_TESTS = tests/test_bls_device.py tests/test_curve_device.py \
                tests/test_fq_device.py tests/test_sha256_device.py \
                tests/test_multichip.py
 
-.PHONY: test citest test-fast test-device test-mainnet lint docs generate_tests gen_% bench dryrun \
-        detect_generator_incomplete clean-vectors help
+.PHONY: test citest test-fast test-device test-mainnet lint docs generate_tests gen_% replay bench \
+        dryrun detect_generator_incomplete clean-vectors help
 
 help:
 	@echo "test                  full pytest suite (CPU, virtual 8-device mesh)"
@@ -26,6 +26,7 @@ help:
 	@echo "docs                  regenerate docs/specs/ from the executable deltas"
 	@echo "generate_tests        run every vector generator into $(TEST_VECTOR_DIR)"
 	@echo "gen_<name>            run one generator (e.g. make gen_operations)"
+	@echo "replay                replay generated vectors back through the spec (conformance consumer)"
 	@echo "bench                 run bench.py (one JSON line)"
 	@echo "dryrun                multi-chip dry-run on a virtual 8-device mesh"
 
@@ -69,6 +70,9 @@ generate_tests: $(addprefix gen_,$(GENERATORS))
 
 gen_%:
 	$(PYTHON) -m consensus_specs_tpu.generators.main --runners $* -o $(TEST_VECTOR_DIR)
+
+replay:
+	$(PYTHON) tools/replay_vectors.py $(TEST_VECTOR_DIR)
 
 bench:
 	$(PYTHON) bench.py

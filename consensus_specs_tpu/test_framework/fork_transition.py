@@ -59,6 +59,11 @@ def run_fork_transition_with_operation(spec_pre, spec_post, state, kind, before_
     yield "post_fork", "meta", spec_post.fork
     if kind == "voluntary_exit":
         state.slot += spec_pre.config.SHARD_COMMITTEE_PERIOD * spec_pre.SLOTS_PER_EPOCH
+    # deposits must be built NOW: deposits_for re-points state.eth1_data,
+    # and the pre snapshot below must carry the tree the proof verifies
+    # under (emission bug caught by tools/replay_vectors). The deposit
+    # itself is boundary-independent (tree + genesis-domain signature).
+    prebuilt = _build_boundary_operation(spec_pre, state, kind) if kind == "deposit" else None
     fork_epoch = int(spec_pre.get_current_epoch(state)) + 1
     yield "fork_epoch", "meta", fork_epoch
     yield "pre", state
@@ -67,26 +72,41 @@ def run_fork_transition_with_operation(spec_pre, spec_post, state, kind, before_
     fork_slot = fork_epoch * int(spec_pre.SLOTS_PER_EPOCH)
     assert state.slot < fork_slot
 
-    # empty pre-fork chain up to (not including) the last pre-fork slot
-    while int(state.slot) + 2 < fork_slot:
-        block = build_empty_block_for_next_slot(spec_pre, state)
-        blocks.append(state_transition_and_sign_block(spec_pre, state, block))
+    if prebuilt is not None:
+        # a pending deposit FORCES inclusion in every block (the
+        # expected-count rule, process_operations), so mirror the
+        # reference recipe: slide to the boundary by slot processing
+        # alone and let only the op-carrying block exist pre-fork
+        if int(state.slot) + 2 < fork_slot:
+            spec_pre.process_slots(state, fork_slot - 2)
+    else:
+        # empty pre-fork chain up to (not including) the last pre-fork slot
+        while int(state.slot) + 2 < fork_slot:
+            block = build_empty_block_for_next_slot(spec_pre, state)
+            blocks.append(state_transition_and_sign_block(spec_pre, state, block))
 
     # last pre-fork block — carries the op in the before_fork flavor.
     # The op is built BEFORE the block: deposits re-point state.eth1_data
     # at their tree, and the block's parent root snapshots the state root
     # at build time (a later state mutation would poison it)
     if before_fork:
-        field, operation = _build_boundary_operation(spec_pre, state, kind)
+        field, operation = prebuilt or _build_boundary_operation(spec_pre, state, kind)
         block = build_empty_block_for_next_slot(spec_pre, state)
         getattr(block.body, field).append(operation)
-    else:
+        blocks.append(state_transition_and_sign_block(spec_pre, state, block))
+    elif prebuilt is None:
         block = build_empty_block_for_next_slot(spec_pre, state)
-    blocks.append(state_transition_and_sign_block(spec_pre, state, block))
-    yield "fork_block", "meta", len(blocks) - 1
+        blocks.append(state_transition_and_sign_block(spec_pre, state, block))
+    # else: deposit-after-fork — a pending deposit makes ANY empty
+    # pre-fork block unbuildable; the first block is the post-fork one.
+    # fork_block is OPTIONAL meta (format contract: present => a
+    # pre-fork block exists), so it is omitted when no block landed
+    # before the boundary
+    if blocks:
+        yield "fork_block", "meta", len(blocks) - 1
 
     # a cross-fork attestation is authored in the PRE-fork context
-    carried = None
+    carried = prebuilt if not before_fork else None
     if not before_fork and kind == "attestation":
         carried = _build_boundary_operation(spec_pre, state, kind)
 

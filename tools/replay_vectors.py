@@ -24,6 +24,12 @@ Format contract per runner (docs/formats/<runner>/README.md):
   block; no post => some block MUST be rejected.
 - forks/fork: pre (previous fork's state) + post (this fork's state);
   apply upgrade_to_<fork>.
+- transition/<handler>: pre (previous fork) + blocks spanning the
+  boundary (fork_block meta = last pre-fork index) + post; the client
+  recipe is process_slots to the fork slot, upgrade, continue.
+- fork_choice/<handler>: anchor_state/anchor_block + steps.yaml
+  (tick/block/attestation/attester_slashing/pow_block/checks); `checks`
+  steps pin store time, head, checkpoints, and proposer boost.
 
 bls_setting meta (docs/formats README): 1 = replay MUST verify
 signatures, 2 = must skip them, absent/0 = either (an explicit --bls
@@ -97,6 +103,115 @@ class _ReplayEngine:
 _REJECTION_ERRORS = (AssertionError, ValueError, IndexError, OverflowError)
 
 
+class ReplayMismatch(Exception):
+    """A replay DIVERGENCE (failed fork-choice check, invalid step
+    accepted) — deliberately outside _REJECTION_ERRORS so it can never
+    be mistaken for a vector's expected spec rejection."""
+
+
+def _prepare_fork_choice_replay(spec, case_dir: pathlib.Path):
+    """The fork-choice steps format: anchor_state + anchor_block +
+    steps.yaml referencing block_/attestation_/attester_slashing_/
+    pow_block_ part files; `checks` steps pin store time, head,
+    checkpoints, and proposer boost (docs/formats/fork_choice)."""
+    anchor_state = _read_part_ssz(case_dir, "anchor_state", spec.BeaconState)
+    anchor_block = _read_part_ssz(case_dir, "anchor_block", spec.BeaconBlock)
+    steps = _read_yaml(case_dir / "steps.yaml")
+    parts = {}  # eager-decode every referenced object: harness errors surface now
+    for step in steps:
+        if "block" in step:
+            parts[step["block"]] = _read_part_ssz(case_dir, step["block"], spec.SignedBeaconBlock)
+        elif "attestation" in step:
+            parts[step["attestation"]] = _read_part_ssz(
+                case_dir, step["attestation"], spec.Attestation)
+        elif "attester_slashing" in step:
+            parts[step["attester_slashing"]] = _read_part_ssz(
+                case_dir, step["attester_slashing"], spec.AttesterSlashing)
+        elif "pow_block" in step:
+            parts[step["pow_block"]] = _read_part_ssz(
+                case_dir, step["pow_block"], spec.PowBlock)
+
+    def apply_maybe_invalid(label, step, fn):
+        if step.get("valid", True):
+            fn()
+        else:
+            try:
+                fn()
+            except _REJECTION_ERRORS + (KeyError,):
+                return
+            raise ReplayMismatch(f"invalid {label} step was accepted")
+
+    def run():
+        store = spec.get_forkchoice_store(anchor_state, anchor_block)
+        pow_chain = {}
+        original_get_pow = getattr(spec, "get_pow_block", None)
+        if original_get_pow is not None:
+            spec.get_pow_block = lambda block_hash: pow_chain[bytes(block_hash)]
+        try:
+            for step in steps:
+                if "tick" in step:
+                    spec.on_tick(store, int(step["tick"]))
+                elif "block" in step:
+                    sb = parts[step["block"]]
+
+                    def apply_block(sb=sb):
+                        spec.on_block(store, sb)
+                        for att in sb.message.body.attestations:
+                            spec.on_attestation(store, att, is_from_block=True)
+                        for sl in sb.message.body.attester_slashings:
+                            spec.on_attester_slashing(store, sl)
+
+                    apply_maybe_invalid("block", step, apply_block)
+                elif "attestation" in step:
+                    att = parts[step["attestation"]]
+                    apply_maybe_invalid(
+                        "attestation", step,
+                        lambda att=att: spec.on_attestation(store, att, is_from_block=False))
+                elif "attester_slashing" in step:
+                    sl = parts[step["attester_slashing"]]
+                    apply_maybe_invalid(
+                        "attester_slashing", step,
+                        lambda sl=sl: spec.on_attester_slashing(store, sl))
+                elif "pow_block" in step:
+                    pb = parts[step["pow_block"]]
+                    pow_chain[bytes(pb.block_hash)] = pb
+                elif "checks" in step:
+                    c = step["checks"]
+                    got = {}
+                    if "time" in c:
+                        got["time"] = int(store.time)
+                    if "head" in c:
+                        head = spec.get_head(store)
+                        got["head"] = {"slot": int(store.blocks[head].slot),
+                                       "root": "0x" + bytes(head).hex()}
+                    for name in ("justified_checkpoint", "finalized_checkpoint",
+                                 "best_justified_checkpoint"):
+                        if name in c:
+                            cp = getattr(store, name)
+                            got[name] = {"epoch": int(cp.epoch),
+                                         "root": "0x" + bytes(cp.root).hex()}
+                    if "proposer_boost_root" in c:
+                        got["proposer_boost_root"] = (
+                            "0x" + bytes(store.proposer_boost_root).hex())
+                    for key, want in c.items():
+                        if key not in got:
+                            # a pinned property this harness cannot compute
+                            # must never read as green
+                            raise NotImplementedError(f"fork_choice check '{key}'")
+                        if got[key] != want:
+                            raise ReplayMismatch(
+                                f"check '{key}' diverged: store has {got[key]}, "
+                                f"vector pins {want}")
+                else:
+                    raise NotImplementedError(f"fork_choice step {sorted(step)}")
+        finally:
+            if original_get_pow is not None:
+                spec.get_pow_block = original_get_pow
+        return None
+
+    return run
+
+
 def _replay_case(runner, handler, fork, preset, case_dir, bls_mode):
     """Returns None on success, an error string on divergence."""
     from consensus_specs_tpu.crypto import bls
@@ -150,6 +265,54 @@ def _replay_case(runner, handler, fork, preset, case_dir, bls_mode):
         pre_spec = build_spec(PREVIOUS_FORK[fork], preset)
         state = _read_part_ssz(case_dir, "pre", pre_spec.BeaconState)
         run = lambda: getattr(spec, f"upgrade_to_{fork}")(state)  # noqa: E731
+    elif runner == "transition":
+        # transition vectors file under the PRE fork; the target fork
+        # comes from the post_fork meta (test_framework/fork_transition)
+        post_fork_name = str(meta["post_fork"])
+        post_spec = build_spec(post_fork_name, preset)
+        fork_epoch = int(meta["fork_epoch"])
+        fork_block = int(meta.get("fork_block", -1))  # last pre-fork block index
+        state = _read_part_ssz(case_dir, "pre", spec.BeaconState)
+        blocks = [
+            _read_part_ssz(
+                case_dir, f"blocks_{i}",
+                (spec if i <= fork_block else post_spec).SignedBeaconBlock,
+            )
+            for i in range(int(meta["blocks_count"]))
+        ]
+
+        def run(state=state, blocks=blocks):
+            # the standard client recipe: pre-fork blocks under the old
+            # spec; crossing the boundary = process_slots to the fork
+            # slot (pre spec, including the boundary epoch transition),
+            # upgrade, continue under the new spec. The FIRST post-fork
+            # block lands AT the fork slot on the already-advanced
+            # state, so it applies without further slot processing
+            # (signature + block processing + state-root check — the
+            # state_transition body minus process_slots).
+            upgrade = getattr(post_spec, f"upgrade_to_{post_fork_name}")
+            fork_slot = fork_epoch * int(spec.SLOTS_PER_EPOCH)
+            upgraded = False
+            for i, block in enumerate(blocks):
+                if i > fork_block and not upgraded:
+                    if state.slot < fork_slot:
+                        spec.process_slots(state, fork_slot)
+                    state = upgrade(state)
+                    upgraded = True
+                sp = post_spec if upgraded else spec
+                if block.message.slot == state.slot:
+                    assert sp.verify_block_signature(state, block)
+                    sp.process_block(state, block.message)
+                    assert block.message.state_root == sp.hash_tree_root(state)
+                else:
+                    sp.state_transition(state, block)
+            if not upgraded:
+                if state.slot < fork_slot:
+                    spec.process_slots(state, fork_slot)
+                state = upgrade(state)
+            return state
+    elif runner == "fork_choice":
+        run = _prepare_fork_choice_replay(spec, case_dir)
     else:
         raise NotImplementedError(f"{runner}/{handler}")
 
@@ -160,13 +323,17 @@ def _replay_case(runner, handler, fork, preset, case_dir, bls_mode):
     try:
         try:
             out_state = run()
+        except ReplayMismatch as e:
+            return str(e)
         except _REJECTION_ERRORS as e:
-            if post is None:
+            if post is None and runner != "fork_choice":
                 return None  # failure expected and delivered
             return f"replay raised {type(e).__name__}: {e} (post state was expected)"
     finally:
         bls.bls_active = prev
 
+    if runner == "fork_choice":
+        return None  # adjudicated inline by its `checks` steps
     if post is None:
         return "replay succeeded but the vector ships no post state"
     got = out_state.encode_bytes()

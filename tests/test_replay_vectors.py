@@ -18,36 +18,41 @@ from tools.replay_vectors import replay_tree
 
 
 def _generate(out_dir: str) -> pathlib.Path:
-    """A small two-runner corpus: operations/attestation (ssz + meta
-    parts, expected-failure cases, always_bls cases) and sanity/slots
-    (yaml data part)."""
+    """A small four-runner corpus covering the distinct format families:
+    operations/attestation (ssz + meta parts, expected-failure cases,
+    always_bls cases), sanity/slots (yaml data part), fork_choice/
+    get_head (anchor + steps + referenced object files), and forks/fork
+    (cross-spec pre/post decode)."""
+    import tests.spec.test_fork_choice as fc_src
+    import tests.spec.test_fork_upgrade_altair as forks_src
     import tests.spec.test_operations_attestation as ops_src
     import tests.spec.test_sanity_slots as slots_src
 
-    def cases(runner, handler, src):
+    def cases(runner, handler, src, fork, phase):
         def make():
             yield from generate_from_tests(
                 runner_name=runner,
                 handler_name=handler,
                 src=src,
-                fork_name="phase0",
+                fork_name=fork,
                 preset_name="minimal",
                 bls_active=False,
+                phase=phase,
             )
         return make
 
-    run_generator(
-        "operations",
-        [TestProvider(prepare=lambda: None,
-                      make_cases=cases("operations", "attestation", ops_src))],
-        args=["-o", out_dir],
-    )
-    run_generator(
-        "sanity",
-        [TestProvider(prepare=lambda: None,
-                      make_cases=cases("sanity", "slots", slots_src))],
-        args=["-o", out_dir],
-    )
+    for runner, handler, src, fork, phase in (
+        ("operations", "attestation", ops_src, "phase0", None),
+        ("sanity", "slots", slots_src, "phase0", None),
+        ("fork_choice", "get_head", fc_src, "phase0", None),
+        ("forks", "fork", forks_src, "altair", "phase0"),
+    ):
+        run_generator(
+            runner,
+            [TestProvider(prepare=lambda: None,
+                          make_cases=cases(runner, handler, src, fork, phase))],
+            args=["-o", out_dir],
+        )
     return pathlib.Path(out_dir)
 
 
@@ -61,10 +66,38 @@ def test_emitted_corpus_replays_clean(corpus):
     ok, failed, unsupported, incomplete = replay_tree(corpus)
     assert failed == [], failed
     assert unsupported == 0 and incomplete == 0
-    # both runners contributed: attestation ops incl. expected-failure
-    # cases, and the yaml-part slots format
-    assert ok >= 10
+    # all four format families contributed: attestation ops incl.
+    # expected-failure cases, the yaml-part slots format, fork-choice
+    # steps, and the cross-spec forks decode
+    assert ok >= 20
     assert any((corpus / "minimal/phase0/sanity/slots").rglob("slots.yaml"))
+    assert any((corpus / "minimal/phase0/fork_choice").rglob("steps.yaml"))
+    assert (corpus / "minimal/altair/forks/fork/pyspec_tests").is_dir()
+
+
+def test_tampered_fork_choice_check_is_caught(corpus):
+    """Corrupting a pinned head root must fail exactly that case with a
+    check-divergence message."""
+    import yaml
+
+    base = corpus / "minimal/phase0/fork_choice/get_head/pyspec_tests"
+    case = next(d for d in sorted(base.iterdir()) if (d / "steps.yaml").exists())
+    steps_path = case / "steps.yaml"
+    original = steps_path.read_bytes()
+    steps = yaml.safe_load(original.decode())
+    for step in steps:
+        if "checks" in step and "head" in step["checks"]:
+            step["checks"]["head"]["root"] = "0x" + "ab" * 32
+            break
+    else:
+        raise AssertionError("no head check found to tamper")
+    steps_path.write_text(yaml.safe_dump(steps))
+    try:
+        _ok, failed, _unsupported, _incomplete = replay_tree(corpus)
+        assert len(failed) == 1 and case.name in failed[0][0], failed
+        assert "diverged" in failed[0][1]
+    finally:
+        steps_path.write_bytes(original)
 
 
 def test_corrupted_post_is_caught(corpus):

@@ -1,10 +1,15 @@
-"""`genesis` runner (ref: tests/generators/genesis/main.py)."""
+"""`genesis` runner (ref: tests/generators/genesis/main.py — two
+handlers, matching the reference's initialization/validity split and
+docs/formats/genesis)."""
 from ..gen_from_tests import run_state_test_generators
 
 all_mods = {
-    "phase0": {"genesis": "tests.spec.test_genesis"},
+    "phase0": {
+        "initialization": "tests.spec.test_genesis",
+        "validity": "tests.spec.test_genesis_validity",
+    },
     # bellatrix genesis adds the execution-payload-header parameter cases
-    "bellatrix": {"genesis": "tests.spec.test_genesis"},
+    "bellatrix": {"initialization": "tests.spec.test_genesis"},
 }
 
 

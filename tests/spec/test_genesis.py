@@ -1,8 +1,10 @@
-"""Genesis initialization/validity tests
-(ref: test/phase0/genesis/{test_initialization,test_validity}.py)."""
+"""Genesis initialization tests
+(ref: test/phase0/genesis/test_initialization.py; validity lives in
+test_genesis_validity.py — separate vector handler)."""
 from consensus_specs_tpu.test_framework.context import (
     BELLATRIX,
     PHASE0,
+    always_bls,
     spec_test,
     single_phase,
     with_phases,
@@ -11,6 +13,22 @@ from consensus_specs_tpu.test_framework.context import (
 )
 from consensus_specs_tpu.test_framework.deposits import build_deposit
 from consensus_specs_tpu.test_framework.keys import privkeys, pubkeys
+
+
+def emit_genesis_inputs(eth1_block_hash, eth1_timestamp, deposits,
+                        execution_payload_header=None):
+    """The genesis/initialization INPUT parts (docs/formats/genesis):
+    eth1.yaml + deposits_<i>.ssz_snappy (+ the optional payload header).
+    A consumer must be able to re-run initialize_beacon_state_from_eth1
+    from the emitted bytes alone (tools/replay_vectors does)."""
+    yield "eth1", {
+        "eth1_block_hash": "0x" + bytes(eth1_block_hash).hex(),
+        "eth1_timestamp": int(eth1_timestamp),
+    }
+    yield "deposits", deposits
+    if execution_payload_header is not None:
+        yield "execution_payload_header", execution_payload_header
+        yield "execution_payload_header", "meta", True
 
 
 def create_valid_beacon_state(spec):
@@ -52,6 +70,7 @@ def prepare_full_genesis_deposits(spec, amount, deposit_count, min_pubkey_index=
 @with_phases([PHASE0])
 @spec_test
 @single_phase
+@always_bls
 @with_presets([MINIMAL], reason="too slow")
 def test_initialize_beacon_state_from_eth1(spec, phases=None):
     deposit_count = spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT
@@ -62,8 +81,7 @@ def test_initialize_beacon_state_from_eth1(spec, phases=None):
     eth1_block_hash = b"\x12" * 32
     eth1_timestamp = spec.config.MIN_GENESIS_TIME
 
-    yield "eth1_block_hash", eth1_block_hash
-    yield "eth1_timestamp", "meta", int(eth1_timestamp)
+    yield from emit_genesis_inputs(eth1_block_hash, eth1_timestamp, deposits)
 
     # initialize beacon_state
     state = spec.initialize_beacon_state_from_eth1(eth1_block_hash, eth1_timestamp, deposits)
@@ -82,6 +100,7 @@ def test_initialize_beacon_state_from_eth1(spec, phases=None):
 @with_phases([PHASE0])
 @spec_test
 @single_phase
+@always_bls
 @with_presets([MINIMAL], reason="too slow")
 def test_initialize_beacon_state_some_small_balances(spec, phases=None):
     main_deposit_count = spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT
@@ -103,7 +122,7 @@ def test_initialize_beacon_state_some_small_balances(spec, phases=None):
     eth1_block_hash = b"\x12" * 32
     eth1_timestamp = spec.config.MIN_GENESIS_TIME
 
-    yield "eth1_block_hash", eth1_block_hash
+    yield from emit_genesis_inputs(eth1_block_hash, eth1_timestamp, deposits)
 
     state = spec.initialize_beacon_state_from_eth1(eth1_block_hash, eth1_timestamp, deposits)
 
@@ -116,38 +135,6 @@ def test_initialize_beacon_state_some_small_balances(spec, phases=None):
     assert spec.get_total_active_balance(state) == main_deposit_count * spec.MAX_EFFECTIVE_BALANCE
 
     yield "state", state
-
-
-@with_phases([PHASE0])
-@spec_test
-@single_phase
-@with_presets([MINIMAL], reason="too slow")
-def test_is_valid_genesis_state_true(spec, phases=None):
-    state = create_valid_beacon_state(spec)
-    yield "genesis", state
-    assert spec.is_valid_genesis_state(state)
-
-
-@with_phases([PHASE0])
-@spec_test
-@single_phase
-@with_presets([MINIMAL], reason="too slow")
-def test_is_valid_genesis_state_false_invalid_timestamp(spec, phases=None):
-    state = create_valid_beacon_state(spec)
-    state.genesis_time = spec.config.MIN_GENESIS_TIME - 1
-    yield "genesis", state
-    assert not spec.is_valid_genesis_state(state)
-
-
-@with_phases([PHASE0])
-@spec_test
-@single_phase
-@with_presets([MINIMAL], reason="too slow")
-def test_is_valid_genesis_state_false_not_enough_validator(spec, phases=None):
-    state = create_valid_beacon_state(spec)
-    state.validators[0].activation_epoch = spec.FAR_FUTURE_EPOCH
-    yield "genesis", state
-    assert not spec.is_valid_genesis_state(state)
 
 
 def prepare_random_genesis_deposits(spec, rng, deposit_count, min_pubkey_index=0,
@@ -179,6 +166,7 @@ def prepare_random_genesis_deposits(spec, rng, deposit_count, min_pubkey_index=0
 @with_phases([PHASE0])
 @spec_test
 @single_phase
+@always_bls
 @with_presets([MINIMAL], reason="too slow")
 def test_initialize_beacon_state_one_topup_activation(spec, phases=None):
     """A partial deposit completed by a top-up still activates at genesis."""
@@ -204,7 +192,7 @@ def test_initialize_beacon_state_one_topup_activation(spec, phases=None):
 
     eth1_block_hash = b"\x13" * 32
     eth1_timestamp = spec.config.MIN_GENESIS_TIME
-    yield "eth1_block_hash", eth1_block_hash
+    yield from emit_genesis_inputs(eth1_block_hash, eth1_timestamp, deposits)
 
     state = spec.initialize_beacon_state_from_eth1(eth1_block_hash, eth1_timestamp, deposits)
     assert spec.is_valid_genesis_state(state)
@@ -214,6 +202,7 @@ def test_initialize_beacon_state_one_topup_activation(spec, phases=None):
 @with_phases([PHASE0])
 @spec_test
 @single_phase
+@always_bls
 @with_presets([MINIMAL], reason="too slow")
 def test_initialize_beacon_state_random_invalid_genesis(spec, phases=None):
     """Too few distinct full deposits: genesis state must be invalid."""
@@ -225,7 +214,7 @@ def test_initialize_beacon_state_random_invalid_genesis(spec, phases=None):
     )
     eth1_block_hash = b"\x14" * 32
     eth1_timestamp = spec.config.MIN_GENESIS_TIME + 1
-    yield "eth1_block_hash", eth1_block_hash
+    yield from emit_genesis_inputs(eth1_block_hash, eth1_timestamp, deposits)
 
     state = spec.initialize_beacon_state_from_eth1(eth1_block_hash, eth1_timestamp, deposits)
     assert not spec.is_valid_genesis_state(state)
@@ -235,6 +224,7 @@ def test_initialize_beacon_state_random_invalid_genesis(spec, phases=None):
 @with_phases([PHASE0])
 @spec_test
 @single_phase
+@always_bls
 @with_presets([MINIMAL], reason="too slow")
 def test_initialize_beacon_state_random_valid_genesis(spec, phases=None):
     """Random deposit noise on top of a full validator set stays valid."""
@@ -256,35 +246,8 @@ def test_initialize_beacon_state_random_valid_genesis(spec, phases=None):
     deposits = random_deposits + full_deposits
     eth1_block_hash = b"\x15" * 32
     eth1_timestamp = spec.config.MIN_GENESIS_TIME + 2
-    yield "eth1_block_hash", eth1_block_hash
+    yield from emit_genesis_inputs(eth1_block_hash, eth1_timestamp, deposits)
 
-    state = spec.initialize_beacon_state_from_eth1(eth1_block_hash, eth1_timestamp, deposits)
-    assert spec.is_valid_genesis_state(state)
-    yield "state", state
-
-
-@with_phases([PHASE0])
-@spec_test
-@single_phase
-@with_presets([MINIMAL], reason="too slow")
-def test_is_valid_genesis_state_true_more_balance(spec, phases=None):
-    state = create_valid_beacon_state(spec)
-    state.validators[0].effective_balance = spec.MAX_EFFECTIVE_BALANCE + 1
-    assert spec.is_valid_genesis_state(state)
-    yield "state", state
-
-
-@with_phases([PHASE0])
-@spec_test
-@single_phase
-@with_presets([MINIMAL], reason="too slow")
-def test_is_valid_genesis_state_true_one_more_validator(spec, phases=None):
-    deposit_count = spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT + 1
-    deposits, _, _ = prepare_full_genesis_deposits(
-        spec, spec.MAX_EFFECTIVE_BALANCE, deposit_count=deposit_count, signed=True
-    )
-    eth1_block_hash = b"\x12" * 32
-    eth1_timestamp = spec.config.MIN_GENESIS_TIME
     state = spec.initialize_beacon_state_from_eth1(eth1_block_hash, eth1_timestamp, deposits)
     assert spec.is_valid_genesis_state(state)
     yield "state", state
@@ -305,12 +268,12 @@ def _bellatrix_genesis_inputs(spec):
 @with_phases([BELLATRIX])
 @spec_test
 @single_phase
+@always_bls
 @with_presets([MINIMAL], reason="too slow")
 def test_initialize_pre_transition_no_param(spec, phases=None):
     """No header passed: the chain starts pre-merge."""
     deposits, deposit_root, eth1_hash, eth1_time = _bellatrix_genesis_inputs(spec)
-    yield "eth1_block_hash", eth1_hash
-    yield "eth1_timestamp", "meta", int(eth1_time)
+    yield from emit_genesis_inputs(eth1_hash, eth1_time, deposits)
     state = spec.initialize_beacon_state_from_eth1(eth1_hash, eth1_time, deposits)
     assert state.fork.current_version == spec.config.BELLATRIX_FORK_VERSION
     assert not spec.is_merge_transition_complete(state)
@@ -321,30 +284,29 @@ def test_initialize_pre_transition_no_param(spec, phases=None):
 @with_phases([BELLATRIX])
 @spec_test
 @single_phase
+@always_bls
 @with_presets([MINIMAL], reason="too slow")
 def test_initialize_pre_transition_empty_payload(spec, phases=None):
     """An explicitly DEFAULT header is the same pre-merge start."""
     deposits, _, eth1_hash, eth1_time = _bellatrix_genesis_inputs(spec)
-    yield "eth1_block_hash", eth1_hash
-    yield "eth1_timestamp", "meta", int(eth1_time)
+    header = spec.ExecutionPayloadHeader()
+    yield from emit_genesis_inputs(eth1_hash, eth1_time, deposits,
+                                   execution_payload_header=header)
     state = spec.initialize_beacon_state_from_eth1(
-        eth1_hash, eth1_time, deposits,
-        execution_payload_header=spec.ExecutionPayloadHeader(),
+        eth1_hash, eth1_time, deposits, execution_payload_header=header
     )
     assert not spec.is_merge_transition_complete(state)
-    yield "execution_payload_header", "meta", False
     yield "state", state
 
 
 @with_phases([BELLATRIX])
 @spec_test
 @single_phase
+@always_bls
 @with_presets([MINIMAL], reason="too slow")
 def test_initialize_post_transition(spec, phases=None):
     """A real header seeds a born-merged chain."""
     deposits, _, eth1_hash, eth1_time = _bellatrix_genesis_inputs(spec)
-    yield "eth1_block_hash", eth1_hash
-    yield "eth1_timestamp", "meta", int(eth1_time)
     genesis_header = spec.ExecutionPayloadHeader(
         block_hash=b"\x30" * 32,
         parent_hash=b"\x29" * 32,
@@ -352,10 +314,11 @@ def test_initialize_post_transition(spec, phases=None):
         gas_limit=30_000_000,
         timestamp=eth1_time,
     )
+    yield from emit_genesis_inputs(eth1_hash, eth1_time, deposits,
+                                   execution_payload_header=genesis_header)
     state = spec.initialize_beacon_state_from_eth1(
         eth1_hash, eth1_time, deposits, execution_payload_header=genesis_header
     )
     assert spec.is_merge_transition_complete(state)
     assert state.latest_execution_payload_header == genesis_header
-    yield "execution_payload_header", "meta", True
     yield "state", state

@@ -1,0 +1,79 @@
+"""Genesis validity tests — the genesis/validity vector handler
+(ref: test/phase0/genesis/test_validity.py). Every case emits the
+candidate state as `genesis` plus the expected `is_valid` verdict so a
+consumer can adjudicate without running the assertions
+(docs/formats/genesis; replayed by tools/replay_vectors)."""
+from consensus_specs_tpu.test_framework.context import (
+    PHASE0,
+    spec_test,
+    single_phase,
+    with_phases,
+    with_presets,
+    MINIMAL,
+)
+
+from tests.spec.test_genesis import (
+    create_valid_beacon_state,
+    prepare_full_genesis_deposits,
+)
+
+
+def run_validity_case(spec, state):
+    yield "genesis", state
+    is_valid = bool(spec.is_valid_genesis_state(state))
+    yield "is_valid", is_valid
+    return is_valid
+
+
+@with_phases([PHASE0])
+@spec_test
+@single_phase
+@with_presets([MINIMAL], reason="too slow")
+def test_is_valid_genesis_state_true(spec, phases=None):
+    state = create_valid_beacon_state(spec)
+    assert (yield from run_validity_case(spec, state))
+
+
+@with_phases([PHASE0])
+@spec_test
+@single_phase
+@with_presets([MINIMAL], reason="too slow")
+def test_is_valid_genesis_state_false_invalid_timestamp(spec, phases=None):
+    state = create_valid_beacon_state(spec)
+    state.genesis_time = spec.config.MIN_GENESIS_TIME - 1
+    assert not (yield from run_validity_case(spec, state))
+
+
+@with_phases([PHASE0])
+@spec_test
+@single_phase
+@with_presets([MINIMAL], reason="too slow")
+def test_is_valid_genesis_state_false_not_enough_validator(spec, phases=None):
+    state = create_valid_beacon_state(spec)
+    state.validators[0].activation_epoch = spec.FAR_FUTURE_EPOCH
+    assert not (yield from run_validity_case(spec, state))
+
+
+@with_phases([PHASE0])
+@spec_test
+@single_phase
+@with_presets([MINIMAL], reason="too slow")
+def test_is_valid_genesis_state_true_more_balance(spec, phases=None):
+    state = create_valid_beacon_state(spec)
+    state.validators[0].effective_balance = spec.MAX_EFFECTIVE_BALANCE + 1
+    assert (yield from run_validity_case(spec, state))
+
+
+@with_phases([PHASE0])
+@spec_test
+@single_phase
+@with_presets([MINIMAL], reason="too slow")
+def test_is_valid_genesis_state_true_one_more_validator(spec, phases=None):
+    deposit_count = spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT + 1
+    deposits, _, _ = prepare_full_genesis_deposits(
+        spec, spec.MAX_EFFECTIVE_BALANCE, deposit_count=deposit_count, signed=True
+    )
+    eth1_block_hash = b"\x12" * 32
+    eth1_timestamp = spec.config.MIN_GENESIS_TIME
+    state = spec.initialize_beacon_state_from_eth1(eth1_block_hash, eth1_timestamp, deposits)
+    assert (yield from run_validity_case(spec, state))

@@ -109,6 +109,109 @@ class ReplayMismatch(Exception):
     be mistaken for a vector's expected spec rejection."""
 
 
+# runners whose cases adjudicate INLINE (via ReplayMismatch) and ship no
+# post state; a spec rejection escaping one of these is a failure, never
+# the vector's expected outcome
+_INLINE_RUNNERS = {"fork_choice", "rewards", "shuffling", "bls",
+                   "ssz_generic", "ssz_static", "merkle"}
+
+
+def _prepare_bls_replay(handler: str, data: dict):
+    """The bls runner's {input, output} contract: output null means the
+    operation MUST refuse (zero privkey, empty aggregation). Inputs are
+    decoded EAGERLY so a corrupt data.yaml is a harness error — only the
+    crypto call itself may produce the expected refusal."""
+    from consensus_specs_tpu.crypto.bls import ciphersuite
+
+    def b(h):
+        return bytes.fromhex(h[2:])
+
+    inp, want = data["input"], data["output"]
+    if handler == "sign":
+        args = (int.from_bytes(b(inp["privkey"]), "big"), b(inp["message"]))
+        op = lambda: "0x" + ciphersuite.Sign(*args).hex()  # noqa: E731
+    elif handler == "verify":
+        args = (b(inp["pubkey"]), b(inp["message"]), b(inp["signature"]))
+        op = lambda: bool(ciphersuite.Verify(*args))  # noqa: E731
+    elif handler == "aggregate":
+        sigs = [b(s) for s in inp]
+        op = lambda: "0x" + ciphersuite.Aggregate(sigs).hex()  # noqa: E731
+    elif handler == "fast_aggregate_verify":
+        args = ([b(p) for p in inp["pubkeys"]], b(inp["message"]), b(inp["signature"]))
+        op = lambda: bool(ciphersuite.FastAggregateVerify(*args))  # noqa: E731
+    elif handler == "aggregate_verify":
+        args = ([b(p) for p in inp["pubkeys"]],
+                [b(m) for m in inp["messages"]], b(inp["signature"]))
+        op = lambda: bool(ciphersuite.AggregateVerify(*args))  # noqa: E731
+    else:
+        raise NotImplementedError(f"bls/{handler}")
+
+    def run():
+        try:
+            got = op()
+        except Exception:
+            got = None if want is None or isinstance(want, str) else False
+        if got != want:
+            raise ReplayMismatch(f"bls {handler}: got {got!r}, vector pins {want!r}")
+        return None
+
+    return run
+
+
+def _prepare_ssz_generic_replay(handler: str, case_name: str, suite: str,
+                                case_dir: pathlib.Path):
+    """ssz_generic: valid cases must decode + re-encode byte-stable with
+    the pinned root; invalid cases must refuse to decode. The concrete
+    types are the format's own declarations (runners/ssz_generic)."""
+    from consensus_specs_tpu.generators.runners.ssz_generic import (
+        CONTAINER_TYPES,
+        UINT_TYPES,
+    )
+    from consensus_specs_tpu.ssz import Bitlist, Bitvector, Vector, boolean, uint8, uint16, uint64
+
+    def resolve():
+        if handler == "uints":
+            return next(t for t in UINT_TYPES
+                        if case_name.startswith(f"uint_{8 * t.type_byte_length()}_"))
+        if handler == "boolean":
+            return boolean
+        if handler == "basic_vector":
+            _, elem_name, length, *_ = case_name.split("_")
+            elem = {"uint8": uint8, "uint16": uint16, "uint64": uint64}[elem_name]
+            return Vector[elem, int(length)]
+        if handler == "bitvector":
+            return Bitvector[int(case_name.split("_")[1])]
+        if handler == "bitlist":
+            return Bitlist[int(case_name.split("_")[1])]
+        if handler == "containers":
+            return next(t for t in CONTAINER_TYPES if case_name.startswith(t.__name__))
+        raise NotImplementedError(f"ssz_generic/{handler}")
+
+    typ = resolve()
+    serialized = snappy.decompress((case_dir / "serialized.ssz_snappy").read_bytes())
+    meta = (_read_yaml(case_dir / "meta.yaml")
+            if (case_dir / "meta.yaml").exists() else {})
+
+    def run():
+        if suite == "invalid":
+            try:
+                typ.decode_bytes(serialized)
+            except (ValueError, TypeError, AssertionError, IndexError):
+                return None
+            raise ReplayMismatch("invalid encoding was accepted")
+        obj = typ.decode_bytes(serialized)
+        if obj.encode_bytes() != serialized:
+            raise ReplayMismatch("valid case does not round-trip byte-stable")
+        want_root = meta.get("root")
+        if want_root is not None:
+            got = "0x" + bytes(obj.hash_tree_root()).hex()
+            if got != want_root:
+                raise ReplayMismatch(f"root diverged: {got} != {want_root}")
+        return None
+
+    return run
+
+
 def _prepare_fork_choice_replay(spec, case_dir: pathlib.Path):
     """The fork-choice steps format: anchor_state + anchor_block +
     steps.yaml referencing block_/attestation_/attester_slashing_/
@@ -212,11 +315,13 @@ def _prepare_fork_choice_replay(spec, case_dir: pathlib.Path):
     return run
 
 
-def _replay_case(runner, handler, fork, preset, case_dir, bls_mode):
+def _replay_case(runner, handler, fork, preset, suite, case, case_dir, bls_mode):
     """Returns None on success, an error string on divergence."""
     from consensus_specs_tpu.crypto import bls
 
-    spec = build_spec(fork, preset)
+    # ssz_generic and bls vectors file under the "general" pseudo-preset
+    # (reference convention) and need no spec module at all
+    spec = None if runner in ("ssz_generic", "bls") else build_spec(fork, preset)
     meta = _read_yaml(case_dir / "meta.yaml") if (case_dir / "meta.yaml").exists() else {}
 
     bls_setting = int(meta.get("bls_setting", 0))
@@ -313,11 +418,127 @@ def _replay_case(runner, handler, fork, preset, case_dir, bls_mode):
             return state
     elif runner == "fork_choice":
         run = _prepare_fork_choice_replay(spec, case_dir)
+    elif runner == "rewards":
+        from consensus_specs_tpu.test_framework.rewards import _deltas_class
+
+        state = _read_part_ssz(case_dir, "pre", spec.BeaconState)
+        deltas_cls = _deltas_class(spec)
+        emitted = {
+            p.name[: -len(".ssz_snappy")]: snappy.decompress(p.read_bytes())
+            for p in case_dir.glob("*_deltas.ssz_snappy")
+        }
+        if not emitted:
+            # a rewards case without its deltas parts is a corrupt
+            # corpus, never a vacuous green
+            raise FileNotFoundError(f"{case_dir}: no *_deltas.ssz_snappy parts")
+
+        def run(state=state):
+            def compute(part):
+                if part == "inactivity_penalty_deltas":
+                    return spec.get_inactivity_penalty_deltas(state)
+                if part == "inclusion_delay_deltas":
+                    return spec.get_inclusion_delay_deltas(state)
+                component = part[: -len("_deltas")]  # source/target/head
+                if hasattr(spec, "get_flag_index_deltas"):  # altair+
+                    flag = getattr(spec, f"TIMELY_{component.upper()}_FLAG_INDEX")
+                    return spec.get_flag_index_deltas(state, flag)
+                return getattr(spec, f"get_{component}_deltas")(state)
+
+            for part, want in sorted(emitted.items()):
+                rewards, penalties = compute(part)
+                got = deltas_cls(rewards=rewards, penalties=penalties).encode_bytes()
+                if got != want:
+                    raise ReplayMismatch(f"{part} diverged from the emitted deltas")
+            return None
+
+    elif runner == "shuffling":
+        mapping = _read_yaml(case_dir / "mapping.yaml")
+
+        def run(mapping=mapping):
+            seed = bytes.fromhex(mapping["seed"][2:])
+            count = int(mapping["count"])
+            got = [
+                int(spec.compute_shuffled_index(spec.uint64(i), spec.uint64(count), seed))
+                for i in range(count)
+            ]
+            if got != [int(v) for v in mapping["mapping"]]:
+                raise ReplayMismatch("shuffled mapping diverged")
+            return None
+
+    elif runner == "bls":
+        data = _read_yaml(case_dir / "data.yaml")
+        run = _prepare_bls_replay(handler, data)
+    elif runner == "ssz_generic":
+        run = _prepare_ssz_generic_replay(handler, case, suite, case_dir)
+    elif runner == "ssz_static":
+        serialized = snappy.decompress((case_dir / "serialized.ssz_snappy").read_bytes())
+        roots = _read_yaml(case_dir / "roots.yaml")
+        typ = getattr(spec, handler)
+
+        def run(typ=typ, serialized=serialized, roots=roots):
+            obj = typ.decode_bytes(serialized)
+            if obj.encode_bytes() != serialized:
+                raise ReplayMismatch("ssz_static round-trip not byte-stable")
+            got = "0x" + bytes(obj.hash_tree_root()).hex()
+            if got != roots["root"]:
+                raise ReplayMismatch(f"hash_tree_root diverged: {got} != {roots['root']}")
+            return None
+
+    elif runner == "merkle":
+        state = _read_part_ssz(case_dir, "state", spec.BeaconState)
+        proof = _read_yaml(case_dir / "proof.yaml")
+
+        def run(state=state, proof=proof):
+            gindex = int(proof["leaf_index"])
+            ok = spec.is_valid_merkle_branch(
+                leaf=bytes.fromhex(proof["leaf"][2:]),
+                branch=[bytes.fromhex(b[2:]) for b in proof["branch"]],
+                depth=spec.floorlog2(gindex),
+                index=spec.get_subtree_index(gindex),
+                root=spec.hash_tree_root(state),
+            )
+            if not bool(ok):
+                raise ReplayMismatch("merkle branch failed verification against the state root")
+            return None
+
+    elif runner == "genesis" and handler == "validity":
+        candidate = _read_part_ssz(case_dir, "genesis", spec.BeaconState)
+        want_valid = bool(_read_yaml(case_dir / "is_valid.yaml"))
+
+        def run(candidate=candidate, want_valid=want_valid):
+            got = bool(spec.is_valid_genesis_state(candidate))
+            if got != want_valid:
+                raise ReplayMismatch(
+                    f"is_valid_genesis_state == {got}, vector pins {want_valid}")
+            return None
+
+    elif runner == "genesis" and handler == "initialization":
+        eth1 = _read_yaml(case_dir / "eth1.yaml")
+        deposits = [
+            _read_part_ssz(case_dir, f"deposits_{i}", spec.Deposit)
+            for i in range(int(meta["deposits_count"]))
+        ]
+        header = None
+        if (case_dir / "execution_payload_header.ssz_snappy").exists():
+            header = _read_part_ssz(
+                case_dir, "execution_payload_header", spec.ExecutionPayloadHeader)
+        # the expected state ships as state.ssz_snappy in this format
+        post = snappy.decompress((case_dir / "state.ssz_snappy").read_bytes())
+
+        def run(eth1=eth1, deposits=deposits, header=header):
+            kwargs = {"execution_payload_header": header} if header is not None else {}
+            return spec.initialize_beacon_state_from_eth1(
+                bytes.fromhex(eth1["eth1_block_hash"][2:]),
+                int(eth1["eth1_timestamp"]),
+                deposits,
+                **kwargs,
+            )
     else:
         raise NotImplementedError(f"{runner}/{handler}")
 
     # ---- replay: only the spec's own rejection surface may count as
     # the expected failure
+    inline = runner in _INLINE_RUNNERS or (runner, handler) == ("genesis", "validity")
     prev = bls.bls_active
     bls.bls_active = bls_on
     try:
@@ -326,14 +547,15 @@ def _replay_case(runner, handler, fork, preset, case_dir, bls_mode):
         except ReplayMismatch as e:
             return str(e)
         except _REJECTION_ERRORS as e:
-            if post is None and runner != "fork_choice":
+            if post is None and not inline:
                 return None  # failure expected and delivered
-            return f"replay raised {type(e).__name__}: {e} (post state was expected)"
+            return f"replay raised {type(e).__name__}: {e}" + (
+                "" if inline else " (post state was expected)")
     finally:
         bls.bls_active = prev
 
-    if runner == "fork_choice":
-        return None  # adjudicated inline by its `checks` steps
+    if inline:
+        return None  # adjudicated inline (checks steps / pinned outputs)
     if post is None:
         return "replay succeeded but the vector ships no post state"
     got = out_state.encode_bytes()
@@ -364,12 +586,12 @@ def replay_tree(root: pathlib.Path, bls_mode: str = "auto"):
             failed.append((str(rel), f"unexpected layout depth {len(rel.parts)} "
                            "(want preset/fork/runner/handler/suite/case)"))
             continue
-        preset, fork, runner, handler, _suite, _case = rel.parts
+        preset, fork, runner, handler, suite, case = rel.parts
         if (case_dir / "INCOMPLETE").exists():
             incomplete += 1
             continue
         try:
-            err = _replay_case(runner, handler, fork, preset, case_dir, bls_mode)
+            err = _replay_case(runner, handler, fork, preset, suite, case, case_dir, bls_mode)
         except NotImplementedError:
             unsupported += 1
             continue

@@ -19,10 +19,10 @@ DEVICE_TESTS = tests/test_bls_device.py tests/test_curve_device.py \
         dryrun detect_generator_incomplete clean-vectors help
 
 help:
-	@echo "test                  full pytest suite (CPU, virtual 8-device mesh)"
-	@echo "citest fork=<fork>    per-fork suite slice (CI shape, ref Makefile:109-117)"
+	@echo "test                  full pytest suite (CPU, virtual 8-device mesh; -n auto when pytest-xdist is installed)"
+	@echo "citest fork=<fork>    per-fork suite slice (CI shape, ref Makefile:109-117); engine=vectorized for the SoA epoch engine"
 	@echo "test-fast             suite minus device-kernel tests (no XLA compiles)"
-	@echo "lint                  byte-compile every source file"
+	@echo "lint                  byte-compile + repo checker + mypy (engine/ + ssz/, when installed)"
 	@echo "docs                  regenerate docs/specs/ from the executable deltas"
 	@echo "generate_tests        run every vector generator into $(TEST_VECTOR_DIR)"
 	@echo "gen_<name>            run one generator (e.g. make gen_operations)"
@@ -30,13 +30,18 @@ help:
 	@echo "bench                 run bench.py (one JSON line)"
 	@echo "dryrun                multi-chip dry-run on a virtual 8-device mesh"
 
-test:
-	$(PYTHON) -m pytest tests/ -q
+# parallelize like the reference (ref Makefile:100-106) when pytest-xdist
+# is present; degrade to single-process so the suite stays runnable cold
+XDIST := $(shell $(PYTHON) -c "import importlib.util,sys; sys.stdout.write('-n auto' if importlib.util.find_spec('xdist') else '')" 2>/dev/null)
 
-# per-fork CI slice: run the spec suites restricted to one fork
+test:
+	$(PYTHON) -m pytest tests/ -q $(XDIST)
+
+# per-fork CI slice: run the spec suites restricted to one fork;
+# engine=vectorized runs the same matrix on the SoA epoch engine
 citest:
 	$(if $(fork),,$(error citest requires fork=<name>, e.g. make citest fork=phase0))
-	$(PYTHON) -m pytest tests/spec -q --fork $(fork)
+	$(PYTHON) -m pytest tests/spec -q --fork $(fork) $(if $(engine),--engine $(engine))
 
 test-fast:
 	$(PYTHON) -m pytest tests/ -q $(addprefix --ignore=,$(DEVICE_TESTS)) $(PYTEST_EXTRA)
@@ -62,6 +67,9 @@ test-mainnet:
 lint:
 	$(PYTHON) -m compileall -q consensus_specs_tpu tests tools bench.py __graft_entry__.py
 	$(PYTHON) tools/lint.py
+	@$(PYTHON) -c "import mypy" 2>/dev/null \
+	  && $(PYTHON) -m mypy --config-file mypy.ini \
+	  || echo "mypy not installed; type check (engine/ + ssz/, mypy.ini) skipped"
 
 docs:
 	$(PYTHON) tools/gen_spec_docs.py

@@ -23,6 +23,10 @@ Extra keys:
   minimal operations suites (5 handlers) with device backends on
   (deferred batched BLS + calibrated device hasher) vs the pure-host
   path, as a speedup.
+- epoch_vectorized: interpreted vs structure-of-arrays epoch processing
+  (consensus_specs_tpu/engine) on mainnet-preset randomized states,
+  HOST-only and root-checked — a protocol-plane speedup that banks even
+  when the tunnel is dead.
 
 Budget discipline (the round-4 AND round-5 lesson): the parent process
 is a pure-stdlib SUPERVISOR that never imports jax and never opens the
@@ -741,6 +745,58 @@ def bench_kzg() -> None:
     RESULTS["kzg_batch_speedup"] = round((n / t_dev) / host_rate, 2) if t_dev else None
 
 
+def bench_epoch_vectorized() -> None:
+    """Protocol-plane SoA engine vs interpreted epoch processing — the
+    registry-axis analog of the crypto-plane speedups, measured ENTIRELY
+    on host (numpy backend, no jax, no tunnel) so the number banks even
+    when the device is unreachable. Randomized mainnet-preset states with
+    live reward/churn/slashing paths; each timed pair is root-checked
+    bit-identical, so a wrong-but-fast engine can never post a speedup."""
+    import time as _time
+
+    from consensus_specs_tpu import engine
+    from consensus_specs_tpu.engine import crosscheck
+    from consensus_specs_tpu.specs import build_spec
+
+    engine.use_interpreted_epoch()
+    speedups = {}
+    # phase0: pending-attestation accounting dominates; altair: flag-weight
+    # accounting. Registry sizes chosen to finish interpreted in seconds.
+    for fork, n_validators in (("phase0", 4096), ("altair", 8192)):
+        spec = build_spec(fork, "mainnet")
+        t0 = _time.perf_counter()
+        state = crosscheck.random_epoch_state(
+            spec, seed=42, n_validators=n_validators, epoch=6, leak=False
+        )
+        _note(f"epoch_vectorized: {fork} state ({n_validators} validators) "
+              f"built in {_time.perf_counter() - t0:.1f}s")
+
+        interpreted = state.copy()
+        t0 = _time.perf_counter()
+        spec.process_epoch(interpreted)
+        t_interp = _time.perf_counter() - t0
+
+        engine.use_vectorized_epoch()
+        try:
+            vectorized = state.copy()
+            t0 = _time.perf_counter()
+            spec.process_epoch(vectorized)
+            t_soa = _time.perf_counter() - t0
+        finally:
+            engine.use_interpreted_epoch()
+
+        if bytes(interpreted.hash_tree_root()) != bytes(vectorized.hash_tree_root()):
+            raise AssertionError(f"epoch_vectorized: {fork} post-state root diverged")
+        RESULTS[f"epoch_interpreted_{fork}_s"] = round(t_interp, 3)
+        RESULTS[f"epoch_soa_{fork}_s"] = round(t_soa, 3)
+        speedups[fork] = round(t_interp / t_soa, 2) if t_soa else None
+        RESULTS[f"epoch_vectorized_speedup_{fork}"] = speedups[fork]
+        _note(f"epoch_vectorized: {fork} interpreted={t_interp:.2f}s "
+              f"soa={t_soa:.2f}s ({speedups[fork]}x)")
+    # headline: the production accounting family (altair+)
+    RESULTS["epoch_vectorized_speedup"] = speedups.get("altair")
+
+
 def _device_alive(timeout_s: int = 90) -> bool:
     """Open the device in a DISPOSABLE CHILD first: a wedged tunnel (hung
     server-side compile / dead worker) blocks `jax.devices()` forever,
@@ -825,6 +881,7 @@ SECTIONS = {
     "hash": bench_hash,
     "kzg": bench_kzg,
     "incremental_reroot": bench_incremental_reroot,
+    "epoch_vectorized": bench_epoch_vectorized,
     "pallas_probe": bench_pallas_probe,
     "host_fallback": bench_host_fallback,
 }
@@ -834,7 +891,8 @@ SECTIONS = {
 # in the section child first would block uninterruptibly if the tunnel
 # wedged mid-run, and the grandchild inherits no per-process cache
 # config anyway)
-HOST_ONLY_SECTIONS = {"incremental_reroot", "host_fallback", "pallas_probe"}
+HOST_ONLY_SECTIONS = {"incremental_reroot", "host_fallback", "pallas_probe",
+                      "epoch_vectorized"}
 
 
 def _child_main(name: str) -> None:
@@ -891,6 +949,7 @@ def main() -> None:
         _note("device UNREACHABLE — host-only fallback")
         RESULTS["device_unreachable"] = True
         run("host_fallback", 150, 320, keep_s=45)
+        run("epoch_vectorized", 120, 300)
         run("incremental_reroot", 30, 90)
     else:
         host_keep = 220.0  # host_fallback (incl. config #3 host) + reroot stay fundable
@@ -937,6 +996,7 @@ def main() -> None:
             _note("no headline BLS value after retry — host-only numbers")
             RESULTS["device_compile_failed"] = True
             run("host_fallback", 150, 320, keep_s=45)
+        run("epoch_vectorized", 120, 300)
         run("incremental_reroot", 30, 90)
         if os.environ.get("BENCH_PALLAS") == "1":
             run("pallas_probe", 75, 85)

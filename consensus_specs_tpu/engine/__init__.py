@@ -1,0 +1,124 @@
+"""Vectorized state-transition engine: structure-of-arrays epoch
+processing behind the compiled spec modules.
+
+The crypto plane (BLS, KZG, SHA-256) got device-batched rounds ago; the
+protocol plane still ran the spec's per-validator Python loops. This
+subsystem is the protocol plane's batching layer:
+
+- :mod:`plane` — ``StatePlane``, the SoA mirror of BeaconState's
+  registry-axis columns, with exact (overflow-guarded) uint64 helpers
+  and sparse write-back that preserves SSZ dirty-tracking.
+- :mod:`stages` — vectorized ``process_*`` implementations of the hot
+  epoch sub-transitions for the phase0 and altair fork families.
+- :mod:`backend` / :mod:`ops_jax` — the NumPy-always / jnp-opt-in
+  backend hook, the ``ops/`` convention applied to protocol math.
+- :mod:`crosscheck` — the differential harness that holds every stage
+  to hash_tree_root bit-identity against the interpreted oracle on
+  randomized states (epoch processing on the host reference path is the
+  oracle here, exactly as ``crypto/`` is the oracle for ``ops/``).
+
+Install model: ``use_vectorized_epoch()`` swaps the stage functions in
+every built (and every future) spec module via the specs.build module
+hook — the same switchable-backend shape as ``use_device_hasher()`` and
+``bls.use_backend("jax")``. Wrappers keep the interpreted function on
+``__wrapped__`` and preserve ``__name__`` so ``epoch_process_steps()``
+staging, generators, and the replayer see the same public surface
+either way. ``use_interpreted_epoch()`` restores the originals.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+from ..specs import build as _build
+from . import stages
+from .backend import active as backend_name  # noqa: F401  (public surface)
+from .backend import use_backend
+
+__all__ = [
+    "use_vectorized_epoch",
+    "use_interpreted_epoch",
+    "is_vectorized",
+    "use_backend",
+    "backend_name",
+    "STAGE_NAMES",
+    "SUPPORTED_FORKS",
+]
+
+# The hot registry-axis sub-transitions with SoA implementations.
+STAGE_NAMES = (
+    "process_justification_and_finalization",
+    "process_rewards_and_penalties",
+    "process_inactivity_updates",
+    "process_effective_balance_updates",
+    "process_registry_updates",
+    "process_slashings",
+)
+
+# Production chain only: R&D branches (sharding/custody_game/das/eip4844)
+# may re-shape epoch processing and are never auto-wrapped.
+SUPPORTED_FORKS = ("phase0", "altair", "bellatrix", "capella")
+
+_enabled = False
+
+
+def _wrap_stage(spec, name: str):
+    impl = getattr(stages, f"vectorized_{name}")
+    interpreted = getattr(spec, name)
+
+    def wrapped(state):
+        return impl(spec, state)
+
+    wrapped.__name__ = name
+    wrapped.__qualname__ = f"engine.{name}[{spec.fork}]"
+    wrapped.__doc__ = interpreted.__doc__
+    wrapped.__wrapped__ = interpreted
+    wrapped.engine_vectorized = True
+    return wrapped
+
+
+def _install_on(spec) -> None:
+    """specs.build module hook: swap stage functions on one module."""
+    if getattr(spec, "fork", None) not in SUPPORTED_FORKS:
+        return
+    for name in STAGE_NAMES:
+        current = getattr(spec, name, None)
+        if current is None or getattr(current, "engine_vectorized", False):
+            continue
+        setattr(spec, name, _wrap_stage(spec, name))
+
+
+def _uninstall_from(spec) -> None:
+    for name in STAGE_NAMES:
+        current = getattr(spec, name, None)
+        if current is not None and getattr(current, "engine_vectorized", False):
+            setattr(spec, name, current.__wrapped__)
+
+
+def use_vectorized_epoch() -> None:
+    """Route the hot epoch stages of every built (and future) spec module
+    through the SoA engine. Idempotent."""
+    global _enabled
+    _enabled = True
+    _build.register_module_hook(_install_on)
+
+
+def use_interpreted_epoch() -> None:
+    """Restore the interpreted spec functions everywhere. Idempotent."""
+    global _enabled
+    _enabled = False
+    _build.unregister_module_hook(_install_on)
+    for mod in _build.cached_modules():
+        _uninstall_from(mod)
+
+
+def is_vectorized() -> bool:
+    return _enabled
+
+
+def stage_status(spec) -> Dict[str, bool]:
+    """{stage name: engine-installed?} for one spec module (diagnostics)."""
+    return {
+        name: getattr(getattr(spec, name, None), "engine_vectorized", False)
+        for name in STAGE_NAMES
+        if hasattr(spec, name)
+    }

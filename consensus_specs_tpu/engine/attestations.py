@@ -1,0 +1,71 @@
+"""Vectorized pending-attestation resolution (phase0 family).
+
+Phase0 epoch accounting keys everything on *who attested*: committee
+membership per (slot, index) sliced out of the swap-or-not permutation,
+intersected with each attestation's aggregation bits. The interpreted
+path materializes Python sets per attestation per component (source,
+target, head, inclusion — four passes); here each attestation's member
+rows are gathered ONCE as a NumPy index array from the cached shuffle
+permutation, and every component reduces those arrays with boolean
+scatters. Bit-identical by construction: the permutation is the spec's
+own cached ``_shuffle_permutation``, the slicing mirrors
+compute_committee's integer bounds exactly.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+
+class EpochCommittees:
+    """Committee geometry of one epoch, in array form."""
+
+    def __init__(self, spec, state, epoch: int) -> None:
+        self.epoch = int(epoch)
+        self.active = np.asarray(
+            [int(i) for i in spec.get_active_validator_indices(state, epoch)],
+            dtype=np.int64,
+        )
+        seed = spec.get_seed(state, epoch, spec.DOMAIN_BEACON_ATTESTER)
+        self.perm = spec._shuffle_permutation(len(self.active), seed)
+        self.committees_per_slot = int(spec.get_committee_count_per_slot(state, epoch))
+        self.slots_per_epoch = int(spec.SLOTS_PER_EPOCH)
+        self.count = self.committees_per_slot * self.slots_per_epoch
+
+    def committee(self, slot: int, index: int) -> np.ndarray:
+        """compute_committee's slice of the shuffled active set
+        (beacon-chain.md:807) as validator-index rows."""
+        i = (int(slot) % self.slots_per_epoch) * self.committees_per_slot + int(index)
+        n = len(self.active)
+        start = n * i // self.count
+        end = n * (i + 1) // self.count
+        assert end <= n  # the spec's per-element bound assert, batched
+        return self.active[self.perm[start:end]]
+
+
+def resolve_members(spec, state, attestations: Sequence,
+                    cache: Dict[int, EpochCommittees]) -> List[Tuple[object, np.ndarray]]:
+    """[(attestation, attesting validator rows)] — get_attesting_indices
+    for every attestation in one pass, committees cached per epoch."""
+    out = []
+    for a in attestations:
+        epoch = int(spec.compute_epoch_at_slot(a.data.slot))
+        comm = cache.get(epoch)
+        if comm is None:
+            comm = cache[epoch] = EpochCommittees(spec, state, epoch)
+        members = comm.committee(int(a.data.slot), int(a.data.index))
+        bits = np.fromiter(a.aggregation_bits, dtype=bool, count=len(a.aggregation_bits))
+        assert len(bits) == len(members)  # process_attestation's length contract
+        out.append((a, members[bits]))
+    return out
+
+
+def attester_mask(n: int, resolved: Sequence[Tuple[object, np.ndarray]],
+                  slashed: np.ndarray) -> np.ndarray:
+    """get_unslashed_attesting_indices as a row mask: the union of all
+    attesting rows, minus slashed."""
+    mask = np.zeros(n, dtype=bool)
+    for _, members in resolved:
+        mask[members] = True
+    return mask & ~slashed

@@ -1,0 +1,48 @@
+"""Engine compute-backend hook — the ops/ convention applied to the
+protocol plane.
+
+The NumPy host path is always available and always correct; the jnp
+device path is OPT-IN, exactly like ``bls.use_backend("jax")`` and
+``use_device_hasher()`` on the crypto plane. Stages route their bulk
+elementwise delta arithmetic through :func:`delta_kernel` when the jax
+backend is active AND the row count clears ``DEVICE_MIN_ROWS`` (a
+device dispatch costs ~100us; small registries never win) AND the
+stage's own overflow guard proved the products fit 64 bits (the jitted
+kernel wraps silently where NumPy's guarded helpers would fall back to
+exact object ints — so the guard decides the dispatch, not the kernel).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+_active = "numpy"
+
+DEVICE_MIN_ROWS = 4096  # below this, dispatch overhead beats the kernel
+_DEFAULT_DEVICE_MIN_ROWS = 4096
+
+
+def use_backend(name: str = "numpy") -> None:
+    """Select the engine compute backend: ``numpy`` (host, default) or
+    ``jax`` (jitted uint64 kernels; requires jax importable)."""
+    global _active, DEVICE_MIN_ROWS
+    if name not in ("numpy", "jax"):
+        raise ValueError(f"unknown engine backend {name!r} (have numpy, jax)")
+    if name == "jax":
+        from . import ops_jax  # noqa: F401  (import error = backend unavailable)
+    else:
+        DEVICE_MIN_ROWS = _DEFAULT_DEVICE_MIN_ROWS
+    _active = name
+
+
+def active() -> str:
+    return _active
+
+
+def delta_kernel() -> Optional[object]:
+    """The jitted flag-delta kernel when the jax backend is active, else
+    None (callers take the NumPy path)."""
+    if _active != "jax":
+        return None
+    from . import ops_jax
+
+    return ops_jax.flag_deltas

@@ -1,0 +1,64 @@
+"""jnp device path for the engine's bulk delta arithmetic (opt-in via
+``engine.use_backend("jax")``).
+
+Only the embarrassingly-parallel elementwise piece moves to the device:
+the altair flag-weight reward/penalty formula over the whole registry
+(altair/beacon-chain.md:367-389). Everything stateful (masks, sums,
+sequential churn) stays on host. The kernel runs under x64 so uint64
+columns keep their width; callers must have proved the products fit 64
+bits before dispatching (see engine.backend.delta_kernel) — the kernel
+itself wraps on overflow like any fixed-width lane.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import enable_x64
+
+# The engine's columns are uint64: without x64 jax silently truncates
+# them to uint32, which is a correctness bug, not a performance choice.
+# x64 is entered as a SCOPED context around trace + execution (never a
+# global flag flip) so the repo's uint32-limb crypto kernels and any
+# test sharing the process keep their default dtype world.
+
+
+@partial(jax.jit, static_argnames=("leak", "penalize"))
+def _flag_deltas_jit(increments, in_mask, eligible, brpi, weight, upi, active_increments,
+                     wd, leak, penalize):
+    base = increments * brpi
+    reward = (base * weight * upi) // (active_increments * wd)
+    penalty = (base * weight) // wd
+    zero = jnp.uint64(0)
+    if leak:  # static: participating rows earn nothing during a leak
+        rewards = jnp.zeros_like(base)
+    else:
+        rewards = jnp.where(in_mask & eligible, reward, zero)
+    penalties = (
+        jnp.where(~in_mask & eligible, penalty, zero) if penalize else jnp.zeros_like(base)
+    )
+    return rewards, penalties
+
+
+def flag_deltas(increments: np.ndarray, in_mask: np.ndarray, eligible: np.ndarray,
+                brpi: int, weight: int, upi: int, active_increments: int,
+                wd: int, leak: bool, penalize: bool):
+    """One flag's (rewards, penalties) columns, computed on device and
+    materialized back to host NumPy (conversions included in the x64
+    scope — outside it, asarray would truncate uint64 to uint32)."""
+    with enable_x64():
+        r, p = _flag_deltas_jit(
+            jnp.asarray(increments),
+            jnp.asarray(in_mask),
+            jnp.asarray(eligible),
+            jnp.uint64(brpi),
+            jnp.uint64(weight),
+            jnp.uint64(upi),
+            jnp.uint64(active_increments),
+            jnp.uint64(wd),
+            bool(leak),
+            bool(penalize),
+        )
+        return np.asarray(r), np.asarray(p)

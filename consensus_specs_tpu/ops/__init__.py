@@ -4,6 +4,14 @@ tower (tower.py), batched ate pairing (pairing_jax.py), curve group ops
 + subgroup checks (curve_jax.py), hash-to-G2 (h2c_jax.py), and the
 device BLS signature backend (bls_jax.py).
 
+The PROTOCOL-plane counterpart of this package lives in
+``consensus_specs_tpu/engine`` (SoA epoch processing): its jnp delta
+kernels (engine/ops_jax.py) follow the same conventions as the crypto
+kernels here — host path always available, device path opt-in behind a
+backend switch, host oracle as the bit-exactness arbiter, and a
+min-batch-size dispatch floor so small shapes never pay dispatch
+latency (engine.backend.DEVICE_MIN_ROWS, the DEVICE_MIN_BLOCKS analog).
+
 The persistent XLA compile cache is OPT-IN via the
 CONSENSUS_SPECS_TPU_JAX_CACHE env var (path to a cache dir). It is NOT
 enabled by default: on the CPU backend of this jaxlib, serializing the

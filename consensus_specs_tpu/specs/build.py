@@ -44,6 +44,33 @@ _SOURCE_DIR = Path(__file__).resolve().parent
 _cache: Dict[Tuple, types.ModuleType] = {}
 _code_cache: Dict[str, Any] = {}
 
+# Module hooks: installed-backend shims (e.g. engine.use_vectorized_epoch)
+# run over every built module — retroactively on registration, and on each
+# future build — so a backend switch applies to the whole (fork, preset)
+# matrix no matter when modules were compiled relative to the switch.
+_module_hooks: list = []
+
+
+def register_module_hook(hook) -> None:
+    """Register ``hook(module)`` to run on every spec module, existing and
+    future. Idempotent per hook object."""
+    if hook not in _module_hooks:
+        _module_hooks.append(hook)
+    for mod in list(_cache.values()):
+        hook(mod)
+
+
+def unregister_module_hook(hook) -> None:
+    """Stop applying ``hook`` to future builds (does not undo its effect
+    on already-built modules — the owner restores those)."""
+    if hook in _module_hooks:
+        _module_hooks.remove(hook)
+
+
+def cached_modules():
+    """Every spec module built so far (hook owners restore through this)."""
+    return list(_cache.values())
+
 
 def available_forks():
     """Production forks whose spec source exists on disk, in dependency
@@ -127,6 +154,9 @@ def build_spec(
 
     ns["fork"] = fork
     ns["preset_base"] = preset_name
+
+    for hook in _module_hooks:
+        hook(mod)
 
     _cache[cache_key] = mod
     return mod

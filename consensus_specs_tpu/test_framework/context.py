@@ -22,6 +22,9 @@ from .utils import vector_test, with_meta_tags
 DEFAULT_PRESET = MINIMAL
 DEFAULT_BLS_ACTIVE = False
 ALLOWED_FORKS = None  # --fork filter: None = all implemented forks
+# --engine flag: "vectorized" = the SoA epoch engine is installed for the
+# whole session (engine x fork matrix); "interpreted" = spec oracle
+DEFAULT_ENGINE = "interpreted"
 
 
 def get_spec(fork: str, preset: str, config_overrides: Optional[Dict[str, Any]] = None):

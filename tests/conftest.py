@@ -61,6 +61,14 @@ def pytest_addoption(parser):
         help="BLS backend: 'reference' host oracle or 'jax' device batch "
              "(ref conftest.py:54-60, py_ecc/milagro analog)",
     )
+    parser.addoption(
+        "--engine", action="store", default="interpreted",
+        choices=("interpreted", "vectorized"),
+        help="epoch-processing engine for the whole run: 'vectorized' "
+             "installs the SoA engine (consensus_specs_tpu/engine) on every "
+             "spec module, so the full fork matrix exercises the batched "
+             "registry plane; 'interpreted' (default) is the spec oracle",
+    )
 
 
 def pytest_configure(config):
@@ -78,6 +86,11 @@ def pytest_configure(config):
     bls_type = config.getoption("--bls-type")
     if bls_type:
         bls.use_backend(bls_type)
+    context.DEFAULT_ENGINE = config.getoption("--engine")
+    if context.DEFAULT_ENGINE == "vectorized":
+        from consensus_specs_tpu import engine
+
+        engine.use_vectorized_epoch()
 
 
 import pytest  # noqa: E402

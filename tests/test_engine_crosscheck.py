@@ -1,0 +1,142 @@
+"""Differential cross-checks for the vectorized state-transition engine
+(consensus_specs_tpu/engine/): every SoA epoch stage must produce a
+bit-identical hash_tree_root post-state against the interpreted spec
+oracle on randomized registries, across the production fork matrix —
+host-only and fast (tier-1 CI).
+"""
+from __future__ import annotations
+
+import pytest
+
+from consensus_specs_tpu import engine
+from consensus_specs_tpu.engine import backend, crosscheck
+from consensus_specs_tpu.specs import build_spec
+
+FORKS = engine.SUPPORTED_FORKS
+
+
+@pytest.fixture(autouse=True)
+def _interpreted_baseline():
+    """Every test starts and ends with the engine uninstalled so ordering
+    can't leak an installed engine into unrelated suites."""
+    engine.use_interpreted_epoch()
+    yield
+    engine.use_interpreted_epoch()
+    engine.use_backend("numpy")
+
+
+@pytest.mark.parametrize("fork", FORKS)
+@pytest.mark.parametrize("leak", [False, True], ids=["finalizing", "leaking"])
+def test_stages_bit_identical(fork, leak):
+    spec = build_spec(fork, "minimal")
+    epoch = 6 if leak else 3
+    for seed in (0, 1):
+        state = crosscheck.random_epoch_state(
+            spec, seed=seed, n_validators=64, epoch=epoch, leak=leak
+        )
+        for name in crosscheck.stages_for(spec):
+            same, interpreted_root, vectorized_root = crosscheck.crosscheck_stage(
+                spec, name, state
+            )
+            assert same, (
+                f"{fork}/{name} diverged (seed={seed}, leak={leak}): "
+                f"{interpreted_root} != {vectorized_root}"
+            )
+
+
+@pytest.mark.parametrize("fork", FORKS)
+def test_full_epoch_with_engine_installed(fork):
+    """process_epoch end-to-end: engine on == engine off, including the
+    stages the engine does NOT vectorize (resets, historical roots)."""
+    spec = build_spec(fork, "minimal")
+    state = crosscheck.random_epoch_state(spec, seed=7, n_validators=64, epoch=6, leak=True)
+    reference = state.copy()
+    spec.process_epoch(reference)
+
+    engine.use_vectorized_epoch()
+    assert engine.is_vectorized()
+    assert engine.stage_status(spec)["process_slashings"]
+    vectorized = state.copy()
+    spec.process_epoch(vectorized)
+
+    assert bytes(reference.hash_tree_root()) == bytes(vectorized.hash_tree_root())
+
+
+def test_install_is_idempotent_and_reversible():
+    spec = build_spec("altair", "minimal")
+    original = spec.process_slashings
+    engine.use_vectorized_epoch()
+    engine.use_vectorized_epoch()  # double-install must not double-wrap
+    wrapped = spec.process_slashings
+    assert wrapped.engine_vectorized and wrapped.__wrapped__ is original
+    engine.use_interpreted_epoch()
+    assert spec.process_slashings is original
+
+
+def test_future_builds_get_hooked():
+    engine.use_vectorized_epoch()
+    spec = build_spec("bellatrix", "minimal")
+    assert engine.stage_status(spec)["process_rewards_and_penalties"]
+    engine.use_interpreted_epoch()
+    assert not engine.stage_status(spec)["process_rewards_and_penalties"]
+
+
+def test_epoch_staging_names_survive_install():
+    """The test framework stages sub-transitions by fn.__name__
+    (test_framework/epoch_processing.py) — wrappers must not rename."""
+    from consensus_specs_tpu.test_framework.epoch_processing import get_process_calls
+
+    spec = build_spec("altair", "minimal")
+    before = get_process_calls(spec)
+    engine.use_vectorized_epoch()
+    assert get_process_calls(spec) == before
+    engine.use_interpreted_epoch()
+
+
+def test_rnd_forks_left_interpreted():
+    """R&D branches may re-shape epoch processing: never auto-wrapped."""
+    spec = build_spec("sharding", "minimal")
+    engine.use_vectorized_epoch()
+    assert not any(engine.stage_status(spec).values())
+    engine.use_interpreted_epoch()
+
+
+def test_jax_backend_bit_identical():
+    """The opt-in jnp delta kernel must match the oracle too (CPU jax)."""
+    engine.use_backend("jax")
+    saved = backend.DEVICE_MIN_ROWS
+    backend.DEVICE_MIN_ROWS = 1  # force dispatch on the small test registry
+    try:
+        spec = build_spec("altair", "minimal")
+        for leak in (False, True):
+            state = crosscheck.random_epoch_state(
+                spec, seed=11, n_validators=64, epoch=6 if leak else 3, leak=leak
+            )
+            same, interpreted_root, vectorized_root = crosscheck.crosscheck_stage(
+                spec, "process_rewards_and_penalties", state
+            )
+            assert same, f"jax backend diverged: {interpreted_root} != {vectorized_root}"
+    finally:
+        backend.DEVICE_MIN_ROWS = saved
+        engine.use_backend("numpy")
+
+
+def test_crosscheck_detects_divergence():
+    """The harness itself must not be vacuous: a deliberately corrupted
+    'vectorized' stage has to be flagged."""
+    from consensus_specs_tpu.engine import stages
+
+    spec = build_spec("altair", "minimal")
+    state = crosscheck.random_epoch_state(spec, seed=13, n_validators=64, epoch=3)
+    real = stages.vectorized_process_slashings
+
+    def corrupted(spec_, state_):
+        real(spec_, state_)
+        state_.balances[0] = int(state_.balances[0]) + 1
+
+    stages.vectorized_process_slashings = corrupted
+    try:
+        same, _, _ = crosscheck.crosscheck_stage(spec, "process_slashings", state)
+    finally:
+        stages.vectorized_process_slashings = real
+    assert not same

@@ -578,7 +578,12 @@ def replay_tree(root: pathlib.Path, bls_mode: str = "auto"):
     error inside a case (missing part, undecodable pre) is that case's
     failure, never its expected rejection."""
     ok, failed, unsupported, incomplete = 0, [], 0, 0
-    case_dirs = {p.parent for p in root.rglob("meta.yaml")}
+    # ANY part file marks a case directory. Globbing *.yaml (not just
+    # meta.yaml) matters: bls cases ship only data.yaml and shuffling
+    # cases only mapping.yaml — meta.yaml is written solely when meta is
+    # non-empty (gen_runner.py), so those two formats were invisible to a
+    # meta/ssz-only walk and their replay branches were dead code.
+    case_dirs = {p.parent for p in root.rglob("*.yaml")}
     case_dirs |= {p.parent for p in root.rglob("*.ssz_snappy")}
     for case_dir in sorted(case_dirs):
         rel = case_dir.relative_to(root)

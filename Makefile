@@ -16,7 +16,12 @@ DEVICE_TESTS = tests/test_bls_device.py tests/test_curve_device.py \
                tests/test_multichip.py
 
 .PHONY: test citest test-fast test-device test-mainnet lint docs generate_tests gen_% replay bench \
-        dryrun detect_generator_incomplete clean-vectors help
+        dryrun detect_generator_incomplete clean-vectors chaos help
+
+# the fault-injection suite: supervisor/taxonomy units, chaos replay
+# (tampered vectors), induced backend failures, generator crash/resume
+CHAOS_TESTS = tests/test_resilience.py tests/test_chaos_replay.py \
+              tests/test_backend_fallback.py tests/test_gen_journal.py
 
 help:
 	@echo "test                  full pytest suite (CPU, virtual 8-device mesh; -n auto when pytest-xdist is installed)"
@@ -29,6 +34,7 @@ help:
 	@echo "replay                replay generated vectors back through the spec (conformance consumer)"
 	@echo "bench                 run bench.py (one JSON line)"
 	@echo "dryrun                multi-chip dry-run on a virtual 8-device mesh"
+	@echo "chaos                 fault-injection suite (resilience layer: retries, quarantine, journal, tampered vectors)"
 
 # parallelize like the reference (ref Makefile:100-106) when pytest-xdist
 # is present; degrade to single-process so the suite stays runnable cold
@@ -81,6 +87,9 @@ gen_%:
 
 replay:
 	$(PYTHON) tools/replay_vectors.py $(TEST_VECTOR_DIR)
+
+chaos:
+	$(PYTHON) -m pytest $(CHAOS_TESTS) -q
 
 bench:
 	$(PYTHON) bench.py

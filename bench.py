@@ -60,6 +60,16 @@ import numpy as np  # no jax: safe in the supervisor
 faulthandler.enable()
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+# pure-stdlib fault layer (no jax): taxonomy + event log shared with the
+# section children — every retry/fallback/quarantine lands in the BENCH
+# json so the trajectory shows degradation, not silence
+from consensus_specs_tpu.resilience import (  # noqa: E402
+    chaos,
+    classify_exit,
+    events as resilience_events,
+    record_event,
+)
+
 DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S", "1380"))
 _T0 = time.monotonic()
 
@@ -87,6 +97,10 @@ def _emit() -> None:
     if _EMITTED:
         return
     _EMITTED = True
+    evs = resilience_events()
+    if evs:
+        seen = RESULTS.setdefault("resilience_events", [])
+        seen.extend(e for e in evs if e not in seen)
     if not _IS_CHILD:
         # strip bookkeeping keys + run the pallas/host root cross-check on
         # EVERY parent exit path (normal, SIGTERM/SIGALRM, atexit) — a
@@ -192,13 +206,21 @@ def _run_child(name: str, cap_s: float) -> None:
             RESULTS["section_seconds"].update(v)
         elif k == "section_errors":
             RESULTS.setdefault("section_errors", {}).update(v)
+        elif k == "resilience_events":
+            seen = RESULTS.setdefault("resilience_events", [])
+            seen.extend(e for e in v if e not in seen)
         elif v is not None or k not in RESULTS:
             RESULTS[k] = v
     RESULTS["section_seconds"][name] = round(dt, 1)
     if timed_out:
         RESULTS.setdefault("section_errors", {})[name] = f"timeout>{cap_s:.0f}s"
+        record_event("child_timeout", domain="bench", capability=name,
+                     kind="transient", detail=f"killed at the {cap_s:.0f}s cap")
     elif proc.returncode != 0:
         RESULTS.setdefault("section_errors", {}).setdefault(name, f"rc={proc.returncode}")
+        record_event("child_failed", domain="bench", capability=name,
+                     kind=classify_exit(proc.returncode) or "",
+                     detail=f"rc={proc.returncode}")
     new_keys = {k: v for k, v in merged.items() if k not in ("section_seconds", "section_errors") and v is not None}
     _note(f"{name} child done in {dt:.1f}s rc={proc.returncode} {json.dumps(new_keys) if new_keys else ''}")
 
@@ -903,6 +925,7 @@ def _child_main(name: str) -> None:
     if name not in HOST_ONLY_SECTIONS:
         _maybe_enable_compile_cache()
     try:
+        chaos("bench.section")  # injection point: children are killable
         fn()
     except Exception as e:
         _note(f"{name} FAILED: {e!r}")
@@ -971,6 +994,9 @@ def main() -> None:
             if dt1 is not None:
                 RESULTS["section_seconds"]["bls_attempt1"] = dt1
             _note("bls produced no headline value — retrying once")
+            record_event("retry", domain="bench", capability="bls",
+                         kind="transient",
+                         detail=f"headline section retry (attempt1: {err1})")
             # force the COLD estimate: after a mid-compile death the
             # cache holds partial entries, so _cache_is_warm() would
             # admit a doomed retry under the warm estimate and burn the

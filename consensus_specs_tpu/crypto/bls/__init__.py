@@ -22,12 +22,29 @@ boolean Verify family can run in three modes —
     was wrong (the signature was actually invalid) replays it with the
     true answers at zero crypto cost.
 The vector generator drives this (generators/gen_runner.py --bls-defer).
+
+Resilience (consensus_specs_tpu/resilience): the reference backend IS
+the correctness oracle, so the facade can always degrade to it. An
+unimportable jax backend quarantines ``bls.jax`` and stays on reference
+with a recorded event. A device-backend failure inside the Verify
+family is adjudicated BY the oracle: the check re-runs on reference,
+and only if the oracle accepts the input (so the backend failed on a
+valid check — a defect, not a bad signature) does the quarantine fire;
+either way the caller gets the oracle's bit-identical answer. Chaos
+points ``bls.import`` and ``bls.dispatch`` inject all fault classes.
 """
 from __future__ import annotations
 
 import contextlib
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ...resilience import (
+    chaos,
+    is_quarantined,
+    quarantine,
+    record_event,
+    supervised,
+)
 from . import ciphersuite as _reference
 
 G2_POINT_AT_INFINITY = _reference.G2_POINT_AT_INFINITY
@@ -37,17 +54,66 @@ _backend = _reference
 _backend_name = "reference"
 
 
-def use_backend(name: str) -> None:
+def use_backend(name: str) -> str:
+    """Select the BLS backend. Returns the backend actually installed:
+    asking for ``jax`` when it is quarantined or unimportable degrades
+    to ``reference`` with a recorded event instead of raising."""
     global _backend, _backend_name
     if name == "reference":
         _backend = _reference
     elif name == "jax":
-        from ...ops import bls_jax
+        def _probe_import():
+            chaos("bls.import")
+            from ...ops import bls_jax
 
-        _backend = bls_jax
+            return bls_jax
+
+        try:
+            _backend = supervised(_probe_import, domain="crypto.bls",
+                                  capability="bls.jax")
+        except Exception:
+            # quarantined (event already recorded): reference takes over
+            _backend, _backend_name = _reference, "reference"
+            return _backend_name
     else:
         raise ValueError(f"unknown BLS backend {name!r}")
     _backend_name = name
+    return _backend_name
+
+
+def _verify_dispatch(op: str, *args) -> bool:
+    """Verify-family dispatch with quarantine-and-fallback.
+
+    Reference backend: direct call (its exceptions are the spec's
+    invalid-input surface; the caller maps them to False). Device
+    backend: transient faults retry in place; a terminal fault re-runs
+    the check on the reference oracle — if the oracle ACCEPTS the input
+    the backend is defective and ``bls.<name>`` is quarantined (every
+    later check goes straight to the oracle); if the oracle also
+    rejects, the input was simply invalid. Results are the oracle's
+    either way, so degradation is bit-identical by construction."""
+    ref_op = getattr(_reference, op)
+    if _backend is _reference:
+        return ref_op(*args)
+    capability = f"bls.{_backend_name}"
+    if is_quarantined(capability):
+        return ref_op(*args)
+
+    def _attempt():
+        chaos("bls.dispatch")
+        return getattr(_backend, op)(*args)
+
+    try:
+        return bool(supervised(_attempt, domain="crypto.bls"))
+    except Exception as e:
+        answer = bool(ref_op(*args))  # oracle adjudicates (may raise -> caller's False)
+        if answer:
+            quarantine(capability,
+                       f"{op} failed on a check the oracle accepts: "
+                       f"{type(e).__name__}: {e}", domain="crypto.bls")
+        record_event("fallback", domain="crypto.bls", capability=capability,
+                     detail=f"{op} answered by the reference oracle")
+        return answer
 
 
 def use_reference() -> None:
@@ -117,12 +183,15 @@ class DeferredVerifier:
             else:  # "av"
                 _, pks, msgs, sig = key
                 try:
-                    unique[key] = bool(_backend.AggregateVerify(list(pks), list(msgs), sig))
+                    unique[key] = _verify_dispatch(
+                        "AggregateVerify", list(pks), list(msgs), sig)
                 except Exception:
                     unique[key] = False
 
         if batch_rows:
             cold = getattr(_backend, "fast_aggregate_verify_batch_cold", None)
+            if cold is not None and is_quarantined(f"bls.{_backend_name}"):
+                cold = None  # breaker open: the oracle path answers below
             if cold is not None:
                 try:
                     ok = cold(
@@ -130,11 +199,15 @@ class DeferredVerifier:
                         [r[2] for r in batch_rows],
                         [r[3] for r in batch_rows],
                     )
-                except Exception:
+                except Exception as e:
                     # a device/backend failure must degrade like every
-                    # synchronous facade path (exception -> False per
-                    # check), not abort the whole flush: fall back to the
-                    # per-row scalar path below
+                    # synchronous facade path, not abort the whole flush:
+                    # fall back to the per-row oracle-adjudicated path
+                    # below (which quarantines the backend if warranted)
+                    record_event("fallback", domain="crypto.bls",
+                                 capability=f"bls.{_backend_name}",
+                                 detail=f"batched flush failed "
+                                        f"({type(e).__name__}); per-row fallback")
                     cold = None
                 else:
                     for (key, _, _, _), o in zip(batch_rows, ok):
@@ -142,7 +215,7 @@ class DeferredVerifier:
             if cold is None:
                 for key, pks, msg, sig in batch_rows:
                     try:
-                        unique[key] = bool(_backend.FastAggregateVerify(pks, msg, sig))
+                        unique[key] = _verify_dispatch("FastAggregateVerify", pks, msg, sig)
                     except Exception:
                         unique[key] = False
 
@@ -199,7 +272,7 @@ def Verify(pubkey: bytes, message: bytes, signature: bytes) -> bool:
     if _replay is not None and key in _replay:
         return _replay[key]
     try:
-        return _backend.Verify(pubkey, message, signature)
+        return _verify_dispatch("Verify", pubkey, message, signature)
     except Exception:
         return False
 
@@ -217,7 +290,7 @@ def AggregateVerify(pubkeys: Sequence[bytes], messages: Sequence[bytes], signatu
     if _replay is not None and key in _replay:
         return _replay[key]
     try:
-        return _backend.AggregateVerify(pubkeys, messages, signature)
+        return _verify_dispatch("AggregateVerify", pubkeys, messages, signature)
     except Exception:
         return False
 
@@ -230,7 +303,7 @@ def FastAggregateVerify(pubkeys: Sequence[bytes], message: bytes, signature: byt
     if _replay is not None and key in _replay:
         return _replay[key]
     try:
-        return _backend.FastAggregateVerify(pubkeys, message, signature)
+        return _verify_dispatch("FastAggregateVerify", pubkeys, message, signature)
     except Exception:
         return False
 

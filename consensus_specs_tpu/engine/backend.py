@@ -4,34 +4,59 @@ protocol plane.
 The NumPy host path is always available and always correct; the jnp
 device path is OPT-IN, exactly like ``bls.use_backend("jax")`` and
 ``use_device_hasher()`` on the crypto plane. Stages route their bulk
-elementwise delta arithmetic through :func:`delta_kernel` when the jax
-backend is active AND the row count clears ``DEVICE_MIN_ROWS`` (a
-device dispatch costs ~100us; small registries never win) AND the
+elementwise delta arithmetic through :func:`dispatch_delta_kernel` when
+the jax backend is active AND the row count clears ``DEVICE_MIN_ROWS``
+(a device dispatch costs ~100us; small registries never win) AND the
 stage's own overflow guard proved the products fit 64 bits (the jitted
 kernel wraps silently where NumPy's guarded helpers would fall back to
 exact object ints — so the guard decides the dispatch, not the kernel).
+
+Resilience (consensus_specs_tpu/resilience): selecting or dispatching
+the jax backend runs supervised — an unimportable jax quarantines the
+``engine.jax`` capability and stays on numpy with a recorded event; a
+transient dispatch failure retries with backoff; a deterministic one
+(miscompile-class) quarantines the backend so every later stage call
+takes the bit-identical numpy path. Chaos points ``engine.import`` and
+``engine.dispatch`` let tests inject all three fault classes.
 """
 from __future__ import annotations
 
 from typing import Optional
+
+from ..resilience import chaos, is_quarantined, record_event, supervised
 
 _active = "numpy"
 
 DEVICE_MIN_ROWS = 4096  # below this, dispatch overhead beats the kernel
 _DEFAULT_DEVICE_MIN_ROWS = 4096
 
+CAPABILITY = "engine.jax"
 
-def use_backend(name: str = "numpy") -> None:
+
+def use_backend(name: str = "numpy") -> str:
     """Select the engine compute backend: ``numpy`` (host, default) or
-    ``jax`` (jitted uint64 kernels; requires jax importable)."""
+    ``jax`` (jitted uint64 kernels). Returns the backend actually
+    installed: asking for ``jax`` when it is quarantined or unimportable
+    degrades to ``numpy`` with a recorded event instead of raising."""
     global _active, DEVICE_MIN_ROWS
     if name not in ("numpy", "jax"):
         raise ValueError(f"unknown engine backend {name!r} (have numpy, jax)")
     if name == "jax":
-        from . import ops_jax  # noqa: F401  (import error = backend unavailable)
+        def _probe_import():
+            chaos("engine.import")
+            from . import ops_jax  # noqa: F401  (import error = unavailable)
+
+        try:
+            supervised(_probe_import, domain="engine", capability=CAPABILITY)
+        except Exception:
+            # quarantined (event already recorded): numpy takes over
+            _active = "numpy"
+            DEVICE_MIN_ROWS = _DEFAULT_DEVICE_MIN_ROWS
+            return _active
     else:
         DEVICE_MIN_ROWS = _DEFAULT_DEVICE_MIN_ROWS
     _active = name
+    return _active
 
 
 def active() -> str:
@@ -39,10 +64,38 @@ def active() -> str:
 
 
 def delta_kernel() -> Optional[object]:
-    """The jitted flag-delta kernel when the jax backend is active, else
-    None (callers take the NumPy path)."""
-    if _active != "jax":
+    """The jitted flag-delta kernel when the jax backend is active (and
+    not quarantined), else None (callers take the NumPy path)."""
+    if _active != "jax" or is_quarantined(CAPABILITY):
         return None
     from . import ops_jax
 
     return ops_jax.flag_deltas
+
+
+def dispatch_delta_kernel(*args) -> Optional[tuple]:
+    """Supervised device dispatch of the flag-delta kernel.
+
+    Returns the kernel's (rewards, penalties) or None when the caller
+    must take the NumPy path — backend off, quarantined, or the dispatch
+    just failed terminally (in which case the capability is now
+    quarantined and the event recorded). Transient faults retry in
+    place; the numpy fallback is bit-identical by the crosscheck
+    harness's guarantee, so degradation never changes results.
+    """
+    kernel = delta_kernel()
+    if kernel is None:
+        return None
+
+    def _dispatch():
+        chaos("engine.dispatch")
+        return kernel(*args)
+
+    try:
+        return supervised(_dispatch, domain="engine", capability=CAPABILITY)
+    except Exception as e:
+        # supervised() already quarantined + recorded; belt-and-braces in
+        # case classification re-raised without a capability
+        record_event("fallback", domain="engine", capability=CAPABILITY,
+                     detail=f"delta kernel dispatch failed: {type(e).__name__}: {e}")
+        return None

@@ -212,10 +212,14 @@ def _flag_deltas(increments: np.ndarray, in_mask: np.ndarray, eligible: np.ndarr
     n = increments.size
     hi = int(increments.max()) if n else 0
     fits = hi * brpi * weight * max(upi, 1) <= U64_MAX
-    kernel = backend.delta_kernel()
-    if kernel is not None and fits and n >= backend.DEVICE_MIN_ROWS:
-        return kernel(increments, in_mask, eligible, brpi, weight, upi,
-                      active_increments, wd, leak, penalize)
+    if fits and n >= backend.DEVICE_MIN_ROWS:
+        out = backend.dispatch_delta_kernel(
+            increments, in_mask, eligible, brpi, weight, upi,
+            active_increments, wd, leak, penalize)
+        if out is not None:
+            return out
+        # fall through: backend off, quarantined, or dispatch failed —
+        # the numpy path below is the bit-identical host fallback
     rewards = np.zeros(n, dtype=np.uint64)
     penalties = np.zeros(n, dtype=np.uint64)
     base = mul_floordiv(increments, brpi, 1)

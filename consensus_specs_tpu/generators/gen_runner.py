@@ -9,6 +9,14 @@ answers were all genuinely True commit their buffered parts untouched;
 the rest replay against the flushed truth table at zero crypto cost.
 Output bytes are identical to the synchronous path by construction —
 pinned by tests/test_gen_defer.py.
+
+Resilience (consensus_specs_tpu/resilience): every case executes under
+the supervisor — injected/real transient faults retry with backoff
+before the case is counted failed — and committed cases are journaled
+(part digests, fsync'd) so a killed run resumes from verified-complete
+cases only: output that fails digest or structural verification
+(truncated ``.ssz_snappy``, malformed yaml) is regenerated, never
+silently shipped. Chaos point: ``gen.case``.
 """
 from __future__ import annotations
 
@@ -23,11 +31,16 @@ from typing import Iterable, List, Tuple
 import yaml
 
 from consensus_specs_tpu.exceptions import SkippedTest
+from consensus_specs_tpu.resilience import CaseJournal, RetryPolicy, chaos, supervised
 from consensus_specs_tpu.utils import profiling
 from consensus_specs_tpu.ssz.types import SSZType
 from consensus_specs_tpu.utils import snappy
 
 from .gen_typing import TestCase, TestProvider
+
+# transient-fault budget per case (device flake, injected chaos): short
+# backoff — a generator run has thousands of cases to get through
+CASE_RETRY_POLICY = RetryPolicy(max_attempts=3, base_delay_s=0.05, max_delay_s=1.0)
 
 TIME_THRESHOLD_TO_PRINT = 1.0  # seconds
 
@@ -133,11 +146,17 @@ def run_generator(generator_name: str, test_providers: Iterable[TestProvider], a
                              "optimistically, flush all checks as one device "
                              "dispatch, replay only mispredicted cases "
                              "(default: CONSENSUS_SPECS_TPU_BLS_DEFER env)")
+    parser.add_argument("--no-journal", dest="journal", action="store_false",
+                        default=True,
+                        help="disable the crash-safe case journal (digest-"
+                             "verified resume, corruption regeneration)")
 
     ns = parser.parse_args(args=args)
 
     output_dir: Path = ns.output_dir
     log_file = output_dir / "testgen_error_log.txt"
+
+    journal = CaseJournal(output_dir) if ns.journal and not ns.collect_only else None
 
     counts = {"generated": 0, "skipped": 0, "failed": 0}
     collected = 0
@@ -152,10 +171,26 @@ def run_generator(generator_name: str, test_providers: Iterable[TestProvider], a
         output_dir.mkdir(parents=True, exist_ok=True)
         with open(log_file, "a") as f:
             f.write(f"\n--- {case_dir} ---\n{err}\n")
+        if journal is not None:
+            journal.invalidate(str(case_dir.relative_to(output_dir)))
+
+    def run_case(case_fn) -> Tuple[List[Tuple[str, str, object]], dict]:
+        """One case execution under the supervisor: transient faults
+        (device flake, injected chaos) retry with backoff; SkippedTest
+        passes through as control flow, terminal faults re-raise into
+        the caller's record_failure path."""
+        def _attempt():
+            chaos("gen.case")
+            return _encode_parts(case_fn())
+
+        return supervised(_attempt, domain="generator",
+                          policy=CASE_RETRY_POLICY, passthrough=(SkippedTest,))
 
     def commit(case_dir: Path, encoded, meta, start: float) -> None:
         if _write_case(case_dir, encoded, meta) == 0:
             return
+        if journal is not None:
+            journal.record(str(case_dir.relative_to(output_dir)), case_dir)
         counts["generated"] += 1
         elapsed = time.time() - start
         if elapsed >= TIME_THRESHOLD_TO_PRINT:
@@ -177,7 +212,7 @@ def run_generator(generator_name: str, test_providers: Iterable[TestProvider], a
         encoded, meta, error = None, None, None
         try:
             with bls.deferring(verifier):
-                encoded, meta = _encode_parts(test_case.case_fn())
+                encoded, meta = run_case(test_case.case_fn)
         except SkippedTest as e:
             error = e
         except Exception:
@@ -247,8 +282,13 @@ def run_generator(generator_name: str, test_providers: Iterable[TestProvider], a
 
             if case_dir.exists():
                 if not ns.force and not incomplete_tag_file.exists():
-                    counts["skipped"] += 1
-                    continue
+                    if journal is None or journal.admit(
+                            str(case_dir.relative_to(output_dir)), case_dir):
+                        counts["skipped"] += 1
+                        continue
+                    # journal verification failed (truncated/tampered/
+                    # unverifiable output): regenerate instead of shipping
+                    print(f"regenerating (failed resume verification): {case_dir}")
                 shutil.rmtree(case_dir)
 
             print(f"generating: {case_dir}")
@@ -268,7 +308,7 @@ def run_generator(generator_name: str, test_providers: Iterable[TestProvider], a
                 else:
                     encoded, meta, error = None, None, None
                     try:
-                        encoded, meta = _encode_parts(test_case.case_fn())
+                        encoded, meta = run_case(test_case.case_fn)
                     except SkippedTest as e:
                         error = e
                     except Exception:

@@ -223,6 +223,11 @@ def run_checks_sharded(checks: Sequence[Optional[List[_Pair]]], mesh, axis_name:
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    try:  # jax.shard_map is 0.4.37+; this image's 0.4.36 ships the
+        from jax.experimental.shard_map import shard_map  # experimental path
+    except ImportError:  # pragma: no cover
+        shard_map = jax.shard_map
+
     out = np.zeros(len(checks), dtype=bool)
     n_axis = mesh.shape[axis_name]
     packed, live = _pack_checks(
@@ -244,7 +249,7 @@ def run_checks_sharded(checks: Sequence[Optional[List[_Pair]]], mesh, axis_name:
     def local_count(mask, is_real):
         return jax.lax.psum((mask & is_real).sum(dtype=np.int32), axis_name)
 
-    count = jax.shard_map(
+    count = shard_map(
         local_count, mesh=mesh, in_specs=(P(axis_name), P(axis_name)), out_specs=P()
     )(ok, real)
     ok = np.asarray(ok)

@@ -1,0 +1,61 @@
+"""Resilience subsystem: one fault-domain layer for every plane.
+
+The paper's conformance-vector contract gives this repo a property most
+accelerated systems lack: the interpreted spec and the golden vectors
+are always-available correctness oracles, so every accelerated path
+(device BLS, device hashing, the SoA epoch engine, sharded collectives)
+has a bit-identical host path to degrade to. This package wires that
+degradation up as a system instead of per-plane hand-rolled handling:
+
+- :mod:`taxonomy` — transient / deterministic / environmental fault
+  classes and classifiers (exceptions + child exit codes).
+- :mod:`supervisor` — ``supervised()`` retry-with-backoff for
+  transients, quarantine circuit breaker + host fallback for
+  deterministic faults, bounded structured event log.
+- :mod:`injection` — chaos points (``chaos(site)``) armed by env knob
+  or test fixture, so the recovery machinery is itself tier-1-tested.
+- :mod:`journal` — crash-safe digest journal for ``run_generator``:
+  resumed runs re-admit only byte-verified cases and regenerate
+  corrupted output instead of silently shipping it.
+- :mod:`selfcheck` — startup probes for known-bad paths (the jaxlib
+  GSPMD sharded tree-reduce miscompile), auto-quarantining them with a
+  recorded reason.
+
+Consumers: ``crypto/bls`` + the ssz hashing backend (crypto plane),
+``engine/backend`` (protocol plane), ``generators/gen_runner`` (vector
+plane), ``bench.py`` child sections and ``__graft_entry__``'s multichip
+dryrun (ops plane). Core modules are pure stdlib — bench.py's jax-free
+parent supervisor imports them safely.
+
+See docs/RESILIENCE.md for the taxonomy/quarantine matrix and knobs.
+"""
+from __future__ import annotations
+
+from .injection import ENV_KNOB, arm, chaos, disarm, inject, refresh  # noqa: F401
+from .journal import CaseJournal, verify_outputs  # noqa: F401
+from .selfcheck import SHARDED_TREE_REDUCE, sharded_reduce_status  # noqa: F401
+from .supervisor import (  # noqa: F401
+    DEFAULT_POLICY,
+    RetryPolicy,
+    clear,
+    events,
+    is_quarantined,
+    quarantine,
+    quarantine_reason,
+    quarantined,
+    record_event,
+    supervised,
+)
+from .taxonomy import (  # noqa: F401
+    DETERMINISTIC,
+    ENVIRONMENTAL,
+    TRANSIENT,
+    DeterministicFault,
+    EnvironmentalFault,
+    Fault,
+    QuarantinedError,
+    TransientFault,
+    classify,
+    classify_exit,
+    exit_code_for,
+)

@@ -1,0 +1,172 @@
+"""Chaos injection: named fault points wired into backend dispatch,
+subprocess children, and generator case execution, so the supervisor's
+behavior is itself tier-1-tested.
+
+Arming:
+- env knob (propagates to subprocess children automatically):
+      CONSENSUS_SPECS_TPU_CHAOS="site=kind:count[:after],site2=kind"
+  e.g. "bls.dispatch=transient:2"      fail the first 2 hits
+       "gen.case=kill:1:2"            SIGKILL the process on the 3rd hit
+       "engine.dispatch=deterministic" fail the first hit
+- programmatic (tests): ``with inject("site", "transient", count=2): ...``
+
+Kinds: transient / deterministic / environmental raise the matching
+taxonomy Fault; ``kill`` delivers SIGKILL to the current process (the
+crash-safety drill for the generator journal).
+
+Sites are plain strings; the convention is plane.point:
+  bls.import  bls.dispatch  engine.import  engine.dispatch
+  hash.dispatch  gen.case  bench.section  dryrun.child  replay.case
+
+``chaos(site)`` is a no-op dict probe when nothing is armed — cheap
+enough for hot paths.
+
+Cross-process counting: hit counts are per-process by default, so an
+env-armed ``kill:1`` would re-fire in every respawned child (retry
+supervisors could never drive past it). Point
+``CONSENSUS_SPECS_TPU_CHAOS_STATE`` at a scratch file and hits are
+tallied there instead — "fire once" then means once across the whole
+process tree.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import signal
+from typing import Dict, Optional
+
+from .supervisor import record_event
+from .taxonomy import DeterministicFault, EnvironmentalFault, TransientFault
+
+_FAULTS = {
+    "transient": TransientFault,
+    "deterministic": DeterministicFault,
+    "environmental": EnvironmentalFault,
+}
+
+ENV_KNOB = "CONSENSUS_SPECS_TPU_CHAOS"
+
+
+class _Armed:
+    __slots__ = ("kind", "count", "after", "hits", "_from_env")
+
+    def __init__(self, kind: str, count: int, after: int):
+        self.kind = kind
+        self.count = count      # how many times to fire (-1 = always)
+        self.after = after      # clean hits to allow before firing
+        self.hits = 0
+        self._from_env = False
+
+
+_SITES: Dict[str, _Armed] = {}
+_env_loaded: Optional[str] = None
+
+
+def _parse_env(raw: str) -> Dict[str, _Armed]:
+    sites: Dict[str, _Armed] = {}
+    for clause in raw.split(","):
+        clause = clause.strip()
+        if not clause or "=" not in clause:
+            continue
+        site, _, spec = clause.partition("=")
+        parts = spec.split(":")
+        kind = parts[0].strip()
+        if kind not in _FAULTS and kind != "kill":
+            raise ValueError(f"{ENV_KNOB}: unknown fault kind {kind!r} "
+                             f"(have {sorted(_FAULTS)} + 'kill')")
+        count = int(parts[1]) if len(parts) > 1 and parts[1] != "*" else (
+            1 if len(parts) <= 1 else -1)
+        after = int(parts[2]) if len(parts) > 2 else 0
+        sites[site.strip()] = _Armed(kind, count, after)
+    return sites
+
+
+def refresh() -> None:
+    """Re-read the env knob (tests that monkeypatch os.environ call this;
+    normal runs parse once, lazily)."""
+    global _env_loaded
+    raw = os.environ.get(ENV_KNOB, "")
+    _env_loaded = raw
+    # programmatically armed sites survive a refresh; env sites replace
+    # only the env-sourced population
+    for site in [s for s, a in _SITES.items() if a._from_env]:
+        del _SITES[site]
+    for site, armed in _parse_env(raw).items():
+        armed._from_env = True
+        _SITES[site] = armed
+
+
+def arm(site: str, kind: str, count: int = 1, after: int = 0) -> None:
+    if kind not in _FAULTS and kind != "kill":
+        raise ValueError(f"unknown fault kind {kind!r}")
+    _SITES[site] = _Armed(kind, count, after)
+
+
+def disarm(site: Optional[str] = None) -> None:
+    if site is None:
+        _SITES.clear()
+    else:
+        _SITES.pop(site, None)
+
+
+@contextlib.contextmanager
+def inject(site: str, kind: str, count: int = 1, after: int = 0):
+    """Arm one site for the duration of a with-block (test hook)."""
+    arm(site, kind, count=count, after=after)
+    try:
+        yield
+    finally:
+        disarm(site)
+
+
+def armed_sites() -> Dict[str, str]:
+    _maybe_load_env()
+    return {site: a.kind for site, a in _SITES.items()}
+
+
+def _maybe_load_env() -> None:
+    if _env_loaded != os.environ.get(ENV_KNOB, ""):
+        refresh()
+
+
+def _bump_hits(site: str, armed: _Armed) -> int:
+    """Advance and return this site's hit count — in the shared state
+    file when CONSENSUS_SPECS_TPU_CHAOS_STATE names one (cross-process
+    tally; test-grade read-modify-write), else in-process."""
+    state_path = os.environ.get("CONSENSUS_SPECS_TPU_CHAOS_STATE")
+    if not state_path:
+        armed.hits += 1
+        return armed.hits
+    try:
+        with open(state_path) as f:
+            state = json.load(f)
+    except (OSError, ValueError):
+        state = {}
+    state[site] = int(state.get(site, 0)) + 1
+    tmp = f"{state_path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(state, f)
+    os.replace(tmp, state_path)
+    return state[site]
+
+
+def chaos(site: str) -> None:
+    """The injection point. Call at every supervised dispatch site; fires
+    the armed fault (or SIGKILL) when this site is armed and its
+    after/count window says so."""
+    _maybe_load_env()
+    armed = _SITES.get(site)
+    if armed is None:
+        return
+    hits = _bump_hits(site, armed)
+    position = hits - armed.after
+    if position <= 0:
+        return
+    if armed.count >= 0 and position > armed.count:
+        return
+    record_event("injected", domain="chaos", capability=site, kind=armed.kind,
+                 detail=f"hit {armed.hits} (after={armed.after}, count={armed.count})")
+    if armed.kind == "kill":
+        os.kill(os.getpid(), signal.SIGKILL)
+    raise _FAULTS[armed.kind](f"injected {armed.kind} fault @ {site}", domain=site)

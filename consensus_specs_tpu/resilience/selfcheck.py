@@ -1,0 +1,108 @@
+"""Startup self-check probes for known-bad accelerated paths.
+
+The jax_graft image ships jaxlib 0.4.36, whose CPU GSPMD partitioner
+miscompiles the sharded Merkle TREE REDUCE once the row count drops
+below the shard count (the final levels of every root computation): the
+sharded result silently diverges from the single-device result. Before
+this layer, that bug hard-failed ``tests/test_multichip.py`` and the
+``dryrun_multichip`` child. The probe below reproduces it in miniature
+(16 rows over the mesh, one small compile), and on mismatch QUARANTINES
+the ``jax.sharded_tree_reduce`` capability so consumers degrade to the
+single-device / host path with a recorded reason instead of failing.
+
+The probe result is cached per process; ``CONSENSUS_SPECS_TPU_QUARANTINE=
+jax.sharded_tree_reduce`` pre-opens the breaker without paying the probe
+(known-bad boxes, CI).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from . import supervisor
+from .supervisor import record_event
+
+SHARDED_TREE_REDUCE = "jax.sharded_tree_reduce"
+
+OK = "ok"
+QUARANTINED = "quarantined"
+UNAVAILABLE = "unavailable"
+
+
+@dataclass(frozen=True)
+class ProbeResult:
+    capability: str
+    status: str  # ok | quarantined | unavailable
+    detail: str
+
+    @property
+    def quarantined(self) -> bool:
+        return self.status == QUARANTINED
+
+
+_cached: Optional[ProbeResult] = None
+
+
+def sharded_reduce_status(force: bool = False) -> ProbeResult:
+    """Probe (once per process) whether the sharded tree reduce computes
+    the same root as the single-device path; quarantine it if not."""
+    global _cached
+    if _cached is not None and not force:
+        return _cached
+    if supervisor.is_quarantined(SHARDED_TREE_REDUCE):
+        _cached = ProbeResult(SHARDED_TREE_REDUCE, QUARANTINED,
+                              supervisor.quarantine_reason(SHARDED_TREE_REDUCE) or "")
+        return _cached
+    _cached = _probe()
+    return _cached
+
+
+def _probe() -> ProbeResult:
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from ..ops.sha256 import merkle_reduce_jit
+
+        devices = jax.devices()
+        if len(devices) < 2:
+            return ProbeResult(
+                SHARDED_TREE_REDUCE, UNAVAILABLE,
+                "single device: sharded reduce never exercised")
+
+        # largest power-of-two shard count, and just enough rows that the
+        # reduce drops below it — the exact miscompile window, at the
+        # smallest (cheapest-to-compile) shape that exhibits it
+        n_shards = 1 << (len(devices).bit_length() - 1)
+        rows = 2 * n_shards
+        levels = rows.bit_length() - 1
+        rng = np.random.default_rng(97)
+        words = jnp.asarray(rng.integers(0, 2**32, size=(rows, 8), dtype=np.uint32))
+        want = np.asarray(merkle_reduce_jit(words, levels))
+
+        mesh = Mesh(np.array(devices[:n_shards]), ("dp",))
+        sharded = jax.device_put(words, NamedSharding(mesh, P("dp", None)))
+        got = np.asarray(merkle_reduce_jit(sharded, levels))
+    except Exception as e:
+        # no jax / no mesh / probe itself failed: the capability is not
+        # provably broken, just unprobeable — report, don't quarantine
+        detail = f"probe unavailable: {type(e).__name__}: {e}"
+        record_event("probe", domain="selfcheck", capability=SHARDED_TREE_REDUCE,
+                     kind="environmental", detail=detail)
+        return ProbeResult(SHARDED_TREE_REDUCE, UNAVAILABLE, detail)
+
+    if not np.array_equal(got, want):
+        detail = (f"GSPMD sharded tree-reduce miscompile detected: "
+                  f"{rows} rows over {n_shards} shards diverges from the "
+                  "single-device root (known jaxlib 0.4.36 CPU bug when "
+                  "reduce rows < shard count)")
+        supervisor.quarantine(SHARDED_TREE_REDUCE, detail, domain="selfcheck")
+        return ProbeResult(SHARDED_TREE_REDUCE, QUARANTINED, detail)
+
+    record_event("probe", domain="selfcheck", capability=SHARDED_TREE_REDUCE,
+                 kind="", detail=f"ok ({rows} rows over {n_shards} shards)")
+    return ProbeResult(SHARDED_TREE_REDUCE, OK,
+                       f"sharded tree reduce matches single-device root "
+                       f"({rows} rows over {n_shards} shards)")

@@ -1,0 +1,191 @@
+"""Supervised execution: retry transients, quarantine deterministic
+failures, degrade to the host fallback — one recovery policy for every
+plane (crypto backends, engine dispatch, subprocess children, generator
+cases).
+
+The quarantine registry is the circuit breaker: the first deterministic
+(or environmental, or retry-exhausted) failure of a capability opens the
+breaker, and every later ``supervised()`` call for that capability goes
+straight to its fallback without touching the broken path again. Events
+(retries, quarantines, fallbacks) are recorded in a bounded in-process
+log that bench.py serializes into the BENCH json — degradation is
+visible in the trajectory, never silent.
+
+Pure stdlib: importable from bench.py's jax-free parent supervisor.
+"""
+from __future__ import annotations
+
+import os
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from . import taxonomy
+from .taxonomy import (  # noqa: F401  (re-exported convenience)
+    DETERMINISTIC,
+    ENVIRONMENTAL,
+    TRANSIENT,
+    QuarantinedError,
+)
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff under a deadline, for TRANSIENT faults only."""
+
+    max_attempts: int = 3          # total tries (1 initial + retries)
+    base_delay_s: float = 0.05
+    factor: float = 2.0
+    max_delay_s: float = 2.0
+    deadline_s: Optional[float] = None  # wall-clock cap across all tries
+
+    def delay(self, retry_index: int) -> float:
+        return min(self.base_delay_s * (self.factor ** retry_index), self.max_delay_s)
+
+
+DEFAULT_POLICY = RetryPolicy()
+
+# ---------------------------------------------------------------------------
+# event log
+# ---------------------------------------------------------------------------
+
+_EVENTS: deque = deque(maxlen=512)
+
+
+def record_event(event: str, *, domain: str = "", capability: str = "",
+                 kind: str = "", detail: str = "") -> dict:
+    entry = {
+        "t": round(time.time(), 3),
+        "event": event,
+        "domain": domain,
+        "capability": capability,
+        "kind": kind,
+        "detail": detail[:500],
+    }
+    _EVENTS.append(entry)
+    return entry
+
+
+def events(clear: bool = False) -> List[dict]:
+    out = list(_EVENTS)
+    if clear:
+        _EVENTS.clear()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# quarantine registry (the circuit breaker)
+# ---------------------------------------------------------------------------
+
+_QUARANTINED: Dict[str, str] = {}
+
+
+def _env_quarantined() -> Dict[str, str]:
+    """Capabilities pre-quarantined via env (testing / known-bad boxes):
+    CONSENSUS_SPECS_TPU_QUARANTINE="cap1,cap2"."""
+    raw = os.environ.get("CONSENSUS_SPECS_TPU_QUARANTINE", "")
+    return {c.strip(): "pre-quarantined via CONSENSUS_SPECS_TPU_QUARANTINE"
+            for c in raw.split(",") if c.strip()}
+
+
+def quarantine(capability: str, reason: str, *, kind: str = DETERMINISTIC,
+               domain: str = "") -> bool:
+    """Open the breaker for ``capability``. Returns True the FIRST time
+    (the event fires once); later calls are no-ops."""
+    if capability in _QUARANTINED:
+        return False
+    _QUARANTINED[capability] = reason
+    record_event("quarantine", domain=domain, capability=capability,
+                 kind=kind, detail=reason)
+    return True
+
+
+def is_quarantined(capability: str) -> bool:
+    return capability in _QUARANTINED or capability in _env_quarantined()
+
+
+def quarantine_reason(capability: str) -> Optional[str]:
+    if capability in _QUARANTINED:
+        return _QUARANTINED[capability]
+    return _env_quarantined().get(capability)
+
+
+def quarantined() -> Dict[str, str]:
+    out = dict(_env_quarantined())
+    out.update(_QUARANTINED)
+    return out
+
+
+def clear(capability: Optional[str] = None) -> None:
+    """Close the breaker(s) — test/repair hook."""
+    if capability is None:
+        _QUARANTINED.clear()
+    else:
+        _QUARANTINED.pop(capability, None)
+
+
+# ---------------------------------------------------------------------------
+# supervised execution
+# ---------------------------------------------------------------------------
+
+def supervised(fn: Callable, *, domain: str, capability: Optional[str] = None,
+               policy: RetryPolicy = DEFAULT_POLICY,
+               fallback: Optional[Callable] = None,
+               classify: Callable[[BaseException], str] = taxonomy.classify,
+               passthrough: tuple = (),
+               sleep: Callable[[float], None] = time.sleep):
+    """Run ``fn()`` under the recovery policy.
+
+    - TRANSIENT faults retry with exponential backoff up to
+      ``policy.max_attempts`` tries within ``policy.deadline_s``.
+    - DETERMINISTIC / ENVIRONMENTAL faults (and exhausted transients —
+      a fault that never stops being "transient" is an environment
+      problem) quarantine ``capability`` and run ``fallback()``.
+    - A capability whose breaker is already open skips ``fn`` entirely.
+    - ``passthrough`` exception types re-raise untouched (control-flow
+      exceptions like SkippedTest are not faults).
+
+    Without a fallback the terminal fault re-raises, after the breaker
+    state is recorded — callers that cannot degrade still report.
+    """
+    if capability is not None and is_quarantined(capability):
+        if fallback is not None:
+            record_event("fallback", domain=domain, capability=capability,
+                         detail=f"breaker open: {quarantine_reason(capability)}")
+            return fallback()
+        raise QuarantinedError(
+            f"{capability} is quarantined ({quarantine_reason(capability)}) "
+            "and no fallback is available", domain=domain)
+
+    t0 = time.monotonic()
+    retries = 0
+    while True:
+        try:
+            return fn()
+        except BaseException as exc:
+            if isinstance(exc, (KeyboardInterrupt, SystemExit)) or (
+                    passthrough and isinstance(exc, passthrough)):
+                raise
+            kind = classify(exc)
+            detail = f"{type(exc).__name__}: {exc}"
+            if kind == TRANSIENT:
+                within_deadline = (policy.deadline_s is None
+                                   or time.monotonic() - t0 < policy.deadline_s)
+                if retries + 1 < policy.max_attempts and within_deadline:
+                    record_event("retry", domain=domain, capability=capability or "",
+                                 kind=kind, detail=detail)
+                    sleep(policy.delay(retries))
+                    retries += 1
+                    continue
+                kind = ENVIRONMENTAL  # transients that never clear
+                detail = f"retries exhausted ({retries + 1} tries): {detail}"
+            if capability is not None:
+                quarantine(capability, detail, kind=kind, domain=domain)
+            else:
+                record_event("gave_up", domain=domain, kind=kind, detail=detail)
+            if fallback is not None:
+                record_event("fallback", domain=domain,
+                             capability=capability or "", kind=kind, detail=detail)
+                return fallback()
+            raise

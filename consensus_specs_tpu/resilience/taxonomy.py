@@ -1,0 +1,144 @@
+"""Structured fault taxonomy for the accelerated planes.
+
+Every failure the system can meet falls into one of three classes, and
+the class — not the exception type — decides the recovery action:
+
+- TRANSIENT: the operation may succeed if simply tried again (device
+  dispatch flake, resource exhaustion, subprocess timeout, a wedged
+  tunnel connection). Recovery: retry with exponential backoff under a
+  deadline.
+- DETERMINISTIC: retrying is pointless — the same inputs will fail the
+  same way (a miscompile, a wrong result caught by a cross-check, a
+  compile error). Recovery: quarantine the capability and degrade to
+  the always-correct host path.
+- ENVIRONMENTAL: the capability's prerequisites are absent (jax not
+  importable, native lib missing, no devices). Recovery: same as
+  deterministic — quarantine + host fallback — but the event is
+  recorded as an environment gap, not a defect.
+
+The conformance-vector contract makes this tractable: the interpreted
+spec and the golden vectors are always-available oracles, so every
+accelerated path has a correct fallback to degrade to. The taxonomy is
+pure stdlib — bench.py's supervisor (which never imports jax) and the
+generator pipeline both load it.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+TRANSIENT = "transient"
+DETERMINISTIC = "deterministic"
+ENVIRONMENTAL = "environmental"
+
+KINDS = (TRANSIENT, DETERMINISTIC, ENVIRONMENTAL)
+
+
+class Fault(Exception):
+    """A failure that already carries its classification (raised by
+    injection hooks and by code that knows its own failure mode)."""
+
+    kind: str = DETERMINISTIC
+
+    def __init__(self, message: str = "", *, domain: str = ""):
+        super().__init__(message)
+        self.domain = domain
+
+
+class TransientFault(Fault):
+    kind = TRANSIENT
+
+
+class DeterministicFault(Fault):
+    kind = DETERMINISTIC
+
+
+class EnvironmentalFault(Fault):
+    kind = ENVIRONMENTAL
+
+
+class QuarantinedError(Fault):
+    """Raised when a quarantined capability is invoked with no fallback
+    available — deterministic by definition (the breaker is open)."""
+
+    kind = DETERMINISTIC
+
+
+# Message substrings that mark a device/runtime error as retryable even
+# though its Python type is opaque (jaxlib surfaces everything as
+# XlaRuntimeError): resource pressure, dead connections, server-side
+# deadline hits, and the tunnel's mid-compile disconnects.
+_TRANSIENT_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "out of memory",
+    "OOM",
+    "DEADLINE_EXCEEDED",
+    "UNAVAILABLE",
+    "ABORTED",
+    "remote_compile",
+    "response body closed",
+    "Connection reset",
+    "Socket closed",
+    "timed out",
+)
+
+
+def classify(exc: BaseException) -> str:
+    """Map an exception to its fault class. Explicit Fault subclasses
+    win; everything else is classified structurally, with DETERMINISTIC
+    as the safe default (an unknown failure must quarantine and degrade
+    to the correct host path, never spin in a retry loop)."""
+    if isinstance(exc, Fault):
+        return exc.kind
+    if isinstance(exc, (ImportError, ModuleNotFoundError)):
+        return ENVIRONMENTAL
+    if isinstance(exc, (TimeoutError, ConnectionError, BrokenPipeError,
+                        InterruptedError, MemoryError)):
+        return TRANSIENT
+    try:  # subprocess is stdlib but keep the import local: hot paths
+        import subprocess
+
+        if isinstance(exc, subprocess.TimeoutExpired):
+            return TRANSIENT
+    except Exception:  # pragma: no cover
+        pass
+    if isinstance(exc, FileNotFoundError):
+        return ENVIRONMENTAL  # missing lib/binary, not a data error
+    if isinstance(exc, OSError):
+        return TRANSIENT  # I/O flake: fd churn, EAGAIN-class errors
+    text = f"{type(exc).__name__}: {exc}"
+    if any(marker in text for marker in _TRANSIENT_MARKERS):
+        return TRANSIENT
+    return DETERMINISTIC
+
+
+# sysexits.h conventions a supervised child can use to report its own
+# fault class (see exit_code_for / __graft_entry__'s dryrun child)
+EX_TEMPFAIL = 75     # transient: retry me
+EX_CONFIG = 78       # environmental: my prerequisites are missing
+EX_SOFTWARE = 70     # deterministic: same inputs will fail the same way
+
+
+def exit_code_for(kind: str) -> int:
+    """The exit code a child should use to report a classified fault."""
+    return {TRANSIENT: EX_TEMPFAIL, ENVIRONMENTAL: EX_CONFIG}.get(kind, EX_SOFTWARE)
+
+
+def classify_exit(returncode: Optional[int]) -> Optional[str]:
+    """Fault class of a child process exit. None for success.
+
+    Signal deaths (negative rc, or the shell's 128+N convention) read as
+    TRANSIENT: the child was killed from outside (deadline enforcement,
+    OOM killer), which says nothing deterministic about its inputs. The
+    sysexits codes above round-trip a child's own classification. Any
+    other nonzero exit is the child reporting its own failure —
+    DETERMINISTIC until a retry proves otherwise.
+    """
+    if returncode is None or returncode == 0:
+        return None
+    if returncode == EX_TEMPFAIL:
+        return TRANSIENT
+    if returncode == EX_CONFIG:
+        return ENVIRONMENTAL
+    if returncode < 0 or returncode in (124, 125) or returncode > 128:
+        return TRANSIENT
+    return DETERMINISTIC

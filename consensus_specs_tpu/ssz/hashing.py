@@ -86,6 +86,25 @@ def backend_name() -> str:
 
 DEVICE_MIN_BLOCKS = 64  # below this, host hashlib beats the dispatch overhead
 
+HASH_CAPABILITY = "hash.device"
+
+
+def _device_call(fn: Callable, host_fn: Callable, *args):
+    """Supervised non-host hasher dispatch: transient faults retry,
+    terminal faults quarantine ``hash.device`` and the host path (always
+    bit-identical — same SHA-256) takes over with a recorded event."""
+    from ..resilience import chaos, is_quarantined, supervised
+
+    if is_quarantined(HASH_CAPABILITY):
+        return host_fn(*args)
+
+    def _attempt():
+        chaos("hash.dispatch")
+        return fn(*args)
+
+    return supervised(_attempt, domain="crypto.hash", capability=HASH_CAPABILITY,
+                      fallback=lambda: host_fn(*args))
+
 
 def hash_many(data: bytes) -> bytes:
     """SHA-256 of each consecutive 64-byte block of ``data``, concatenated.
@@ -98,8 +117,10 @@ def hash_many(data: bytes) -> bytes:
         raise ValueError(f"hash_many input must be a multiple of 64 bytes, got {len(data)}")
     if not data:
         return b""
-    if _backend is not _host_hash_many and len(data) < 64 * DEVICE_MIN_BLOCKS:
-        return _host_hash_many(data)
+    if _backend is not _host_hash_many:
+        if len(data) < 64 * DEVICE_MIN_BLOCKS:
+            return _host_hash_many(data)
+        return _device_call(_backend, _host_hash_many, data)
     return _backend(data)
 
 
@@ -117,11 +138,13 @@ def set_fused_root_backend(fn: Optional[Callable]) -> None:
 
 
 def fused_root(chunks: bytes, limit: int) -> Optional[bytes]:
-    """The fused whole-tree root, or None when no backend is installed or
-    the tree is too small to be worth a device dispatch."""
+    """The fused whole-tree root, or None when no backend is installed,
+    the tree is too small to be worth a device dispatch, or the device
+    hasher is quarantined (callers' level-by-level path is the host
+    fallback)."""
     if _fused_root_backend is None or len(chunks) < 32 * FUSED_ROOT_MIN_CHUNKS:
         return None
-    return _fused_root_backend(chunks, limit)
+    return _device_call(_fused_root_backend, lambda *_: None, chunks, limit)
 
 
 _tree_backend: Optional[Callable] = None

@@ -1,0 +1,39 @@
+"""Subprocess driver for the generator crash/resume drill
+(tests/test_gen_journal.py): generates the sanity/slots minimal suite
+into the given output dir. Run in a child process so the test can
+SIGKILL it mid-generation (via the chaos 'kill' injection) and then
+rerun it to prove journal-based resume yields a byte-identical tree."""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(out_dir: str) -> None:
+    import tests.spec.test_sanity_slots as slots_src
+    from consensus_specs_tpu.generators.gen_from_tests import generate_from_tests
+    from consensus_specs_tpu.generators.gen_runner import run_generator
+    from consensus_specs_tpu.generators.gen_typing import TestProvider
+
+    def make():
+        yield from generate_from_tests(
+            runner_name="sanity",
+            handler_name="slots",
+            src=slots_src,
+            fork_name="phase0",
+            preset_name="minimal",
+            bls_active=False,
+            phase=None,
+        )
+
+    run_generator(
+        "sanity",
+        [TestProvider(prepare=lambda: None, make_cases=make)],
+        args=["-o", out_dir],
+    )
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

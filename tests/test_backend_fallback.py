@@ -1,0 +1,256 @@
+"""Backend quarantine-and-fallback: induced import/compile/dispatch
+failures in the engine backend, the BLS facade, and the hashing backend
+must (a) retry transients to success, (b) quarantine exactly once on a
+deterministic fault, (c) hand every later call to the host path, and
+(d) keep results bit-identical to the interpreted/reference oracle
+throughout — degradation may never change an answer."""
+from __future__ import annotations
+
+import pytest
+
+from consensus_specs_tpu import engine, resilience as r
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.engine import backend, crosscheck
+from consensus_specs_tpu.specs import build_spec
+from consensus_specs_tpu.ssz import hashing
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    r.clear()
+    r.events(clear=True)
+    from consensus_specs_tpu.resilience import injection
+
+    injection.disarm()
+    yield
+    r.clear()
+    injection.disarm()
+    engine.use_interpreted_epoch()
+    engine.use_backend("numpy")
+    bls.use_backend("reference")
+    hashing.set_backend(None)
+
+
+# ---------------------------------------------------------------------------
+# engine backend
+# ---------------------------------------------------------------------------
+
+def _rewards_state(spec, seed=11):
+    return crosscheck.random_epoch_state(spec, seed=seed, n_validators=64, epoch=3)
+
+
+def test_engine_import_failure_degrades_to_numpy():
+    with r.inject("engine.import", "environmental"):
+        installed = engine.use_backend("jax")
+    assert installed == "numpy"
+    assert backend.active() == "numpy"
+    assert r.is_quarantined("engine.jax")
+    # results still correct: the numpy engine is the oracle-checked path
+    spec = build_spec("altair", "minimal")
+    same, *_ = crosscheck.crosscheck_stage(
+        spec, "process_rewards_and_penalties", _rewards_state(spec))
+    assert same
+
+
+def test_engine_dispatch_deterministic_quarantines_once_numpy_takes_over():
+    engine.use_backend("jax")
+    saved = backend.DEVICE_MIN_ROWS
+    backend.DEVICE_MIN_ROWS = 1  # force the dispatch path on a small registry
+    try:
+        spec = build_spec("altair", "minimal")
+        with r.inject("engine.dispatch", "deterministic", count=-1):
+            same, i_root, v_root = crosscheck.crosscheck_stage(
+                spec, "process_rewards_and_penalties", _rewards_state(spec))
+        # the injected kernel fault degraded to numpy mid-stage: still
+        # bit-identical to the interpreted oracle
+        assert same, f"fallback changed results: {i_root} != {v_root}"
+        assert r.is_quarantined("engine.jax")
+        quarantines = [e for e in r.events() if e["event"] == "quarantine"
+                       and e["capability"] == "engine.jax"]
+        assert len(quarantines) == 1
+        # breaker open: the kernel is not offered anymore
+        assert backend.delta_kernel() is None
+        # and the stage keeps producing oracle-identical results
+        same, *_ = crosscheck.crosscheck_stage(
+            spec, "process_rewards_and_penalties", _rewards_state(spec, seed=12))
+        assert same
+    finally:
+        backend.DEVICE_MIN_ROWS = saved
+
+
+def test_engine_dispatch_transient_retried_to_success():
+    engine.use_backend("jax")
+    saved = backend.DEVICE_MIN_ROWS
+    backend.DEVICE_MIN_ROWS = 1
+    try:
+        spec = build_spec("altair", "minimal")
+        with r.inject("engine.dispatch", "transient", count=1):
+            same, *_ = crosscheck.crosscheck_stage(
+                spec, "process_rewards_and_penalties", _rewards_state(spec))
+        assert same
+        assert not r.is_quarantined("engine.jax")  # retry succeeded
+        assert any(e["event"] == "retry" for e in r.events())
+    finally:
+        backend.DEVICE_MIN_ROWS = saved
+
+
+# ---------------------------------------------------------------------------
+# bls facade
+# ---------------------------------------------------------------------------
+
+_SK = 42
+_MSG = b"\x5a" * 32
+
+
+def _valid_check():
+    from consensus_specs_tpu.crypto.bls import ciphersuite
+
+    pk = ciphersuite.SkToPk(_SK)
+    sig = ciphersuite.Sign(_SK, _MSG)
+    return pk, _MSG, sig
+
+
+class _StubDeviceBackend:
+    """A 'device' backend the facade can quarantine without compiling
+    anything: correct answers via the reference implementation."""
+
+    def __init__(self):
+        from consensus_specs_tpu.crypto.bls import ciphersuite
+
+        self._ref = ciphersuite
+        self.calls = 0
+
+    def Verify(self, pk, msg, sig):
+        self.calls += 1
+        return self._ref.Verify(pk, msg, sig)
+
+    def FastAggregateVerify(self, pks, msg, sig):
+        self.calls += 1
+        return self._ref.FastAggregateVerify(pks, msg, sig)
+
+    def AggregateVerify(self, pks, msgs, sig):
+        self.calls += 1
+        return self._ref.AggregateVerify(pks, msgs, sig)
+
+
+@pytest.fixture()
+def stub_backend(monkeypatch):
+    stub = _StubDeviceBackend()
+    monkeypatch.setattr(bls, "_backend", stub)
+    monkeypatch.setattr(bls, "_backend_name", "jax")
+    return stub
+
+
+def test_bls_import_failure_degrades_to_reference():
+    with r.inject("bls.import", "environmental"):
+        installed = bls.use_backend("jax")
+    assert installed == "reference"
+    assert bls.backend_name() == "reference"
+    assert r.is_quarantined("bls.jax")
+    pk, msg, sig = _valid_check()
+    assert bls.Verify(pk, msg, sig) is True
+
+
+def test_bls_dispatch_deterministic_quarantines_and_oracle_answers(stub_backend):
+    from consensus_specs_tpu.crypto.bls import ciphersuite
+
+    pk, msg, sig = _valid_check()
+    with r.inject("bls.dispatch", "deterministic", count=-1):
+        got = bls.Verify(pk, msg, sig)
+    # the backend failed on a check the oracle ACCEPTS: defect -> quarantine
+    assert got is ciphersuite.Verify(pk, msg, sig) is True
+    assert r.is_quarantined("bls.jax")
+    quarantines = [e for e in r.events() if e["event"] == "quarantine"
+                   and e["capability"] == "bls.jax"]
+    assert len(quarantines) == 1
+    # breaker open: the stub is never called again, answers stay correct
+    calls_before = stub_backend.calls
+    assert bls.Verify(pk, msg, sig) is True
+    assert bls.Verify(pk, msg, b"\x00" * 96) is False  # invalid sig, oracle says no
+    assert stub_backend.calls == calls_before
+
+
+def test_bls_dispatch_transient_retried_to_success(stub_backend):
+    pk, msg, sig = _valid_check()
+    with r.inject("bls.dispatch", "transient", count=1):
+        assert bls.Verify(pk, msg, sig) is True
+    assert not r.is_quarantined("bls.jax")
+    assert stub_backend.calls == 1  # the retry reached the backend
+    assert any(e["event"] == "retry" for e in r.events())
+
+
+def test_bls_invalid_input_does_not_quarantine(stub_backend, monkeypatch):
+    """A backend exception on an input the ORACLE also rejects is the
+    spec's invalid-input surface, not a backend defect: answer False,
+    keep the breaker closed."""
+    def raising_verify(pk, msg, sig):
+        raise ValueError("bad point encoding")
+
+    monkeypatch.setattr(stub_backend, "Verify", raising_verify)
+    pk, msg, _ = _valid_check()
+    assert bls.Verify(pk, msg, b"\xff" * 96) is False
+    assert not r.is_quarantined("bls.jax")
+
+
+def test_bls_env_knob_drives_injection(stub_backend, monkeypatch):
+    """The acceptance-criteria path: injection enabled via the env knob
+    (not the fixture API) retries the transient to success."""
+    monkeypatch.setenv(r.ENV_KNOB, "bls.dispatch=transient:1")
+    r.refresh()
+    try:
+        pk, msg, sig = _valid_check()
+        assert bls.Verify(pk, msg, sig) is True
+        assert not r.is_quarantined("bls.jax")
+    finally:
+        monkeypatch.delenv(r.ENV_KNOB)
+        r.refresh()
+
+
+# ---------------------------------------------------------------------------
+# hashing backend
+# ---------------------------------------------------------------------------
+
+def _install_stub_hasher(fail=False):
+    calls = {"n": 0}
+
+    def stub(data: bytes) -> bytes:
+        calls["n"] += 1
+        if fail:
+            raise AssertionError("stub device hasher corrupted digest")
+        return hashing._host_hash_many(data)
+
+    hashing.set_backend(stub, "stub-device")
+    return calls
+
+
+def test_hash_dispatch_deterministic_quarantines_host_takes_over():
+    data = b"\xab" * (64 * hashing.DEVICE_MIN_BLOCKS)
+    want = hashing._host_hash_many(data)
+    calls = _install_stub_hasher(fail=True)
+    assert hashing.hash_many(data) == want  # fallback answered
+    assert r.is_quarantined(hashing.HASH_CAPABILITY)
+    n = calls["n"]
+    assert hashing.hash_many(data) == want  # breaker open: host path
+    assert calls["n"] == n
+
+
+def test_hash_dispatch_transient_retried():
+    data = b"\xcd" * (64 * hashing.DEVICE_MIN_BLOCKS)
+    want = hashing._host_hash_many(data)
+    _install_stub_hasher(fail=False)
+    with r.inject("hash.dispatch", "transient", count=1):
+        assert hashing.hash_many(data) == want
+    assert not r.is_quarantined(hashing.HASH_CAPABILITY)
+
+
+def test_hash_quarantine_keeps_tree_roots_identical():
+    """End-to-end: a quarantined device hasher must not change a
+    hash_tree_root (the host path is the same SHA-256)."""
+    from consensus_specs_tpu.ssz import hash_tree_root
+    from consensus_specs_tpu.ssz.types import List, uint64
+
+    value = List[uint64, 1024](list(range(500)))
+    want = bytes(hash_tree_root(value))
+    _install_stub_hasher(fail=True)
+    r.quarantine(hashing.HASH_CAPABILITY, "test-forced")
+    assert bytes(hash_tree_root(value)) == want

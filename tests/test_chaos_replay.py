@@ -1,0 +1,141 @@
+"""Chaos replay: a generated corpus with tampered fixtures — truncated
+``.ssz_snappy``, malformed ``data.yaml``/``mapping.yaml``/``slots.yaml``,
+missing parts — must degrade gracefully through tools/replay_vectors:
+every tampered case is flagged with the ``corruption`` taxonomy class,
+untampered cases keep replaying clean, and the walk never aborts on the
+first bad file."""
+from __future__ import annotations
+
+import pathlib
+import shutil
+import tempfile
+
+import pytest
+import yaml
+
+from consensus_specs_tpu import resilience as r
+from consensus_specs_tpu.generators.gen_from_tests import generate_from_tests
+from consensus_specs_tpu.generators.gen_runner import run_generator
+from consensus_specs_tpu.generators.gen_typing import TestProvider
+from consensus_specs_tpu.utils import snappy
+from tools.replay_vectors import replay_tree, summarize_failures
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    """A small sanity/slots corpus (pre + slots.yaml + post per case) —
+    the cheapest format family carrying both ssz and yaml parts."""
+    import tests.spec.test_sanity_slots as slots_src
+
+    with tempfile.TemporaryDirectory() as out:
+        def make():
+            yield from generate_from_tests(
+                runner_name="sanity",
+                handler_name="slots",
+                src=slots_src,
+                fork_name="phase0",
+                preset_name="minimal",
+                bls_active=False,
+                phase=None,
+            )
+
+        run_generator(
+            "sanity",
+            [TestProvider(prepare=lambda: None, make_cases=make)],
+            args=["-o", out],
+        )
+        yield pathlib.Path(out)
+
+
+def _tampered_copy(corpus: pathlib.Path, dest: str) -> pathlib.Path:
+    work = pathlib.Path(dest)
+    shutil.copytree(corpus, work, dirs_exist_ok=True)
+    return work
+
+
+def _case_dirs(root: pathlib.Path):
+    return sorted(p.parent for p in root.rglob("slots.yaml"))
+
+
+def test_clean_corpus_replays_ok(corpus):
+    ok, failed, unsupported, incomplete = replay_tree(corpus)
+    assert failed == [] and ok >= 3
+    assert unsupported == 0 and incomplete == 0
+
+
+def test_every_tamper_class_is_flagged_as_corruption(corpus, tmp_path):
+    work = _tampered_copy(corpus, tmp_path / "work")
+    cases = _case_dirs(work)
+    assert len(cases) >= 3, "need at least 3 cases to tamper independently"
+
+    tampered = {}
+
+    # (1) truncated ssz part: survives nothing — the snappy CRC catches it
+    post = cases[0] / "post.ssz_snappy"
+    post.write_bytes(post.read_bytes()[: max(1, post.stat().st_size // 2)])
+    tampered[str(cases[0].relative_to(work))] = "truncated ssz_snappy"
+
+    # (2) malformed yaml data part
+    (cases[1] / "slots.yaml").write_text("{unclosed: [")
+    tampered[str(cases[1].relative_to(work))] = "malformed yaml"
+
+    # (3) missing part: pre state deleted out from under the case
+    (cases[2] / "pre.ssz_snappy").unlink()
+    tampered[str(cases[2].relative_to(work))] = "missing part"
+
+    # (4) handcrafted bls case with malformed data.yaml (the yaml-only
+    # format family the replayer walks via *.yaml)
+    bls_case = work / "general/phase0/bls/verify/small/corrupt_case"
+    bls_case.mkdir(parents=True)
+    (bls_case / "data.yaml").write_text("input: {pubkey: [unterminated")
+    tampered[str(bls_case.relative_to(work))] = "malformed bls data.yaml"
+
+    # (5) handcrafted shuffling case with malformed mapping.yaml
+    shuf_case = work / "minimal/phase0/shuffling/core/shuffle/corrupt_case"
+    shuf_case.mkdir(parents=True)
+    (shuf_case / "mapping.yaml").write_text("seed: '0x' mapping: [")
+    tampered[str(shuf_case.relative_to(work))] = "malformed mapping.yaml"
+
+    ok, failed, unsupported, incomplete = replay_tree(work)
+
+    # the walk completed and flagged EVERY tampered case — exactly those
+    failed_paths = {rel for rel, _ in failed}
+    assert failed_paths == set(tampered), (
+        f"flagged {failed_paths} vs tampered {set(tampered)}")
+    # all classified as corruption, visible in the structured summary
+    assert summarize_failures(failed) == {"corruption": len(tampered)}
+    for f in failed:
+        assert f.taxonomy == "corruption"
+        assert f[1].startswith("[corruption] ")
+    # untampered cases still replayed clean (graceful degradation)
+    assert ok == len(_case_dirs(work)) - 3
+
+
+def test_divergence_classified_separately_from_corruption(corpus, tmp_path):
+    """A corrupted POST STATE that still decodes is a divergence (the
+    replay ran, the bytes disagree) — not corpus corruption."""
+    work = _tampered_copy(corpus, tmp_path / "work")
+    case = _case_dirs(work)[0]
+    post = case / "post.ssz_snappy"
+    raw = bytearray(snappy.decompress(post.read_bytes()))
+    raw[0] ^= 0xFF
+    post.write_bytes(snappy.compress(bytes(raw)))
+
+    ok, failed, _, _ = replay_tree(work)
+    assert len(failed) == 1
+    assert failed[0].taxonomy == "divergence"
+    assert "post mismatch" in failed[0][1]
+
+
+def test_injected_replay_fault_is_classified(corpus, monkeypatch):
+    """The env knob drives injection INTO the replayer loop itself."""
+    monkeypatch.setenv(r.ENV_KNOB, "replay.case=deterministic:1")
+    r.refresh()
+    try:
+        ok, failed, _, _ = replay_tree(corpus)
+        assert len(failed) == 1
+        assert failed[0].taxonomy == "deterministic"
+        assert ok == len(_case_dirs(corpus)) - 1
+    finally:
+        monkeypatch.delenv(r.ENV_KNOB)
+        r.refresh()

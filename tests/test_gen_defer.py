@@ -20,10 +20,14 @@ from consensus_specs_tpu.generators.gen_typing import TestProvider
 
 
 def _tree(root: pathlib.Path) -> dict:
+    # the resilience journal is run metadata (commit ORDER differs
+    # between deferred and strict runs by design), not corpus bytes
+    from consensus_specs_tpu.resilience import journal
+
     return {
         str(p.relative_to(root)): p.read_bytes()
         for p in sorted(root.rglob("*"))
-        if p.is_file()
+        if p.is_file() and p.name != journal.JOURNAL_NAME
     }
 
 

@@ -1,0 +1,127 @@
+"""Generator crash safety: a run killed with SIGKILL mid-generation must
+resume from the journal on rerun and produce a byte-identical vector
+tree; corrupted committed output (truncated parts, tampered yaml) must
+be detected at resume and regenerated, never silently shipped; injected
+transient faults inside case execution retry to success."""
+from __future__ import annotations
+
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+
+import pytest
+
+from consensus_specs_tpu import resilience as r
+from consensus_specs_tpu.resilience import journal as journal_mod
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+DRIVER = REPO / "tests" / "_gen_journal_driver.py"
+
+
+def _run_driver(out_dir: pathlib.Path, chaos: str = "") -> subprocess.CompletedProcess:
+    env = dict(os.environ)
+    env.pop("CONSENSUS_SPECS_TPU_CHAOS_STATE", None)
+    if chaos:
+        env[r.ENV_KNOB] = chaos
+    else:
+        env.pop(r.ENV_KNOB, None)
+    return subprocess.run(
+        [sys.executable, str(DRIVER), str(out_dir)],
+        env=env, cwd=str(REPO), capture_output=True, text=True, timeout=300,
+    )
+
+
+def _tree(root: pathlib.Path) -> dict:
+    """{relative path: bytes} over the corpus, minus journal/log files."""
+    skip = {journal_mod.JOURNAL_NAME, "testgen_error_log.txt"}
+    return {
+        str(p.relative_to(root)): p.read_bytes()
+        for p in sorted(root.rglob("*"))
+        if p.is_file() and p.name not in skip
+    }
+
+
+@pytest.fixture(scope="module")
+def clean_tree(tmp_path_factory):
+    out = tmp_path_factory.mktemp("gen_clean")
+    proc = _run_driver(out)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    tree = _tree(out)
+    assert len(tree) >= 9, "expected at least 3 cases x 3 parts"
+    return tree
+
+
+def test_kill9_then_rerun_resumes_byte_identical(clean_tree, tmp_path):
+    out = tmp_path / "vectors"
+    # the chaos 'kill' kind delivers SIGKILL to the generator process at
+    # the start of the 3rd case — a genuine kill -9 mid-generation
+    proc = _run_driver(out, chaos="gen.case=kill:1:2")
+    assert proc.returncode == -signal.SIGKILL, (
+        f"rc={proc.returncode}; stdout tail: {proc.stdout[-500:]}")
+    partial = _tree(out)
+    assert 0 < len(partial) < len(clean_tree), "the kill must land mid-run"
+
+    # rerun without injection: journal-verified resume completes the tree
+    proc = _run_driver(out)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "generating: " in proc.stdout  # some cases actually regenerated
+    assert _tree(out) == clean_tree  # byte-identical to the uninterrupted run
+
+    # committed-before-kill cases were admitted from the journal, not
+    # regenerated: the resume run skipped at least the first two
+    assert proc.stdout.count("generating: ") < len(clean_tree) // 3 + 1
+
+
+def test_corrupted_output_detected_and_regenerated(clean_tree, tmp_path):
+    out = tmp_path / "vectors"
+    assert _run_driver(out).returncode == 0
+
+    # tamper two committed cases behind the journal's back
+    files = sorted(out.rglob("*.ssz_snappy"))
+    truncated = files[0]
+    truncated.write_bytes(truncated.read_bytes()[:10])
+    yamls = sorted(out.rglob("slots.yaml"))
+    tampered_yaml = yamls[-1]
+    tampered_yaml.write_text("]]malformed[[")
+
+    # a plain rerun (no --force) must catch both, regenerate, and land
+    # byte-identical to the clean tree
+    proc = _run_driver(out)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert proc.stdout.count("failed resume verification") == 2
+    assert _tree(out) == clean_tree
+
+
+def test_untampered_resume_skips_everything(clean_tree, tmp_path):
+    out = tmp_path / "vectors"
+    assert _run_driver(out).returncode == 0
+    proc = _run_driver(out)
+    assert proc.returncode == 0
+    assert "generating: " not in proc.stdout  # full skip, no regeneration
+    assert _tree(out) == clean_tree
+
+
+def test_transient_case_fault_retried_to_success(clean_tree, tmp_path):
+    """Injected transient inside case execution: the supervisor retries
+    and the run completes with zero failed cases and identical bytes."""
+    out = tmp_path / "vectors"
+    proc = _run_driver(out, chaos="gen.case=transient:2")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "0 failed" in proc.stdout.replace(", ", " ").replace("failed,", "failed") or \
+        " 0 failed" in proc.stdout
+    assert _tree(out) == clean_tree
+
+
+def test_deterministic_case_fault_counts_failed_and_leaves_incomplete(tmp_path):
+    out = tmp_path / "vectors"
+    proc = _run_driver(out, chaos="gen.case=deterministic:1")
+    assert proc.returncode == 1  # run_generator exits 1 on failed cases
+    assert "DeterministicFault" in (out / "testgen_error_log.txt").read_text()
+    incompletes = list(out.rglob("INCOMPLETE"))
+    assert len(incompletes) == 1
+    # and a rerun heals the failed case to a complete tree
+    proc = _run_driver(out)
+    assert proc.returncode == 0
+    assert not list(out.rglob("INCOMPLETE"))

@@ -1,0 +1,294 @@
+"""Unit tests for the resilience core: taxonomy classification,
+supervised retry/backoff/deadline, the quarantine circuit breaker,
+chaos injection arming (programmatic + env knob), and the generator
+case journal's corruption detection."""
+from __future__ import annotations
+
+import json
+import subprocess
+
+import pytest
+
+from consensus_specs_tpu import resilience as r
+from consensus_specs_tpu.resilience import injection, journal, supervisor
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    """Every test starts with closed breakers and disarmed sites."""
+    r.clear()
+    injection.disarm()
+    yield
+    r.clear()
+    injection.disarm()
+
+
+# ---------------------------------------------------------------------------
+# taxonomy
+# ---------------------------------------------------------------------------
+
+def test_classify_explicit_faults_win():
+    assert r.classify(r.TransientFault("x")) == r.TRANSIENT
+    assert r.classify(r.DeterministicFault("x")) == r.DETERMINISTIC
+    assert r.classify(r.EnvironmentalFault("x")) == r.ENVIRONMENTAL
+
+
+def test_classify_structural():
+    assert r.classify(ImportError("no jax")) == r.ENVIRONMENTAL
+    assert r.classify(ModuleNotFoundError("no lib")) == r.ENVIRONMENTAL
+    assert r.classify(TimeoutError()) == r.TRANSIENT
+    assert r.classify(ConnectionResetError()) == r.TRANSIENT
+    assert r.classify(MemoryError()) == r.TRANSIENT
+    assert r.classify(subprocess.TimeoutExpired("cmd", 1)) == r.TRANSIENT
+    assert r.classify(FileNotFoundError("libsha.so")) == r.ENVIRONMENTAL
+    # the device runtime's opaque error type, classified by message
+    assert r.classify(RuntimeError("RESOURCE_EXHAUSTED: oom")) == r.TRANSIENT
+    assert r.classify(RuntimeError("remote_compile: response body closed")) == r.TRANSIENT
+    # bad output / unknown failures default to deterministic (quarantine,
+    # never blind-retry)
+    assert r.classify(AssertionError("root mismatch")) == r.DETERMINISTIC
+    assert r.classify(RuntimeError("whatever")) == r.DETERMINISTIC
+
+
+def test_classify_exit_codes():
+    assert r.classify_exit(0) is None
+    assert r.classify_exit(None) is None
+    assert r.classify_exit(-9) == r.TRANSIENT       # signal kill
+    assert r.classify_exit(137) == r.TRANSIENT      # shell's 128+9
+    assert r.classify_exit(124) == r.TRANSIENT      # timeout(1)
+    assert r.classify_exit(1) == r.DETERMINISTIC
+    # the sysexits round-trip a child's own classification
+    assert r.classify_exit(r.exit_code_for(r.TRANSIENT)) == r.TRANSIENT
+    assert r.classify_exit(r.exit_code_for(r.ENVIRONMENTAL)) == r.ENVIRONMENTAL
+    assert r.classify_exit(r.exit_code_for(r.DETERMINISTIC)) == r.DETERMINISTIC
+
+
+# ---------------------------------------------------------------------------
+# supervised execution
+# ---------------------------------------------------------------------------
+
+def test_transient_retried_to_success():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise r.TransientFault("flake")
+        return "ok"
+
+    slept = []
+    assert r.supervised(flaky, domain="t", sleep=slept.append) == "ok"
+    assert len(calls) == 3
+    # exponential backoff between tries
+    assert len(slept) == 2 and slept[1] > slept[0]
+
+
+def test_transient_exhaustion_quarantines():
+    def always_flaky():
+        raise r.TransientFault("never clears")
+
+    with pytest.raises(r.TransientFault):
+        r.supervised(always_flaky, domain="t", capability="cap.flaky",
+                     sleep=lambda s: None)
+    assert r.is_quarantined("cap.flaky")
+    assert "retries exhausted" in r.quarantine_reason("cap.flaky")
+
+
+def test_deterministic_quarantines_once_and_breaker_opens():
+    attempts = []
+
+    def broken():
+        attempts.append(1)
+        raise AssertionError("miscompiled")
+
+    out = r.supervised(broken, domain="t", capability="cap.b",
+                       fallback=lambda: "host", sleep=lambda s: None)
+    assert out == "host" and len(attempts) == 1
+    assert r.is_quarantined("cap.b")
+    # breaker open: fn is never called again
+    out2 = r.supervised(broken, domain="t", capability="cap.b",
+                        fallback=lambda: "host2")
+    assert out2 == "host2" and len(attempts) == 1
+    # exactly ONE quarantine event fired
+    quarantines = [e for e in r.events() if e["event"] == "quarantine"
+                   and e["capability"] == "cap.b"]
+    assert len(quarantines) == 1
+
+
+def test_quarantined_without_fallback_raises():
+    r.quarantine("cap.q", "broken by test")
+    with pytest.raises(r.QuarantinedError):
+        r.supervised(lambda: 1, domain="t", capability="cap.q")
+
+
+def test_passthrough_exceptions_bypass_recovery():
+    class Control(Exception):
+        pass
+
+    with pytest.raises(Control):
+        r.supervised(lambda: (_ for _ in ()).throw(Control()),
+                     domain="t", capability="cap.c", fallback=lambda: "x",
+                     passthrough=(Control,))
+    assert not r.is_quarantined("cap.c")
+
+
+def test_deadline_caps_retries():
+    policy = r.RetryPolicy(max_attempts=100, base_delay_s=0.0, deadline_s=0.0)
+
+    def flaky():
+        raise r.TransientFault("flake")
+
+    with pytest.raises(r.TransientFault):
+        r.supervised(flaky, domain="t", policy=policy, sleep=lambda s: None)
+
+
+def test_env_quarantine_knob(monkeypatch):
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_QUARANTINE", "cap.env,cap.other")
+    assert r.is_quarantined("cap.env") and r.is_quarantined("cap.other")
+    assert "CONSENSUS_SPECS_TPU_QUARANTINE" in r.quarantine_reason("cap.env")
+
+
+# ---------------------------------------------------------------------------
+# chaos injection
+# ---------------------------------------------------------------------------
+
+def test_inject_counts_and_disarm():
+    fired = []
+    with r.inject("t.site", "deterministic", count=2):
+        for _ in range(4):
+            try:
+                r.chaos("t.site")
+            except r.DeterministicFault:
+                fired.append(1)
+    assert len(fired) == 2
+    r.chaos("t.site")  # disarmed: no-op
+
+
+def test_inject_after_window():
+    with r.inject("t.after", "transient", count=1, after=2):
+        r.chaos("t.after")
+        r.chaos("t.after")
+        with pytest.raises(r.TransientFault):
+            r.chaos("t.after")
+        r.chaos("t.after")  # count consumed
+
+
+def test_env_knob_parsing(monkeypatch):
+    monkeypatch.setenv(r.ENV_KNOB, "a.site=transient:2, b.site=deterministic, c.site=kill:1:5")
+    r.refresh()
+    try:
+        assert injection.armed_sites() == {
+            "a.site": "transient", "b.site": "deterministic", "c.site": "kill"}
+        with pytest.raises(r.TransientFault):
+            r.chaos("a.site")
+    finally:
+        monkeypatch.delenv(r.ENV_KNOB)
+        r.refresh()
+
+
+def test_env_knob_rejects_unknown_kind(monkeypatch):
+    monkeypatch.setenv(r.ENV_KNOB, "x=bogus")
+    with pytest.raises(ValueError):
+        r.refresh()
+    monkeypatch.delenv(r.ENV_KNOB)
+    r.refresh()
+
+
+def test_cross_process_hit_state(tmp_path, monkeypatch):
+    state = tmp_path / "chaos_state.json"
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_CHAOS_STATE", str(state))
+    with r.inject("t.xproc", "transient", count=1):
+        with pytest.raises(r.TransientFault):
+            r.chaos("t.xproc")
+        # a "fresh process" (new in-memory site object, same state file)
+        injection.disarm()
+        injection.arm("t.xproc", "transient", count=1)
+        r.chaos("t.xproc")  # count=1 already consumed globally: no fire
+    assert json.loads(state.read_text())["t.xproc"] == 2
+
+
+# ---------------------------------------------------------------------------
+# journal
+# ---------------------------------------------------------------------------
+
+def _write_case_dir(case_dir, yaml_text="value: 1\n"):
+    from consensus_specs_tpu.utils import snappy
+
+    case_dir.mkdir(parents=True)
+    (case_dir / "pre.ssz_snappy").write_bytes(snappy.compress(b"\x01" * 64))
+    (case_dir / "data.yaml").write_text(yaml_text)
+
+
+def test_journal_roundtrip_and_corruption(tmp_path):
+    case = tmp_path / "minimal/phase0/x/y/suite/case_0"
+    _write_case_dir(case)
+    j = journal.CaseJournal(tmp_path)
+    rel = "minimal/phase0/x/y/suite/case_0"
+    j.record(rel, case)
+    assert j.status(rel, case) == (journal.COMPLETE, "")
+
+    # a fresh journal instance (new process) reloads the entries
+    j2 = journal.CaseJournal(tmp_path)
+    assert j2.status(rel, case)[0] == journal.COMPLETE
+
+    # truncation is caught by digest mismatch
+    blob = (case / "pre.ssz_snappy").read_bytes()
+    (case / "pre.ssz_snappy").write_bytes(blob[: len(blob) // 2])
+    status, reason = j2.status(rel, case)
+    assert status == journal.CORRUPT and "digest mismatch" in reason
+    assert j2.admit(rel, case) is False
+
+
+def test_journal_structural_check_without_entry(tmp_path):
+    """Pre-journal corpora degrade to the structural check."""
+    good = tmp_path / "a/b/c/d/e/good"
+    _write_case_dir(good)
+    bad_yaml = tmp_path / "a/b/c/d/e/bad_yaml"
+    _write_case_dir(bad_yaml, yaml_text="{unclosed: [")
+    truncated = tmp_path / "a/b/c/d/e/truncated"
+    _write_case_dir(truncated)
+    blob = (truncated / "pre.ssz_snappy").read_bytes()
+    (truncated / "pre.ssz_snappy").write_bytes(blob[:-4])
+
+    j = journal.CaseJournal(tmp_path)
+    assert j.status("a/b/c/d/e/good", good)[0] == journal.COMPLETE
+    st, reason = j.status("a/b/c/d/e/bad_yaml", bad_yaml)
+    assert st == journal.CORRUPT and "yaml" in reason
+    st, reason = j.status("a/b/c/d/e/truncated", truncated)
+    assert st == journal.CORRUPT and "snappy" in reason
+
+
+def test_journal_tolerates_partial_trailing_line(tmp_path):
+    case = tmp_path / "a/b/c/d/e/case"
+    _write_case_dir(case)
+    j = journal.CaseJournal(tmp_path)
+    j.record("a/b/c/d/e/case", case)
+    # simulate a kill mid-append
+    with open(j.path, "a") as f:
+        f.write('{"case": "a/b/c/d/e/other", "par')
+    j2 = journal.CaseJournal(tmp_path)
+    assert j2.status("a/b/c/d/e/case", case)[0] == journal.COMPLETE
+
+
+def test_journal_invalidate(tmp_path):
+    case = tmp_path / "a/b/c/d/e/case"
+    _write_case_dir(case)
+    j = journal.CaseJournal(tmp_path)
+    j.record("a/b/c/d/e/case", case)
+    j.invalidate("a/b/c/d/e/case")
+    j3 = journal.CaseJournal(tmp_path)
+    # no entry -> structural check (still complete), but the journaled
+    # digests are gone (invalidation persisted)
+    assert "a/b/c/d/e/case" not in j3._entries
+
+
+# ---------------------------------------------------------------------------
+# events
+# ---------------------------------------------------------------------------
+
+def test_event_log_bounded_and_structured():
+    for i in range(600):
+        supervisor.record_event("retry", domain="t", detail=f"e{i}")
+    evs = r.events()
+    assert len(evs) <= 512
+    assert {"t", "event", "domain", "capability", "kind", "detail"} <= set(evs[-1])

@@ -44,6 +44,7 @@ import sys
 REPO = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
+from consensus_specs_tpu import resilience  # noqa: E402
 from consensus_specs_tpu.specs.build import build_spec  # noqa: E402
 from consensus_specs_tpu.utils import snappy  # noqa: E402
 
@@ -570,13 +571,58 @@ def _replay_case(runner, handler, fork, preset, suite, case, case_dir, bls_mode)
     return None
 
 
+class Failure(tuple):
+    """A failed case as a (rel_path, message) pair — tuple-compatible
+    with every existing consumer — carrying its fault-taxonomy class on
+    ``.taxonomy``: 'corruption' (undecodable corpus bytes: truncated
+    snappy, malformed yaml, missing parts), 'divergence' (the replay ran
+    but disagreed with the pinned vector), 'layout' (mispointed root /
+    tree drift), 'harness' (this consumer's own defect), or an injected
+    fault's kind."""
+
+    taxonomy: str
+
+    def __new__(cls, rel: str, msg: str, taxonomy: str):
+        self = super().__new__(cls, (rel, f"[{taxonomy}] {msg}"))
+        self.taxonomy = taxonomy
+        return self
+
+
+# the decode surface of a corrupt part file: truncated/tampered snappy
+# frames and ssz bytes surface as these before any spec code runs
+_CORRUPTION_ERRORS = (FileNotFoundError, ValueError, AssertionError,
+                      IndexError, OverflowError, UnicodeDecodeError)
+
+
+def _classify_harness_error(e: Exception) -> str:
+    """Taxonomy class of an exception that escaped a case replay."""
+    import yaml
+
+    if isinstance(e, resilience.Fault):
+        return e.kind  # injected / pre-classified
+    if isinstance(e, yaml.YAMLError) or isinstance(e, _CORRUPTION_ERRORS):
+        return "corruption"
+    return "harness"
+
+
+def summarize_failures(failed):
+    """{taxonomy class: count} over a replay_tree failure list."""
+    counts: dict = {}
+    for f in failed:
+        cls = getattr(f, "taxonomy", "harness")
+        counts[cls] = counts.get(cls, 0) + 1
+    return counts
+
+
 def replay_tree(root: pathlib.Path, bls_mode: str = "auto"):
     """Walk <root>/<preset>/<fork>/<runner>/<handler>/<suite>/<case>/.
-    Returns (ok, failed_list, unsupported, incomplete). A part-bearing
-    directory at the wrong depth is a FAILURE (mispointed root or layout
-    drift must never read as an empty-but-green corpus), and a harness
-    error inside a case (missing part, undecodable pre) is that case's
-    failure, never its expected rejection."""
+    Returns (ok, failed_list, unsupported, incomplete) where failed_list
+    holds :class:`Failure` entries (tuple-compatible, taxonomy-tagged).
+    A part-bearing directory at the wrong depth is a FAILURE (mispointed
+    root or layout drift must never read as an empty-but-green corpus),
+    and a harness error inside a case (missing part, undecodable pre) is
+    that case's failure — classified, reported, and never allowed to
+    abort the walk or masquerade as the vector's expected rejection."""
     ok, failed, unsupported, incomplete = 0, [], 0, 0
     # ANY part file marks a case directory. Globbing *.yaml (not just
     # meta.yaml) matters: bls cases ship only data.yaml and shuffling
@@ -588,25 +634,27 @@ def replay_tree(root: pathlib.Path, bls_mode: str = "auto"):
     for case_dir in sorted(case_dirs):
         rel = case_dir.relative_to(root)
         if len(rel.parts) != 6:
-            failed.append((str(rel), f"unexpected layout depth {len(rel.parts)} "
-                           "(want preset/fork/runner/handler/suite/case)"))
+            failed.append(Failure(str(rel), f"unexpected layout depth {len(rel.parts)} "
+                          "(want preset/fork/runner/handler/suite/case)", "layout"))
             continue
         preset, fork, runner, handler, suite, case = rel.parts
         if (case_dir / "INCOMPLETE").exists():
             incomplete += 1
             continue
         try:
+            resilience.chaos("replay.case")
             err = _replay_case(runner, handler, fork, preset, suite, case, case_dir, bls_mode)
         except NotImplementedError:
             unsupported += 1
             continue
         except Exception as e:
-            failed.append((str(rel), f"harness error {type(e).__name__}: {e}"))
+            failed.append(Failure(str(rel), f"{type(e).__name__}: {e}",
+                                  _classify_harness_error(e)))
             continue
         if err is None:
             ok += 1
         else:
-            failed.append((str(rel), err))
+            failed.append(Failure(str(rel), err, "divergence"))
     return ok, failed, unsupported, incomplete
 
 
@@ -618,7 +666,10 @@ def main() -> int:
     ns = parser.parse_args()
 
     ok, failed, unsupported, incomplete = replay_tree(ns.output_dir, ns.bls)
-    print(f"replayed OK: {ok}; failed: {len(failed)}; "
+    by_class = summarize_failures(failed)
+    breakdown = (" (" + ", ".join(f"{k}: {v}" for k, v in sorted(by_class.items())) + ")"
+                 if by_class else "")
+    print(f"replayed OK: {ok}; failed: {len(failed)}{breakdown}; "
           f"unsupported format: {unsupported}; incomplete skipped: {incomplete}")
     for rel, err in failed:
         print(f"FAIL {rel}: {err}")

@@ -5,6 +5,7 @@
 
 PYTHON ?= python
 TEST_VECTOR_DIR ?= ./test-vectors
+TRACE_DIR ?= ./trace-smoke
 GENERATORS = bls epoch_processing finality fork_choice forks genesis merkle \
              operations random rewards sanity shuffling ssz_generic ssz_static transition
 
@@ -16,7 +17,7 @@ DEVICE_TESTS = tests/test_bls_device.py tests/test_curve_device.py \
                tests/test_multichip.py
 
 .PHONY: test citest test-fast test-device test-mainnet lint docs generate_tests gen_% replay bench \
-        dryrun detect_generator_incomplete clean-vectors chaos help
+        dryrun detect_generator_incomplete clean-vectors chaos trace help
 
 # the fault-injection suite: supervisor/taxonomy units, chaos replay
 # (tampered vectors), induced backend failures, generator crash/resume
@@ -27,7 +28,7 @@ help:
 	@echo "test                  full pytest suite (CPU, virtual 8-device mesh; -n auto when pytest-xdist is installed)"
 	@echo "citest fork=<fork>    per-fork suite slice (CI shape, ref Makefile:109-117); engine=vectorized for the SoA epoch engine"
 	@echo "test-fast             suite minus device-kernel tests (no XLA compiles)"
-	@echo "lint                  byte-compile + repo checker + mypy (engine/ + ssz/, when installed)"
+	@echo "lint                  byte-compile + repo checker + mypy (engine/ssz/resilience/obs, when installed)"
 	@echo "docs                  regenerate docs/specs/ from the executable deltas"
 	@echo "generate_tests        run every vector generator into $(TEST_VECTOR_DIR)"
 	@echo "gen_<name>            run one generator (e.g. make gen_operations)"
@@ -35,6 +36,7 @@ help:
 	@echo "bench                 run bench.py (one JSON line)"
 	@echo "dryrun                multi-chip dry-run on a virtual 8-device mesh"
 	@echo "chaos                 fault-injection suite (resilience layer: retries, quarantine, journal, tampered vectors)"
+	@echo "trace                 instrumented bench+generator smoke -> $(TRACE_DIR)/trace.json (Perfetto-loadable) + summary"
 
 # parallelize like the reference (ref Makefile:100-106) when pytest-xdist
 # is present; degrade to single-process so the suite stays runnable cold
@@ -44,10 +46,19 @@ test:
 	$(PYTHON) -m pytest tests/ -q $(XDIST)
 
 # per-fork CI slice: run the spec suites restricted to one fork;
-# engine=vectorized runs the same matrix on the SoA epoch engine
+# engine=vectorized runs the same matrix on the SoA epoch engine.
+# Ends with the observability smoke: the merged trace must be valid
+# Chrome-trace JSON with >=1 subprocess child span under its parent
+# (trace_smoke asserts, trace_report summarizes — both exit nonzero
+# on a broken trace).
 citest:
 	$(if $(fork),,$(error citest requires fork=<name>, e.g. make citest fork=phase0))
 	$(PYTHON) -m pytest tests/spec -q --fork $(fork) $(if $(engine),--engine $(engine))
+	$(MAKE) trace
+
+trace:
+	$(PYTHON) tools/trace_smoke.py --out $(TRACE_DIR)
+	$(PYTHON) tools/trace_report.py $(TRACE_DIR)/trace.json
 
 test-fast:
 	$(PYTHON) -m pytest tests/ -q $(addprefix --ignore=,$(DEVICE_TESTS)) $(PYTEST_EXTRA)
@@ -75,7 +86,7 @@ lint:
 	$(PYTHON) tools/lint.py
 	@$(PYTHON) -c "import mypy" 2>/dev/null \
 	  && $(PYTHON) -m mypy --config-file mypy.ini \
-	  || echo "mypy not installed; type check (engine/ + ssz/, mypy.ini) skipped"
+	  || echo "mypy not installed; type check (engine/ + ssz/ + resilience/ + obs/, mypy.ini) skipped"
 
 docs:
 	$(PYTHON) tools/gen_spec_docs.py

@@ -70,6 +70,12 @@ from consensus_specs_tpu.resilience import (  # noqa: E402
     record_event,
 )
 
+# pure-stdlib tracing plane (no jax): progress notes become structured
+# events (BENCH json `events` key), section children get spans that
+# merge into one Perfetto-loadable tree when CONSENSUS_SPECS_TPU_TRACE
+# names a directory (see docs/OBSERVABILITY.md)
+from consensus_specs_tpu import obs  # noqa: E402
+
 DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S", "1380"))
 _T0 = time.monotonic()
 
@@ -85,8 +91,19 @@ RESULTS: dict = {
 _EMITTED = False
 
 
+def _event(name: str, msg: str = "", **fields) -> None:
+    """One structured progress event: buffered for the BENCH json's
+    `events` key (and the trace, when armed) with a human rendering to
+    stderr — the _note free-text lines, upgraded."""
+    obs.event(name, **(dict(fields, msg=msg) if msg else fields))
+    human = msg or " ".join(f"{k}={v}" for k, v in fields.items())
+    label = "" if name == "note" else f"{name}: "
+    print(f"bench[{time.monotonic() - _T0:7.1f}s]: {label}{human}",
+          file=sys.stderr, flush=True)
+
+
 def _note(msg: str) -> None:
-    print(f"bench[{time.monotonic() - _T0:7.1f}s]: {msg}", file=sys.stderr, flush=True)
+    _event("note", msg=msg)
 
 
 _IS_CHILD = False  # set in _child_main; children must emit private keys
@@ -101,7 +118,20 @@ def _emit() -> None:
     if evs:
         seen = RESULTS.setdefault("resilience_events", [])
         seen.extend(e for e in evs if e not in seen)
+    oevs = obs.events()
+    if oevs:
+        seen = RESULTS.setdefault("events", [])
+        seen.extend(e for e in oevs if e not in seen)
     if not _IS_CHILD:
+        # merge every process's span JSONL into ONE Perfetto-loadable
+        # trace.json — on every parent exit path, so a deadline-killed
+        # run still ships whatever spans its children committed
+        if obs.enabled() and obs.is_root_process():
+            try:
+                obs.publish()
+                RESULTS["trace_json"] = obs.export_chrome(obs.trace_dir())
+            except Exception as e:
+                RESULTS["trace_json_error"] = repr(e)
         # strip bookkeeping keys + run the pallas/host root cross-check on
         # EVERY parent exit path (normal, SIGTERM/SIGALRM, atexit) — a
         # pallas kernel that ran but produced a wrong root is a
@@ -162,36 +192,40 @@ def _remaining() -> float:
 def _run_child(name: str, cap_s: float) -> None:
     """Run one section in a killable child process: SIGTERM at the cap
     (the child's handler dumps whatever it measured), SIGKILL as the
-    backstop, merge the child's last-line JSON into RESULTS."""
-    _note(f"{name} ... (child, cap {cap_s:.0f}s)")
+    backstop, merge the child's last-line JSON into RESULTS. The child
+    inherits the trace context (obs.child_env) so its spans merge under
+    this section's span in the exported tree."""
+    _event("section_start", section=name, cap_s=round(cap_s))
     t0 = time.monotonic()
-    proc = subprocess.Popen(
-        [sys.executable, os.path.abspath(__file__), "--section", name],
-        stdout=subprocess.PIPE,
-        text=True,
-        start_new_session=True,
-    )
-    _CURRENT_CHILD.append(proc.pid)
-    out = ""
-    timed_out = False
-    try:
-        out, _ = proc.communicate(timeout=cap_s)
-    except subprocess.TimeoutExpired:
-        timed_out = True
+    with obs.span(f"bench.{name}", cat="bench.section"):
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--section", name],
+            stdout=subprocess.PIPE,
+            text=True,
+            start_new_session=True,
+            env=obs.child_env(),
+        )
+        _CURRENT_CHILD.append(proc.pid)
+        out = ""
+        timed_out = False
         try:
-            os.killpg(proc.pid, signal.SIGTERM)
-        except OSError:
-            pass
-        try:
-            out, _ = proc.communicate(timeout=10)
+            out, _ = proc.communicate(timeout=cap_s)
         except subprocess.TimeoutExpired:
+            timed_out = True
             try:
-                os.killpg(proc.pid, signal.SIGKILL)
+                os.killpg(proc.pid, signal.SIGTERM)
             except OSError:
                 pass
-            out, _ = proc.communicate()
-    finally:
-        _CURRENT_CHILD.remove(proc.pid)
+            try:
+                out, _ = proc.communicate(timeout=10)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except OSError:
+                    pass
+                out, _ = proc.communicate()
+        finally:
+            _CURRENT_CHILD.remove(proc.pid)
     dt = time.monotonic() - t0
 
     merged: dict = {}
@@ -206,8 +240,8 @@ def _run_child(name: str, cap_s: float) -> None:
             RESULTS["section_seconds"].update(v)
         elif k == "section_errors":
             RESULTS.setdefault("section_errors", {}).update(v)
-        elif k == "resilience_events":
-            seen = RESULTS.setdefault("resilience_events", [])
+        elif k in ("resilience_events", "events"):
+            seen = RESULTS.setdefault(k, [])
             seen.extend(e for e in v if e not in seen)
         elif v is not None or k not in RESULTS:
             RESULTS[k] = v
@@ -221,8 +255,12 @@ def _run_child(name: str, cap_s: float) -> None:
         record_event("child_failed", domain="bench", capability=name,
                      kind=classify_exit(proc.returncode) or "",
                      detail=f"rc={proc.returncode}")
-    new_keys = {k: v for k, v in merged.items() if k not in ("section_seconds", "section_errors") and v is not None}
-    _note(f"{name} child done in {dt:.1f}s rc={proc.returncode} {json.dumps(new_keys) if new_keys else ''}")
+    new_keys = {k: v for k, v in merged.items()
+                if k not in ("section_seconds", "section_errors",
+                             "resilience_events", "events") and v is not None}
+    _event("section_done", section=name, seconds=round(dt, 1), rc=proc.returncode,
+           msg=f"{name} child done in {dt:.1f}s rc={proc.returncode} "
+               f"{json.dumps(new_keys) if new_keys else ''}")
 
 
 # ---------------------------------------------------------------------------
@@ -925,10 +963,14 @@ def _child_main(name: str) -> None:
     if name not in HOST_ONLY_SECTIONS:
         _maybe_enable_compile_cache()
     try:
-        chaos("bench.section")  # injection point: children are killable
-        fn()
+        # the child's root span: parents to the supervisor's bench.<name>
+        # span via the env-propagated trace context
+        with obs.span(f"section.{name}", cat="bench.section"):
+            chaos("bench.section")  # injection point: children are killable
+            fn()
     except Exception as e:
-        _note(f"{name} FAILED: {e!r}")
+        _event("section_failed", section=name, error=repr(e)[:500],
+               msg=f"{name} FAILED: {e!r}")
         RESULTS.setdefault("section_errors", {})[name] = repr(e)
     _emit()
 
@@ -953,7 +995,9 @@ def main() -> None:
             est_s = est_s[0] if _cache_is_warm() else est_s[1]  # the cache for everyone after
         rem = _remaining() - reserve - keep_s
         if rem < est_s:
-            _note(f"SKIP {name}: remaining {rem:.0f}s < estimate {est_s:.0f}s")
+            _event("section_skip", section=name, remaining_s=round(rem),
+                   estimate_s=round(est_s),
+                   msg=f"SKIP {name}: remaining {rem:.0f}s < estimate {est_s:.0f}s")
             RESULTS.setdefault("skipped_sections", []).append(name)
             return
         _run_child(name, min(cap_s, rem))
@@ -969,7 +1013,7 @@ def main() -> None:
     if not _device_alive():
         # the tunnel is wedged (hung server compile / dead worker): no
         # device section can run — record the host-side truth and say so
-        _note("device UNREACHABLE — host-only fallback")
+        _event("device_unreachable", msg="device UNREACHABLE — host-only fallback")
         RESULTS["device_unreachable"] = True
         run("host_fallback", 150, 320, keep_s=45)
         run("epoch_vectorized", 120, 300)
@@ -993,7 +1037,8 @@ def main() -> None:
                 RESULTS.setdefault("section_errors", {})["bls_attempt1"] = err1
             if dt1 is not None:
                 RESULTS["section_seconds"]["bls_attempt1"] = dt1
-            _note("bls produced no headline value — retrying once")
+            _event("section_retry", section="bls",
+                   msg="bls produced no headline value — retrying once")
             record_event("retry", domain="bench", capability="bls",
                          kind="transient",
                          detail=f"headline section retry (attempt1: {err1})")

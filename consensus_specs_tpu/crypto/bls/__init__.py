@@ -38,6 +38,7 @@ from __future__ import annotations
 import contextlib
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ... import obs
 from ...resilience import (
     chaos,
     is_quarantined,
@@ -104,9 +105,11 @@ def _verify_dispatch(op: str, *args) -> bool:
         return getattr(_backend, op)(*args)
 
     try:
-        return bool(supervised(_attempt, domain="crypto.bls"))
+        with obs.kernel_span(f"bls.dispatch.{op}", backend=_backend_name):
+            return bool(supervised(_attempt, domain="crypto.bls"))
     except Exception as e:
-        answer = bool(ref_op(*args))  # oracle adjudicates (may raise -> caller's False)
+        with obs.span("bls.oracle_adjudicate", op=op):
+            answer = bool(ref_op(*args))  # oracle adjudicates (may raise -> caller's False)
         if answer:
             quarantine(capability,
                        f"{op} failed on a check the oracle accepts: "
@@ -194,11 +197,13 @@ class DeferredVerifier:
                 cold = None  # breaker open: the oracle path answers below
             if cold is not None:
                 try:
-                    ok = cold(
-                        [r[1] for r in batch_rows],
-                        [r[2] for r in batch_rows],
-                        [r[3] for r in batch_rows],
-                    )
+                    with obs.kernel_span("bls.flush_batch", rows=len(batch_rows),
+                                         backend=_backend_name):
+                        ok = cold(
+                            [r[1] for r in batch_rows],
+                            [r[2] for r in batch_rows],
+                            [r[3] for r in batch_rows],
+                        )
                 except Exception as e:
                     # a device/backend failure must degrade like every
                     # synchronous facade path, not abort the whole flush:

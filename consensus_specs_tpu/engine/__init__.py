@@ -29,6 +29,7 @@ from __future__ import annotations
 
 from typing import Dict
 
+from .. import obs
 from ..specs import build as _build
 from . import stages
 from .backend import active as backend_name  # noqa: F401  (public surface)
@@ -66,7 +67,8 @@ def _wrap_stage(spec, name: str):
     interpreted = getattr(spec, name)
 
     def wrapped(state):
-        return impl(spec, state)
+        with obs.span(f"epoch.{name}", fork=spec.fork, engine="vectorized"):
+            return impl(spec, state)
 
     wrapped.__name__ = name
     wrapped.__qualname__ = f"engine.{name}[{spec.fork}]"

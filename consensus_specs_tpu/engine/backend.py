@@ -23,6 +23,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+from .. import obs
 from ..resilience import chaos, is_quarantined, record_event, supervised
 
 _active = "numpy"
@@ -91,8 +92,10 @@ def dispatch_delta_kernel(*args) -> Optional[tuple]:
         chaos("engine.dispatch")
         return kernel(*args)
 
+    rows = getattr(args[0], "shape", (0,))[0] if args else 0
     try:
-        return supervised(_dispatch, domain="engine", capability=CAPABILITY)
+        with obs.kernel_span("engine.delta_kernel", rows=int(rows)):
+            return supervised(_dispatch, domain="engine", capability=CAPABILITY)
     except Exception as e:
         # supervised() already quarantined + recorded; belt-and-braces in
         # case classification re-raised without a capability

@@ -30,6 +30,7 @@ from typing import Iterable, List, Tuple
 
 import yaml
 
+from consensus_specs_tpu import obs
 from consensus_specs_tpu.exceptions import SkippedTest
 from consensus_specs_tpu.resilience import CaseJournal, RetryPolicy, chaos, supervised
 from consensus_specs_tpu.utils import profiling
@@ -240,7 +241,9 @@ def run_generator(generator_name: str, test_providers: Iterable[TestProvider], a
 
         if not pending:
             return
-        verifier.flush()
+        with obs.span("gen.flush", cases=len(pending),
+                      checks=len(verifier.entries) - len(verifier.results)):
+            verifier.flush()
         table = verifier.table()
         for p in pending:
             if p.error is None and verifier.all_true(*p.marks):
@@ -259,7 +262,8 @@ def run_generator(generator_name: str, test_providers: Iterable[TestProvider], a
             finalize_case(p.case_dir, encoded, meta, error, p.start)
         pending.clear()
 
-    with (profiling.trace(generator_name) if ns.profile else contextlib.nullcontext()):
+    with (profiling.trace(generator_name) if ns.profile else contextlib.nullcontext()), \
+            obs.span("gen.run", generator=generator_name):
       # ONE deferred-check population across every provider in the run:
       # providers' prepare() only selects the BLS backend (idempotent) and
       # each case_fn carries its own (fork, preset) context, so checks from
@@ -285,10 +289,17 @@ def run_generator(generator_name: str, test_providers: Iterable[TestProvider], a
                     if journal is None or journal.admit(
                             str(case_dir.relative_to(output_dir)), case_dir):
                         counts["skipped"] += 1
+                        if journal is not None:
+                            # resume marked in the trace: digest-verified
+                            # cases skipped on re-run are visible, not silent
+                            obs.instant("gen.journal_admitted",
+                                        case=test_case.dir_path())
                         continue
                     # journal verification failed (truncated/tampered/
                     # unverifiable output): regenerate instead of shipping
                     print(f"regenerating (failed resume verification): {case_dir}")
+                    obs.instant("gen.journal_regenerate",
+                                case=test_case.dir_path())
                 shutil.rmtree(case_dir)
 
             print(f"generating: {case_dir}")
@@ -298,7 +309,10 @@ def run_generator(generator_name: str, test_providers: Iterable[TestProvider], a
                 if ns.profile
                 else contextlib.nullcontext()
             )
-            with profile_ctx:
+            with profile_ctx, obs.span(
+                    "gen.case", case=test_case.dir_path(),
+                    fork=test_case.fork_name, preset=test_case.preset_name,
+                    runner=test_case.runner_name, handler=test_case.handler_name):
                 if verifier is not None:
                     outcome = run_case_deferred(test_case, case_dir, start)
                     if outcome is not None:

@@ -1,0 +1,49 @@
+"""Unified tracing + metrics plane (zero-dependency, pure stdlib).
+
+The repo's two hot primitives were accelerated (PR 1) and
+fault-isolated (PR 2); this package makes the whole system *visible*:
+one span API wired into every plane, one env knob, one merged
+Perfetto-loadable trace per run.
+
+- :mod:`core` — ``span()`` context manager + ``traced()`` decorator,
+  ``kernel_span()`` (jit compile-vs-execute tagging), ``instant()``,
+  structured ``event()`` buffering, and cross-process propagation via
+  the ``CONSENSUS_SPECS_TPU_TRACE=<dir>[;trace[;parent]]`` env knob:
+  subprocess children (bench sections, the dryrun child, generator
+  workers) write their own span JSONL and the parent merges them into
+  one tree. Disabled-by-default cost: a single env check per span.
+- :mod:`metrics` — thread-safe counters + bounded histograms; span
+  durations feed ``span.<name>`` histograms automatically.
+- :mod:`export` — per-pid JSONL -> one Chrome trace-event JSON
+  (``trace.json``) that Perfetto / ``chrome://tracing`` loads directly,
+  with resilience retries/quarantines/chaos hits as instant events on
+  the owning span and cross-process flow arrows.
+
+Instrumented planes: bls facade dispatch + oracle adjudication, engine
+``dispatch_delta_kernel`` + every vectorized epoch stage, the ssz
+hashing backend, gen_runner per-case (journal resume marked),
+replay_vectors per-case, bench.py sections, and the multichip dryrun
+parent/child. ``tools/trace_report.py`` summarizes a trace; ``make
+trace`` runs an instrumented smoke end-to-end.
+
+See docs/OBSERVABILITY.md.
+"""
+from __future__ import annotations
+
+from .core import (  # noqa: F401
+    TRACE_ENV,
+    Span,
+    child_env,
+    current_span_id,
+    enabled,
+    event,
+    events,
+    instant,
+    is_root_process,
+    kernel_span,
+    span,
+    trace_dir,
+    traced,
+)
+from .export import export_chrome, read_records, to_chrome, validate_chrome  # noqa: F401
+from .metrics import count, observe, publish, snapshot  # noqa: F401

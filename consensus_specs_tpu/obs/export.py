@@ -1,0 +1,174 @@
+"""Exporters: merge per-pid span JSONL into one Chrome-trace JSON.
+
+Every traced process appends records to its own ``spans-<pid>-*.jsonl``
+under the trace dir (obs.core); the ROOT process (or any tool) merges
+them here into one ``trace.json`` in the Chrome trace-event format that
+Perfetto / ``chrome://tracing`` loads directly:
+
+- spans      -> ``ph:"X"`` complete events (name, ts, dur, pid, tid)
+- instants   -> ``ph:"i"`` thread-scoped instant events (resilience
+  retries/quarantines/chaos hits render as ticks on the owning track)
+- counters   -> ``ph:"C"`` counter events
+- processes  -> ``ph:"M"`` process_name metadata
+- cross-process parenthood -> ``ph:"s"``/``ph:"f"`` flow arrows from
+  the parent span's track to the child process's root spans
+
+A truncated trailing line (the writing process was SIGKILLed mid-write)
+is skipped, like the generator journal's recovery contract — everything
+committed before it survives.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+
+def read_records(trace_dir: str) -> List[Dict[str, Any]]:
+    """All records from every per-pid JSONL under ``trace_dir``, in file
+    order (corrupt/truncated lines skipped)."""
+    records: List[Dict[str, Any]] = []
+    try:
+        names = sorted(os.listdir(trace_dir))
+    except OSError:
+        return records
+    for fname in names:
+        if not (fname.startswith("spans-") and fname.endswith(".jsonl")):
+            continue
+        try:
+            with open(os.path.join(trace_dir, fname)) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue  # torn tail of a killed writer
+                    if isinstance(rec, dict):
+                        records.append(rec)
+        except OSError:
+            continue
+    return records
+
+
+def span_index(records: Iterable[Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
+    """{span_id: span record} over the merged record stream."""
+    return {r["span"]: r for r in records
+            if r.get("type") == "span" and r.get("span")}
+
+
+def span_children(records: Iterable[Dict[str, Any]]) -> Dict[Optional[str], List[Dict[str, Any]]]:
+    """{parent span_id: [child span records]} (None = roots)."""
+    out: Dict[Optional[str], List[Dict[str, Any]]] = {}
+    for r in records:
+        if r.get("type") == "span":
+            out.setdefault(r.get("parent"), []).append(r)
+    return out
+
+
+def to_chrome(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Chrome trace-event JSON (object form) for a merged record list."""
+    events: List[Dict[str, Any]] = []
+    spans = span_index(records)
+    flow_id = 0
+    for rec in records:
+        rtype = rec.get("type")
+        if rtype == "process":
+            events.append({
+                "ph": "M", "name": "process_name", "pid": rec.get("pid", 0),
+                "args": {"name": rec.get("name", "python")},
+            })
+        elif rtype == "span":
+            args = dict(rec.get("attrs") or {})
+            args["span"] = rec.get("span")
+            if rec.get("parent"):
+                args["parent"] = rec["parent"]
+            events.append({
+                "ph": "X",
+                "name": rec.get("name", "?"),
+                "cat": str(args.get("cat", "span")),
+                "ts": rec.get("ts", 0),
+                "dur": rec.get("dur", 0),
+                "pid": rec.get("pid", 0),
+                "tid": rec.get("tid", 0),
+                "args": args,
+            })
+            parent = spans.get(rec.get("parent") or "")
+            if parent is not None and parent.get("pid") != rec.get("pid"):
+                # parent lives in another process: draw the flow arrow
+                flow_id += 1
+                ts = rec.get("ts", 0)
+                events.append({
+                    "ph": "s", "id": flow_id, "name": "spawn", "cat": "flow",
+                    "ts": max(parent.get("ts", 0), min(
+                        ts, parent.get("ts", 0) + parent.get("dur", 0))),
+                    "pid": parent.get("pid", 0), "tid": parent.get("tid", 0),
+                })
+                events.append({
+                    "ph": "f", "bp": "e", "id": flow_id, "name": "spawn",
+                    "cat": "flow", "ts": ts,
+                    "pid": rec.get("pid", 0), "tid": rec.get("tid", 0),
+                })
+        elif rtype == "instant":
+            events.append({
+                "ph": "i", "s": "t",
+                "name": rec.get("name", "?"),
+                "cat": "instant",
+                "ts": rec.get("ts", 0),
+                "pid": rec.get("pid", 0),
+                "tid": rec.get("tid", 0),
+                "args": dict(rec.get("attrs") or {},
+                             **({"span": rec["span"]} if rec.get("span") else {})),
+            })
+        elif rtype == "counter":
+            values = {k: v for k, v in (rec.get("values") or {}).items()
+                      if isinstance(v, (int, float))}
+            if values:
+                events.append({
+                    "ph": "C", "name": rec.get("name", "counters"),
+                    "ts": rec.get("ts", 0), "pid": rec.get("pid", 0),
+                    "args": values,
+                })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def export_chrome(trace_dir: str, out_path: Optional[str] = None) -> str:
+    """Merge ``trace_dir``'s JSONL into a Chrome trace; returns the
+    output path (default ``<trace_dir>/trace.json``). Atomic replace so
+    a concurrent reader never sees a torn file."""
+    records = read_records(trace_dir)
+    trace = to_chrome(records)
+    if out_path is None:
+        out_path = os.path.join(trace_dir, "trace.json")
+    tmp = f"{out_path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(trace, f)
+    os.replace(tmp, out_path)
+    return out_path
+
+
+def validate_chrome(trace: Any) -> Tuple[bool, str]:
+    """Structural validation of a Chrome trace-event object: the
+    contract ``make trace`` asserts before calling a run green."""
+    if not isinstance(trace, dict):
+        return False, "trace is not a JSON object"
+    events = trace.get("traceEvents")
+    if not isinstance(events, list) or not events:
+        return False, "traceEvents missing or empty"
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            return False, f"event {i} is not an object"
+        ph = ev.get("ph")
+        if not isinstance(ph, str) or not ph:
+            return False, f"event {i} has no ph"
+        if "pid" not in ev:
+            return False, f"event {i} has no pid"
+        if ph == "X":
+            if not isinstance(ev.get("ts"), (int, float)):
+                return False, f"X event {i} has non-numeric ts"
+            if not isinstance(ev.get("dur"), (int, float)):
+                return False, f"X event {i} has non-numeric dur"
+        if ph in ("X", "i", "C", "s", "f") and not ev.get("name"):
+            return False, f"{ph} event {i} has no name"
+    return True, f"{len(events)} events"

@@ -1,0 +1,97 @@
+"""Counters and histograms: thread-safe in-process aggregates.
+
+These are always live (no env gate — a dict update is cheaper than the
+question of whether to do it), queryable via :func:`snapshot`, and
+flushed into the trace as Chrome counter events by :func:`publish`
+when tracing is armed. Span durations feed the ``span.<name>``
+histograms automatically (obs.core.Span.__exit__), so per-site latency
+distributions exist without any extra call sites.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+_lock = threading.Lock()
+_counters: Dict[str, float] = {}
+_histograms: Dict[str, List[float]] = {}
+
+_HIST_CAP = 4096  # per-name sample bound (reservoir-free: drop the tail)
+
+
+def count(name: str, n: float = 1) -> None:
+    """Increment a monotonic counter."""
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + n
+
+
+def observe(name: str, value: float) -> None:
+    """Record one sample into a histogram (bounded; extra samples still
+    bump the count so rates stay truthful)."""
+    with _lock:
+        hist = _histograms.setdefault(name, [])
+        if len(hist) < _HIST_CAP:
+            hist.append(value)
+        _counters[name + ".count"] = _counters.get(name + ".count", 0) + 1
+
+
+def percentile(samples: List[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile (q in [0, 100]) of a sample list."""
+    if not samples:
+        return None
+    ordered = sorted(samples)
+    idx = min(len(ordered) - 1, max(0, int(round(q / 100 * (len(ordered) - 1)))))
+    return ordered[idx]
+
+
+def snapshot(clear: bool = False) -> Dict[str, Any]:
+    """{counters: {...}, histograms: {name: {count,min,p50,p90,p99,max}}}."""
+    with _lock:
+        counters = dict(_counters)
+        hists = {name: list(vals) for name, vals in _histograms.items()}
+        if clear:
+            _counters.clear()
+            _histograms.clear()
+    out_h = {}
+    for name, vals in hists.items():
+        if not vals:
+            continue
+        out_h[name] = {
+            "count": int(counters.get(name + ".count", len(vals))),
+            "min": min(vals),
+            "p50": percentile(vals, 50),
+            "p90": percentile(vals, 90),
+            "p99": percentile(vals, 99),
+            "max": max(vals),
+        }
+    return {"counters": counters, "histograms": out_h}
+
+
+def publish() -> None:
+    """Write current counter values into the trace as a counter record
+    (rendered as a Chrome 'C' event by the exporter). No-op when
+    tracing is off."""
+    from . import core
+
+    ctx = core._context()
+    if ctx is None:
+        return
+    with _lock:
+        values = {k: v for k, v in _counters.items()}
+    if not values:
+        return
+    ctx.write({
+        "type": "counter",
+        "trace": ctx.trace_id,
+        "name": "obs.counters",
+        "ts": ctx.now_us(),
+        "pid": ctx.pid,
+        "values": values,
+    })
+
+
+def reset() -> None:
+    """Test hook: drop all aggregates."""
+    with _lock:
+        _counters.clear()
+        _histograms.clear()

@@ -52,6 +52,26 @@ DEFAULT_POLICY = RetryPolicy()
 
 _EVENTS: deque = deque(maxlen=512)
 
+# Listener hook: the obs tracing plane registers here so every fault
+# event (retry, quarantine, fallback, chaos injection, probe) also
+# lands as an instant event on the owning trace span. Listeners must
+# never break fault handling: exceptions are swallowed. Kept as a
+# plain callback list so resilience stays importable with no obs
+# dependency (obs imports this module, never the reverse).
+_LISTENERS: List[Callable[[dict], None]] = []
+
+
+def add_listener(fn: Callable[[dict], None]) -> None:
+    if fn not in _LISTENERS:
+        _LISTENERS.append(fn)
+
+
+def remove_listener(fn: Callable[[dict], None]) -> None:
+    try:
+        _LISTENERS.remove(fn)
+    except ValueError:
+        pass
+
 
 def record_event(event: str, *, domain: str = "", capability: str = "",
                  kind: str = "", detail: str = "") -> dict:
@@ -64,6 +84,11 @@ def record_event(event: str, *, domain: str = "", capability: str = "",
         "detail": detail[:500],
     }
     _EVENTS.append(entry)
+    for listener in list(_LISTENERS):
+        try:
+            listener(entry)
+        except Exception:
+            pass
     return entry
 
 

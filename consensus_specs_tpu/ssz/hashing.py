@@ -93,6 +93,7 @@ def _device_call(fn: Callable, host_fn: Callable, *args):
     """Supervised non-host hasher dispatch: transient faults retry,
     terminal faults quarantine ``hash.device`` and the host path (always
     bit-identical — same SHA-256) takes over with a recorded event."""
+    from .. import obs
     from ..resilience import chaos, is_quarantined, supervised
 
     if is_quarantined(HASH_CAPABILITY):
@@ -102,8 +103,10 @@ def _device_call(fn: Callable, host_fn: Callable, *args):
         chaos("hash.dispatch")
         return fn(*args)
 
-    return supervised(_attempt, domain="crypto.hash", capability=HASH_CAPABILITY,
-                      fallback=lambda: host_fn(*args))
+    nbytes = sum(len(a) for a in args if isinstance(a, (bytes, bytearray)))
+    with obs.kernel_span("hash.dispatch", backend=_backend_name, bytes=nbytes):
+        return supervised(_attempt, domain="crypto.hash", capability=HASH_CAPABILITY,
+                          fallback=lambda: host_fn(*args))
 
 
 def hash_many(data: bytes) -> bytes:

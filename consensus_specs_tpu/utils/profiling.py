@@ -13,8 +13,13 @@ Two layers:
   is what gen_runner's slow-case print upgrades into
   (ref gen_runner.py:26,203-206 only printed per-case wall time).
 
-Explicitly NOT a metrics system — the reference has none and exports
-none (SURVEY §5 observability row); parity is print-level reporting.
+These hooks predate (and complement) the span plane in
+`consensus_specs_tpu/obs` — `trace()` captures XLA *device* op
+timelines via the jax profiler, while obs traces *host-side* spans
+across processes into one Perfetto-loadable file with counters and
+histograms (docs/OBSERVABILITY.md). Use obs for system-level
+visibility; use `trace()` when you need to see inside a single
+dispatch.
 """
 from __future__ import annotations
 

@@ -1,0 +1,212 @@
+"""Tier-1 tests for the obs tracing plane: span API + nesting, the
+disabled fast path, cross-process propagation/merge, the Chrome
+exporter contract, kernel first-call tagging, metrics, and the
+structured event buffer (bench.py's `events` key)."""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from consensus_specs_tpu import obs
+from consensus_specs_tpu.obs import core as obs_core
+from consensus_specs_tpu.obs import metrics as obs_metrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def trace_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv(obs.TRACE_ENV, str(tmp_path))
+    yield tmp_path
+
+
+def _spans(trace_dir):
+    return [r for r in obs.read_records(str(trace_dir)) if r["type"] == "span"]
+
+
+def test_disabled_is_noop(tmp_path, monkeypatch):
+    monkeypatch.delenv(obs.TRACE_ENV, raising=False)
+    assert not obs.enabled()
+    cm = obs.span("nope", x=1)
+    assert cm is obs_core._NOOP
+    with cm:
+        obs.instant("nothing")
+    assert obs.read_records(str(tmp_path)) == []
+
+
+def test_span_nesting_and_attrs(trace_dir):
+    with obs.span("outer", kind="test") as outer:
+        with obs.span("inner") as inner:
+            assert obs.current_span_id() == inner.span_id
+        assert obs.current_span_id() == outer.span_id
+    spans = {s["name"]: s for s in _spans(trace_dir)}
+    assert spans["inner"]["parent"] == spans["outer"]["span"]
+    assert spans["outer"]["parent"] is None
+    assert spans["outer"]["attrs"]["kind"] == "test"
+    assert spans["outer"]["dur"] >= spans["inner"]["dur"] >= 0
+
+
+def test_span_records_error_and_unwinds(trace_dir):
+    with pytest.raises(ValueError):
+        with obs.span("boom"):
+            raise ValueError("bad")
+    assert obs.current_span_id() is None
+    (rec,) = _spans(trace_dir)
+    assert rec["attrs"]["error"].startswith("ValueError")
+
+
+def test_traced_decorator(trace_dir):
+    @obs.traced("deco.fn", tag=7)
+    def fn(x):
+        return x + 1
+
+    assert fn(1) == 2
+    (rec,) = _spans(trace_dir)
+    assert rec["name"] == "deco.fn" and rec["attrs"]["tag"] == 7
+
+
+def test_kernel_span_first_call_tagging(trace_dir):
+    name = f"k.{os.urandom(4).hex()}"  # fresh name: the seen-set is process-global
+    with obs.kernel_span(name):
+        pass
+    with obs.kernel_span(name):
+        pass
+    phases = [s["attrs"]["jit_phase"] for s in _spans(trace_dir)]
+    assert phases == ["first_call", "steady"]
+
+
+def test_instant_attaches_to_current_span(trace_dir):
+    with obs.span("holder") as holder:
+        obs.instant("tick", n=3)
+    recs = obs.read_records(str(trace_dir))
+    (inst,) = [r for r in recs if r["type"] == "instant"]
+    assert inst["span"] == holder.span_id
+    assert inst["attrs"]["n"] == 3
+
+
+def test_event_buffer_and_trace_mirror(trace_dir):
+    obs.events(clear=True)
+    entry = obs.event("note", msg="hello", n=1)
+    assert entry["name"] == "note" and entry["msg"] == "hello"
+    assert entry in obs.events()
+    recs = obs.read_records(str(trace_dir))
+    assert any(r["type"] == "instant" and r["name"] == "event.note" for r in recs)
+
+
+def test_event_buffer_works_disabled(monkeypatch):
+    monkeypatch.delenv(obs.TRACE_ENV, raising=False)
+    obs.events(clear=True)
+    obs.event("still.buffered", x=2)
+    assert obs.events()[-1]["name"] == "still.buffered"
+
+
+def test_resilience_events_become_instants(trace_dir):
+    from consensus_specs_tpu.resilience import record_event
+
+    with obs.span("owner") as owner:
+        record_event("retry", domain="d", capability="cap", kind="transient",
+                     detail="flake")
+    recs = obs.read_records(str(trace_dir))
+    (inst,) = [r for r in recs if r["type"] == "instant"
+               and r["name"] == "resilience.retry"]
+    assert inst["span"] == owner.span_id
+    assert inst["attrs"]["capability"] == "cap"
+
+
+def test_child_env_propagation_and_merge(trace_dir):
+    child_code = (
+        "from consensus_specs_tpu import obs\n"
+        "with obs.span('child.root'):\n"
+        "    with obs.span('child.leaf'):\n"
+        "        pass\n"
+    )
+    with obs.span("parent.spawn") as parent:
+        env = obs.child_env()
+        assert env[obs.TRACE_ENV].endswith(parent.span_id)
+        subprocess.run([sys.executable, "-c", child_code], env=env,
+                       cwd=REPO, check=True, timeout=120)
+    spans = {s["name"]: s for s in _spans(trace_dir)}
+    assert spans["child.root"]["parent"] == spans["parent.spawn"]["span"]
+    assert spans["child.leaf"]["parent"] == spans["child.root"]["span"]
+    assert spans["child.root"]["pid"] != spans["parent.spawn"]["pid"]
+    # one trace id across both processes
+    assert spans["child.root"]["trace"] == spans["parent.spawn"]["trace"]
+
+
+def test_chrome_export_valid_and_flowed(trace_dir):
+    child_code = (
+        "from consensus_specs_tpu import obs\n"
+        "with obs.span('child.work'):\n"
+        "    obs.instant('child.tick')\n"
+    )
+    with obs.span("parent"):
+        subprocess.run([sys.executable, "-c", child_code],
+                       env=obs.child_env(), cwd=REPO, check=True, timeout=120)
+    out = obs.export_chrome(str(trace_dir))
+    with open(out) as f:
+        trace = json.load(f)
+    ok, why = obs.validate_chrome(trace)
+    assert ok, why
+    phs = {e["ph"] for e in trace["traceEvents"]}
+    assert {"X", "M", "i", "s", "f"} <= phs  # spans, meta, instant, flow pair
+    # the flow arrow connects the two pids
+    flows = [e for e in trace["traceEvents"] if e["ph"] in ("s", "f")]
+    assert len({e["pid"] for e in flows}) == 2
+
+
+def test_export_skips_torn_tail(trace_dir):
+    with obs.span("whole"):
+        pass
+    # simulate a SIGKILLed writer: append half a record
+    jsonl = next(p for p in trace_dir.iterdir()
+                 if p.name.startswith("spans-"))
+    with open(jsonl, "a") as f:
+        f.write('{"type": "span", "name": "torn')
+    spans = _spans(trace_dir)
+    assert [s["name"] for s in spans] == ["whole"]
+
+
+def test_validate_chrome_rejects_garbage():
+    for bad in (None, {}, {"traceEvents": []}, {"traceEvents": [{"name": "x"}]},
+                {"traceEvents": [{"ph": "X", "pid": 1, "name": "x",
+                                  "ts": "NaN", "dur": 0}]}):
+        ok, _ = obs.validate_chrome(bad)
+        assert not ok
+
+
+def test_metrics_counters_histograms(trace_dir):
+    obs_metrics.reset()
+    obs.count("widgets", 2)
+    obs.count("widgets")
+    for v in (1.0, 2.0, 10.0):
+        obs.observe("lat_ms", v)
+    snap = obs.snapshot()
+    assert snap["counters"]["widgets"] == 3
+    hist = snap["histograms"]["lat_ms"]
+    assert hist["count"] == 3 and hist["min"] == 1.0 and hist["max"] == 10.0
+    # span durations feed span.<name> histograms automatically
+    with obs.span("metered"):
+        pass
+    assert "span.metered" in obs.snapshot()["histograms"]
+    obs.publish()
+    recs = obs.read_records(str(trace_dir))
+    counters = [r for r in recs if r["type"] == "counter"]
+    assert counters and counters[-1]["values"]["widgets"] == 3
+    obs_metrics.reset()
+
+
+def test_trace_report_summarizes(trace_dir, capsys):
+    from tools import trace_report
+
+    with obs.span("work"):
+        with obs.kernel_span(f"kern.{os.urandom(4).hex()}"):
+            pass
+    obs.export_chrome(str(trace_dir))
+    assert trace_report.main([str(trace_dir)]) == 0
+    assert trace_report.main([os.path.join(str(trace_dir), "trace.json")]) == 0
+    out = capsys.readouterr().out
+    assert "top spans by self-time" in out

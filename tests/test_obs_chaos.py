@@ -1,0 +1,154 @@
+"""Chaos-injected faults must be VISIBLE in the exported trace: every
+`CONSENSUS_SPECS_TPU_CHAOS` hit lands as an instant event attached to
+the span that owned the dispatch — including hits that fire inside a
+subprocess child, which must merge under the parent's span tree with
+the attachment intact (the ISSUE-3 acceptance contract)."""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from consensus_specs_tpu import obs, resilience
+from consensus_specs_tpu.ssz import hashing
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def trace_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv(obs.TRACE_ENV, str(tmp_path))
+    yield tmp_path
+
+
+def _records(trace_dir):
+    return obs.read_records(str(trace_dir))
+
+
+def test_injected_fault_attaches_to_owning_span(trace_dir):
+    """A chaos hit at a bare site inside a span: the `injected` instant
+    carries that span's id."""
+    with obs.span("victim") as victim:
+        with resilience.inject("test.site", "deterministic", count=1):
+            with pytest.raises(resilience.Fault):
+                resilience.chaos("test.site")
+    instants = [r for r in _records(trace_dir) if r["type"] == "instant"
+                and r["name"] == "resilience.injected"]
+    assert len(instants) == 1
+    assert instants[0]["span"] == victim.span_id
+    assert instants[0]["attrs"]["capability"] == "test.site"
+    assert instants[0]["attrs"]["kind"] == "deterministic"
+
+
+def test_supervised_dispatch_chaos_on_dispatch_span(trace_dir):
+    """A transient chaos hit inside the hash backend dispatch: the
+    injected + retry instants attach to the hash.dispatch kernel span
+    (the supervisor retries in place, so the call still succeeds)."""
+    hashing.set_backend(hashing._hashlib_hash_many, name="chaos-test")
+    try:
+        with resilience.inject("hash.dispatch", "transient", count=1):
+            digests = hashing.hash_many(b"\xab" * 64 * 128)
+        assert len(digests) == 32 * 128
+    finally:
+        hashing.set_backend(None)
+        resilience.clear("hash.device")
+    recs = _records(trace_dir)
+    dispatch = [r for r in recs if r["type"] == "span"
+                and r["name"] == "hash.dispatch"]
+    assert dispatch, "hash dispatch span missing"
+    span_ids = {r["span"] for r in dispatch}
+    for name in ("resilience.injected", "resilience.retry"):
+        hits = [r for r in recs if r["type"] == "instant" and r["name"] == name]
+        assert hits, f"{name} instant missing"
+        assert all(h["span"] in span_ids for h in hits), \
+            f"{name} not attached to the hash.dispatch span"
+
+
+_CHILD_CODE = """
+import sys
+from consensus_specs_tpu import obs, resilience
+from consensus_specs_tpu.ssz import hashing
+
+with obs.span("child.hashwork"):
+    hashing.set_backend(hashing._hashlib_hash_many, name="chaos-child")
+    digests = hashing.hash_many(b"\\xcd" * 64 * 128)
+    assert len(digests) == 32 * 128
+"""
+
+
+def test_child_process_chaos_hits_merge_under_parent(trace_dir):
+    """Chaos armed via env fires INSIDE a subprocess child; the exported
+    merged trace must contain the child's injected instant attached to a
+    child span whose ancestry chains up to the parent's span."""
+    with obs.span("parent.drive") as parent:
+        env = obs.child_env({resilience.ENV_KNOB: "hash.dispatch=transient:1"})
+        proc = subprocess.run([sys.executable, "-c", _CHILD_CODE], env=env,
+                              cwd=REPO, timeout=120, capture_output=True,
+                              text=True)
+        assert proc.returncode == 0, proc.stderr
+
+    recs = _records(trace_dir)
+    spans = {r["span"]: r for r in recs if r["type"] == "span"}
+    my_pid = os.getpid()
+
+    injected = [r for r in recs if r["type"] == "instant"
+                and r["name"] == "resilience.injected"
+                and r["pid"] != my_pid]
+    assert injected, "no chaos instant from the subprocess child"
+    (hit,) = injected
+    # attached to the child's hash.dispatch span ...
+    owner = spans[hit["span"]]
+    assert owner["name"] == "hash.dispatch" and owner["pid"] == hit["pid"]
+    # ... whose ancestry reaches the parent process's driving span
+    seen = set()
+    cur = owner
+    while cur is not None and cur["span"] not in seen:
+        seen.add(cur["span"])
+        if cur["span"] == parent.span_id:
+            break
+        cur = spans.get(cur.get("parent") or "")
+    assert cur is not None and cur["span"] == parent.span_id, \
+        "child chaos span does not chain to the parent span"
+
+    # and the merged Chrome export carries the instant with the span ref
+    out = obs.export_chrome(str(trace_dir))
+    with open(out) as f:
+        trace = json.load(f)
+    ok, why = obs.validate_chrome(trace)
+    assert ok, why
+    chrome_instants = [e for e in trace["traceEvents"] if e["ph"] == "i"
+                       and e["name"] == "resilience.injected"
+                       and e["pid"] != my_pid]
+    assert chrome_instants
+    assert chrome_instants[0]["args"]["span"] == owner["span"]
+
+
+def test_gen_case_chaos_retry_marked_in_trace(trace_dir, tmp_path):
+    """The generator's supervised per-case retry: an injected transient
+    at gen.case lands on that case's span and the case still commits."""
+    from consensus_specs_tpu.generators.gen_runner import run_generator
+    from consensus_specs_tpu.generators.gen_typing import TestCase, TestProvider
+
+    def case_fn():
+        yield "value", "data", {"k": 1}
+
+    case = TestCase(fork_name="phase0", preset_name="minimal",
+                    runner_name="smoke", handler_name="core",
+                    suite_name="chaos", case_name="case_0", case_fn=case_fn)
+    out = tmp_path / "vectors"
+    with resilience.inject("gen.case", "transient", count=1):
+        run_generator("obs_chaos", [TestProvider(
+            prepare=lambda: None, make_cases=lambda: iter([case]))],
+            args=["-o", str(out)])
+    assert (out / case.dir_path() / "value.yaml").exists()
+
+    recs = _records(trace_dir)
+    case_spans = {r["span"]: r for r in recs if r["type"] == "span"
+                  and r["name"] == "gen.case"}
+    assert case_spans
+    injected = [r for r in recs if r["type"] == "instant"
+                and r["name"] == "resilience.injected"]
+    assert injected and injected[0]["span"] in case_spans

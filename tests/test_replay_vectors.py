@@ -227,3 +227,48 @@ def test_missing_expected_failure_is_caught(corpus):
         import shutil
 
         shutil.rmtree(clone)
+
+
+def test_json_summary(corpus, tmp_path):
+    """--json writes the machine-readable summary CI asserts on: totals,
+    per-class failure counts, per-format case counts, wall time."""
+    import json
+
+    from tools.replay_vectors import main
+
+    out = tmp_path / "replay.json"
+    rc = main([str(corpus), "--json", str(out)])
+    assert rc == 0
+    summary = json.loads(out.read_text())
+    assert summary["failed"] == 0 and summary["ok"] >= 20
+    assert summary["failures_by_class"] == {} and summary["failures"] == []
+    assert summary["wall_s"] > 0 and summary["empty_corpus"] is False
+    by_format = summary["cases_by_format"]
+    for runner in ("operations", "sanity", "fork_choice", "forks"):
+        assert by_format.get(runner, 0) > 0, by_format
+    assert sum(by_format.values()) == summary["ok"]
+
+
+def test_json_summary_classifies_failures(corpus, tmp_path):
+    """A corrupted post must show up in the --json class breakdown."""
+    import json
+
+    from tools.replay_vectors import main
+
+    d = corpus / "minimal/phase0/operations/attestation/pyspec_tests/success"
+    post_path = d / "post.ssz_snappy"
+    original = post_path.read_bytes()
+    raw = bytearray(snappy.decompress(original))
+    raw[-1] ^= 0xFF
+    post_path.write_bytes(snappy.compress(bytes(raw)))
+    out = tmp_path / "replay.json"
+    try:
+        rc = main([str(corpus), "--json", str(out)])
+    finally:
+        post_path.write_bytes(original)
+    assert rc == 1
+    summary = json.loads(out.read_text())
+    assert summary["failed"] == 1
+    assert summary["failures_by_class"] == {"divergence": 1}
+    assert summary["failures"][0]["class"] == "divergence"
+    assert "success" in summary["failures"][0]["case"]

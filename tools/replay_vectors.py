@@ -38,13 +38,15 @@ on/off overrides only the optional cases).
 from __future__ import annotations
 
 import argparse
+import json
 import pathlib
 import sys
+import time
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
-from consensus_specs_tpu import resilience  # noqa: E402
+from consensus_specs_tpu import obs, resilience  # noqa: E402
 from consensus_specs_tpu.specs.build import build_spec  # noqa: E402
 from consensus_specs_tpu.utils import snappy  # noqa: E402
 
@@ -614,7 +616,7 @@ def summarize_failures(failed):
     return counts
 
 
-def replay_tree(root: pathlib.Path, bls_mode: str = "auto"):
+def replay_tree(root: pathlib.Path, bls_mode: str = "auto", stats: dict = None):
     """Walk <root>/<preset>/<fork>/<runner>/<handler>/<suite>/<case>/.
     Returns (ok, failed_list, unsupported, incomplete) where failed_list
     holds :class:`Failure` entries (tuple-compatible, taxonomy-tagged).
@@ -622,8 +624,15 @@ def replay_tree(root: pathlib.Path, bls_mode: str = "auto"):
     root or layout drift must never read as an empty-but-green corpus),
     and a harness error inside a case (missing part, undecodable pre) is
     that case's failure — classified, reported, and never allowed to
-    abort the walk or masquerade as the vector's expected rejection."""
+    abort the walk or masquerade as the vector's expected rejection.
+
+    ``stats`` (optional dict) is filled with machine-readable totals:
+    ``cases_by_format`` ({runner: walked case count, layout failures
+    under ``_layout``}) for the --json summary."""
     ok, failed, unsupported, incomplete = 0, [], 0, 0
+    by_format: dict = {}
+    if stats is not None:
+        stats["cases_by_format"] = by_format
     # ANY part file marks a case directory. Globbing *.yaml (not just
     # meta.yaml) matters: bls cases ship only data.yaml and shuffling
     # cases only mapping.yaml — meta.yaml is written solely when meta is
@@ -636,14 +645,19 @@ def replay_tree(root: pathlib.Path, bls_mode: str = "auto"):
         if len(rel.parts) != 6:
             failed.append(Failure(str(rel), f"unexpected layout depth {len(rel.parts)} "
                           "(want preset/fork/runner/handler/suite/case)", "layout"))
+            by_format["_layout"] = by_format.get("_layout", 0) + 1
             continue
         preset, fork, runner, handler, suite, case = rel.parts
         if (case_dir / "INCOMPLETE").exists():
             incomplete += 1
             continue
+        by_format[runner] = by_format.get(runner, 0) + 1
         try:
-            resilience.chaos("replay.case")
-            err = _replay_case(runner, handler, fork, preset, suite, case, case_dir, bls_mode)
+            with obs.span("replay.case", case=str(rel), runner=runner,
+                          handler=handler, fork=fork, preset=preset):
+                resilience.chaos("replay.case")
+                err = _replay_case(runner, handler, fork, preset, suite, case,
+                                   case_dir, bls_mode)
         except NotImplementedError:
             unsupported += 1
             continue
@@ -658,14 +672,21 @@ def replay_tree(root: pathlib.Path, bls_mode: str = "auto"):
     return ok, failed, unsupported, incomplete
 
 
-def main() -> int:
+def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("output_dir", type=pathlib.Path)
     parser.add_argument("--bls", choices=("auto", "on", "off"), default="auto",
                         help="signature policy for cases whose bls_setting is optional")
-    ns = parser.parse_args()
+    parser.add_argument("--json", dest="json_path", type=pathlib.Path, default=None,
+                        help="write a machine-readable summary (per-class failure "
+                             "counts, per-format case counts, wall time) so CI can "
+                             "assert on replay results instead of grepping stdout")
+    ns = parser.parse_args(argv)
 
-    ok, failed, unsupported, incomplete = replay_tree(ns.output_dir, ns.bls)
+    t0 = time.monotonic()
+    stats: dict = {}
+    ok, failed, unsupported, incomplete = replay_tree(ns.output_dir, ns.bls, stats=stats)
+    wall_s = time.monotonic() - t0
     by_class = summarize_failures(failed)
     breakdown = (" (" + ", ".join(f"{k}: {v}" for k, v in sorted(by_class.items())) + ")"
                  if by_class else "")
@@ -673,7 +694,26 @@ def main() -> int:
           f"unsupported format: {unsupported}; incomplete skipped: {incomplete}")
     for rel, err in failed:
         print(f"FAIL {rel}: {err}")
-    if ok == 0 and not failed:
+    empty = ok == 0 and not failed
+    if ns.json_path is not None:
+        summary = {
+            "ok": ok,
+            "failed": len(failed),
+            "unsupported": unsupported,
+            "incomplete": incomplete,
+            "wall_s": round(wall_s, 3),
+            "failures_by_class": by_class,
+            "cases_by_format": stats.get("cases_by_format", {}),
+            "failures": [{"case": f[0], "error": f[1],
+                          "class": getattr(f, "taxonomy", "harness")}
+                         for f in failed],
+            "empty_corpus": empty,
+        }
+        ns.json_path.parent.mkdir(parents=True, exist_ok=True)
+        with open(ns.json_path, "w") as f:
+            json.dump(summary, f, indent=2, sort_keys=True)
+        print(f"json summary written to {ns.json_path}")
+    if empty:
         print("ERROR: no replayable cases found under the given directory")
         return 1
     return 1 if failed else 0

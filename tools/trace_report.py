@@ -1,0 +1,212 @@
+"""Summarize an obs trace: top spans by self-time, jit compile-vs-
+execute split, resilience retry/quarantine tally, per-fork generator
+case latency percentiles.
+
+Usage:
+    python tools/trace_report.py <trace-dir | trace.json> [--json <path>]
+
+Accepts either the raw span-JSONL directory a traced run wrote
+(CONSENSUS_SPECS_TPU_TRACE=<dir>) or an already-merged Chrome
+``trace.json`` (obs.export.export_chrome); the two carry the same span
+ids/attrs, so one summary path serves both. Exit status 0 iff the
+input parses as a valid trace with at least one span.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+from typing import Any, Dict, List, Optional
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from consensus_specs_tpu.obs import export as obs_export  # noqa: E402
+from consensus_specs_tpu.obs.metrics import percentile  # noqa: E402
+
+
+def _records_from_chrome(trace: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Reconstruct obs records from a merged Chrome trace (the exporter
+    keeps span/parent ids in ``args``, so the tree survives the trip)."""
+    records: List[Dict[str, Any]] = []
+    for ev in trace.get("traceEvents", []):
+        ph = ev.get("ph")
+        args = ev.get("args") or {}
+        if ph == "X":
+            records.append({
+                "type": "span", "name": ev.get("name"),
+                "span": args.get("span"), "parent": args.get("parent"),
+                "ts": ev.get("ts", 0), "dur": ev.get("dur", 0),
+                "pid": ev.get("pid"), "tid": ev.get("tid"),
+                "attrs": {k: v for k, v in args.items()
+                          if k not in ("span", "parent")},
+            })
+        elif ph == "i":
+            records.append({
+                "type": "instant", "name": ev.get("name"),
+                "span": args.get("span"), "ts": ev.get("ts", 0),
+                "pid": ev.get("pid"), "tid": ev.get("tid"),
+                "attrs": {k: v for k, v in args.items() if k != "span"},
+            })
+    return records
+
+
+def load_records(path: pathlib.Path) -> List[Dict[str, Any]]:
+    if path.is_dir():
+        return obs_export.read_records(str(path))
+    with open(path) as f:
+        trace = json.load(f)
+    ok, why = obs_export.validate_chrome(trace)
+    if not ok:
+        raise ValueError(f"{path} is not a valid Chrome trace: {why}")
+    return _records_from_chrome(trace)
+
+
+def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    spans = [r for r in records if r.get("type") == "span"]
+    instants = [r for r in records if r.get("type") == "instant"]
+
+    # --- self time: dur minus the dur of DIRECT children, per span name
+    child_dur: Dict[Optional[str], float] = {}
+    for s in spans:
+        parent = s.get("parent")
+        child_dur[parent] = child_dur.get(parent, 0.0) + float(s.get("dur") or 0)
+    by_name: Dict[str, Dict[str, float]] = {}
+    for s in spans:
+        self_us = max(0.0, float(s.get("dur") or 0)
+                      - child_dur.get(s.get("span"), 0.0))
+        acc = by_name.setdefault(s["name"], {"count": 0, "total_us": 0.0,
+                                             "self_us": 0.0})
+        acc["count"] += 1
+        acc["total_us"] += float(s.get("dur") or 0)
+        acc["self_us"] += self_us
+    top = sorted(by_name.items(), key=lambda kv: -kv[1]["self_us"])
+
+    # --- jit compile vs execute: the first_call population carries
+    # trace+compile; steady-state is execution alone
+    kernels: Dict[str, Dict[str, List[float]]] = {}
+    for s in spans:
+        phase = (s.get("attrs") or {}).get("jit_phase")
+        if phase in ("first_call", "compile"):
+            kernels.setdefault(s["name"], {}).setdefault("first", []).append(
+                float(s.get("dur") or 0))
+        elif phase in ("steady", "execute"):
+            kernels.setdefault(s["name"], {}).setdefault("steady", []).append(
+                float(s.get("dur") or 0))
+    jit_split = {}
+    for name, pops in sorted(kernels.items()):
+        first = pops.get("first", [])
+        steady = pops.get("steady", [])
+        steady_p50 = percentile(steady, 50)
+        entry: Dict[str, Any] = {
+            "first_call_ms": round(max(first) / 1e3, 3) if first else None,
+            "steady_p50_ms": (round(steady_p50 / 1e3, 3)
+                              if steady_p50 is not None else None),
+            "dispatches": len(first) + len(steady),
+        }
+        if first and steady_p50 is not None:
+            entry["compile_ms_est"] = round(
+                max(0.0, max(first) - steady_p50) / 1e3, 3)
+        jit_split[name] = entry
+
+    # --- resilience tally (the supervisor bridge prefixes everything)
+    tally: Dict[str, int] = {}
+    for i in instants:
+        name = i.get("name") or ""
+        if name.startswith("resilience."):
+            tally[name[len("resilience."):]] = tally.get(
+                name[len("resilience."):], 0) + 1
+    chaos_hits = tally.get("injected", 0)
+
+    # --- generator case latency percentiles, per fork
+    gen: Dict[str, List[float]] = {}
+    for s in spans:
+        if s["name"] != "gen.case":
+            continue
+        fork = str((s.get("attrs") or {}).get("fork", "?"))
+        gen.setdefault(fork, []).append(float(s.get("dur") or 0) / 1e3)
+    gen_pcts = {
+        fork: {
+            "cases": len(vals),
+            "p50_ms": round(percentile(vals, 50), 3),
+            "p90_ms": round(percentile(vals, 90), 3),
+            "p99_ms": round(percentile(vals, 99), 3),
+        }
+        for fork, vals in sorted(gen.items())
+    }
+
+    n_pids = len({s.get("pid") for s in spans})
+    return {
+        "spans": len(spans),
+        "instants": len(instants),
+        "processes": n_pids,
+        "top_spans_by_self_time": [
+            {"name": name, "count": int(acc["count"]),
+             "total_ms": round(acc["total_us"] / 1e3, 3),
+             "self_ms": round(acc["self_us"] / 1e3, 3)}
+            for name, acc in top[:20]
+        ],
+        "jit_compile_vs_execute": jit_split,
+        "resilience_events": tally,
+        "chaos_hits": chaos_hits,
+        "gen_case_latency_by_fork": gen_pcts,
+    }
+
+
+def print_summary(summary: Dict[str, Any]) -> None:
+    print(f"trace: {summary['spans']} spans, {summary['instants']} instants, "
+          f"{summary['processes']} process(es)")
+    rows = summary["top_spans_by_self_time"]
+    if rows:
+        width = max(len(r["name"]) for r in rows)
+        print("\ntop spans by self-time:")
+        for r in rows:
+            print(f"  {r['name']:<{width}}  self {r['self_ms']:>10.3f}ms  "
+                  f"total {r['total_ms']:>10.3f}ms  x{r['count']}")
+    if summary["jit_compile_vs_execute"]:
+        print("\njit compile vs execute:")
+        for name, e in summary["jit_compile_vs_execute"].items():
+            compile_est = (f"  compile~{e['compile_ms_est']}ms"
+                           if e.get("compile_ms_est") is not None else "")
+            print(f"  {name}: first_call {e['first_call_ms']}ms, "
+                  f"steady p50 {e['steady_p50_ms']}ms, "
+                  f"{e['dispatches']} dispatch(es){compile_est}")
+    if summary["resilience_events"]:
+        print("\nresilience events:")
+        for name, n in sorted(summary["resilience_events"].items()):
+            print(f"  {name}: {n}")
+    if summary["gen_case_latency_by_fork"]:
+        print("\ngenerator case latency (per fork):")
+        for fork, e in summary["gen_case_latency_by_fork"].items():
+            print(f"  {fork}: {e['cases']} cases  p50 {e['p50_ms']}ms  "
+                  f"p90 {e['p90_ms']}ms  p99 {e['p99_ms']}ms")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("trace", type=pathlib.Path,
+                        help="trace dir (span JSONL) or merged trace.json")
+    parser.add_argument("--json", dest="json_path", type=pathlib.Path,
+                        default=None, help="also write the summary as JSON")
+    ns = parser.parse_args(argv)
+
+    try:
+        records = load_records(ns.trace)
+    except (OSError, ValueError) as e:
+        print(f"ERROR: {e}")
+        return 1
+    summary = summarize(records)
+    if summary["spans"] == 0:
+        print(f"ERROR: no spans found in {ns.trace}")
+        return 1
+    print_summary(summary)
+    if ns.json_path is not None:
+        with open(ns.json_path, "w") as f:
+            json.dump(summary, f, indent=2, sort_keys=True)
+        print(f"\njson summary written to {ns.json_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,198 @@
+"""`make trace` smoke: a small instrumented bench+generator run whose
+merged trace must be a valid Chrome trace containing (1) parent spans,
+(2) at least one subprocess child's spans merged under the correct
+parent span, (3) a jit compile-vs-execute split for at least one
+kernel, and (4) at least one resilience/chaos instant event. Exits
+nonzero if any of those is missing — this is the observability plane's
+end-to-end conformance check, cheap enough for citest.
+
+Usage:
+    python tools/trace_smoke.py [--out DIR]     # default ./trace-smoke
+
+What runs:
+- the engine's jitted flag-delta kernel twice on the CPU backend
+  (first_call vs steady spans -> the compile/execute split);
+- a batched hash backend dispatch with a chaos-armed transient fault
+  (retry + injected instants on the owning span, parent side);
+- one REAL bench section child (``bench.py --section
+  incremental_reroot``) under the trace env, so the bench supervisor's
+  child-span plumbing is exercised, not simulated;
+- one generator child running a tiny 4-case suite with ``gen.case``
+  chaos armed (child-side chaos instants), then a SECOND run over the
+  same output dir so the journal-admit path marks resumed cases.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import tempfile
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def _gen_child(out_dir: str) -> None:
+    """A tiny self-contained generator run: 4 trivial data-part cases."""
+    from consensus_specs_tpu.generators.gen_runner import run_generator
+    from consensus_specs_tpu.generators.gen_typing import TestCase, TestProvider
+
+    def case_fn(i: int):
+        def fn():
+            yield "value", "data", {"case": i, "payload": [i, i * i]}
+
+        return fn
+
+    cases = [
+        TestCase(fork_name="phase0", preset_name="minimal",
+                 runner_name="smoke", handler_name="core",
+                 suite_name="trace", case_name=f"case_{i}",
+                 case_fn=case_fn(i))
+        for i in range(4)
+    ]
+    provider = TestProvider(prepare=lambda: None, make_cases=lambda: iter(cases))
+    run_generator("trace_smoke", [provider], args=["-o", out_dir])
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--out", type=pathlib.Path,
+                        default=pathlib.Path("trace-smoke"),
+                        help="trace directory (span JSONL + trace.json)")
+    parser.add_argument("--gen-child", dest="gen_child", default=None,
+                        help=argparse.SUPPRESS)  # internal: child mode
+    ns = parser.parse_args(argv)
+
+    if ns.gen_child is not None:
+        _gen_child(ns.gen_child)
+        return 0
+
+    # keep every jax touch on the host CPU backend (the axon sitecustomize
+    # pins platforms via jax.config, so set it the same way, pre-init)
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+    out = ns.out
+    out.mkdir(parents=True, exist_ok=True)
+    for stale in list(out.glob("spans-*.jsonl")) + [out / "trace.json"]:
+        try:
+            stale.unlink()
+        except OSError:
+            pass
+    os.environ["CONSENSUS_SPECS_TPU_TRACE"] = str(out)
+
+    import numpy as np
+
+    from consensus_specs_tpu import obs
+    from consensus_specs_tpu.engine import backend
+    from consensus_specs_tpu.resilience import clear as clear_quarantine, inject
+    from consensus_specs_tpu.ssz import hashing
+
+    my_pid = os.getpid()
+    with obs.span("trace_smoke"):
+        # (3) jit compile vs execute: two dispatches of the delta kernel
+        with obs.span("smoke.engine"):
+            installed = backend.use_backend("jax")
+            if installed == "jax":
+                n = 8192
+                inc = np.ones(n, dtype=np.uint64)
+                mask = np.ones(n, dtype=bool)
+                elig = np.ones(n, dtype=bool)
+                for _ in range(2):
+                    got = backend.dispatch_delta_kernel(
+                        inc, mask, elig, 7, 14, 64, n, 64, False, True)
+                    assert got is not None, "delta kernel dispatch degraded"
+            backend.use_backend("numpy")
+
+        # (4) parent-side chaos: one injected transient on the hash
+        # dispatch — the supervisor retries, both events land as instants
+        with obs.span("smoke.hash"):
+            hashing.set_backend(hashing._hashlib_hash_many, name="smoke")
+            try:
+                with inject("hash.dispatch", "transient", count=1):
+                    digests = hashing.hash_many(b"\x5f" * 64 * 128)
+                assert len(digests) == 32 * 128
+            finally:
+                hashing.set_backend(None)
+                clear_quarantine("hash.device")
+
+        # (2) real subprocess children whose spans must merge under the
+        # parent: a bench section child + a generator child (chaos-armed
+        # so an injected fault fires INSIDE the child), then a resume
+        # pass over the same output (journal-admit instants)
+        with obs.span("smoke.bench_child"):
+            subprocess.run(
+                [sys.executable, str(REPO / "bench.py"),
+                 "--section", "incremental_reroot"],
+                env=obs.child_env(), cwd=str(REPO), check=True,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                timeout=240)
+        with tempfile.TemporaryDirectory() as gen_out:
+            with obs.span("smoke.gen_child"):
+                subprocess.run(
+                    [sys.executable, str(REPO / "tools" / "trace_smoke.py"),
+                     "--gen-child", gen_out],
+                    env=obs.child_env(
+                        {"CONSENSUS_SPECS_TPU_CHAOS": "gen.case=transient:1"}),
+                    cwd=str(REPO), check=True, stdout=subprocess.DEVNULL,
+                    timeout=240)
+            with obs.span("smoke.gen_child_resume"):
+                subprocess.run(
+                    [sys.executable, str(REPO / "tools" / "trace_smoke.py"),
+                     "--gen-child", gen_out],
+                    env=obs.child_env(), cwd=str(REPO), check=True,
+                    stdout=subprocess.DEVNULL, timeout=240)
+
+    obs.publish()
+    trace_path = obs.export_chrome(str(out))
+
+    # ---- assert the acceptance contract on the merged trace ----------
+    with open(trace_path) as f:
+        trace = json.load(f)
+    ok, why = obs.validate_chrome(trace)
+    assert ok, f"merged trace is not valid Chrome-trace JSON: {why}"
+
+    events = trace["traceEvents"]
+    spans = [e for e in events if e.get("ph") == "X"]
+    by_id = {e["args"]["span"]: e for e in spans if e.get("args", {}).get("span")}
+
+    child_under_parent = [
+        e for e in spans
+        if e["pid"] != my_pid
+        and by_id.get(e.get("args", {}).get("parent", ""), {}).get("pid") == my_pid
+    ]
+    assert child_under_parent, \
+        "no subprocess child span merged under a parent-process span"
+
+    jit_names = {}
+    for e in spans:
+        phase = e.get("args", {}).get("jit_phase")
+        if phase:
+            jit_names.setdefault(e["name"], set()).add(phase)
+    split = [n for n, phases in jit_names.items()
+             if {"first_call", "steady"} <= phases or {"compile", "execute"} <= phases]
+    assert split, f"no kernel has a compile-vs-execute split (saw {jit_names})"
+
+    resilience_instants = [e for e in events if e.get("ph") == "i"
+                           and str(e.get("name", "")).startswith("resilience.")]
+    assert resilience_instants, "no resilience/chaos instant events in the trace"
+    child_instants = [e for e in resilience_instants if e["pid"] != my_pid]
+
+    print(f"trace smoke OK: {trace_path}")
+    print(f"  {len(spans)} spans over {len({e['pid'] for e in spans})} processes; "
+          f"{len(child_under_parent)} child spans under parent spans")
+    print(f"  jit split for: {', '.join(sorted(split))}")
+    print(f"  {len(resilience_instants)} resilience instants "
+          f"({len(child_instants)} inside subprocess children)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,6 +6,7 @@
 PYTHON ?= python
 TEST_VECTOR_DIR ?= ./test-vectors
 TRACE_DIR ?= ./trace-smoke
+LEDGER ?= ./perf-ledger/ledger.jsonl
 GENERATORS = bls epoch_processing finality fork_choice forks genesis merkle \
              operations random rewards sanity shuffling ssz_generic ssz_static transition
 
@@ -17,7 +18,7 @@ DEVICE_TESTS = tests/test_bls_device.py tests/test_curve_device.py \
                tests/test_multichip.py
 
 .PHONY: test citest test-fast test-device test-mainnet lint docs generate_tests gen_% replay bench \
-        dryrun detect_generator_incomplete clean-vectors chaos trace help
+        dryrun detect_generator_incomplete clean-vectors chaos trace perfgate perf-report help
 
 # the fault-injection suite: supervisor/taxonomy units, chaos replay
 # (tampered vectors), induced backend failures, generator crash/resume
@@ -37,6 +38,8 @@ help:
 	@echo "dryrun                multi-chip dry-run on a virtual 8-device mesh"
 	@echo "chaos                 fault-injection suite (resilience layer: retries, quarantine, journal, tampered vectors)"
 	@echo "trace                 instrumented bench+generator smoke -> $(TRACE_DIR)/trace.json (Perfetto-loadable) + summary"
+	@echo "perfgate              host-only micro-bench slice -> $(LEDGER); FAILS on a sentinel-confirmed regression"
+	@echo "perf-report           render the perf ledger trajectory -> perf-report.html (+ stdout summary)"
 
 # parallelize like the reference (ref Makefile:100-106) when pytest-xdist
 # is present; degrade to single-process so the suite stays runnable cold
@@ -55,10 +58,21 @@ citest:
 	$(if $(fork),,$(error citest requires fork=<name>, e.g. make citest fork=phase0))
 	$(PYTHON) -m pytest tests/spec -q --fork $(fork) $(if $(engine),--engine $(engine))
 	$(MAKE) trace
+	$(MAKE) perfgate
 
 trace:
 	$(PYTHON) tools/trace_smoke.py --out $(TRACE_DIR)
 	$(PYTHON) tools/trace_report.py $(TRACE_DIR)/trace.json
+
+# the perf evidence gate (docs/OBSERVABILITY.md): a deterministic
+# host-only micro-bench appended to the ledger, failed by the sentinel
+# on a confirmed (non-environmental) regression against the rolling
+# baseline — cold ledgers pass (no_baseline never gates)
+perfgate:
+	$(PYTHON) tools/perfgate.py --ledger $(LEDGER)
+
+perf-report:
+	$(PYTHON) tools/perf_report.py report --ledger $(LEDGER) --html perf-report.html
 
 test-fast:
 	$(PYTHON) -m pytest tests/ -q $(addprefix --ignore=,$(DEVICE_TESTS)) $(PYTEST_EXTRA)

@@ -18,6 +18,14 @@ Perfetto-loadable trace per run.
   (``trace.json``) that Perfetto / ``chrome://tracing`` loads directly,
   with resilience retries/quarantines/chaos hits as instant events on
   the owning span and cross-process flow arrows.
+- :mod:`ledger` — the perf evidence ledger: crash-safe append-only
+  JSONL time series of every bench/perfgate datapoint (git sha,
+  backend, environment fingerprint; degraded runs as first-class
+  host-only datapoints).
+- :mod:`sentinel` — noise-aware regression verdicts over the ledger
+  (rolling median+MAD baselines; resilience-taxonomy classification so
+  environment gaps never read as regressions). ``make perfgate`` gates
+  CI on them.
 
 Instrumented planes: bls facade dispatch + oracle adjudication, engine
 ``dispatch_delta_kernel`` + every vectorized epoch stage, the ssz
@@ -45,5 +53,13 @@ from .core import (  # noqa: F401
     trace_dir,
     traced,
 )
-from .export import export_chrome, read_records, to_chrome, validate_chrome  # noqa: F401
-from .metrics import count, observe, publish, snapshot  # noqa: F401
+from .export import (  # noqa: F401
+    export_chrome,
+    load_records,
+    read_records,
+    records_from_chrome,
+    to_chrome,
+    validate_chrome,
+)
+from .metrics import count, observe, prometheus_text, publish, snapshot  # noqa: F401
+from . import ledger, sentinel  # noqa: F401  (perf evidence plane)

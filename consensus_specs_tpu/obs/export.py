@@ -148,6 +148,50 @@ def export_chrome(trace_dir: str, out_path: Optional[str] = None) -> str:
     return out_path
 
 
+def records_from_chrome(trace: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Reconstruct obs records from a merged Chrome trace — the exporter
+    keeps span/parent ids in ``args``, so the span tree survives the
+    round trip. Shared by tools/trace_report.py and tools/trace_diff.py
+    so both accept either input form."""
+    records: List[Dict[str, Any]] = []
+    for ev in trace.get("traceEvents", []):
+        if not isinstance(ev, dict):
+            continue
+        ph = ev.get("ph")
+        args = ev.get("args") or {}
+        if ph == "X":
+            records.append({
+                "type": "span", "name": ev.get("name", "?"),
+                "span": args.get("span"), "parent": args.get("parent"),
+                "ts": ev.get("ts", 0), "dur": ev.get("dur", 0),
+                "pid": ev.get("pid"), "tid": ev.get("tid"),
+                "attrs": {k: v for k, v in args.items()
+                          if k not in ("span", "parent")},
+            })
+        elif ph == "i":
+            records.append({
+                "type": "instant", "name": ev.get("name", "?"),
+                "span": args.get("span"), "ts": ev.get("ts", 0),
+                "pid": ev.get("pid"), "tid": ev.get("tid"),
+                "attrs": {k: v for k, v in args.items() if k != "span"},
+            })
+    return records
+
+
+def load_records(path: str) -> List[Dict[str, Any]]:
+    """Records from either input form a traced run produces: a raw span
+    JSONL directory, or a merged ``trace.json``. Raises ValueError on a
+    file that is not a valid Chrome trace."""
+    if os.path.isdir(path):
+        return read_records(path)
+    with open(path) as f:
+        trace = json.load(f)
+    ok, why = validate_chrome(trace)
+    if not ok:
+        raise ValueError(f"{path} is not a valid Chrome trace: {why}")
+    return records_from_chrome(trace)
+
+
 def validate_chrome(trace: Any) -> Tuple[bool, str]:
     """Structural validation of a Chrome trace-event object: the
     contract ``make trace`` asserts before calling a run green."""

@@ -1,0 +1,430 @@
+"""Perf evidence ledger: a crash-safe, append-only JSONL time series of
+every benchmark datapoint the system ever produces.
+
+The round-5 lesson (VERDICT r5 "bottom line"): structure without
+*evidence*. Bench runs, trace exports, and metrics snapshots were rich
+but ephemeral — nothing accumulated them, so no run was ever compared
+against a prior run, and a device-unreachable round recorded
+``value: null`` instead of the host-side truth it actually measured.
+The ledger is the accumulation point:
+
+- one file (default ``perf-ledger/ledger.jsonl`` at the repo root,
+  overridable via ``CONSENSUS_SPECS_TPU_LEDGER``; the empty string or
+  ``off`` disables it), one flushed+fsync'd JSON line per record —
+  the generator-journal crash contract: a SIGKILL mid-write costs
+  exactly the torn last line, never the history before it;
+- two record types: a ``run`` header (source, git sha, backend,
+  environment fingerprint) followed by one ``point`` per metric, so a
+  partially-written run still yields joinable points;
+- device-unreachable runs are FIRST-CLASS host-only datapoints: the
+  run's environment carries ``device_unreachable: true``, its points
+  carry ``backend: "host"``, and the headline metric is populated from
+  the host-path measurement instead of null;
+- :func:`Ledger.ingest_bench_payload` accepts both a raw bench.py
+  RESULTS dict and the driver's ``BENCH_r0N.json`` wrapper
+  (``{"n", "rc", "tail", "parsed"}``), recovering metrics from the
+  stderr tail when ``parsed`` is null (the r04 rc=124 case) so the
+  historical rounds backfill completely.
+
+Consumers: ``bench.py`` appends every parent run, ``tools/perfgate.py``
+appends the CI micro-bench slice and gates on :mod:`.sentinel`'s
+verdicts, ``tools/perf_report.py`` renders the trajectory.
+
+See docs/OBSERVABILITY.md ("Perf evidence plane") for the schema.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import socket
+import subprocess
+import sys
+import time
+from typing import Any, Dict, Iterable, List, Optional
+
+LEDGER_ENV = "CONSENSUS_SPECS_TPU_LEDGER"
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+DEFAULT_RELPATH = os.path.join("perf-ledger", "ledger.jsonl")
+
+HEADLINE_METRIC = "bls_cold_fast_aggregate_verifies_per_sec"
+
+# bench.py RESULTS keys that are bookkeeping, not metrics
+_NON_METRIC_KEYS = {
+    "n", "rc", "metric", "unit", "backend", "section_seconds",
+    "section_errors", "skipped_sections", "resilience_events", "events",
+    "trace_json", "trace_json_error", "ledger", "ledger_error",
+}
+
+
+def default_path() -> str:
+    """The ledger path to append to, or "" when disabled. Env knob wins;
+    the default anchors to the repo root so every tool and bench run
+    shares one file regardless of cwd."""
+    raw = os.environ.get(LEDGER_ENV)
+    if raw is not None:
+        if raw.strip().lower() in ("", "0", "off", "none"):
+            return ""
+        return raw
+    return os.path.join(_REPO_ROOT, DEFAULT_RELPATH)
+
+
+_SHA_CACHE: Optional[str] = None
+
+
+def git_sha() -> Optional[str]:
+    """Short git sha of the repo HEAD, or None outside a checkout."""
+    global _SHA_CACHE
+    if _SHA_CACHE is not None:
+        return _SHA_CACHE or None
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=_REPO_ROOT,
+            capture_output=True, text=True, timeout=10)
+        _SHA_CACHE = out.stdout.strip() if out.returncode == 0 else ""
+    except (OSError, subprocess.SubprocessError):
+        _SHA_CACHE = ""
+    return _SHA_CACHE or None
+
+
+def environment_fingerprint(**extra: Any) -> Dict[str, Any]:
+    """Where a datapoint was measured: enough to decide comparability
+    (the sentinel only baselines points from comparable environments)."""
+    env: Dict[str, Any] = {
+        "platform": sys.platform,
+        "python": ".".join(str(v) for v in sys.version_info[:3]),
+        "host": socket.gethostname(),
+    }
+    env.update({k: v for k, v in extra.items() if v is not None})
+    return env
+
+
+def infer_unit(metric: str) -> Optional[str]:
+    """Best-effort unit from the metric-name conventions bench.py uses."""
+    if metric.endswith("_mibs") or metric.endswith("mibs"):
+        return "MiB/s"
+    if metric.endswith("_ms"):
+        return "ms"
+    if metric.endswith("_us"):
+        return "us"
+    if metric.endswith("_s") or metric.endswith("_seconds"):
+        return "s"
+    if "per_sec" in metric or metric.endswith("_rate"):
+        return "/s"
+    if "speedup" in metric or metric == "vs_baseline":
+        return "x"
+    return None
+
+
+def metric_backend(metric: str, run_backend: str) -> str:
+    """Per-point backend tag: host-path metrics stay ``host`` even in a
+    device run (they are measured on host by construction), device-named
+    metrics stay ``jax``; everything else inherits the run's backend."""
+    name = metric.lower()
+    if ("host" in name or "hashlib" in name or name.startswith("epoch_")
+            or name.startswith("incremental_reroot")
+            or name.startswith("perfgate_")):
+        return "host"
+    if "device" in name or "pallas" in name:
+        return "jax"
+    return run_backend
+
+
+class Ledger:
+    """Append-only JSONL perf ledger (see module docstring for schema)."""
+
+    def __init__(self, path: Optional[str] = None) -> None:
+        p = path if path is not None else default_path()
+        if not p:
+            raise ValueError("ledger disabled (empty path); check "
+                             f"{LEDGER_ENV} or pass an explicit path")
+        self.path = p
+
+    # -- write ----------------------------------------------------------
+
+    def append_raw(self, record: Dict[str, Any]) -> None:
+        """One record, one flushed+fsync'd line (crash-safe append)."""
+        parent = os.path.dirname(self.path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        line = json.dumps(record, default=repr)
+        with open(self.path, "a") as f:
+            f.write(line + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+    def record_run(
+        self,
+        metrics: Dict[str, Any],
+        *,
+        source: str,
+        backend: str = "host",
+        environment: Optional[Dict[str, Any]] = None,
+        sha: Optional[str] = None,
+        units: Optional[Dict[str, str]] = None,
+        run_id: Optional[str] = None,
+        ts: Optional[float] = None,
+        label: Optional[str] = None,
+        extra: Optional[Dict[str, Any]] = None,
+    ) -> str:
+        """Append one run header + one point per numeric metric. Returns
+        the run id. ``metrics`` values that are None or non-numeric are
+        skipped (a degraded run records what it has)."""
+        ts = time.time() if ts is None else ts
+        if run_id is None:
+            run_id = f"{source}-{int(ts)}-{os.urandom(3).hex()}"
+        if sha is None:
+            sha = git_sha()
+        env = environment or environment_fingerprint()
+        numeric = {k: float(v) for k, v in metrics.items()
+                   if isinstance(v, (int, float)) and not isinstance(v, bool)}
+        header: Dict[str, Any] = {
+            "type": "run", "run_id": run_id, "ts": ts, "source": source,
+            "sha": sha, "backend": backend, "environment": env,
+            "metrics_count": len(numeric),
+        }
+        if label:
+            header["label"] = label
+        if extra:
+            header.update(extra)
+        self.append_raw(header)
+        for metric, value in sorted(numeric.items()):
+            unit = (units or {}).get(metric) or infer_unit(metric)
+            self.append_raw({
+                "type": "point", "run_id": run_id, "ts": ts,
+                "metric": metric, "value": value, "unit": unit,
+                "backend": metric_backend(metric, backend),
+                "source": source, "sha": sha,
+            })
+        return run_id
+
+    # -- read -----------------------------------------------------------
+
+    def read(self) -> List[Dict[str, Any]]:
+        """All committed records, torn trailing lines skipped."""
+        records: List[Dict[str, Any]] = []
+        try:
+            with open(self.path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue  # torn tail of a killed writer
+                    if isinstance(rec, dict):
+                        records.append(rec)
+        except OSError:
+            return []
+        return records
+
+    def runs(self) -> List[Dict[str, Any]]:
+        """Run headers, ordered by timestamp (then round label)."""
+        runs = [r for r in self.read() if r.get("type") == "run"]
+        runs.sort(key=lambda r: (r.get("ts") or 0, r.get("round") or 0))
+        return runs
+
+    def points(
+        self,
+        metric: Optional[str] = None,
+        backend: Optional[str] = None,
+        source: Optional[str] = None,
+    ) -> List[Dict[str, Any]]:
+        """Point records with the owning run's environment joined in
+        (key ``environment``), filtered and ordered by timestamp."""
+        records = self.read()
+        envs = {r.get("run_id"): r.get("environment") or {}
+                for r in records if r.get("type") == "run"}
+        out = []
+        for r in records:
+            if r.get("type") != "point":
+                continue
+            if metric is not None and r.get("metric") != metric:
+                continue
+            if backend is not None and r.get("backend") != backend:
+                continue
+            if source is not None and r.get("source") != source:
+                continue
+            joined = dict(r)
+            joined["environment"] = envs.get(r.get("run_id"), {})
+            out.append(joined)
+        out.sort(key=lambda r: r.get("ts") or 0)
+        return out
+
+    def series(self, metric: str, backend: Optional[str] = None) -> List[Dict[str, Any]]:
+        """Time-ordered datapoints for one metric (optionally one backend)."""
+        return self.points(metric=metric, backend=backend)
+
+    def metrics(self) -> List[str]:
+        """All metric names present, sorted."""
+        return sorted({r.get("metric") for r in self.read()
+                       if r.get("type") == "point" and r.get("metric")})
+
+    def labels(self) -> List[str]:
+        return [r["label"] for r in self.runs() if r.get("label")]
+
+    # -- bench ingestion ------------------------------------------------
+
+    def ingest_bench_payload(
+        self,
+        payload: Dict[str, Any],
+        *,
+        source: str = "bench",
+        label: Optional[str] = None,
+        ts: Optional[float] = None,
+    ) -> str:
+        """Ingest a bench run: either bench.py's raw RESULTS dict or the
+        driver's ``BENCH_r0N.json`` wrapper. Returns the run id."""
+        round_no: Optional[int] = None
+        rc: Optional[int] = None
+        results: Dict[str, Any] = payload
+        tail = ""
+        if "tail" in payload and ("parsed" in payload or "rc" in payload):
+            # driver wrapper: {"n", "cmd", "rc", "tail", "parsed"}
+            round_no = payload.get("n")
+            rc = payload.get("rc")
+            tail = payload.get("tail") or ""
+            results = payload.get("parsed") or {}
+            if ts is None:
+                ts = _tail_timestamp(tail)
+            if not results:
+                # r04 shape: the run was killed before the JSON line —
+                # recover what the progress tail proves was measured
+                results = _recover_metrics_from_tail(tail)
+
+        metrics = {k: v for k, v in results.items()
+                   if k not in _NON_METRIC_KEYS
+                   and isinstance(v, (int, float)) and not isinstance(v, bool)}
+        headline = results.get("metric") or HEADLINE_METRIC
+        unreachable = bool(results.get("device_unreachable"))
+        degraded = bool(results.get("device_compile_failed"))
+        backend = str(results.get("backend") or
+                      ("host" if (unreachable or degraded or
+                                  results.get("value") is None) else "jax"))
+
+        value = results.get("value")
+        if isinstance(value, (int, float)) and not isinstance(value, bool):
+            metrics[headline] = float(value)
+        elif unreachable or degraded:
+            # first-class host-only datapoint instead of null: the host
+            # oracle rate IS the headline measurement of a degraded run
+            host_rate = results.get("bls_host_oracle_cold_rate")
+            if isinstance(host_rate, (int, float)):
+                metrics[headline] = float(host_rate)
+                backend = "host"
+        metrics.pop("value", None)
+
+        env = environment_fingerprint(
+            device_unreachable=unreachable or None,
+            device_compile_failed=degraded or None,
+            external_timeout=(True if rc == 124 else None),
+        )
+        units = {headline: results.get("unit") or "/s"}
+        extra: Dict[str, Any] = {}
+        if round_no is not None:
+            extra["round"] = round_no
+        if rc is not None:
+            extra["rc"] = rc
+        if results.get("section_errors"):
+            extra["section_errors"] = results["section_errors"]
+        return self.record_run(
+            metrics, source=source, backend=backend, environment=env,
+            units=units, ts=ts, label=label, extra=extra)
+
+
+# ---------------------------------------------------------------------------
+# historical-tail recovery (the r04 rc=124 wrapper has parsed: null but a
+# progress tail that proves what was measured before the kill)
+# ---------------------------------------------------------------------------
+
+_TAIL_TS_RE = re.compile(r"(\d{4})-(\d{2})-(\d{2}) (\d{2}):(\d{2}):(\d{2})")
+
+_TAIL_PATTERNS = (
+    (re.compile(r"bls done cold=([\d.]+)/s warm=([\d.]+)/s host=([\d.]+)/s"),
+     ("value", "bls_warm_verifies_per_sec", "bls_host_oracle_cold_rate")),
+    (re.compile(r"hashing done dev=([\d.]+) host=([\d.]+) spec=([\d.]+) "
+                r"hashlib=([\d.]+)"),
+     ("hash_tree_root_mibs", "hash_host_shani_mibs", "hash_spec_path_mibs",
+      "hash_hashlib_ref_mibs")),
+    (re.compile(r"config #3 done dev=([\d.]+)s host=([\d.]+)s"),
+     ("block_128atts_mainnet_device_s", "block_128atts_mainnet_host_s")),
+    (re.compile(r"config #4 done dev=([\d.]+)s host=([\d.]+)s"),
+     ("sync_aggregate_512_device_s", "sync_aggregate_512_host_s")),
+)
+
+
+def _tail_timestamp(tail: str) -> Optional[float]:
+    """Epoch seconds of the first wall-clock stamp in a driver tail (the
+    jax warning lines carry one), so backfilled rounds order correctly."""
+    m = _TAIL_TS_RE.search(tail)
+    if not m:
+        return None
+    import calendar
+
+    y, mo, d, h, mi, s = (int(g) for g in m.groups())
+    return float(calendar.timegm((y, mo, d, h, mi, s, 0, 0, 0)))
+
+
+def _recover_metrics_from_tail(tail: str) -> Dict[str, Any]:
+    """Metrics provably measured before a kill, from the progress tail."""
+    out: Dict[str, Any] = {}
+    for pattern, names in _TAIL_PATTERNS:
+        m = pattern.search(tail)
+        if not m:
+            continue
+        for name, group in zip(names, m.groups()):
+            out[name] = float(group)
+    if "value" in out and out.get("bls_host_oracle_cold_rate"):
+        out["vs_baseline"] = round(out["value"] / out["bls_host_oracle_cold_rate"], 2)
+    if out.get("block_128atts_mainnet_device_s"):
+        out["block_128atts_speedup"] = round(
+            out["block_128atts_mainnet_host_s"] / out["block_128atts_mainnet_device_s"], 2)
+    return out
+
+
+def ingest_files(
+    paths: Iterable[str],
+    ledger: Optional[Ledger] = None,
+    *,
+    source: str = "ingest",
+    force: bool = False,
+) -> List[Dict[str, Any]]:
+    """Backfill driver BENCH json files into the ledger, one run per
+    file, keyed by basename so a re-ingest is a no-op unless forced.
+    Returns per-file status dicts."""
+    led = ledger or Ledger()
+    try:
+        seen = set(led.labels())
+    except OSError:
+        seen = set()
+    out = []
+    last_ts: Optional[float] = None
+    for path in paths:
+        label = os.path.basename(path)
+        if not force and label in seen:
+            out.append({"file": label, "status": "skipped", "reason": "already ingested"})
+            continue
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+        except (OSError, ValueError) as e:
+            out.append({"file": label, "status": "error", "reason": repr(e)})
+            continue
+        # keep backfilled rounds in file order even when one wrapper's
+        # tail carries no wall-clock stamp (BENCH_r03): order after the
+        # previous round instead of "now"
+        ts = _tail_timestamp(str(payload.get("tail") or ""))
+        if ts is None and last_ts is not None:
+            ts = last_ts + 60.0
+        if ts is not None:
+            last_ts = ts
+        run_id = led.ingest_bench_payload(payload, source=source, label=label,
+                                          ts=ts)
+        n_points = sum(1 for r in led.read()
+                       if r.get("type") == "point" and r.get("run_id") == run_id)
+        seen.add(label)
+        out.append({"file": label, "status": "ingested", "run_id": run_id,
+                    "points": n_points})
+    return out

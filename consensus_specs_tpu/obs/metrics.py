@@ -9,6 +9,8 @@ distributions exist without any extra call sites.
 """
 from __future__ import annotations
 
+import math
+import re
 import threading
 from typing import Any, Dict, List, Optional
 
@@ -36,12 +38,23 @@ def observe(name: str, value: float) -> None:
 
 
 def percentile(samples: List[float], q: float) -> Optional[float]:
-    """Nearest-rank percentile (q in [0, 100]) of a sample list."""
+    """Nearest-rank percentile (q in [0, 100]) of a sample list.
+
+    Edge contract (unit-tested directly): empty input -> None; a single
+    sample is every percentile of itself; q <= 0 -> min, q >= 100 ->
+    max; otherwise the classic nearest-rank definition
+    ``ordered[ceil(q/100 * n) - 1]`` (the old implementation used a
+    rounded linear-interpolation index, whose banker's rounding could
+    pick the rank BELOW the nearest-rank answer)."""
     if not samples:
         return None
     ordered = sorted(samples)
-    idx = min(len(ordered) - 1, max(0, int(round(q / 100 * (len(ordered) - 1)))))
-    return ordered[idx]
+    if q <= 0:
+        return ordered[0]
+    if q >= 100:
+        return ordered[-1]
+    rank = math.ceil(q / 100.0 * len(ordered))  # 1-based nearest rank
+    return ordered[max(0, rank - 1)]
 
 
 def snapshot(clear: bool = False) -> Dict[str, Any]:
@@ -88,6 +101,54 @@ def publish() -> None:
         "pid": ctx.pid,
         "values": values,
     })
+
+
+_PROM_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    """A legal Prometheus metric name: invalid chars become ``_`` and a
+    leading digit gets an underscore prefix."""
+    out = _PROM_NAME_RE.sub("_", name)
+    if not out or out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def prometheus_text(snap: Optional[Dict[str, Any]] = None) -> str:
+    """Prometheus text-format exposition of :func:`snapshot`.
+
+    Counters render as ``counter`` series; histograms as ``summary``
+    series (p50/p90/p99 quantile labels + ``_count``) plus ``_min`` /
+    ``_max`` gauges. The auto-maintained ``<hist>.count`` counters are
+    folded into their histogram's ``_count`` line rather than emitted
+    twice under a colliding name.
+    """
+    if snap is None:
+        snap = snapshot()
+    counters: Dict[str, float] = snap.get("counters", {})
+    hists: Dict[str, Dict[str, Any]] = snap.get("histograms", {})
+    lines: List[str] = []
+    hist_count_keys = {name + ".count" for name in hists}
+    for name in sorted(counters):
+        if name in hist_count_keys:
+            continue
+        pname = _prom_name(name)
+        lines.append(f"# TYPE {pname} counter")
+        lines.append(f"{pname} {counters[name]:g}")
+    for name in sorted(hists):
+        h = hists[name]
+        pname = _prom_name(name)
+        lines.append(f"# TYPE {pname} summary")
+        for q_label, key in (("0.5", "p50"), ("0.9", "p90"), ("0.99", "p99")):
+            if h.get(key) is not None:
+                lines.append(f'{pname}{{quantile="{q_label}"}} {h[key]:g}')
+        lines.append(f"{pname}_count {h.get('count', 0):g}")
+        for suffix in ("min", "max"):
+            if h.get(suffix) is not None:
+                lines.append(f"# TYPE {pname}_{suffix} gauge")
+                lines.append(f"{pname}_{suffix} {h[suffix]:g}")
+    return "\n".join(lines) + ("\n" if lines else "")
 
 
 def reset() -> None:

@@ -1,0 +1,239 @@
+"""Regression sentinel: noise-aware verdicts over the perf ledger.
+
+For each metric the sentinel computes a rolling baseline — median +
+MAD (median absolute deviation) over the last K *comparable* runs
+(same metric, same backend) — and classifies a new datapoint:
+
+- ``improved`` / ``regressed``: the deviation from the baseline median
+  exceeds the noise envelope, in the metric's good/bad direction;
+- ``stable``: inside the envelope;
+- ``no_baseline``: fewer than ``min_history`` comparable points exist
+  (the point is recorded; the gate never fails on a cold ledger);
+- ``environmental``: the run's environment explains the gap — e.g. a
+  ``device_unreachable`` run cannot produce the jax-backend series, so
+  the missing/host-substituted datapoint is an environment gap, not a
+  regression. The verdict carries the resilience taxonomy kind
+  (:data:`~consensus_specs_tpu.resilience.taxonomy.ENVIRONMENTAL`),
+  exactly like a quarantined backend: recorded, visible, non-fatal.
+
+The noise envelope is ``max(rel_threshold * |median|,
+mad_k * 1.4826 * MAD)``: the MAD term adapts to each metric's observed
+jitter (1.4826 scales MAD to a Gaussian sigma), the relative floor
+keeps near-constant series from flagging on micro-jitter.
+
+Directionality: metrics ending in ``_s``/``_ms``/``_us``/``_seconds``
+are lower-is-better (durations); everything else (rates, MiB/s,
+speedups) is higher-is-better.
+
+Gate contract (``tools/perfgate.py``): FAIL iff any verdict is
+``regressed`` — whose taxonomy kind is deterministic (same code, same
+inputs, slower result = a defect). ``environmental`` and
+``no_baseline`` never fail the gate.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..resilience.taxonomy import DETERMINISTIC, ENVIRONMENTAL
+
+IMPROVED = "improved"
+STABLE = "stable"
+REGRESSED = "regressed"
+NO_BASELINE = "no_baseline"
+ENV_GAP = "environmental"
+
+_LOWER_IS_BETTER_SUFFIXES = ("_s", "_ms", "_us", "_seconds")
+
+# MAD -> sigma for normally-distributed noise
+_MAD_SIGMA = 1.4826
+
+
+@dataclass
+class Policy:
+    """Sentinel thresholds (documented in docs/OBSERVABILITY.md)."""
+
+    window: int = 8          # last K comparable points form the baseline
+    min_history: int = 3     # fewer -> no_baseline
+    rel_threshold: float = 0.25   # 25% relative floor on the envelope
+    mad_k: float = 4.0       # envelope half-width in MAD-sigmas
+
+
+DEFAULT_POLICY = Policy()
+
+
+def polarity(metric: str) -> int:
+    """+1 when higher is better (rates, speedups), -1 for durations."""
+    return -1 if metric.endswith(_LOWER_IS_BETTER_SUFFIXES) else 1
+
+
+def median(values: Sequence[float]) -> float:
+    ordered = sorted(values)
+    n = len(ordered)
+    mid = n // 2
+    if n % 2:
+        return float(ordered[mid])
+    return (ordered[mid - 1] + ordered[mid]) / 2.0
+
+
+def baseline(values: Sequence[float]) -> Dict[str, float]:
+    """Rolling-baseline stats: median + MAD over the given window."""
+    med = median(values)
+    mad = median([abs(v - med) for v in values])
+    return {"median": med, "mad": mad, "n": float(len(values))}
+
+
+@dataclass
+class Verdict:
+    metric: str
+    verdict: str
+    value: Optional[float] = None
+    backend: Optional[str] = None
+    baseline_median: Optional[float] = None
+    baseline_mad: Optional[float] = None
+    baseline_n: int = 0
+    deviation_pct: Optional[float] = None
+    kind: Optional[str] = None   # resilience taxonomy class, when at fault
+    detail: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = {k: v for k, v in self.__dict__.items() if v is not None and v != ""}
+        return out
+
+
+def classify_point(
+    metric: str,
+    value: float,
+    history: Sequence[float],
+    policy: Policy = DEFAULT_POLICY,
+) -> Verdict:
+    """Verdict for one datapoint against its comparable history."""
+    window = list(history)[-policy.window:]
+    if len(window) < policy.min_history:
+        return Verdict(metric=metric, verdict=NO_BASELINE, value=value,
+                       baseline_n=len(window),
+                       detail=f"{len(window)} comparable point(s), "
+                              f"need {policy.min_history}")
+    stats = baseline(window)
+    med, mad = stats["median"], stats["mad"]
+    envelope = max(policy.rel_threshold * abs(med),
+                   policy.mad_k * _MAD_SIGMA * mad)
+    deviation = value - med
+    dev_pct = (100.0 * deviation / med) if med else None
+    common = dict(value=value, baseline_median=med, baseline_mad=mad,
+                  baseline_n=len(window), deviation_pct=dev_pct)
+    if abs(deviation) <= envelope or envelope == 0:
+        return Verdict(metric=metric, verdict=STABLE, **common)
+    good = deviation * polarity(metric) > 0
+    if good:
+        return Verdict(metric=metric, verdict=IMPROVED, **common)
+    return Verdict(
+        metric=metric, verdict=REGRESSED, kind=DETERMINISTIC,
+        detail=f"beyond noise envelope ±{envelope:.4g} around median {med:.4g}",
+        **common)
+
+
+@dataclass
+class Report:
+    verdicts: List[Verdict] = field(default_factory=list)
+    ok: bool = True
+
+    @property
+    def regressed(self) -> List[Verdict]:
+        return [v for v in self.verdicts if v.verdict == REGRESSED]
+
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for v in self.verdicts:
+            out[v.verdict] = out.get(v.verdict, 0) + 1
+        return out
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"ok": self.ok, "counts": self.counts(),
+                "verdicts": [v.to_dict() for v in self.verdicts]}
+
+
+def evaluate_run(
+    history_points: Sequence[Dict[str, Any]],
+    current_points: Sequence[Dict[str, Any]],
+    *,
+    run_environment: Optional[Dict[str, Any]] = None,
+    policy: Policy = DEFAULT_POLICY,
+) -> Report:
+    """Classify every datapoint of one run against ledger history.
+
+    ``history_points`` / ``current_points`` are ledger point dicts
+    (``metric``/``value``/``backend``; see obs/ledger.py). Comparability
+    = same metric AND same backend: a host-only fallback value is never
+    judged against a jax-backend baseline.
+
+    When ``run_environment`` marks the run degraded (device unreachable
+    or compile failed), any metric whose established baseline lives on a
+    backend this run could not exercise gets an ``environmental``
+    verdict instead of silently vanishing — the r05 case, rendered as a
+    first-class environment gap.
+    """
+    env = run_environment or {}
+    degraded = bool(env.get("device_unreachable") or env.get("device_compile_failed"))
+    report = Report()
+
+    series: Dict[tuple, List[float]] = {}
+    for p in history_points:
+        m, b = p.get("metric"), p.get("backend")
+        if m is None or not isinstance(p.get("value"), (int, float)):
+            continue
+        series.setdefault((m, b), []).append(float(p["value"]))
+
+    current_by_key = {}
+    for p in current_points:
+        m, b = p.get("metric"), p.get("backend")
+        if m is None or not isinstance(p.get("value"), (int, float)):
+            continue
+        current_by_key[(m, b)] = float(p["value"])
+
+    for (m, b), value in sorted(current_by_key.items()):
+        report.verdicts.append(
+            classify_point(m, value, series.get((m, b), []), policy))
+        report.verdicts[-1].backend = b
+
+    if degraded:
+        # baselines this run could not exercise: environment gap verdicts
+        reason = ("device unreachable" if env.get("device_unreachable")
+                  else "device compile failed")
+        for (m, b), values in sorted(series.items()):
+            if b == "host" or len(values) < policy.min_history:
+                continue
+            if (m, b) in current_by_key:
+                continue
+            report.verdicts.append(Verdict(
+                metric=m, verdict=ENV_GAP, backend=b, kind=ENVIRONMENTAL,
+                baseline_median=median(values[-policy.window:]),
+                baseline_n=len(values[-policy.window:]),
+                detail=f"{reason}: no {b}-backend datapoint this run "
+                       f"(recorded as an environment gap, not a regression)"))
+
+    report.ok = not report.regressed
+    return report
+
+
+def evaluate_ledger(
+    ledger: Any,
+    run_id: Optional[str] = None,
+    policy: Policy = DEFAULT_POLICY,
+) -> Report:
+    """Evaluate one run already in the ledger (default: the latest run)
+    against everything recorded before it."""
+    runs = ledger.runs()
+    if not runs:
+        return Report()
+    if run_id is None:
+        run_id = runs[-1].get("run_id")
+    run = next((r for r in runs if r.get("run_id") == run_id), None)
+    points = ledger.points()
+    current = [p for p in points if p.get("run_id") == run_id]
+    run_ts = run.get("ts") if run else None
+    history = [p for p in points if p.get("run_id") != run_id
+               and (run_ts is None or (p.get("ts") or 0) <= run_ts)]
+    return evaluate_run(
+        history, current,
+        run_environment=(run or {}).get("environment"), policy=policy)

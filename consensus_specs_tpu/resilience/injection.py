@@ -12,7 +12,10 @@ Arming:
 
 Kinds: transient / deterministic / environmental raise the matching
 taxonomy Fault; ``kill`` delivers SIGKILL to the current process (the
-crash-safety drill for the generator journal).
+crash-safety drill for the generator journal); ``hang`` sleeps
+(default 3600 s, CONSENSUS_SPECS_TPU_CHAOS_HANG_S overrides) — the
+wedged-tunnel simulation that deadline supervisors are drilled
+against (tests/test_dryrun_guard.py).
 
 Sites are plain strings; the convention is plane.point:
   bls.import  bls.dispatch  engine.import  engine.dispatch
@@ -72,9 +75,9 @@ def _parse_env(raw: str) -> Dict[str, _Armed]:
         site, _, spec = clause.partition("=")
         parts = spec.split(":")
         kind = parts[0].strip()
-        if kind not in _FAULTS and kind != "kill":
+        if kind not in _FAULTS and kind not in ("kill", "hang"):
             raise ValueError(f"{ENV_KNOB}: unknown fault kind {kind!r} "
-                             f"(have {sorted(_FAULTS)} + 'kill')")
+                             f"(have {sorted(_FAULTS)} + 'kill'/'hang')")
         count = int(parts[1]) if len(parts) > 1 and parts[1] != "*" else (
             1 if len(parts) <= 1 else -1)
         after = int(parts[2]) if len(parts) > 2 else 0
@@ -98,7 +101,7 @@ def refresh() -> None:
 
 
 def arm(site: str, kind: str, count: int = 1, after: int = 0) -> None:
-    if kind not in _FAULTS and kind != "kill":
+    if kind not in _FAULTS and kind not in ("kill", "hang"):
         raise ValueError(f"unknown fault kind {kind!r}")
     _SITES[site] = _Armed(kind, count, after)
 
@@ -169,4 +172,10 @@ def chaos(site: str) -> None:
                  detail=f"hit {armed.hits} (after={armed.after}, count={armed.count})")
     if armed.kind == "kill":
         os.kill(os.getpid(), signal.SIGKILL)
+    if armed.kind == "hang":
+        import time
+
+        time.sleep(float(os.environ.get("CONSENSUS_SPECS_TPU_CHAOS_HANG_S",
+                                        "3600")))
+        return
     raise _FAULTS[armed.kind](f"injected {armed.kind} fault @ {site}", domain=site)

@@ -1,0 +1,149 @@
+"""Perf ledger: crash-safe append/read, run+point schema, backend
+tagging, and the historical BENCH_r0*.json backfill contract
+(ISSUE 4 acceptance: all five rounds ingest, r05 is a first-class
+host-only datapoint, r04 recovers from its progress tail)."""
+import glob
+import json
+import os
+import pathlib
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from consensus_specs_tpu.obs import ledger as ledger_mod
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture
+def led(tmp_path):
+    return ledger_mod.Ledger(str(tmp_path / "ledger.jsonl"))
+
+
+def test_record_run_writes_header_then_points(led):
+    run_id = led.record_run({"m_a": 1.5, "m_b_ms": 2.0, "skip_me": None},
+                            source="test", backend="host")
+    records = led.read()
+    assert records[0]["type"] == "run"
+    assert records[0]["run_id"] == run_id
+    assert records[0]["metrics_count"] == 2
+    points = [r for r in records if r["type"] == "point"]
+    assert {p["metric"] for p in points} == {"m_a", "m_b_ms"}
+    assert all(p["run_id"] == run_id for p in points)
+    # unit inference from naming conventions
+    assert {p["metric"]: p["unit"] for p in points}["m_b_ms"] == "ms"
+
+
+def test_torn_trailing_line_is_skipped_not_fatal(led):
+    led.record_run({"m": 1.0}, source="test")
+    with open(led.path, "a") as f:
+        f.write('{"type": "point", "metric": "torn", "val')  # killed mid-write
+    records = led.read()
+    assert all(r.get("metric") != "torn" for r in records)
+    assert len([r for r in records if r["type"] == "point"]) == 1
+    # and the file is still appendable afterwards
+    led.record_run({"m": 2.0}, source="test")
+    assert len(led.series("m")) == 2
+
+
+def test_series_filters_by_metric_and_backend(led):
+    led.record_run({"thing_rate": 10.0}, source="a", backend="jax", ts=1.0)
+    led.record_run({"thing_rate": 11.0}, source="b", backend="jax", ts=2.0)
+    led.record_run({"thing_rate": 0.5}, source="c", backend="host", ts=3.0)
+    assert [p["value"] for p in led.series("thing_rate")] == [10.0, 11.0, 0.5]
+    assert [p["value"] for p in led.series("thing_rate", backend="jax")] == [10.0, 11.0]
+    assert [p["value"] for p in led.series("thing_rate", backend="host")] == [0.5]
+
+
+def test_host_path_metrics_tagged_host_even_in_device_runs(led):
+    led.record_run({"hash_host_shani_mibs": 250.0, "epoch_soa_altair_s": 0.1,
+                    "incremental_reroot_ms": 0.1, "kzg_batch_verifies_per_sec": 99.0},
+                   source="bench", backend="jax")
+    by_metric = {p["metric"]: p["backend"] for p in led.points()}
+    assert by_metric["hash_host_shani_mibs"] == "host"
+    assert by_metric["epoch_soa_altair_s"] == "host"
+    assert by_metric["incremental_reroot_ms"] == "host"
+    assert by_metric["kzg_batch_verifies_per_sec"] == "jax"
+
+
+def test_device_unreachable_run_is_first_class_host_datapoint(led):
+    # the r05 shape: value null, host oracle measured, device unreachable
+    payload = {
+        "metric": ledger_mod.HEADLINE_METRIC, "value": None,
+        "unit": "verifies/s", "vs_baseline": None,
+        "device_unreachable": True,
+        "bls_host_oracle_cold_rate": 0.929,
+        "hash_host_shani_mibs": 268.6,
+    }
+    run_id = led.ingest_bench_payload(payload, source="bench")
+    run = led.runs()[-1]
+    assert run["run_id"] == run_id
+    assert run["backend"] == "host"
+    assert run["environment"]["device_unreachable"] is True
+    headline = led.series(ledger_mod.HEADLINE_METRIC)
+    assert len(headline) == 1
+    assert headline[0]["value"] == 0.929  # NOT null, NOT missing
+    assert headline[0]["backend"] == "host"
+    assert headline[0]["environment"]["device_unreachable"] is True
+
+
+def test_backend_tag_from_bench_results_is_respected(led):
+    led.ingest_bench_payload(
+        {"metric": ledger_mod.HEADLINE_METRIC, "value": 108.4,
+         "unit": "verifies/s", "backend": "jax"}, source="bench")
+    p = led.series(ledger_mod.HEADLINE_METRIC)[0]
+    assert p["backend"] == "jax"
+    assert p["value"] == 108.4
+
+
+def test_backfill_all_five_historical_rounds():
+    files = sorted(glob.glob(str(REPO / "BENCH_r0*.json")))
+    assert len(files) == 5, "expected the five historical driver rounds"
+    import tempfile
+
+    led = ledger_mod.Ledger(os.path.join(tempfile.mkdtemp(), "ledger.jsonl"))
+    statuses = ledger_mod.ingest_files(files, led)
+    assert all(s["status"] == "ingested" for s in statuses), statuses
+    runs = led.runs()
+    assert [r["round"] for r in runs] == [1, 2, 3, 4, 5]
+    # r04 (rc=124, parsed null) recovered real metrics from its tail
+    r04 = next(r for r in runs if r["round"] == 4)
+    r04_points = [p for p in led.points() if p["run_id"] == r04["run_id"]]
+    r04_metrics = {p["metric"]: p["value"] for p in r04_points}
+    assert r04_metrics[ledger_mod.HEADLINE_METRIC] == 108.47
+    assert r04_metrics["block_128atts_mainnet_host_s"] == 56.0
+    assert r04_metrics["block_128atts_speedup"] == pytest.approx(37.09, abs=0.1)
+    assert r04["environment"].get("external_timeout") is True
+    # r05 is the host-only datapoint, not null
+    r05 = next(r for r in runs if r["round"] == 5)
+    assert r05["environment"]["device_unreachable"] is True
+    headline = led.series(ledger_mod.HEADLINE_METRIC)
+    assert headline[-1]["backend"] == "host"
+    assert headline[-1]["value"] == 0.929
+    # re-ingest is a no-op keyed by basename
+    again = ledger_mod.ingest_files(files, led)
+    assert all(s["status"] == "skipped" for s in again)
+    assert len(led.runs()) == 5
+
+
+def test_default_path_env_knob(monkeypatch, tmp_path):
+    monkeypatch.setenv(ledger_mod.LEDGER_ENV, str(tmp_path / "x.jsonl"))
+    assert ledger_mod.default_path() == str(tmp_path / "x.jsonl")
+    monkeypatch.setenv(ledger_mod.LEDGER_ENV, "off")
+    assert ledger_mod.default_path() == ""
+    with pytest.raises(ValueError):
+        ledger_mod.Ledger("")
+    monkeypatch.delenv(ledger_mod.LEDGER_ENV)
+    assert ledger_mod.default_path().endswith(
+        os.path.join("perf-ledger", "ledger.jsonl"))
+
+
+def test_run_extras_survive_round_trip(led):
+    led.record_run({"m": 1.0}, source="test",
+                   extra={"round": 9, "section_errors": {"bls": "x"}})
+    run = led.runs()[-1]
+    assert run["round"] == 9
+    assert run["section_errors"] == {"bls": "x"}
+    assert json.loads(open(led.path).readline())["type"] == "run"

@@ -1,0 +1,104 @@
+"""obs/metrics.py satellites: nearest-rank percentile edge cases
+(empty, single sample, q=0/100) and the Prometheus text-format
+exposition of snapshot()."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+from consensus_specs_tpu.obs import metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_metrics():
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+# -- percentile edge contract ------------------------------------------------
+
+def test_percentile_empty_is_none():
+    assert metrics.percentile([], 50) is None
+    assert metrics.percentile([], 0) is None
+    assert metrics.percentile([], 100) is None
+
+
+def test_percentile_single_sample_is_every_percentile():
+    for q in (0, 1, 50, 99, 100):
+        assert metrics.percentile([7.5], q) == 7.5
+
+
+def test_percentile_q0_is_min_q100_is_max():
+    vals = [5.0, 1.0, 3.0, 9.0]
+    assert metrics.percentile(vals, 0) == 1.0
+    assert metrics.percentile(vals, 100) == 9.0
+    # out-of-range q clamps rather than raising
+    assert metrics.percentile(vals, -10) == 1.0
+    assert metrics.percentile(vals, 250) == 9.0
+
+
+def test_percentile_nearest_rank_definition():
+    vals = list(range(1, 11))  # 1..10
+    # nearest-rank: ordered[ceil(q/100 * n) - 1]
+    assert metrics.percentile(vals, 50) == 5
+    assert metrics.percentile(vals, 90) == 9
+    assert metrics.percentile(vals, 91) == 10
+    assert metrics.percentile(vals, 10) == 1
+    assert metrics.percentile(vals, 11) == 2
+    # two samples: p50 is the FIRST (ceil(0.5*2)=1), not an interpolation
+    assert metrics.percentile([1.0, 2.0], 50) == 1.0
+    assert metrics.percentile([1.0, 2.0], 51) == 2.0
+
+
+def test_snapshot_uses_fixed_percentiles():
+    for v in range(1, 101):
+        metrics.observe("lat", float(v))
+    h = metrics.snapshot()["histograms"]["lat"]
+    assert h["min"] == 1.0 and h["max"] == 100.0
+    assert h["p50"] == 50.0 and h["p90"] == 90.0 and h["p99"] == 99.0
+    assert h["count"] == 100
+
+
+# -- prometheus exposition ---------------------------------------------------
+
+def test_prometheus_text_counters_and_histograms():
+    metrics.count("gen.cases", 3)
+    metrics.observe("span.bls.dispatch", 1.5)
+    metrics.observe("span.bls.dispatch", 2.5)
+    metrics.observe("span.bls.dispatch", 3.5)
+    text = metrics.prometheus_text()
+    lines = text.strip().splitlines()
+    assert "# TYPE gen_cases counter" in lines
+    assert "gen_cases 3" in lines
+    assert "# TYPE span_bls_dispatch summary" in lines
+    assert 'span_bls_dispatch{quantile="0.5"} 2.5' in lines
+    assert "span_bls_dispatch_count 3" in lines
+    assert "span_bls_dispatch_min 1.5" in lines
+    assert "span_bls_dispatch_max 3.5" in lines
+    # the auto ".count" counter folds into _count, no colliding duplicate
+    assert lines.count("span_bls_dispatch_count 3") == 1
+    assert "# TYPE span_bls_dispatch_count counter" not in lines
+    assert text.endswith("\n")
+
+
+def test_prometheus_name_sanitization():
+    metrics.count("1weird name-with.bad/chars", 1)
+    text = metrics.prometheus_text()
+    assert "_1weird_name_with_bad_chars 1" in text
+
+
+def test_prometheus_empty_snapshot_is_empty_string():
+    assert metrics.prometheus_text() == ""
+
+
+def test_prometheus_accepts_external_snapshot():
+    snap = {"counters": {"x": 2.0},
+            "histograms": {"h": {"count": 1, "min": 1.0, "p50": 1.0,
+                                 "p90": None, "p99": 1.0, "max": 1.0}}}
+    text = metrics.prometheus_text(snap)
+    assert "x 2" in text
+    assert 'h{quantile="0.9"}' not in text  # None quantiles skipped
+    assert 'h{quantile="0.99"} 1' in text

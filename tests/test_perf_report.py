@@ -1,0 +1,116 @@
+"""tools/perf_report.py (ISSUE 4 acceptance #5): `ingest BENCH_r0*.json`
+backfills all five historical rounds and the report renders their
+trajectory — including the r05 host-only datapoint — as text, HTML
+(inline SVG series), and Prometheus exposition. Plus: the bench.py
+parent appends its RESULTS to the ledger on emit."""
+import glob
+import importlib.util
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from consensus_specs_tpu.obs import ledger as ledger_mod
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+_spec = importlib.util.spec_from_file_location(
+    "perf_report", str(REPO / "tools" / "perf_report.py"))
+perf_report = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_spec and perf_report)
+
+
+def test_ingest_and_report_render_trajectory(tmp_path, capsys):
+    ledger_path = str(tmp_path / "ledger.jsonl")
+    files = sorted(glob.glob(str(REPO / "BENCH_r0*.json")))
+    assert len(files) == 5
+
+    rc = perf_report.main(["ingest"] + files + ["--ledger", ledger_path])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert out.count("ingested BENCH_r0") == 5
+
+    # idempotent re-ingest
+    rc = perf_report.main(["ingest"] + files + ["--ledger", ledger_path])
+    assert rc == 0
+    assert capsys.readouterr().out.count("skipped BENCH_r0") == 5
+
+    html_path = tmp_path / "report.html"
+    prom_path = tmp_path / "report.prom"
+    rc = perf_report.main(["report", "--ledger", ledger_path,
+                           "--html", str(html_path), "--prom", str(prom_path)])
+    assert rc == 0
+    text = capsys.readouterr().out
+    for n in range(1, 6):
+        assert f"BENCH_r0{n}.json" in text
+    assert "device-unreachable" in text  # r05 rendered as degraded, present
+    assert ledger_mod.HEADLINE_METRIC in text
+
+    html = html_path.read_text()
+    assert "<svg" in html  # trajectory actually rendered
+    assert ledger_mod.HEADLINE_METRIC in html
+    for n in range(1, 6):
+        assert f"BENCH_r0{n}.json" in html
+    assert "device_unreachable" in html  # the r05 flag column
+    assert html.count("stroke=\"#c2410c\"") >= 1  # host-only open marker
+
+    prom = prom_path.read_text()
+    assert "# TYPE consensus_specs_tpu_perf_value gauge" in prom
+    assert f'metric="{ledger_mod.HEADLINE_METRIC}"' in prom
+    assert "consensus_specs_tpu_perf_runs_total 5" in prom
+
+
+def test_report_on_empty_ledger_reports_not_tracebacks(tmp_path, capsys):
+    rc = perf_report.main(["report", "--ledger", str(tmp_path / "none.jsonl")])
+    assert rc == 2
+    assert "ERROR" in capsys.readouterr().out
+
+
+def test_ingest_unreadable_file_reports_error(tmp_path, capsys):
+    bad = tmp_path / "bad.json"
+    bad.write_text("{nope")
+    rc = perf_report.main(["ingest", str(bad),
+                           "--ledger", str(tmp_path / "l.jsonl")])
+    assert rc == 2
+    assert "ERROR bad.json" in capsys.readouterr().out
+
+
+def test_bench_parent_emit_appends_to_ledger(tmp_path):
+    """The bench.py parent's _emit ships RESULTS into the ledger (child
+    processes never do — the parent ingests their merged results once)."""
+    ledger_path = str(tmp_path / "ledger.jsonl")
+    code = (
+        "import bench\n"
+        "bench.RESULTS.update(value=1.23, vs_baseline=1.0, backend='host',\n"
+        "                     device_unreachable=True,\n"
+        "                     bls_host_oracle_cold_rate=1.23)\n"
+        "bench._emit()\n"
+    )
+    env = dict(os.environ, CONSENSUS_SPECS_TPU_LEDGER=ledger_path)
+    env.pop("CONSENSUS_SPECS_TPU_TRACE", None)
+    proc = subprocess.run([sys.executable, "-c", code], cwd=str(REPO),
+                          env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    emitted = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert emitted["ledger"]["path"] == ledger_path
+
+    led = ledger_mod.Ledger(ledger_path)
+    run = led.runs()[-1]
+    assert run["source"] == "bench"
+    assert run["backend"] == "host"
+    assert run["environment"]["device_unreachable"] is True
+    point = led.series(ledger_mod.HEADLINE_METRIC)[-1]
+    assert point["value"] == 1.23
+    assert point["backend"] == "host"
+
+    # a CHILD section run must NOT write the ledger
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--section", "incremental_reroot"],
+        cwd=str(REPO), env=env, capture_output=True, text=True, timeout=200)
+    assert proc.returncode == 0, proc.stderr
+    child_json = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert "ledger" not in child_json
+    assert len(led.runs()) == 1  # unchanged

@@ -1,0 +1,91 @@
+"""`make perfgate` end-to-end (ISSUE 4 acceptance #3): the micro-bench
+appends datapoints to the ledger; with an established baseline a
+synthetic 2x-slowed metric (injected via the perf chaos env knob) is
+flagged ``regressed`` and FAILS the gate; a cold ledger and an
+environmental gap never fail it."""
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from consensus_specs_tpu.obs import ledger as ledger_mod, sentinel
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PERFGATE = [sys.executable, str(REPO / "tools" / "perfgate.py")]
+
+
+def _run(args, env_extra=None, timeout=240):
+    env = dict(os.environ)
+    env.pop("CONSENSUS_SPECS_TPU_PERF_CHAOS", None)
+    env.pop("CONSENSUS_SPECS_TPU_LEDGER", None)
+    if env_extra:
+        env.update(env_extra)
+    return subprocess.run(PERFGATE + args, cwd=str(REPO), env=env,
+                          capture_output=True, text=True, timeout=timeout)
+
+
+def test_perfgate_appends_and_gates(tmp_path):
+    ledger_path = str(tmp_path / "ledger.jsonl")
+    summary_path = tmp_path / "summary.json"
+
+    # 1) cold ledger: measures, appends, passes (no_baseline never gates)
+    proc = _run(["--ledger", ledger_path, "--json", str(summary_path)])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "gate PASSED" in proc.stdout
+    summary = json.loads(summary_path.read_text())
+    measured = summary["metrics"]
+    assert set(measured) >= {"perfgate_hash_mibs", "perfgate_reroot_ms",
+                             "perfgate_epoch_kernel_ms"}
+
+    led = ledger_mod.Ledger(ledger_path)
+    run = led.runs()[-1]
+    assert run["source"] == "perfgate"
+    assert run["backend"] == "host"
+    assert len(led.series("perfgate_hash_mibs")) == 1  # datapoint appended
+
+    # 2) seed a TIGHT baseline around the measured values (MAD ~ 0, so the
+    #    envelope is the 25% relative floor and a 2x slowdown must trip it)
+    for i in range(sentinel.DEFAULT_POLICY.min_history):
+        led.record_run({m: v * (1 + 0.01 * i) for m, v in measured.items()},
+                       source="perfgate", backend="host")
+
+    # 3) chaos knob slows ONE metric 2x: regressed -> gate FAILS (exit 1)
+    proc = _run(["--ledger", ledger_path],
+                env_extra={"CONSENSUS_SPECS_TPU_PERF_CHAOS": "perfgate_hash_mibs=2"})
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "regressed" in proc.stdout
+    assert "gate FAILED" in proc.stdout
+    # the regressed datapoint is still recorded as evidence
+    assert len(led.series("perfgate_hash_mibs")) >= 5
+
+
+def test_environmental_gap_does_not_fail_gate(tmp_path):
+    """The device-unreachable shape at the gate level: an established
+    jax-backend baseline that this (host-only) run cannot exercise is an
+    environmental verdict, and the sentinel-driven gate passes."""
+    ledger_path = str(tmp_path / "ledger.jsonl")
+    led = ledger_mod.Ledger(ledger_path)
+    for v in (108.0, 109.0, 108.5):
+        led.record_run({"bls_cold_fast_aggregate_verifies_per_sec": v},
+                       source="bench", backend="jax")
+    report = sentinel.evaluate_run(
+        led.points(), [],
+        run_environment={"device_unreachable": True})
+    assert report.ok
+    assert [v.verdict for v in report.verdicts] == [sentinel.ENV_GAP]
+
+
+def test_perfgate_help_and_no_gate(tmp_path):
+    ledger_path = str(tmp_path / "ledger.jsonl")
+    led = ledger_mod.Ledger(ledger_path)
+    # hostile history that would fail every metric...
+    for m in ("perfgate_hash_mibs",):
+        for v in (1e9, 1.0000001e9, 0.9999999e9):
+            led.record_run({m: v}, source="perfgate", backend="host")
+    # ...but --no-gate measures + appends without failing
+    proc = _run(["--ledger", ledger_path, "--no-gate"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "regressed" in proc.stdout  # verdict still reported honestly

@@ -1,0 +1,130 @@
+"""Regression sentinel: rolling median+MAD baselines, polarity,
+noise-envelope verdicts, and the taxonomy-backed environmental /
+regressed split (ISSUE 4 acceptance: a 2x-slowed metric is flagged
+``regressed``; a device-unreachable run is ``environmental`` and never
+fails the gate)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from consensus_specs_tpu.obs import sentinel
+from consensus_specs_tpu.resilience.taxonomy import DETERMINISTIC, ENVIRONMENTAL
+
+POLICY = sentinel.Policy(window=8, min_history=3, rel_threshold=0.25, mad_k=4.0)
+
+
+def test_polarity_by_suffix():
+    assert sentinel.polarity("bls_cold_fast_aggregate_verifies_per_sec") == 1
+    assert sentinel.polarity("hash_tree_root_mibs") == 1
+    assert sentinel.polarity("epoch_vectorized_speedup") == 1
+    assert sentinel.polarity("incremental_reroot_ms") == -1
+    assert sentinel.polarity("block_128atts_mainnet_host_s") == -1
+
+
+def test_baseline_median_and_mad():
+    stats = sentinel.baseline([10.0, 12.0, 11.0, 100.0])
+    assert stats["median"] == 11.5
+    assert stats["mad"] == 1.0  # robust to the 100.0 outlier
+    assert sentinel.median([3.0]) == 3.0
+
+
+def test_no_baseline_below_min_history():
+    v = sentinel.classify_point("m_rate", 10.0, [9.0, 11.0], POLICY)
+    assert v.verdict == sentinel.NO_BASELINE
+    assert v.kind is None
+
+
+def test_stable_inside_noise_envelope():
+    v = sentinel.classify_point("m_rate", 95.0, [100.0, 102.0, 98.0, 101.0], POLICY)
+    assert v.verdict == sentinel.STABLE
+
+
+def test_2x_slowdown_is_regressed_and_deterministic():
+    # throughput metric halved: -50% >> the 25% envelope
+    v = sentinel.classify_point("m_mibs", 50.0, [100.0, 101.0, 99.0], POLICY)
+    assert v.verdict == sentinel.REGRESSED
+    assert v.kind == DETERMINISTIC
+    # duration metric doubled: +100% is ALSO a regression (polarity)
+    v = sentinel.classify_point("m_ms", 2.0, [1.0, 1.02, 0.98], POLICY)
+    assert v.verdict == sentinel.REGRESSED
+
+
+def test_improvement_is_improved_not_regressed():
+    v = sentinel.classify_point("m_mibs", 200.0, [100.0, 101.0, 99.0], POLICY)
+    assert v.verdict == sentinel.IMPROVED
+    v = sentinel.classify_point("m_ms", 0.4, [1.0, 1.02, 0.98], POLICY)
+    assert v.verdict == sentinel.IMPROVED
+
+
+def test_mad_envelope_adapts_to_noisy_series():
+    # a series that genuinely jitters 2x: a +60% point is within ITS noise
+    noisy = [10.0, 22.0, 9.0, 21.0, 11.0, 19.0]
+    v = sentinel.classify_point("m_rate", 16.0, noisy, POLICY)
+    assert v.verdict == sentinel.STABLE
+
+
+def test_window_limits_baseline_to_recent_runs():
+    # ancient slow history must not mask a regression vs the recent 8
+    history = [50.0] * 10 + [100.0] * 8
+    v = sentinel.classify_point("m_rate", 55.0, history, POLICY)
+    assert v.verdict == sentinel.REGRESSED
+
+
+def _points(metric, values, backend="host", run_prefix="r"):
+    return [{"metric": metric, "value": v, "backend": backend,
+             "run_id": f"{run_prefix}{i}", "ts": float(i)}
+            for i, v in enumerate(values)]
+
+
+def test_evaluate_run_gate_fails_on_regression():
+    history = _points("perfgate_hash_mibs", [300.0, 310.0, 305.0])
+    current = [{"metric": "perfgate_hash_mibs", "value": 150.0, "backend": "host"}]
+    report = sentinel.evaluate_run(history, current, policy=POLICY)
+    assert not report.ok
+    assert report.regressed[0].metric == "perfgate_hash_mibs"
+    assert report.regressed[0].kind == DETERMINISTIC
+
+
+def test_device_unreachable_run_is_environmental_not_regressed():
+    # established jax-backend baseline; this run could not reach the device
+    history = _points("bls_cold_fast_aggregate_verifies_per_sec",
+                      [108.0, 109.0, 108.5], backend="jax")
+    # the degraded run ships a host-backend substitute datapoint
+    current = [{"metric": "bls_cold_fast_aggregate_verifies_per_sec",
+                "value": 0.93, "backend": "host"}]
+    report = sentinel.evaluate_run(
+        history, current,
+        run_environment={"device_unreachable": True}, policy=POLICY)
+    assert report.ok, report.to_dict()  # gate must NOT fail
+    by_verdict = {v.verdict for v in report.verdicts}
+    assert sentinel.ENV_GAP in by_verdict  # the jax gap is recorded...
+    env_v = next(v for v in report.verdicts if v.verdict == sentinel.ENV_GAP)
+    assert env_v.kind == ENVIRONMENTAL
+    assert env_v.backend == "jax"
+    # ...and the host substitute is not judged against the jax baseline
+    host_v = next(v for v in report.verdicts if v.backend == "host")
+    assert host_v.verdict == sentinel.NO_BASELINE
+
+
+def test_healthy_run_with_same_backend_compares_normally():
+    history = _points("m_rate", [100.0, 101.0, 99.0], backend="jax")
+    current = [{"metric": "m_rate", "value": 100.5, "backend": "jax"}]
+    report = sentinel.evaluate_run(history, current, policy=POLICY)
+    assert report.ok
+    assert report.verdicts[0].verdict == sentinel.STABLE
+
+
+def test_evaluate_ledger_latest_run(tmp_path):
+    from consensus_specs_tpu.obs import ledger as ledger_mod
+
+    led = ledger_mod.Ledger(str(tmp_path / "l.jsonl"))
+    for i, v in enumerate([100.0, 101.0, 99.0]):
+        led.record_run({"m_rate": v}, source="t", backend="host", ts=float(i))
+    led.record_run({"m_rate": 40.0}, source="t", backend="host", ts=10.0)
+    report = sentinel.evaluate_ledger(led, policy=POLICY)
+    assert not report.ok
+    assert report.regressed[0].metric == "m_rate"
+    # empty ledger: a clean no-op report
+    empty = ledger_mod.Ledger(str(tmp_path / "empty.jsonl"))
+    assert sentinel.evaluate_ledger(empty).ok

@@ -1,0 +1,123 @@
+"""tools/trace_diff.py: per-span A/B deltas, compile-vs-execute deltas,
+new/vanished spans, resilience-event deltas, and the
+--fail-on-regression gate (ISSUE 4 acceptance #4: an artificially
+slowed run exits non-zero)."""
+import importlib.util
+import json
+import os
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from consensus_specs_tpu import obs
+from consensus_specs_tpu.obs import export as obs_export
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+_spec = importlib.util.spec_from_file_location(
+    "trace_diff", str(REPO / "tools" / "trace_diff.py"))
+trace_diff = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_spec and trace_diff)
+
+
+def _write_trace(dirpath, spans, instants=()):
+    os.makedirs(dirpath, exist_ok=True)
+    path = os.path.join(dirpath, "spans-1-abc.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"type": "process", "trace": "t", "pid": 1,
+                            "parent": None, "name": "test", "ts": 0}) + "\n")
+        for i, (name, dur_us, attrs) in enumerate(spans, start=1):
+            f.write(json.dumps({
+                "type": "span", "trace": "t", "span": f"1.{i}", "parent": None,
+                "name": name, "ts": float(i), "dur": float(dur_us),
+                "pid": 1, "tid": 1, "attrs": attrs or {}}) + "\n")
+        for name in instants:
+            f.write(json.dumps({
+                "type": "instant", "trace": "t", "span": None, "name": name,
+                "ts": 0.0, "pid": 1, "tid": 1, "attrs": {}}) + "\n")
+
+
+def _traces(tmp_path):
+    a, b = str(tmp_path / "a"), str(tmp_path / "b")
+    _write_trace(a, [
+        ("stage.hot", 10_000, None),
+        ("stage.hot", 11_000, None),
+        ("stage.gone", 2_000, None),
+        ("kernel.k", 50_000, {"jit_phase": "first_call"}),
+        ("kernel.k", 5_000, {"jit_phase": "steady"}),
+        ("kernel.k", 5_200, {"jit_phase": "steady"}),
+    ])
+    _write_trace(b, [
+        ("stage.hot", 33_000, None),     # ~3x slower: regression
+        ("stage.hot", 30_000, None),
+        ("stage.new", 1_000, None),
+        ("kernel.k", 52_000, {"jit_phase": "first_call"}),
+        ("kernel.k", 5_100, {"jit_phase": "steady"}),
+        ("kernel.k", 5_150, {"jit_phase": "steady"}),
+    ], instants=["resilience.retry", "resilience.retry", "resilience.injected"])
+    return a, b
+
+
+def test_diff_structure_and_gate(tmp_path, capsys):
+    a, b = _traces(tmp_path)
+    d = trace_diff.diff(obs_export.load_records(a), obs_export.load_records(b),
+                        threshold_pct=30.0, min_ms=1.0)
+    rows = {r["name"]: r for r in d["common"]}
+    assert rows["stage.hot"]["status"] == "regressed"
+    assert rows["stage.hot"]["delta_pct"] > 150
+    assert rows["kernel.k"]["status"] == "stable"
+    # compile-vs-execute deltas present for the tagged kernel
+    assert rows["kernel.k"]["first_call_ms_delta"] == 2.0
+    assert abs(rows["kernel.k"]["steady_p50_ms_a"] - 5.0) < 0.3
+    assert [r["name"] for r in d["new_spans"]] == ["stage.new"]
+    assert [r["name"] for r in d["vanished_spans"]] == ["stage.gone"]
+    assert d["resilience_delta"] == {"injected": 1, "retry": 2}
+    assert [r["name"] for r in d["regressions"]] == ["stage.hot"]
+
+    # CLI: report-only exits 0; --fail-on-regression exits 1
+    assert trace_diff.main([a, b]) == 0
+    assert trace_diff.main([a, b, "--fail-on-regression"]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSED" in out and "stage.new" in out and "retry: +2" in out
+
+
+def test_diff_accepts_merged_trace_json(tmp_path):
+    a, b = _traces(tmp_path)
+    a_json = obs_export.export_chrome(a)
+    b_json = obs_export.export_chrome(b)
+    assert trace_diff.main([a_json, b_json, "--fail-on-regression"]) == 1
+    # mixed forms work too (dir vs trace.json)
+    assert trace_diff.main([a, b_json, "--fail-on-regression",
+                            "--threshold-pct", "10000"]) == 0
+
+
+def test_diff_on_real_obs_traces(tmp_path, monkeypatch):
+    """Two real traced runs through the span writer, run B artificially
+    slowed — the whole writer -> loader -> differ path."""
+    from consensus_specs_tpu.obs import core
+
+    for label, delay in (("a", 0.002), ("b", 0.08)):
+        out = str(tmp_path / label)
+        monkeypatch.setenv(core.TRACE_ENV, out)
+        with obs.span("workload.step"):
+            time.sleep(delay)
+        with obs.span("workload.step"):
+            time.sleep(delay)
+    monkeypatch.delenv(core.TRACE_ENV)
+    rc = trace_diff.main([str(tmp_path / "a"), str(tmp_path / "b"),
+                          "--fail-on-regression", "--threshold-pct", "50",
+                          "--min-ms", "5"])
+    assert rc == 1
+
+
+def test_invalid_inputs_report_not_traceback(tmp_path, capsys):
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert trace_diff.main([str(empty), str(empty)]) == 2
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    a, _ = _traces(tmp_path)
+    assert trace_diff.main([a, str(bad)]) == 2
+    assert "ERROR" in capsys.readouterr().out

@@ -1,0 +1,79 @@
+"""tools/trace_report.py on edge inputs (ISSUE 4 satellite): an empty
+trace dir, a trace.json holding only instant events, and a
+truncated/partially-written span file must all REPORT (clean message,
+meaningful exit code) — never traceback."""
+import importlib.util
+import json
+import os
+import pathlib
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+_spec = importlib.util.spec_from_file_location(
+    "trace_report", str(REPO / "tools" / "trace_report.py"))
+trace_report = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_spec and trace_report)
+
+
+def test_empty_trace_dir_reports_cleanly(tmp_path, capsys):
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    rc = trace_report.main([str(empty)])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "ERROR" in out and "no spans" in out
+
+
+def test_missing_path_reports_cleanly(tmp_path, capsys):
+    rc = trace_report.main([str(tmp_path / "nope.json")])
+    assert rc == 1
+    assert "ERROR" in capsys.readouterr().out
+
+
+def test_instants_only_trace_json_reports_not_tracebacks(tmp_path, capsys):
+    trace = {"traceEvents": [
+        {"ph": "i", "s": "t", "name": "resilience.retry", "cat": "instant",
+         "ts": 1.0, "pid": 1, "tid": 1, "args": {}},
+        {"ph": "i", "s": "t", "name": "event.note", "cat": "instant",
+         "ts": 2.0, "pid": 1, "tid": 1, "args": {}},
+    ], "displayTimeUnit": "ms"}
+    path = tmp_path / "trace.json"
+    path.write_text(json.dumps(trace))
+    rc = trace_report.main([str(path)])
+    assert rc == 1  # contract: exit 0 iff >= 1 span
+    out = capsys.readouterr().out
+    assert "no spans" in out and "2 instant(s)" in out
+
+
+def test_truncated_span_file_reports_committed_spans(tmp_path, capsys):
+    d = tmp_path / "trace"
+    d.mkdir()
+    good_span = {"type": "span", "trace": "t", "span": "1.1", "parent": None,
+                 "name": "gen.case", "ts": 1.0, "dur": 2500.0, "pid": 1,
+                 "tid": 1, "attrs": {"fork": "phase0"}}
+    with open(d / "spans-1-abc.jsonl", "w") as f:
+        f.write(json.dumps(good_span) + "\n")
+        f.write('{"type": "span", "name": "torn", "dur": 99')  # SIGKILL mid-write
+    rc = trace_report.main([str(d)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "1 spans" in out
+    assert "gen.case" in out
+    assert "torn" not in out
+
+
+def test_degenerate_span_records_do_not_traceback(tmp_path, capsys):
+    # committed-but-minimal records (no name/dur/pid): still a report
+    d = tmp_path / "trace"
+    d.mkdir()
+    with open(d / "spans-1-x.jsonl", "w") as f:
+        f.write(json.dumps({"type": "span", "span": "1.1"}) + "\n")
+        f.write(json.dumps({"type": "instant"}) + "\n")
+        f.write(json.dumps({"type": "span", "span": "1.2",
+                            "attrs": {"jit_phase": "steady"}}) + "\n")
+    rc = trace_report.main([str(d)])
+    assert rc == 0
+    assert "2 spans" in capsys.readouterr().out

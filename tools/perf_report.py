@@ -1,0 +1,289 @@
+"""Perf ledger CLI: backfill historical bench rounds and render the
+trajectory.
+
+Usage:
+    python tools/perf_report.py ingest BENCH_r0*.json [--ledger P] [--force]
+    python tools/perf_report.py report [--ledger P] [--html OUT] [--prom OUT]
+
+``ingest`` accepts the driver's ``BENCH_r0N.json`` wrapper files (or
+raw bench.py JSON) and appends one run per file to the ledger —
+idempotently, keyed by basename. The r04-style wrapper (rc=124,
+``parsed: null``) is recovered from its progress tail; the r05-style
+device-unreachable round lands as a first-class host-only datapoint
+(see consensus_specs_tpu/obs/ledger.py).
+
+``report`` renders the accumulated trajectory:
+- a text summary to stdout (per metric: points, latest value, backend,
+  sentinel verdict against the prior history);
+- ``--html OUT``: a single self-contained HTML file with an inline-SVG
+  series per metric — host-only datapoints (degraded runs) drawn as
+  open markers so an environment gap is visually distinct from a
+  regression;
+- ``--prom OUT``: Prometheus text exposition of the latest datapoint
+  per metric (plus run counters), for scraping into a dashboard.
+
+Exit status: 0 on success; 2 when the ledger is missing/empty or an
+ingest input is unreadable.
+"""
+from __future__ import annotations
+
+import argparse
+import html as html_mod
+import pathlib
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from consensus_specs_tpu.obs import ledger as ledger_mod  # noqa: E402
+from consensus_specs_tpu.obs import sentinel  # noqa: E402
+
+
+def _open_ledger(path: Optional[str]) -> ledger_mod.Ledger:
+    return ledger_mod.Ledger(path) if path else ledger_mod.Ledger()
+
+
+# ---------------------------------------------------------------------------
+# ingest
+# ---------------------------------------------------------------------------
+
+def cmd_ingest(ns: argparse.Namespace) -> int:
+    led = _open_ledger(ns.ledger)
+    statuses = ledger_mod.ingest_files(
+        [str(p) for p in ns.files], led, force=ns.force)
+    errors = 0
+    for st in statuses:
+        if st["status"] == "ingested":
+            print(f"ingested {st['file']}: run {st['run_id']} "
+                  f"({st['points']} datapoints)")
+        elif st["status"] == "skipped":
+            print(f"skipped {st['file']}: {st['reason']} (use --force to re-ingest)")
+        else:
+            errors += 1
+            print(f"ERROR {st['file']}: {st['reason']}")
+    print(f"ledger: {led.path} ({len(led.runs())} runs, "
+          f"{len(led.metrics())} metrics)")
+    return 2 if errors else 0
+
+
+# ---------------------------------------------------------------------------
+# report
+# ---------------------------------------------------------------------------
+
+def _series_by_metric(led: ledger_mod.Ledger) -> Dict[str, List[Dict[str, Any]]]:
+    out: Dict[str, List[Dict[str, Any]]] = {}
+    for p in led.points():
+        out.setdefault(p["metric"], []).append(p)
+    return out
+
+
+def _latest_verdicts(led: ledger_mod.Ledger) -> Dict[str, Any]:
+    report = sentinel.evaluate_ledger(led)
+    return {(v.metric, v.backend): v for v in report.verdicts}
+
+
+def _is_degraded(point: Dict[str, Any]) -> bool:
+    env = point.get("environment") or {}
+    return bool(env.get("device_unreachable") or env.get("device_compile_failed"))
+
+
+def text_report(led: ledger_mod.Ledger) -> str:
+    runs = led.runs()
+    series = _series_by_metric(led)
+    verdicts = _latest_verdicts(led)
+    lines = [f"perf ledger: {led.path}",
+             f"{len(runs)} runs, {len(series)} metrics"]
+    for run in runs:
+        label = run.get("label") or run.get("source")
+        flags = []
+        env = run.get("environment") or {}
+        if env.get("device_unreachable"):
+            flags.append("device-unreachable")
+        if env.get("external_timeout"):
+            flags.append("rc=124")
+        lines.append(f"  run {label}: {run.get('metrics_count', 0)} metrics, "
+                     f"backend={run.get('backend')} sha={run.get('sha')}"
+                     + (f" [{', '.join(flags)}]" if flags else ""))
+    lines.append("")
+    for metric in sorted(series):
+        pts = series[metric]
+        latest = pts[-1]
+        v = verdicts.get((metric, latest.get("backend")))
+        verdict = f"  [{v.verdict}]" if v is not None else ""
+        degraded = " (host-only/degraded run)" if _is_degraded(latest) else ""
+        unit = latest.get("unit") or ""
+        lines.append(f"{metric}: {len(pts)} point(s), latest "
+                     f"{latest['value']:g}{unit} "
+                     f"backend={latest.get('backend')}{verdict}{degraded}")
+    return "\n".join(lines)
+
+
+def _svg_series(points: List[Dict[str, Any]], width: int = 360,
+                height: int = 60) -> str:
+    """Inline SVG polyline for one metric series; degraded-run points
+    render as open circles, normal points as filled."""
+    values = [float(p["value"]) for p in points]
+    lo, hi = min(values), max(values)
+    span = (hi - lo) or 1.0
+    pad = 6
+    n = len(values)
+
+    def xy(i: int, v: float) -> tuple:
+        x = pad + (width - 2 * pad) * (i / max(1, n - 1))
+        y = height - pad - (height - 2 * pad) * ((v - lo) / span)
+        return round(x, 1), round(y, 1)
+
+    coords = [xy(i, v) for i, v in enumerate(values)]
+    polyline = " ".join(f"{x},{y}" for x, y in coords)
+    dots = []
+    for (x, y), p in zip(coords, points):
+        if _is_degraded(p):
+            dots.append(f'<circle cx="{x}" cy="{y}" r="4" fill="white" '
+                        f'stroke="#c2410c" stroke-width="2">'
+                        f'<title>{html_mod.escape(str(p.get("run_id")))} '
+                        f'(degraded/host-only): {p["value"]:g}</title></circle>')
+        else:
+            dots.append(f'<circle cx="{x}" cy="{y}" r="3" fill="#1d4ed8">'
+                        f'<title>{html_mod.escape(str(p.get("run_id")))}: '
+                        f'{p["value"]:g}</title></circle>')
+    return (f'<svg width="{width}" height="{height}" '
+            f'viewBox="0 0 {width} {height}">'
+            f'<polyline points="{polyline}" fill="none" stroke="#93c5fd" '
+            f'stroke-width="1.5"/>' + "".join(dots) + "</svg>")
+
+
+def html_report(led: ledger_mod.Ledger) -> str:
+    runs = led.runs()
+    series = _series_by_metric(led)
+    verdicts = _latest_verdicts(led)
+    badge_colors = {
+        sentinel.IMPROVED: "#15803d", sentinel.STABLE: "#475569",
+        sentinel.REGRESSED: "#b91c1c", sentinel.NO_BASELINE: "#64748b",
+        sentinel.ENV_GAP: "#c2410c",
+    }
+    rows = []
+    for metric in sorted(series):
+        pts = series[metric]
+        latest = pts[-1]
+        v = verdicts.get((metric, latest.get("backend")))
+        badge = ""
+        if v is not None:
+            color = badge_colors.get(v.verdict, "#475569")
+            badge = (f'<span style="background:{color};color:#fff;'
+                     f'border-radius:4px;padding:1px 6px;font-size:11px">'
+                     f'{v.verdict}</span>')
+        unit = html_mod.escape(latest.get("unit") or "")
+        rows.append(
+            "<tr>"
+            f"<td><code>{html_mod.escape(metric)}</code></td>"
+            f"<td>{_svg_series(pts)}</td>"
+            f"<td style='text-align:right'>{latest['value']:g}{unit}</td>"
+            f"<td>{html_mod.escape(str(latest.get('backend')))}</td>"
+            f"<td>{len(pts)}</td>"
+            f"<td>{badge}</td>"
+            "</tr>")
+    run_rows = []
+    for run in runs:
+        env = run.get("environment") or {}
+        flags = [k for k in ("device_unreachable", "device_compile_failed",
+                             "external_timeout") if env.get(k)]
+        run_rows.append(
+            "<tr>"
+            f"<td>{html_mod.escape(str(run.get('label') or run.get('run_id')))}</td>"
+            f"<td>{html_mod.escape(str(run.get('source')))}</td>"
+            f"<td>{html_mod.escape(str(run.get('sha')))}</td>"
+            f"<td>{html_mod.escape(str(run.get('backend')))}</td>"
+            f"<td>{run.get('metrics_count', 0)}</td>"
+            f"<td>{html_mod.escape(', '.join(flags)) or '—'}</td>"
+            "</tr>")
+    generated = time.strftime("%Y-%m-%d %H:%M:%S UTC", time.gmtime())
+    return f"""<!doctype html>
+<html><head><meta charset="utf-8"><title>perf evidence — consensus_specs_tpu</title>
+<style>
+body {{ font: 14px/1.5 system-ui, sans-serif; margin: 2rem; color: #0f172a; }}
+table {{ border-collapse: collapse; margin: 1rem 0; }}
+th, td {{ border: 1px solid #e2e8f0; padding: 4px 10px; vertical-align: middle; }}
+th {{ background: #f1f5f9; text-align: left; }}
+h1 {{ font-size: 20px; }} h2 {{ font-size: 16px; margin-top: 2rem; }}
+.legend {{ color: #475569; font-size: 12px; }}
+</style></head><body>
+<h1>Perf evidence ledger</h1>
+<p class="legend">{len(runs)} runs · {len(series)} metrics · generated {generated}
+· ledger <code>{html_mod.escape(led.path)}</code><br>
+Filled markers = normal datapoints; open orange markers = degraded runs
+(device unreachable / compile failed) recorded as first-class host-only
+datapoints.</p>
+<h2>Metric trajectories</h2>
+<table><tr><th>metric</th><th>trajectory</th><th>latest</th><th>backend</th>
+<th>points</th><th>sentinel</th></tr>
+{''.join(rows)}
+</table>
+<h2>Runs</h2>
+<table><tr><th>run</th><th>source</th><th>sha</th><th>backend</th>
+<th>metrics</th><th>environment flags</th></tr>
+{''.join(run_rows)}
+</table>
+</body></html>
+"""
+
+
+def prometheus_report(led: ledger_mod.Ledger) -> str:
+    """Latest datapoint per (metric, backend) as Prometheus gauges."""
+    latest: Dict[tuple, Dict[str, Any]] = {}
+    for p in led.points():
+        latest[(p["metric"], p.get("backend"))] = p
+    lines = ["# TYPE consensus_specs_tpu_perf_value gauge"]
+    for (metric, backend), p in sorted(latest.items()):
+        unit = p.get("unit") or ""
+        lines.append(
+            f'consensus_specs_tpu_perf_value{{metric="{metric}",'
+            f'backend="{backend}",unit="{unit}"}} {float(p["value"]):g}')
+    lines.append("# TYPE consensus_specs_tpu_perf_runs_total counter")
+    lines.append(f"consensus_specs_tpu_perf_runs_total {len(led.runs())}")
+    return "\n".join(lines) + "\n"
+
+
+def cmd_report(ns: argparse.Namespace) -> int:
+    led = _open_ledger(ns.ledger)
+    if not led.runs():
+        print(f"ERROR: ledger {led.path} is empty or missing "
+              "(run `make bench`, `make perfgate`, or "
+              "`python tools/perf_report.py ingest BENCH_r0*.json` first)")
+        return 2
+    print(text_report(led))
+    if ns.html is not None:
+        ns.html.write_text(html_report(led))
+        print(f"\nhtml report written to {ns.html}")
+    if ns.prom is not None:
+        ns.prom.write_text(prometheus_report(led))
+        print(f"prometheus exposition written to {ns.prom}")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_ing = sub.add_parser("ingest", help="backfill BENCH json files into the ledger")
+    p_ing.add_argument("files", nargs="+", type=pathlib.Path)
+    p_ing.add_argument("--ledger", default=None, help="ledger path override")
+    p_ing.add_argument("--force", action="store_true",
+                       help="re-ingest files already present (by basename)")
+    p_ing.set_defaults(fn=cmd_ingest)
+
+    p_rep = sub.add_parser("report", help="render the ledger trajectory")
+    p_rep.add_argument("--ledger", default=None, help="ledger path override")
+    p_rep.add_argument("--html", type=pathlib.Path, default=None,
+                       help="write a single-file HTML report")
+    p_rep.add_argument("--prom", type=pathlib.Path, default=None,
+                       help="write a Prometheus text exposition")
+    p_rep.set_defaults(fn=cmd_report)
+
+    ns = parser.parse_args(argv)
+    return ns.fn(ns)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,261 @@
+"""CI perf gate: a deterministic host-only micro-bench slice, appended
+to the perf ledger and gated on the regression sentinel's verdict.
+
+Usage:
+    python tools/perfgate.py [--ledger P] [--json OUT] [--no-gate] ...
+
+What it measures (seconds total, never minutes — host paths only, no
+jax import, no device, no tunnel):
+
+- ``perfgate_hash_mibs``      SSZ Merkleization throughput (the SHA-NI
+                              backed ``merkleize_chunks`` on a 2^13-chunk
+                              tree — the hash_tree_root hot path);
+- ``perfgate_reroot_ms``      incremental re-root of a 2^15-leaf List
+                              after a single mutation (the dirty-tracked
+                              backing's O(log n) path);
+- ``perfgate_epoch_kernel_ms`` the engine's flag-delta arithmetic over a
+                              synthetic 2^17-validator registry (numpy
+                              host kernel — the SoA epoch hot loop).
+
+Each run appends one ledger run (git sha + environment fingerprint) and
+is classified by :mod:`consensus_specs_tpu.obs.sentinel` against the
+rolling baseline of prior comparable runs: ``regressed`` verdicts fail
+the gate (exit 1); ``no_baseline`` (cold ledger), ``improved``,
+``stable``, and ``environmental`` verdicts never do. A measurement that
+fails with an ENVIRONMENTAL fault (missing native lib, say) is skipped
+with a recorded event instead of failing CI.
+
+Chaos knob (tests drill the gate itself):
+    CONSENSUS_SPECS_TPU_PERF_CHAOS="<metric-substr>=<factor>[,...]"
+multiplies the measured duration of matching metrics — e.g.
+``perfgate_hash=2`` makes the hash slice report half its real
+throughput, which an established baseline must flag ``regressed``.
+
+Exit status: 0 = gate passed (or --no-gate); 1 = sentinel flagged a
+regression; 2 = a measurement failed deterministically.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import sys
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import numpy as np  # noqa: E402  (host-only; never jax)
+
+from consensus_specs_tpu.obs import ledger as ledger_mod  # noqa: E402
+from consensus_specs_tpu.obs import sentinel  # noqa: E402
+from consensus_specs_tpu.resilience import classify, record_event  # noqa: E402
+from consensus_specs_tpu.resilience.taxonomy import ENVIRONMENTAL  # noqa: E402
+
+PERF_CHAOS_ENV = "CONSENSUS_SPECS_TPU_PERF_CHAOS"
+
+
+def _chaos_factor(metric: str) -> float:
+    """Synthetic slowdown factor for a metric, from the env knob."""
+    raw = os.environ.get(PERF_CHAOS_ENV, "")
+    for clause in raw.split(","):
+        clause = clause.strip()
+        if not clause or "=" not in clause:
+            continue
+        substr, _, factor = clause.partition("=")
+        if substr.strip() and substr.strip() in metric:
+            try:
+                return float(factor)
+            except ValueError:
+                continue
+    return 1.0
+
+
+def _timed(fn: Callable[[], Any], repeats: int) -> float:
+    """Best-of-N wall time of fn() in seconds."""
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+# ---------------------------------------------------------------------------
+# the micro-bench slice (deterministic shapes, host paths only)
+# ---------------------------------------------------------------------------
+
+def measure_hash_mibs() -> float:
+    from consensus_specs_tpu.ssz import merkle
+
+    levels = 13
+    n_chunks = 1 << levels  # 256 KiB of chunks
+    mib = n_chunks * 32 / (1 << 20)
+    rng = np.random.default_rng(7)
+    chunk_bytes = rng.integers(0, 2**32, size=(n_chunks, 8),
+                               dtype=np.uint32).astype(">u4").tobytes()
+    root_holder: List[bytes] = []
+
+    def run() -> None:
+        root_holder.append(merkle.merkleize_chunks(chunk_bytes, limit=n_chunks))
+
+    dt = _timed(run, repeats=3)
+    assert len(set(root_holder)) == 1, "non-deterministic merkle root"
+    dt *= _chaos_factor("perfgate_hash_mibs")
+    return mib / dt
+
+
+def measure_reroot_ms() -> float:
+    from consensus_specs_tpu.ssz import hash_tree_root
+    from consensus_specs_tpu.ssz.types import List as SSZList, uint64
+
+    n = 1 << 15
+    big = SSZList[uint64, 1 << 32](list(range(n)))
+    hash_tree_root(big)          # full first root
+    big[123] = uint64(999)
+    hash_tree_root(big)          # materialize interior levels
+    times = []
+    for k in range(5):
+        t0 = time.perf_counter()
+        big[n // 2 + k] = uint64(7 + k)
+        root = hash_tree_root(big)
+        times.append(time.perf_counter() - t0)
+    assert bytes(root) != b"\x00" * 32
+    return min(times) * 1e3 * _chaos_factor("perfgate_reroot_ms")
+
+
+def measure_epoch_kernel_ms() -> float:
+    from consensus_specs_tpu.engine import stages
+
+    n = 1 << 17
+    rng = np.random.default_rng(11)
+    increments = np.full(n, 32, dtype=np.uint64)
+    in_mask = rng.integers(0, 2, size=n).astype(bool)
+    eligible = rng.integers(0, 2, size=n).astype(bool)
+    brpi = 25_000
+    weight, wd = 14, 64
+    active_increments = n * 32
+    upi = int(in_mask.sum()) * 32
+
+    def run() -> None:
+        rewards, penalties = stages._flag_deltas(
+            increments, in_mask, eligible, brpi, weight, upi,
+            active_increments, wd, False, True)
+        assert rewards.shape == (n,) and penalties.shape == (n,)
+
+    return _timed(run, repeats=3) * 1e3 * _chaos_factor("perfgate_epoch_kernel_ms")
+
+
+MEASUREMENTS: Tuple[Tuple[str, Callable[[], float]], ...] = (
+    ("perfgate_hash_mibs", measure_hash_mibs),
+    ("perfgate_reroot_ms", measure_reroot_ms),
+    ("perfgate_epoch_kernel_ms", measure_epoch_kernel_ms),
+)
+
+
+def run_gate(
+    ledger_path: Optional[str] = None,
+    *,
+    policy: sentinel.Policy = sentinel.DEFAULT_POLICY,
+    gate: bool = True,
+) -> Tuple[int, Dict[str, Any]]:
+    """Measure, evaluate, append, report. Returns (exit_code, summary)."""
+    led = ledger_mod.Ledger(ledger_path) if ledger_path else ledger_mod.Ledger()
+
+    metrics: Dict[str, float] = {}
+    skipped: Dict[str, str] = {}
+    for name, fn in MEASUREMENTS:
+        try:
+            metrics[name] = round(fn(), 4)
+        except Exception as e:
+            kind = classify(e)
+            record_event("perfgate_skip", domain="perfgate", capability=name,
+                         kind=kind, detail=repr(e)[:300])
+            if kind == ENVIRONMENTAL:
+                skipped[name] = f"environmental: {e!r}"
+                continue
+            return 2, {"error": f"{name} failed deterministically: {e!r}"}
+
+    env = ledger_mod.environment_fingerprint(
+        perf_chaos=os.environ.get(PERF_CHAOS_ENV) or None)
+    # history BEFORE this run is appended = the sentinel's baseline
+    history = [p for p in led.points() if p["metric"] in dict(MEASUREMENTS)]
+    current = [{"metric": m, "value": v, "backend": "host"}
+               for m, v in metrics.items()]
+    report = sentinel.evaluate_run(history, current,
+                                   run_environment=env, policy=policy)
+    verdict_counts = report.counts()
+    run_id = led.record_run(
+        metrics, source="perfgate", backend="host", environment=env,
+        extra={"skipped": skipped or None, "sentinel": verdict_counts})
+
+    summary = {
+        "run_id": run_id,
+        "ledger": led.path,
+        "metrics": metrics,
+        "skipped": skipped,
+        "report": report.to_dict(),
+    }
+    code = 1 if (gate and not report.ok) else 0
+    return code, summary
+
+
+def print_summary(summary: Dict[str, Any]) -> None:
+    if "error" in summary:
+        print(f"perfgate ERROR: {summary['error']}")
+        return
+    print(f"perfgate: run {summary['run_id']} -> {summary['ledger']}")
+    verdicts = {v["metric"]: v for v in summary["report"]["verdicts"]}
+    for metric, value in sorted(summary["metrics"].items()):
+        v = verdicts.get(metric, {})
+        base = v.get("baseline_median")
+        base_txt = (f"baseline {base:g} (n={v.get('baseline_n', 0)})"
+                    if base is not None else
+                    f"no baseline yet (n={v.get('baseline_n', 0)})")
+        dev = v.get("deviation_pct")
+        dev_txt = f" {dev:+.1f}%" if dev is not None else ""
+        print(f"  {metric:<26} {value:>12g}  [{v.get('verdict', '?')}]"
+              f"{dev_txt}  {base_txt}")
+    for metric, reason in sorted(summary.get("skipped", {}).items()):
+        print(f"  {metric:<26} {'skipped':>12}  [{reason}]")
+    for v in summary["report"]["verdicts"]:
+        if v["verdict"] == sentinel.ENV_GAP:
+            print(f"  {v['metric']:<26} {'(gap)':>12}  [environmental] {v.get('detail', '')}")
+    counts = summary["report"]["counts"]
+    ok = summary["report"]["ok"]
+    print(f"sentinel: {counts} -> gate {'PASSED' if ok else 'FAILED'}")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--ledger", default=None, help="ledger path override")
+    parser.add_argument("--json", dest="json_path", type=pathlib.Path,
+                        default=None, help="also write the summary as JSON")
+    parser.add_argument("--no-gate", action="store_true",
+                        help="measure + append but never fail")
+    parser.add_argument("--window", type=int,
+                        default=sentinel.DEFAULT_POLICY.window)
+    parser.add_argument("--min-history", type=int,
+                        default=sentinel.DEFAULT_POLICY.min_history)
+    parser.add_argument("--rel-threshold", type=float,
+                        default=sentinel.DEFAULT_POLICY.rel_threshold,
+                        help="relative envelope floor (fraction, default 0.25)")
+    parser.add_argument("--mad-k", type=float,
+                        default=sentinel.DEFAULT_POLICY.mad_k)
+    ns = parser.parse_args(argv)
+
+    policy = sentinel.Policy(window=ns.window, min_history=ns.min_history,
+                             rel_threshold=ns.rel_threshold, mad_k=ns.mad_k)
+    code, summary = run_gate(ns.ledger, policy=policy, gate=not ns.no_gate)
+    print_summary(summary)
+    if ns.json_path is not None:
+        with open(ns.json_path, "w") as f:
+            json.dump(summary, f, indent=2, sort_keys=True, default=repr)
+        print(f"json summary written to {ns.json_path}")
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,242 @@
+"""Span-level A/B diff of two traced runs.
+
+Usage:
+    python tools/trace_diff.py <A> <B> [options]
+
+``A`` and ``B`` are each either a raw span-JSONL trace directory
+(what ``CONSENSUS_SPECS_TPU_TRACE=<dir>`` produced) or a merged
+``trace.json`` (obs.export.export_chrome) — e.g. two ``make trace``
+outputs. A is the baseline, B the candidate.
+
+Reports, per span name:
+- dispatch count, total self-time (duration minus direct children) and
+  mean self-time per dispatch, with absolute + relative deltas;
+- the jit compile-vs-execute split delta (first_call max, steady p50)
+  for kernel spans carrying ``jit_phase`` tags;
+- NEW spans (in B only) and VANISHED spans (in A only);
+- the resilience instant tally delta (retries, quarantines, chaos hits)
+  — a run that got slower because it started retrying is a different
+  diagnosis than one whose kernel regressed.
+
+Gate mode: ``--fail-on-regression`` exits 1 when any span's mean
+self-time per dispatch regresses by more than ``--threshold-pct``
+(default 30%) AND more than ``--min-ms`` (default 1.0 ms) absolute —
+the same two-sided rule the perf sentinel uses, so micro-jitter on
+nanosecond spans cannot fail a build.
+
+Exit status: 0 = diff printed (no gate, or gate passed); 1 = gate
+failed; 2 = an input was unreadable/invalid.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+from typing import Any, Dict, List, Optional
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from consensus_specs_tpu.obs import export as obs_export  # noqa: E402
+from consensus_specs_tpu.obs.metrics import percentile  # noqa: E402
+
+
+def span_stats(records: List[Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
+    """Per-span-name aggregates over one trace's records."""
+    spans = [r for r in records if r.get("type") == "span"]
+    child_dur: Dict[Optional[str], float] = {}
+    for s in spans:
+        parent = s.get("parent")
+        child_dur[parent] = child_dur.get(parent, 0.0) + float(s.get("dur") or 0)
+    out: Dict[str, Dict[str, Any]] = {}
+    for s in spans:
+        name = s.get("name", "?")
+        self_us = max(0.0, float(s.get("dur") or 0)
+                      - child_dur.get(s.get("span"), 0.0))
+        acc = out.setdefault(name, {
+            "count": 0, "total_us": 0.0, "self_us": 0.0,
+            "first": [], "steady": [],
+        })
+        acc["count"] += 1
+        acc["total_us"] += float(s.get("dur") or 0)
+        acc["self_us"] += self_us
+        phase = (s.get("attrs") or {}).get("jit_phase")
+        if phase in ("first_call", "compile"):
+            acc["first"].append(float(s.get("dur") or 0))
+        elif phase in ("steady", "execute"):
+            acc["steady"].append(float(s.get("dur") or 0))
+    for acc in out.values():
+        acc["mean_self_ms"] = acc["self_us"] / 1e3 / acc["count"]
+        acc["self_ms"] = acc["self_us"] / 1e3
+        first = acc.pop("first")
+        steady = acc.pop("steady")
+        acc["first_call_ms"] = max(first) / 1e3 if first else None
+        steady_p50 = percentile(steady, 50)
+        acc["steady_p50_ms"] = steady_p50 / 1e3 if steady_p50 is not None else None
+    return out
+
+
+def resilience_tally(records: List[Dict[str, Any]]) -> Dict[str, int]:
+    tally: Dict[str, int] = {}
+    for r in records:
+        if r.get("type") != "instant":
+            continue
+        name = str(r.get("name") or "")
+        if name.startswith("resilience."):
+            key = name[len("resilience."):]
+            tally[key] = tally.get(key, 0) + 1
+    return tally
+
+
+def diff(
+    records_a: List[Dict[str, Any]],
+    records_b: List[Dict[str, Any]],
+    *,
+    threshold_pct: float = 30.0,
+    min_ms: float = 1.0,
+) -> Dict[str, Any]:
+    """The structured A/B diff (the CLI renders it; tests consume it)."""
+    stats_a = span_stats(records_a)
+    stats_b = span_stats(records_b)
+    names_a, names_b = set(stats_a), set(stats_b)
+
+    rows: List[Dict[str, Any]] = []
+    for name in sorted(names_a & names_b):
+        a, b = stats_a[name], stats_b[name]
+        delta_ms = b["mean_self_ms"] - a["mean_self_ms"]
+        delta_pct = (100.0 * delta_ms / a["mean_self_ms"]
+                     if a["mean_self_ms"] else None)
+        regressed = (delta_pct is not None and delta_pct > threshold_pct
+                     and delta_ms > min_ms)
+        improved = (delta_pct is not None and delta_pct < -threshold_pct
+                    and -delta_ms > min_ms)
+        row: Dict[str, Any] = {
+            "name": name,
+            "count_a": a["count"], "count_b": b["count"],
+            "mean_self_ms_a": round(a["mean_self_ms"], 3),
+            "mean_self_ms_b": round(b["mean_self_ms"], 3),
+            "delta_ms": round(delta_ms, 3),
+            "delta_pct": round(delta_pct, 1) if delta_pct is not None else None,
+            "status": ("regressed" if regressed
+                       else "improved" if improved else "stable"),
+        }
+        # compile-vs-execute deltas where both sides carry the split
+        for key in ("first_call_ms", "steady_p50_ms"):
+            if a.get(key) is not None and b.get(key) is not None:
+                row[f"{key}_a"] = round(a[key], 3)
+                row[f"{key}_b"] = round(b[key], 3)
+                row[f"{key}_delta"] = round(b[key] - a[key], 3)
+        rows.append(row)
+    rows.sort(key=lambda r: -abs(r["delta_ms"]))
+
+    new = [{"name": n, "count": stats_b[n]["count"],
+            "mean_self_ms": round(stats_b[n]["mean_self_ms"], 3)}
+           for n in sorted(names_b - names_a)]
+    vanished = [{"name": n, "count": stats_a[n]["count"],
+                 "mean_self_ms": round(stats_a[n]["mean_self_ms"], 3)}
+                for n in sorted(names_a - names_b)]
+
+    res_a, res_b = resilience_tally(records_a), resilience_tally(records_b)
+    res_delta = {k: res_b.get(k, 0) - res_a.get(k, 0)
+                 for k in sorted(set(res_a) | set(res_b))
+                 if res_b.get(k, 0) != res_a.get(k, 0)}
+
+    regressions = [r for r in rows if r["status"] == "regressed"]
+    return {
+        "spans_a": sum(s["count"] for s in stats_a.values()),
+        "spans_b": sum(s["count"] for s in stats_b.values()),
+        "common": rows,
+        "new_spans": new,
+        "vanished_spans": vanished,
+        "resilience_delta": res_delta,
+        "resilience_a": res_a,
+        "resilience_b": res_b,
+        "regressions": regressions,
+        "threshold_pct": threshold_pct,
+        "min_ms": min_ms,
+    }
+
+
+def print_diff(d: Dict[str, Any], top: int = 20) -> None:
+    print(f"trace diff: {d['spans_a']} spans (A) vs {d['spans_b']} spans (B); "
+          f"gate rule: >+{d['threshold_pct']:g}% and >+{d['min_ms']:g}ms mean self-time")
+    rows = d["common"][:top]
+    if rows:
+        width = max(len(r["name"]) for r in rows)
+        print("\nper-span mean self-time (largest |delta| first):")
+        for r in rows:
+            pct = f"{r['delta_pct']:+7.1f}%" if r["delta_pct"] is not None else "      --"
+            marker = {"regressed": " <-- REGRESSED", "improved": " (improved)",
+                      "stable": ""}[r["status"]]
+            print(f"  {r['name']:<{width}}  {r['mean_self_ms_a']:>10.3f}ms -> "
+                  f"{r['mean_self_ms_b']:>10.3f}ms  {pct}  "
+                  f"x{r['count_a']}->x{r['count_b']}{marker}")
+            if r.get("first_call_ms_delta") is not None:
+                print(f"  {'':<{width}}  first_call {r['first_call_ms_a']}ms -> "
+                      f"{r['first_call_ms_b']}ms; steady p50 "
+                      f"{r.get('steady_p50_ms_a')}ms -> {r.get('steady_p50_ms_b')}ms")
+    if d["new_spans"]:
+        print("\nnew spans (B only):")
+        for r in d["new_spans"]:
+            print(f"  {r['name']}  x{r['count']}  mean self {r['mean_self_ms']}ms")
+    if d["vanished_spans"]:
+        print("\nvanished spans (A only):")
+        for r in d["vanished_spans"]:
+            print(f"  {r['name']}  x{r['count']}  mean self {r['mean_self_ms']}ms")
+    if d["resilience_delta"]:
+        print("\nresilience event delta (B - A):")
+        for name, n in d["resilience_delta"].items():
+            print(f"  {name}: {n:+d}")
+    if d["regressions"]:
+        print(f"\n{len(d['regressions'])} span(s) regressed:")
+        for r in d["regressions"]:
+            print(f"  {r['name']}: {r['mean_self_ms_a']}ms -> "
+                  f"{r['mean_self_ms_b']}ms ({r['delta_pct']:+.1f}%)")
+    else:
+        print("\nno span regressions beyond thresholds")
+
+
+def load(path: pathlib.Path) -> List[Dict[str, Any]]:
+    records = obs_export.load_records(str(path))
+    if not any(r.get("type") == "span" for r in records):
+        raise ValueError(f"no spans found in {path}")
+    return records
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("a", type=pathlib.Path, help="baseline trace dir or trace.json")
+    parser.add_argument("b", type=pathlib.Path, help="candidate trace dir or trace.json")
+    parser.add_argument("--fail-on-regression", action="store_true",
+                        help="exit 1 when any span regresses beyond thresholds")
+    parser.add_argument("--threshold-pct", type=float, default=30.0,
+                        help="relative regression threshold (default 30%%)")
+    parser.add_argument("--min-ms", type=float, default=1.0,
+                        help="absolute floor for a regression (default 1.0 ms)")
+    parser.add_argument("--top", type=int, default=20,
+                        help="rows to print in the common-span table")
+    parser.add_argument("--json", dest="json_path", type=pathlib.Path, default=None,
+                        help="also write the structured diff as JSON")
+    ns = parser.parse_args(argv)
+
+    try:
+        records_a = load(ns.a)
+        records_b = load(ns.b)
+    except (OSError, ValueError) as e:
+        print(f"ERROR: {e}")
+        return 2
+    d = diff(records_a, records_b,
+             threshold_pct=ns.threshold_pct, min_ms=ns.min_ms)
+    print_diff(d, top=ns.top)
+    if ns.json_path is not None:
+        with open(ns.json_path, "w") as f:
+            json.dump(d, f, indent=2, sort_keys=True)
+        print(f"\njson diff written to {ns.json_path}")
+    if ns.fail_on_regression and d["regressions"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

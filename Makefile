@@ -7,6 +7,11 @@ PYTHON ?= python
 TEST_VECTOR_DIR ?= ./test-vectors
 TRACE_DIR ?= ./trace-smoke
 LEDGER ?= ./perf-ledger/ledger.jsonl
+# persistent XLA compile cache (sched/compile_cache.py): primed by the
+# citest trace smoke so the SECOND run's kernels load instead of compile
+# (hit instants land in the trace); lives under the gitignored + CI-cached
+# perf-ledger tree
+COMPILE_CACHE ?= ./perf-ledger/xla-cache
 GENERATORS = bls epoch_processing finality fork_choice forks genesis merkle \
              operations random rewards sanity shuffling ssz_generic ssz_static transition
 
@@ -18,7 +23,7 @@ DEVICE_TESTS = tests/test_bls_device.py tests/test_curve_device.py \
                tests/test_multichip.py
 
 .PHONY: test citest test-fast test-device test-mainnet lint docs generate_tests gen_% replay bench \
-        dryrun detect_generator_incomplete clean-vectors chaos trace perfgate perf-report help
+        dryrun detect_generator_incomplete clean-vectors chaos trace perfgate perf-report gen-bench help
 
 # the fault-injection suite: supervisor/taxonomy units, chaos replay
 # (tampered vectors), induced backend failures, generator crash/resume
@@ -40,6 +45,7 @@ help:
 	@echo "trace                 instrumented bench+generator smoke -> $(TRACE_DIR)/trace.json (Perfetto-loadable) + summary"
 	@echo "perfgate              host-only micro-bench slice -> $(LEDGER); FAILS on a sentinel-confirmed regression"
 	@echo "perf-report           render the perf ledger trajectory -> perf-report.html (+ stdout summary)"
+	@echo "gen-bench             generation-pipeline bench: operations suite in 3 modes, byte-identity proven, speedup -> $(LEDGER)"
 
 # parallelize like the reference (ref Makefile:100-106) when pytest-xdist
 # is present; degrade to single-process so the suite stays runnable cold
@@ -61,7 +67,7 @@ citest:
 	$(MAKE) perfgate
 
 trace:
-	$(PYTHON) tools/trace_smoke.py --out $(TRACE_DIR)
+	CONSENSUS_SPECS_TPU_COMPILE_CACHE=$(COMPILE_CACHE) $(PYTHON) tools/trace_smoke.py --out $(TRACE_DIR)
 	$(PYTHON) tools/trace_report.py $(TRACE_DIR)/trace.json
 
 # the perf evidence gate (docs/OBSERVABILITY.md): a deterministic
@@ -73,6 +79,12 @@ perfgate:
 
 perf-report:
 	$(PYTHON) tools/perf_report.py report --ledger $(LEDGER) --html perf-report.html
+
+# the generation-pipeline bench (docs/GENPIPE.md): the minimal-preset
+# operations suite in strict / per-case-flush / pipelined modes, digest
+# journals compared byte-for-byte, the speedup banked in the ledger
+gen-bench:
+	$(PYTHON) tools/gen_bench.py --ledger $(LEDGER)
 
 test-fast:
 	$(PYTHON) -m pytest tests/ -q $(addprefix --ignore=,$(DEVICE_TESTS)) $(PYTEST_EXTRA)

@@ -90,6 +90,16 @@ RESULTS: dict = {
 }
 _EMITTED = False
 
+# the round-4 verdict's three required scoreboard keys: present on EVERY
+# parent exit path (see _emit) — a host-only run banks them as explicit
+# backend:"host" datapoints (the host path vs itself, 1.0) instead of
+# absent keys the trajectory can't plot
+HEADLINE_SPEEDUP_KEYS = (
+    "block_128atts_speedup",
+    "sync_aggregate_512_speedup",
+    "gen_operations_speedup",
+)
+
 
 def _event(name: str, msg: str = "", **fields) -> None:
     """One structured progress event: buffered for the BENCH json's
@@ -141,6 +151,13 @@ def _emit() -> None:
         if pallas_root is not None and hash_root is not None and pallas_root != hash_root:
             RESULTS["hash_pallas_status"] = "mismatch"
             RESULTS["hash_pallas_mibs"] = None
+        # required headline keys on every exit path: a host-only run
+        # (device unreachable / compile failed) emits them as explicit
+        # host-vs-host 1.0 datapoints under backend:"host"; a device run
+        # whose section died keeps the explicit null (present, honest)
+        for key in HEADLINE_SPEEDUP_KEYS:
+            if RESULTS.get(key) is None:
+                RESULTS[key] = 1.0 if RESULTS.get("backend") == "host" else None
         # every parent run lands in the perf ledger (obs/ledger.py) so
         # the next run has a baseline to be judged against; disable via
         # CONSENSUS_SPECS_TPU_LEDGER=off
@@ -179,21 +196,22 @@ signal.alarm(max(1, int(DEADLINE_S)))
 
 
 def _maybe_enable_compile_cache() -> None:
-    """Persist XLA executables across bench runs (jax compilation cache)
+    """Persist XLA executables across bench runs (sched/compile_cache.py)
     so the ~12-minute cold BLS graph compile is paid once per MACHINE,
-    not once per process. Device backends only: writing the large pairing
-    executable from the CPU backend's cache path was observed to
-    segfault (see ops/__init__.py), so CPU keeps cold compiles."""
+    not once per process. Device backends default on; on CPU the cache
+    engages only when CONSENSUS_SPECS_TPU_COMPILE_CACHE asks for it
+    (measured safe on the current jaxlib — see sched/compile_cache.py —
+    but a bench child has nothing to gain from caching CPU fallbacks).
+    Cache hits/requests surface as sched.compile_cache trace instants."""
     try:
         import jax
 
-        if jax.default_backend() == "cpu":
-            return
-        cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-        _note(f"compile cache enabled at {cache_dir}")
+        from consensus_specs_tpu.sched import compile_cache as _cc
+
+        cache_dir = _cc.configure_compile_cache(
+            enable_by_default=jax.default_backend() != "cpu")
+        if cache_dir:
+            _note(f"compile cache enabled at {cache_dir}")
     except Exception as e:  # cache is an optimization, never a requirement
         _note(f"compile cache unavailable: {e!r}")
 
@@ -954,6 +972,11 @@ def bench_host_fallback() -> None:
     spec.state_transition(base.copy(), signed_block)
     RESULTS["block_128atts_mainnet_host_s"] = round(time.perf_counter() - t0, 2)
 
+    # the three round-4 scoreboard keys, as explicit host datapoints
+    # (host path vs itself): comparable, plottable, never absent
+    for key in HEADLINE_SPEEDUP_KEYS:
+        RESULTS[key] = 1.0
+
 
 SECTIONS = {
     "bls": bench_bls,
@@ -1109,7 +1132,11 @@ def main() -> None:
 
 
 def _cache_is_warm() -> bool:
-    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+    # the parent stays jax-free: resolve the SAME dir the children will
+    # configure (sched/compile_cache.py — pure stdlib resolution)
+    from consensus_specs_tpu.sched import compile_cache as _cc
+
+    cache_dir = _cc.resolve_dir(enable_by_default=True)
     try:
         return any(os.scandir(cache_dir))
     except OSError:

@@ -65,6 +65,9 @@ def use_backend(name: str) -> str:
     elif name == "jax":
         def _probe_import():
             chaos("bls.import")
+            from ...sched import configure_compile_cache
+
+            configure_compile_cache()  # knob-gated; before the pairing jits
             from ...ops import bls_jax
 
             return bls_jax
@@ -166,9 +169,13 @@ class DeferredVerifier:
         """Resolve every still-pending check. Duplicate keys (the same
         check recorded by several workload items — pure function of the
         key) resolve once; the unique Verify/FastAggregateVerify
-        population goes through one batched device dispatch (they share
-        the 2-pairing row shape). AggregateVerify resolves scalar (it
-        never appears in spec-level state-transition code)."""
+        population is planned into canonical power-of-two shape buckets
+        (sched.bucketing — one compiled program per bucket shape, rows
+        grouped by aggregate width so narrow checks never pad to the
+        widest row in the flush) and dispatched bucket-by-bucket through
+        the backend's cold batch pipeline when it has one, scalar
+        otherwise. AggregateVerify resolves scalar (it never appears in
+        spec-level state-transition code)."""
         todo = self.entries[len(self.results):]
         if not todo:
             return
@@ -196,37 +203,65 @@ class DeferredVerifier:
             if cold is not None and is_quarantined(f"bls.{_backend_name}"):
                 cold = None  # breaker open: the oracle path answers below
             if cold is not None:
+                self._flush_bucketed(cold, batch_rows, unique,
+                                     dedup_hits=len(todo) - len(unique))
+            # rows a failed bucket dispatch left unresolved (or all rows,
+            # when no cold pipeline exists) go per-row through the
+            # oracle-adjudicated synchronous path
+            for key, pks, msg, sig in batch_rows:
+                if unique[key] is not None:
+                    continue
                 try:
-                    with obs.kernel_span("bls.flush_batch", rows=len(batch_rows),
-                                         backend=_backend_name):
-                        ok = cold(
-                            [r[1] for r in batch_rows],
-                            [r[2] for r in batch_rows],
-                            [r[3] for r in batch_rows],
-                        )
-                except Exception as e:
-                    # a device/backend failure must degrade like every
-                    # synchronous facade path, not abort the whole flush:
-                    # fall back to the per-row oracle-adjudicated path
-                    # below (which quarantines the backend if warranted)
-                    record_event("fallback", domain="crypto.bls",
-                                 capability=f"bls.{_backend_name}",
-                                 detail=f"batched flush failed "
-                                        f"({type(e).__name__}); per-row fallback")
-                    cold = None
-                else:
-                    for (key, _, _, _), o in zip(batch_rows, ok):
-                        unique[key] = bool(o)
-            if cold is None:
-                for key, pks, msg, sig in batch_rows:
-                    try:
-                        unique[key] = _verify_dispatch("FastAggregateVerify", pks, msg, sig)
-                    except Exception:
-                        unique[key] = False
+                    unique[key] = _verify_dispatch("FastAggregateVerify", pks, msg, sig)
+                except Exception:
+                    unique[key] = False
 
         out = [unique[key] for key in todo]
         assert all(o is not None for o in out)
         self.results.extend(out)  # type: ignore[arg-type]
+
+    @staticmethod
+    def _flush_bucketed(cold, batch_rows, unique, dedup_hits: int) -> None:
+        """Dispatch the deduped rows bucket-by-bucket per the sched
+        planner. A failed bucket degrades like every synchronous facade
+        path — its rows stay None for the caller's per-row fallback
+        (which quarantines the backend if warranted) — without aborting
+        the other buckets."""
+        from ...sched import plan_flush
+
+        floors = getattr(_backend, "cold_shape_floors", None)
+        if floors is not None:
+            min_rows, max_rows, min_keys = floors()
+        else:  # planner defaults mirror the device backend's CPU floors
+            min_rows, max_rows, min_keys = 8, 128, 2
+        plan = plan_flush([len(r[1]) for r in batch_rows],
+                          min_rows=min_rows, max_rows=max_rows,
+                          min_keys=min_keys, dedup_hits=dedup_hits)
+        obs.instant("sched.flush_plan", **plan.stats())
+        obs.count("sched.flush.rows", len(batch_rows))
+        obs.count("sched.flush.dedup_hits", dedup_hits)
+        for d in plan.dispatches:
+            sub = [batch_rows[i] for i in d.indices]
+            try:
+                with obs.kernel_span(f"sched.flush.k{d.k_bucket}",
+                                     rows=d.rows, row_bucket=d.row_bucket,
+                                     k=d.k_bucket, backend=_backend_name):
+                    chaos("sched.flush")
+                    ok = cold(
+                        [r[1] for r in sub],
+                        [r[2] for r in sub],
+                        [r[3] for r in sub],
+                    )
+            except Exception as e:
+                record_event("fallback", domain="crypto.bls",
+                             capability=f"bls.{_backend_name}",
+                             detail=f"bucket k={d.k_bucket} flush failed "
+                                    f"({type(e).__name__}); per-row fallback")
+                continue
+            obs.count("sched.flush.dispatches")
+            obs.instant("sched.flush_bucket", **d.stats())
+            for (key, _, _, _), o in zip(sub, ok):
+                unique[key] = bool(o)
 
 
 @contextlib.contextmanager

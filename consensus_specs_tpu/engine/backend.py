@@ -45,6 +45,9 @@ def use_backend(name: str = "numpy") -> str:
     if name == "jax":
         def _probe_import():
             chaos("engine.import")
+            from ..sched import configure_compile_cache
+
+            configure_compile_cache()  # knob-gated; before any jit builds
             from . import ops_jax  # noqa: F401  (import error = unavailable)
 
         try:

@@ -5,7 +5,7 @@ from __future__ import annotations
 
 import importlib
 import inspect
-from typing import Dict, Iterable
+from typing import Dict, Iterable, Optional
 
 from consensus_specs_tpu.crypto import bls
 from consensus_specs_tpu.exceptions import SkippedTest
@@ -16,7 +16,7 @@ from .gen_typing import TestCase, TestProvider
 
 def generate_from_tests(runner_name: str, handler_name: str, src, fork_name: str,
                         preset_name: str, bls_active: bool = True,
-                        phase: str = None) -> Iterable[TestCase]:
+                        phase: Optional[str] = None) -> Iterable[TestCase]:
     """One TestCase per test_* function in module ``src``
     (ref gen.py:13-56)."""
     fn_names = [

@@ -16,7 +16,16 @@ before the case is counted failed — and committed cases are journaled
 (part digests, fsync'd) so a killed run resumes from verified-complete
 cases only: output that fails digest or structural verification
 (truncated ``.ssz_snappy``, malformed yaml) is regenerated, never
-silently shipped. Chaos point: ``gen.case``.
+silently shipped. Chaos points: ``gen.case``, ``sched.writer``.
+
+Pipelining (consensus_specs_tpu/sched, docs/GENPIPE.md): deferred
+checks accumulate across up to ``--flush-every`` cases before one
+bucketed flush (sched.bucketing plans the canonical power-of-two
+dispatch shapes), and committed cases are written by a bounded
+supervised writer thread (``--serial-writes`` opts out) so yaml/part
+IO + the journal append overlap the next case's compute and the next
+bucket's device dispatch. Output bytes are mode-independent — pinned
+by tests/test_gen_defer.py and tests/test_gen_sched.py.
 """
 from __future__ import annotations
 
@@ -46,7 +55,8 @@ CASE_RETRY_POLICY = RetryPolicy(max_attempts=3, base_delay_s=0.05, max_delay_s=1
 TIME_THRESHOLD_TO_PRINT = 1.0  # seconds
 
 # bound deferred-case buffering (parts are already-encoded bytes; this is
-# a memory bound, not a dispatch bound — one flush still covers a batch)
+# a memory bound, not a dispatch bound — one flush still covers a batch);
+# --flush-every / CONSENSUS_SPECS_TPU_GEN_FLUSH_EVERY override
 DEFER_FLUSH_EVERY = 256
 
 
@@ -151,11 +161,23 @@ def run_generator(generator_name: str, test_providers: Iterable[TestProvider], a
                         default=True,
                         help="disable the crash-safe case journal (digest-"
                              "verified resume, corruption regeneration)")
+    parser.add_argument("--flush-every", type=int, default=_flush_every_default(),
+                        help="deferred-BLS cases to accumulate before one "
+                             "bucketed cross-case flush (1 = per-case flush; "
+                             "default: CONSENSUS_SPECS_TPU_GEN_FLUSH_EVERY "
+                             f"env or {DEFER_FLUSH_EVERY})")
+    parser.add_argument("--serial-writes", dest="overlap_writes",
+                        action="store_false", default=_overlap_default(),
+                        help="write committed cases inline on the main thread "
+                             "instead of the bounded overlap writer queue "
+                             "(default: overlapped unless "
+                             "CONSENSUS_SPECS_TPU_GEN_OVERLAP=0)")
 
     ns = parser.parse_args(args=args)
 
     output_dir: Path = ns.output_dir
     log_file = output_dir / "testgen_error_log.txt"
+    flush_every = max(1, int(ns.flush_every))
 
     journal = CaseJournal(output_dir) if ns.journal and not ns.collect_only else None
 
@@ -187,7 +209,7 @@ def run_generator(generator_name: str, test_providers: Iterable[TestProvider], a
         return supervised(_attempt, domain="generator",
                           policy=CASE_RETRY_POLICY, passthrough=(SkippedTest,))
 
-    def commit(case_dir: Path, encoded, meta, start: float) -> None:
+    def commit_sync(case_dir: Path, encoded, meta, start: float) -> None:
         if _write_case(case_dir, encoded, meta) == 0:
             return
         if journal is not None:
@@ -196,6 +218,21 @@ def run_generator(generator_name: str, test_providers: Iterable[TestProvider], a
         elapsed = time.time() - start
         if elapsed >= TIME_THRESHOLD_TO_PRINT:
             print(f"  done in {elapsed:.2f}s")
+
+    # overlapped serialization (sched/writer.py): part IO + the journal
+    # append run on a bounded supervised thread, in submit order, so
+    # serialization overlaps the next case's compute / bucket flush
+    writer = None
+    if ns.overlap_writes and not ns.collect_only:
+        from consensus_specs_tpu.sched import CaseWriter
+
+        writer = CaseWriter(commit_sync)
+
+    def commit(case_dir: Path, encoded, meta, start: float) -> None:
+        if writer is not None:
+            writer.submit(str(case_dir), case_dir, encoded, meta, start)
+        else:
+            commit_sync(case_dir, encoded, meta, start)
 
     verifier = None
     if ns.bls_defer and not ns.collect_only:
@@ -209,6 +246,7 @@ def run_generator(generator_name: str, test_providers: Iterable[TestProvider], a
         _CaseOutcome for the flush to adjudicate."""
         from consensus_specs_tpu.crypto import bls
 
+        assert verifier is not None
         m0 = verifier.mark()
         encoded, meta, error = None, None, None
         try:
@@ -241,6 +279,7 @@ def run_generator(generator_name: str, test_providers: Iterable[TestProvider], a
 
         if not pending:
             return
+        assert verifier is not None
         with obs.span("gen.flush", cases=len(pending),
                       checks=len(verifier.entries) - len(verifier.results)):
             verifier.flush()
@@ -317,7 +356,7 @@ def run_generator(generator_name: str, test_providers: Iterable[TestProvider], a
                     outcome = run_case_deferred(test_case, case_dir, start)
                     if outcome is not None:
                         pending.append(outcome)
-                        if len(pending) >= DEFER_FLUSH_EVERY:
+                        if len(pending) >= flush_every:
                             flush_pending(pending)
                 else:
                     encoded, meta, error = None, None, None
@@ -331,6 +370,12 @@ def run_generator(generator_name: str, test_providers: Iterable[TestProvider], a
 
       if verifier is not None:
           flush_pending(pending)
+      if writer is not None:
+          # drain inside the gen.run span so the trace shows the writer
+          # tail; terminal write failures surface as failed cases, never
+          # silently dropped output
+          for label, err in writer.close():
+              record_failure(Path(label), f"writer failed terminally: {err}")
 
     if ns.collect_only:
         print(f"collected {collected} test cases")
@@ -351,3 +396,19 @@ def _defer_default() -> bool:
     import os
 
     return os.environ.get("CONSENSUS_SPECS_TPU_BLS_DEFER", "") not in ("", "0", "false")
+
+
+def _flush_every_default() -> int:
+    import os
+
+    raw = os.environ.get("CONSENSUS_SPECS_TPU_GEN_FLUSH_EVERY", "")
+    try:
+        return max(1, int(raw)) if raw else DEFER_FLUSH_EVERY
+    except ValueError:
+        return DEFER_FLUSH_EVERY
+
+
+def _overlap_default() -> bool:
+    import os
+
+    return os.environ.get("CONSENSUS_SPECS_TPU_GEN_OVERLAP", "") not in ("0", "false", "off")

@@ -13,23 +13,22 @@ min-batch-size dispatch floor so small shapes never pay dispatch
 latency (engine.backend.DEVICE_MIN_ROWS, the DEVICE_MIN_BLOCKS analog).
 
 The persistent XLA compile cache is OPT-IN via the
-CONSENSUS_SPECS_TPU_JAX_CACHE env var (path to a cache dir). It is NOT
-enabled by default: on the CPU backend of this jaxlib, serializing the
-large pairing executable into the cache was observed to segfault
-(compilation_cache.put_executable_and_time), and cached CPU AOT entries
-fail to load across machines with differing feature sets anyway
-(cpu_aot_loader machine-feature mismatch). On TPU runners that want
-warm restarts, set the env var explicitly.
+CONSENSUS_SPECS_TPU_COMPILE_CACHE env var (sched/compile_cache.py;
+CONSENSUS_SPECS_TPU_JAX_CACHE is the legacy alias). It is not enabled
+implicitly at import: processes that want warm restarts (bench section
+children, the dryrun child, `make citest`) opt in, and the cache-hit/
+miss traffic is mirrored into the obs plane as `sched.compile_cache`
+instants. (PR 1 observed a CPU-backend segfault serializing the large
+pairing executable on an older jaxlib; the current jax round-trips it
+cleanly — see sched/compile_cache.py for the measured evidence.)
 """
 import os
 
 try:
-    _cache_dir = os.environ.get("CONSENSUS_SPECS_TPU_JAX_CACHE")
-    if _cache_dir:
-        import jax
+    if (os.environ.get("CONSENSUS_SPECS_TPU_COMPILE_CACHE")
+            or os.environ.get("CONSENSUS_SPECS_TPU_JAX_CACHE")):
+        from ..sched import compile_cache as _cc
 
-        if jax.config.jax_compilation_cache_dir is None:  # respect host app config
-            jax.config.update("jax_compilation_cache_dir", _cache_dir)
-            jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+        _cc.configure_compile_cache()
 except Exception:  # pragma: no cover - cache is best-effort
     pass

@@ -194,6 +194,14 @@ def _cold_min_keys() -> int:
     return 2 if jax.default_backend() == "cpu" else 64
 
 
+def cold_shape_floors() -> Tuple[int, int, int]:
+    """(min_rows, max_rows, min_keys) — the canonical bucket floors the
+    cold pipeline pads to. The sched flush planner (sched/bucketing.py)
+    groups rows with these same floors so its per-bucket dispatches land
+    exactly on the shapes this backend would compile anyway."""
+    return _cold_min_rows(), _max_rows(), _cold_min_keys()
+
+
 def _run_checks(checks: Sequence[Optional[List[_Pair]]]) -> np.ndarray:
     out = np.zeros(len(checks), dtype=bool)
     # pre-filter only sizes the chunks; _pack_checks re-applies the

@@ -20,6 +20,8 @@ against (tests/test_dryrun_guard.py).
 Sites are plain strings; the convention is plane.point:
   bls.import  bls.dispatch  engine.import  engine.dispatch
   hash.dispatch  gen.case  bench.section  dryrun.child  replay.case
+  sched.flush (per bucket dispatch of the cross-case deferred flush)
+  sched.writer (per case written by the overlap writer thread)
 
 ``chaos(site)`` is a no-op dict probe when nothing is armed — cheap
 enough for hot paths.

@@ -96,6 +96,13 @@ class CaseJournal:
             f.flush()
             os.fsync(f.fileno())
 
+    def entries(self) -> Dict[str, Dict[str, str]]:
+        """{case rel-path: {part file: sha256 hex}} for every currently
+        journaled case — the per-case digest view consumers compare to
+        prove byte-identity across generation modes (tools/gen_bench.py,
+        tests/test_gen_sched.py)."""
+        return {case: dict(parts) for case, parts in self._entries.items()}
+
     def record(self, rel: str, case_dir: Path) -> None:
         """Journal a committed case: digest every part file, fsync."""
         parts = {

@@ -1,0 +1,56 @@
+"""Cross-case batch scheduler for the generation pipeline.
+
+The north-star suite-regeneration metric kept losing to the host path
+(gen_suite_speedup 0.63 in round 3) because the generator paid per-case
+costs the device never amortized: every case flushed its own tiny
+DeferredVerifier batch, every fresh row-count shape triggered a cold XLA
+compile in a cold child, and yaml/snappy serialization ran serially on
+the thread that feeds the device. This package turns suite generation
+into a pipelined batch workload — the cross-request batching +
+compile-cache + host/device overlap shape any serving stack needs:
+
+- :mod:`bucketing` — the flush planner: dedups recorded signature
+  checks by key, groups them by aggregate width into a SMALL canonical
+  set of power-of-two (rows x keys) bucket shapes, and chunks rows
+  under the backend's dispatch cap — so a whole suite compiles
+  O(#buckets) pairing programs instead of O(#distinct shapes) and every
+  dispatch amortizes over a full bucket. Pure planning, no jax; the
+  per-bucket pad-waste stats land in the trace (``sched.flush_bucket``
+  instants) so overhead is measured, not guessed.
+- :mod:`compile_cache` — the persistent XLA compilation cache
+  (``CONSENSUS_SPECS_TPU_COMPILE_CACHE`` knob, default under the
+  gitignored ``perf-ledger/xla-cache``): wired into the bls/engine/hash
+  backends, bench section children, and the multichip dryrun child, so
+  a cold child process reuses the executables a prior process already
+  paid to compile. Cache hits/requests are mirrored as
+  ``sched.compile_cache`` instants on the owning kernel span —
+  ``tools/trace_report.py`` shows the cold window shrinking across
+  child processes.
+- :mod:`writer` — the overlapped host serialization stage: a bounded,
+  resilience-supervised writer thread that performs the yaml encode +
+  part-file IO + journal append of committed cases while the main
+  thread prepares the next bucket's host inputs and device flush.
+  Backpressure through the bounded queue; crash-safe ordering through
+  the existing fsync'd digest journal (submit order == journal order).
+
+Consumers: ``crypto/bls`` (DeferredVerifier.flush plans through
+:func:`bucketing.plan_flush`), ``generators/gen_runner`` (cross-case
+flush accumulation + the writer queue), ``bench.py`` section children
+and ``__graft_entry__``'s dryrun child (compile cache), and
+``tools/perfgate.py`` (the host-only ``gen_pipeline`` micro-bench the
+sentinel gates from this round on).
+
+Chaos sites: ``sched.flush`` (per bucket dispatch) and ``sched.writer``
+(per written case). Counters: ``sched.flush.*`` / ``sched.writer.*`` /
+``sched.compile_cache.*``. See docs/GENPIPE.md.
+"""
+from __future__ import annotations
+
+from . import bucketing, compile_cache, writer  # noqa: F401
+from .bucketing import BucketDispatch, FlushPlan, plan_flush, pow2_bucket  # noqa: F401
+from .compile_cache import (  # noqa: F401
+    COMPILE_CACHE_ENV,
+    configure_compile_cache,
+    compile_cache_stats,
+)
+from .writer import CaseWriter  # noqa: F401

@@ -1,0 +1,147 @@
+"""Flush planner: dedup + canonical power-of-two shape buckets for the
+cross-case deferred-BLS flush.
+
+A generation run records thousands of signature checks whose aggregate
+widths (pubkeys per check) span 1..512. Dispatching them as one batch
+pads every row to the WIDEST width in the batch (a 1-key voluntary-exit
+check padded to a 512-key sync-committee row is 99.8% wasted pairing
+work), while dispatching per distinct shape compiles a fresh XLA program
+for every (rows, keys) pair it meets. The planner picks the middle:
+
+- rows are grouped by the power-of-two bucket of their width (floored at
+  the backend's key-bucket minimum), so each group shares ONE compiled
+  K shape;
+- each group is chunked under the backend's row cap and each chunk pads
+  its row count to a power of two (floored at the backend's row-bucket
+  minimum) — the same canonical row shapes the backend's own packer
+  uses, so the plan adds no shapes the backend wouldn't;
+- duplicate check keys (the same check recorded by several cases — a
+  pure function of the key) collapse to one row before any grouping.
+
+The planner is pure host bookkeeping (no jax import): callers feed it
+widths and get back index groups + pad-waste stats that land in the
+trace as ``sched.flush_bucket`` instants.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Sequence, Tuple
+
+DEFAULT_MIN_ROWS = 8
+DEFAULT_MAX_ROWS = 128
+DEFAULT_MIN_KEYS = 2
+
+
+def pow2_bucket(n: int, minimum: int = 1) -> int:
+    """Smallest power-of-two >= max(n, minimum) (minimum itself need not
+    be a power of two; the result is then the next pow2 above it)."""
+    b = 1
+    floor = max(1, minimum)
+    while b < floor or b < n:
+        b <<= 1
+    return b
+
+
+@dataclass
+class BucketDispatch:
+    """One device dispatch: rows sharing a compiled (row_bucket, k_bucket)
+    shape. ``indices`` index the caller's deduped row list."""
+
+    k_bucket: int
+    row_bucket: int
+    indices: List[int] = field(default_factory=list)
+    width_sum: int = 0  # sum of real aggregate widths (pad accounting)
+
+    @property
+    def rows(self) -> int:
+        return len(self.indices)
+
+    @property
+    def pad_rows(self) -> int:
+        return self.row_bucket - len(self.indices)
+
+    @property
+    def slot_waste_pct(self) -> float:
+        """Fraction of the padded (rows x keys) pairing slots that hold
+        padding rather than a real (pubkey, message) pair."""
+        slots = self.row_bucket * self.k_bucket
+        if slots == 0:
+            return 0.0
+        return round(100.0 * (slots - self.width_sum) / slots, 2)
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "k": self.k_bucket,
+            "rows": self.rows,
+            "row_bucket": self.row_bucket,
+            "pad_rows": self.pad_rows,
+            "slot_waste_pct": self.slot_waste_pct,
+        }
+
+
+@dataclass
+class FlushPlan:
+    """The bucketed dispatch schedule for one flush."""
+
+    dispatches: List[BucketDispatch]
+    total_rows: int
+    dedup_hits: int  # recorded checks that collapsed onto an earlier key
+
+    @property
+    def shapes(self) -> List[Tuple[int, int]]:
+        """Distinct compiled (row_bucket, k_bucket) shapes this plan
+        needs — the O(#buckets) compile bound."""
+        return sorted({(d.row_bucket, d.k_bucket) for d in self.dispatches})
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "dispatches": len(self.dispatches),
+            "shapes": len(self.shapes),
+            "rows": self.total_rows,
+            "dedup_hits": self.dedup_hits,
+        }
+
+
+def plan_flush(
+    widths: Sequence[int],
+    *,
+    min_rows: int = DEFAULT_MIN_ROWS,
+    max_rows: int = DEFAULT_MAX_ROWS,
+    min_keys: int = DEFAULT_MIN_KEYS,
+    dedup_hits: int = 0,
+) -> FlushPlan:
+    """Plan the bucketed dispatches for deduped rows of the given
+    aggregate ``widths`` (pubkeys per check; callers dedup first and
+    report the collapse count via ``dedup_hits``).
+
+    Rows land in their width's power-of-two K bucket; each bucket is
+    chunked to at most ``max_rows`` rows per dispatch, padded up to the
+    canonical power-of-two row shapes. Original order is preserved
+    within a bucket so results map back by index.
+    """
+    by_k: Dict[int, List[Tuple[int, int]]] = {}
+    for i, w in enumerate(widths):
+        k = pow2_bucket(w, minimum=min_keys)
+        by_k.setdefault(k, []).append((i, w))
+
+    dispatches: List[BucketDispatch] = []
+    for k in sorted(by_k):
+        rows = by_k[k]
+        for start in range(0, len(rows), max_rows):
+            chunk = rows[start : start + max_rows]
+            row_bucket = min(pow2_bucket(len(chunk), minimum=min_rows), max_rows) \
+                if max_rows >= min_rows else pow2_bucket(len(chunk), minimum=min_rows)
+            # a cap below the pow2 floor is the cap's problem, not ours:
+            # never plan a dispatch wider than the backend accepts
+            row_bucket = max(row_bucket, len(chunk))
+            dispatches.append(BucketDispatch(
+                k_bucket=k,
+                row_bucket=row_bucket,
+                indices=[i for i, _ in chunk],
+                width_sum=sum(w for _, w in chunk),
+            ))
+    return FlushPlan(
+        dispatches=dispatches,
+        total_rows=len(widths),
+        dedup_hits=dedup_hits,
+    )

@@ -1,0 +1,193 @@
+"""Persistent XLA compilation cache wiring + hit/miss observability.
+
+One knob::
+
+    CONSENSUS_SPECS_TPU_COMPILE_CACHE=<dir> | 1/default | 0/off
+
+- a path: use that directory;
+- ``1`` / ``default``: use the default directory
+  (``<repo>/perf-ledger/xla-cache`` — under the gitignored perf-ledger
+  tree so CI's ledger cache carries the executables too);
+- ``0`` / ``off`` / empty: disabled, even for consumers that default on.
+
+The legacy ``CONSENSUS_SPECS_TPU_JAX_CACHE`` knob (PR 1, path-only) is
+honored as an alias when the new knob is unset.
+
+Consumers call :func:`configure_compile_cache` BEFORE building their
+jits (ops/__init__ at import when a knob is armed; the engine and hash
+backends before their first device-backend build; bench.py section
+children; the dryrun child — those last two pass ``enable_by_default=
+True`` because a killable child process is exactly where a warm cache
+pays: the executables survive the child). History note: PR 1 observed a
+CPU-backend segfault serializing the large pairing executable on this
+image's jaxlib and kept the cache opt-in; the current jax 0.4.37
+round-trips that same executable cleanly (measured: 253 s cold compile
+-> 62 s with 6 cache hits in a fresh process), so the remaining
+conservatism is only that nothing enables the cache implicitly for
+processes that didn't ask.
+
+Observability: jax's monitoring events are mirrored into the obs plane
+— every cache request/hit becomes a ``sched.compile_cache`` instant
+attached to the current (kernel) span plus a ``sched.compile_cache.*``
+counter, and ``compile_time_saved_sec`` accumulates into
+:func:`compile_cache_stats`. ``tools/trace_report.py`` tallies them so
+a trace shows the cold-compile window shrinking across child processes.
+Misses are derived (requests - hits): jax emits no explicit miss event.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Optional
+
+COMPILE_CACHE_ENV = "CONSENSUS_SPECS_TPU_COMPILE_CACHE"
+LEGACY_CACHE_ENV = "CONSENSUS_SPECS_TPU_JAX_CACHE"
+MIN_COMPILE_ENV = "CONSENSUS_SPECS_TPU_COMPILE_CACHE_MIN_S"
+
+# persist EVERY compile by default: jax's measured backend-compile time
+# for the mid-size kernels the citest smoke primes is well under 100ms
+# (a 0.1s floor left the cache empty), the big pairing graphs dominate
+# the disk budget either way, and every consumer here opted in
+# explicitly. CONSENSUS_SPECS_TPU_COMPILE_CACHE_MIN_S raises the floor.
+DEFAULT_MIN_COMPILE_SECS = 0.0
+
+_OFF_TOKENS = ("0", "off", "none", "false")
+_DEFAULT_TOKENS = ("1", "default", "on", "true")
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+DEFAULT_RELPATH = os.path.join("perf-ledger", "xla-cache")
+
+# jax monitoring event names (stable across the 0.4.3x line)
+_EV_REQUEST = "/jax/compilation_cache/compile_requests_use_cache"
+_EV_HIT = "/jax/compilation_cache/cache_hits"
+_EV_SAVED = "/jax/compilation_cache/compile_time_saved_sec"
+
+_lock = threading.Lock()
+_listeners_installed = False
+_configured_dir: Optional[str] = None
+
+_STATS: Dict[str, float] = {"requests": 0, "hits": 0, "saved_s": 0.0}
+
+
+def default_dir() -> str:
+    return os.path.join(_REPO_ROOT, DEFAULT_RELPATH)
+
+
+def resolve_dir(explicit: Optional[str] = None, *,
+                enable_by_default: bool = False) -> str:
+    """The cache directory to use, or "" for disabled. Precedence:
+    explicit argument > new knob > legacy knob > (default dir iff
+    ``enable_by_default``)."""
+    for raw in (explicit, os.environ.get(COMPILE_CACHE_ENV),
+                os.environ.get(LEGACY_CACHE_ENV)):
+        if raw is None:
+            continue
+        token = raw.strip()
+        if token.lower() in _OFF_TOKENS or token == "":
+            return ""
+        if token.lower() in _DEFAULT_TOKENS:
+            return default_dir()
+        return token
+    return default_dir() if enable_by_default else ""
+
+
+def _min_compile_secs_default() -> float:
+    raw = os.environ.get(MIN_COMPILE_ENV, "")
+    try:
+        return float(raw) if raw else DEFAULT_MIN_COMPILE_SECS
+    except ValueError:
+        return DEFAULT_MIN_COMPILE_SECS
+
+
+def configure_compile_cache(cache_dir: Optional[str] = None, *,
+                            enable_by_default: bool = False,
+                            min_compile_secs: Optional[float] = None) -> str:
+    """Point jax's persistent compilation cache at the resolved directory
+    and install the hit/miss observability listeners. Returns the
+    directory in effect ("" when disabled). Never raises: an unsettable
+    cache is an optimization lost, not a fault. Respects a cache dir the
+    host application already configured (first writer wins)."""
+    target = resolve_dir(cache_dir, enable_by_default=enable_by_default)
+    if not target:
+        return ""
+    global _configured_dir
+    try:
+        import jax
+
+        if jax.config.jax_compilation_cache_dir is None:
+            if min_compile_secs is None:
+                min_compile_secs = _min_compile_secs_default()
+            jax.config.update("jax_compilation_cache_dir", target)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              float(min_compile_secs))
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        _configured_dir = jax.config.jax_compilation_cache_dir
+        _install_listeners()
+        return _configured_dir or ""
+    except Exception:
+        return ""
+
+
+def configured_dir() -> Optional[str]:
+    """The cache dir this module configured (None before configure)."""
+    return _configured_dir
+
+
+def compile_cache_stats() -> Dict[str, Any]:
+    """Cumulative cache traffic for THIS process: requests, hits,
+    misses (derived), compile seconds saved by hits."""
+    with _lock:
+        requests = int(_STATS["requests"])
+        hits = int(_STATS["hits"])
+        return {
+            "requests": requests,
+            "hits": hits,
+            "misses": max(0, requests - hits),
+            "saved_s": round(float(_STATS["saved_s"]), 3),
+        }
+
+
+def reset_stats() -> None:
+    with _lock:
+        _STATS.update({"requests": 0, "hits": 0, "saved_s": 0.0})
+
+
+def _on_event(name: str, **kwargs: Any) -> None:
+    if name not in (_EV_REQUEST, _EV_HIT):
+        return
+    from .. import obs
+
+    if name == _EV_HIT:
+        with _lock:
+            _STATS["hits"] += 1
+        obs.count("sched.compile_cache.hits")
+        obs.instant("sched.compile_cache", event="hit")
+    else:
+        with _lock:
+            _STATS["requests"] += 1
+        obs.count("sched.compile_cache.requests")
+        obs.instant("sched.compile_cache", event="request")
+
+
+def _on_duration(name: str, secs: float, **kwargs: Any) -> None:
+    if name != _EV_SAVED:
+        return
+    with _lock:
+        _STATS["saved_s"] += float(secs)
+
+
+def _install_listeners() -> None:
+    global _listeners_installed
+    with _lock:
+        if _listeners_installed:
+            return
+        _listeners_installed = True
+    try:
+        from jax._src import monitoring
+
+        monitoring.register_event_listener(_on_event)
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:
+        # monitoring moved or vanished: the cache still works, only the
+        # hit/miss instants are lost
+        pass

@@ -1,0 +1,124 @@
+"""Overlapped case serialization: a bounded, supervised writer thread.
+
+``run_generator`` historically wrote every committed case inline — yaml
+encode + snappy-framed part files + the fsync'd journal append all ran
+on the thread that also executes cases and feeds the device flush. The
+writer queue moves that serialization off the hot thread so it overlaps
+the next case's compute and the next bucket's device dispatch:
+
+- **bounded**: a full queue blocks ``submit`` (backpressure — memory
+  stays bounded by ``maxsize`` encoded cases; the wait is counted in
+  ``sched.writer.backpressure``);
+- **ordered**: one worker thread drains FIFO, so journal-append order
+  equals submit order — the crash-safety contract is unchanged (a kill
+  loses at most the queued tail, whose case dirs are absent or
+  INCOMPLETE-marked and therefore regenerate on resume; everything the
+  journal admitted was fully written and fsync'd before its entry);
+- **supervised**: each write runs under the resilience supervisor
+  (transient faults — injected or real EIO-class flakes — retry with
+  backoff; chaos site ``sched.writer``); terminal failures are captured
+  per case and surfaced to the caller at ``close()`` instead of dying
+  silently on a daemon thread.
+
+Pure stdlib (threading + queue); no jax anywhere near this module.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, List, Optional, Tuple
+
+from .. import obs
+from ..resilience import RetryPolicy, chaos, record_event, supervised
+
+# transient-write budget: disk flakes clear fast or not at all
+WRITE_RETRY_POLICY = RetryPolicy(max_attempts=3, base_delay_s=0.02, max_delay_s=0.5)
+
+DEFAULT_QUEUE_SIZE = 64
+
+_STOP = object()
+
+
+class CaseWriter:
+    """Background committer: ``submit()`` enqueues one committed case's
+    write closure arguments; the worker runs ``commit_fn(*args)`` in
+    submit order. ``close()`` drains, joins, and returns the failures
+    as ``(label, error_repr)`` pairs."""
+
+    def __init__(self, commit_fn: Callable[..., None], *,
+                 maxsize: int = DEFAULT_QUEUE_SIZE) -> None:
+        self._commit_fn = commit_fn
+        self._q: "queue.Queue[Any]" = queue.Queue(maxsize=max(1, maxsize))
+        self.failures: List[Tuple[str, str]] = []
+        self.written = 0
+        self.submitted = 0
+        self.backpressure_waits = 0
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="sched-case-writer", daemon=True)
+            self._thread.start()
+
+    def submit(self, label: str, *args: Any) -> None:
+        """Enqueue one case write. Blocks when the queue is full (the
+        backpressure bound)."""
+        assert not self._closed, "submit() after close()"
+        self._ensure_thread()
+        self.submitted += 1
+        obs.count("sched.writer.submitted")
+        if self._q.full():
+            self.backpressure_waits += 1
+            obs.count("sched.writer.backpressure")
+        self._q.put((label, args))
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is _STOP:
+                self._q.task_done()
+                return
+            label, args = item
+            try:
+                self._write_one(label, args)
+            finally:
+                self._q.task_done()
+
+    def _write_one(self, label: str, args: Tuple[Any, ...]) -> None:
+        def _attempt() -> None:
+            chaos("sched.writer")
+            self._commit_fn(*args)
+
+        try:
+            with obs.span("sched.write_case", case=label):
+                supervised(_attempt, domain="sched.writer",
+                           policy=WRITE_RETRY_POLICY)
+            self.written += 1
+            obs.count("sched.writer.written")
+        except Exception as e:  # terminal: surfaced at close()
+            self.failures.append((label, repr(e)))
+            record_event("writer_failed", domain="sched.writer",
+                         capability="sched.writer", detail=f"{label}: {e!r}")
+
+    def drain(self) -> None:
+        """Block until every submitted case has been written (or failed)."""
+        if self._thread is not None:
+            self._q.join()
+
+    def close(self) -> List[Tuple[str, str]]:
+        """Drain, stop the worker, and return the per-case failures."""
+        if not self._closed:
+            self._closed = True
+            if self._thread is not None:
+                self._q.put(_STOP)
+                self._q.join()
+                self._thread.join(timeout=60)
+        return list(self.failures)
+
+    def __enter__(self) -> "CaseWriter":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()

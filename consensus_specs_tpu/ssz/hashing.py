@@ -77,6 +77,9 @@ def set_backend(fn: Optional[HashManyFn], name: str = "custom") -> None:
         DEVICE_MIN_BLOCKS = _DEFAULT_DEVICE_MIN_BLOCKS
         FUSED_ROOT_MIN_CHUNKS = _DEFAULT_FUSED_ROOT_MIN_CHUNKS
     else:
+        from ..sched import configure_compile_cache
+
+        configure_compile_cache()  # knob-gated; before the hasher's jits build
         _backend, _backend_name = fn, name
 
 

@@ -1,17 +1,21 @@
-"""Subprocess driver for the generator crash/resume drill
-(tests/test_gen_journal.py): generates the sanity/slots minimal suite
-into the given output dir. Run in a child process so the test can
-SIGKILL it mid-generation (via the chaos 'kill' injection) and then
-rerun it to prove journal-based resume yields a byte-identical tree."""
+"""Subprocess driver for the generator crash/resume drills
+(tests/test_gen_journal.py, tests/test_gen_sched.py): generates the
+sanity/slots minimal suite into the given output dir. Run in a child
+process so the tests can SIGKILL it mid-generation (via the chaos
+'kill' injection — at a case boundary or inside the overlap writer
+thread) and then rerun it to prove journal-based resume yields a
+byte-identical tree. Extra argv after the output dir passes through to
+run_generator (mode flags: --serial-writes, --flush-every, ...)."""
 from __future__ import annotations
 
 import os
 import sys
+from typing import List, Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def main(out_dir: str) -> None:
+def main(out_dir: str, extra_args: Optional[List[str]] = None) -> None:
     import tests.spec.test_sanity_slots as slots_src
     from consensus_specs_tpu.generators.gen_from_tests import generate_from_tests
     from consensus_specs_tpu.generators.gen_runner import run_generator
@@ -31,9 +35,9 @@ def main(out_dir: str) -> None:
     run_generator(
         "sanity",
         [TestProvider(prepare=lambda: None, make_cases=make)],
-        args=["-o", out_dir],
+        args=["-o", out_dir] + list(extra_args or []),
     )
 
 
 if __name__ == "__main__":
-    main(sys.argv[1])
+    main(sys.argv[1], sys.argv[2:])

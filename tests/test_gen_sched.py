@@ -1,0 +1,115 @@
+"""Pipeline equivalence + crash drills for the cross-case generation
+scheduler (docs/GENPIPE.md): a suite generated serial-undeferred must be
+byte-identical — per the digest journal AND the raw tree — to the same
+suite generated cross-case-bucketed-overlapped; killing the overlap
+writer thread mid-suite (chaos ``sched.writer=kill``) must resume from
+the journal to the same bytes."""
+from __future__ import annotations
+
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+
+import pytest
+
+from consensus_specs_tpu import resilience as r
+from consensus_specs_tpu.resilience import journal as journal_mod
+from consensus_specs_tpu.resilience.journal import CaseJournal
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+DRIVER = REPO / "tests" / "_gen_journal_driver.py"
+
+SERIAL_MODE = ["--serial-writes", "--flush-every", "1"]
+PIPELINED_MODE = ["--flush-every", "256"]  # overlap writer is the default
+
+
+def _run_driver(out_dir: pathlib.Path, mode, chaos: str = "") -> subprocess.CompletedProcess:
+    env = dict(os.environ)
+    env.pop("CONSENSUS_SPECS_TPU_CHAOS_STATE", None)
+    env.pop("CONSENSUS_SPECS_TPU_GEN_OVERLAP", None)
+    if chaos:
+        env[r.ENV_KNOB] = chaos
+    else:
+        env.pop(r.ENV_KNOB, None)
+    return subprocess.run(
+        [sys.executable, str(DRIVER), str(out_dir)] + list(mode),
+        env=env, cwd=str(REPO), capture_output=True, text=True, timeout=300,
+    )
+
+
+def _tree(root: pathlib.Path) -> dict:
+    skip = {journal_mod.JOURNAL_NAME, "testgen_error_log.txt"}
+    return {
+        str(p.relative_to(root)): p.read_bytes()
+        for p in sorted(root.rglob("*"))
+        if p.is_file() and p.name not in skip
+    }
+
+
+@pytest.fixture(scope="module")
+def serial_run(tmp_path_factory):
+    """The reference bytes: serial writes, per-case flush, no overlap."""
+    out = tmp_path_factory.mktemp("gen_serial")
+    proc = _run_driver(out, SERIAL_MODE)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    tree = _tree(out)
+    assert len(tree) >= 9
+    return out, tree
+
+
+def test_pipelined_mode_is_byte_identical(serial_run, tmp_path):
+    serial_out, serial_tree = serial_run
+    out = tmp_path / "vectors"
+    proc = _run_driver(out, PIPELINED_MODE)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    # the raw trees match bit-for-bit...
+    assert _tree(out) == serial_tree
+    # ...and the journals agree case-by-case on every part digest (the
+    # contract gen_bench and resumed runs rely on)
+    assert CaseJournal(out).entries() == CaseJournal(serial_out).entries()
+    assert len(CaseJournal(out).entries()) >= 3
+
+
+def test_writer_killed_mid_suite_resumes_byte_identical(serial_run, tmp_path):
+    """SIGKILL delivered INSIDE the overlap writer thread (3rd written
+    case): the run dies mid-pipeline with cases still queued; the rerun
+    admits only journal-verified cases and completes to the same bytes
+    the serial mode produces."""
+    _, serial_tree = serial_run
+    out = tmp_path / "vectors"
+    proc = _run_driver(out, PIPELINED_MODE, chaos="sched.writer=kill:1:2")
+    assert proc.returncode == -signal.SIGKILL, (
+        f"rc={proc.returncode}; stdout tail: {proc.stdout[-500:]}")
+    partial = _tree(out)
+    assert 0 < len(partial) < len(serial_tree), "the kill must land mid-run"
+
+    proc = _run_driver(out, PIPELINED_MODE)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "generating: " in proc.stdout  # some cases actually regenerated
+    assert _tree(out) == serial_tree
+
+
+def test_writer_transient_fault_retries_to_identical_bytes(serial_run, tmp_path):
+    """A transient write fault (injected EIO-class flake) retries inside
+    the supervised writer and the suite still lands byte-identical with
+    zero failed cases."""
+    _, serial_tree = serial_run
+    out = tmp_path / "vectors"
+    proc = _run_driver(out, PIPELINED_MODE, chaos="sched.writer=transient:2")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert " 0 failed" in proc.stdout or "0 failed" in proc.stdout
+    assert _tree(out) == serial_tree
+
+
+def test_writer_terminal_fault_counts_failed_and_heals(tmp_path):
+    """A deterministic writer fault surfaces as a FAILED case (exit 1,
+    error-logged) rather than silently dropped output; the rerun heals."""
+    out = tmp_path / "vectors"
+    proc = _run_driver(out, PIPELINED_MODE, chaos="sched.writer=deterministic:-1:2")
+    assert proc.returncode == 1, (proc.returncode, proc.stdout[-800:])
+    assert "writer failed terminally" in (out / "testgen_error_log.txt").read_text()
+    proc = _run_driver(out, PIPELINED_MODE)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert not list(out.rglob("INCOMPLETE"))

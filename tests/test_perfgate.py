@@ -38,7 +38,8 @@ def test_perfgate_appends_and_gates(tmp_path):
     summary = json.loads(summary_path.read_text())
     measured = summary["metrics"]
     assert set(measured) >= {"perfgate_hash_mibs", "perfgate_reroot_ms",
-                             "perfgate_epoch_kernel_ms"}
+                             "perfgate_epoch_kernel_ms",
+                             "perfgate_gen_pipeline_ms"}
 
     led = ledger_mod.Ledger(ledger_path)
     run = led.runs()[-1]
@@ -60,6 +61,29 @@ def test_perfgate_appends_and_gates(tmp_path):
     assert "gate FAILED" in proc.stdout
     # the regressed datapoint is still recorded as evidence
     assert len(led.series("perfgate_hash_mibs")) >= 5
+
+
+def test_slowed_gen_pipeline_fails_gate(tmp_path):
+    """The ISSUE-5 drill: the suite-generation throughput metric is
+    sentinel-gated — a chaos-slowed pipeline (3x) against an established
+    baseline flags ``regressed`` and fails `make perfgate`."""
+    ledger_path = str(tmp_path / "ledger.jsonl")
+    summary_path = tmp_path / "summary.json"
+    proc = _run(["--ledger", ledger_path, "--json", str(summary_path)])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    measured = json.loads(summary_path.read_text())["metrics"]
+
+    led = ledger_mod.Ledger(ledger_path)
+    base = measured["perfgate_gen_pipeline_ms"]
+    for i in range(sentinel.DEFAULT_POLICY.min_history):
+        led.record_run({"perfgate_gen_pipeline_ms": base * (1 + 0.01 * i)},
+                       source="perfgate", backend="host")
+
+    proc = _run(["--ledger", ledger_path],
+                env_extra={"CONSENSUS_SPECS_TPU_PERF_CHAOS": "gen_pipeline=3"})
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "regressed" in proc.stdout
+    assert "gate FAILED" in proc.stdout
 
 
 def test_environmental_gap_does_not_fail_gate(tmp_path):

@@ -1,0 +1,302 @@
+"""Unit coverage for the cross-case batch scheduler (consensus_specs_tpu/
+sched): the flush planner's canonical bucket shapes and pad accounting,
+the bounded supervised writer (ordering, backpressure, retry, terminal
+failure surfacing), the bucketed DeferredVerifier flush against a fake
+cold backend (including the chaos-degraded per-row fallback), and the
+persistent compile cache's knob resolution + real cross-process reuse."""
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from consensus_specs_tpu.sched import (
+    CaseWriter,
+    compile_cache,
+    plan_flush,
+    pow2_bucket,
+)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+# ---------------------------------------------------------------------------
+# bucketing
+# ---------------------------------------------------------------------------
+
+def test_pow2_bucket():
+    assert pow2_bucket(0) == 1
+    assert pow2_bucket(1) == 1
+    assert pow2_bucket(3) == 4
+    assert pow2_bucket(8) == 8
+    assert pow2_bucket(9) == 16
+    assert pow2_bucket(1, minimum=8) == 8
+    assert pow2_bucket(3, minimum=2) == 4
+    # non-pow2 minimum rounds up to the next pow2
+    assert pow2_bucket(1, minimum=6) == 8
+
+
+def test_plan_flush_groups_by_width_bucket():
+    # 1-key ops checks, 64-key attestation aggregates, 512-key sync rows:
+    # three K shapes, never cross-padded
+    widths = [1] * 10 + [64] * 5 + [512] * 2
+    plan = plan_flush(widths, min_rows=8, max_rows=128, min_keys=2)
+    ks = sorted(d.k_bucket for d in plan.dispatches)
+    assert ks == [2, 64, 512]
+    assert plan.total_rows == 17
+    # all indices covered exactly once
+    covered = sorted(i for d in plan.dispatches for i in d.indices)
+    assert covered == list(range(17))
+    # row padding to pow2 above the floor
+    by_k = {d.k_bucket: d for d in plan.dispatches}
+    assert by_k[2].row_bucket == 16 and by_k[2].pad_rows == 6
+    assert by_k[64].row_bucket == 8 and by_k[64].pad_rows == 3
+    # the O(#buckets) compile bound is visible in the plan
+    assert len(plan.shapes) == 3
+
+
+def test_plan_flush_chunks_under_row_cap():
+    plan = plan_flush([1] * 300, min_rows=8, max_rows=128, min_keys=2)
+    assert [d.rows for d in plan.dispatches] == [128, 128, 44]
+    # one compiled K shape; two row shapes (128 and the 64-pad tail)
+    assert {d.k_bucket for d in plan.dispatches} == {2}
+    assert plan.dispatches[-1].row_bucket == 64
+
+
+def test_plan_flush_pad_accounting():
+    plan = plan_flush([1, 1], min_rows=8, max_rows=128, min_keys=2)
+    (d,) = plan.dispatches
+    # 8 rows x 2 keys = 16 slots; 2 real pairs -> 87.5% padding
+    assert d.slot_waste_pct == 87.5
+    assert d.stats()["pad_rows"] == 6
+
+
+def test_plan_flush_empty_and_dedup_stat():
+    assert plan_flush([]).dispatches == []
+    assert plan_flush([1, 2], dedup_hits=7).stats()["dedup_hits"] == 7
+
+
+# ---------------------------------------------------------------------------
+# writer
+# ---------------------------------------------------------------------------
+
+def test_writer_preserves_submit_order_under_backpressure():
+    out = []
+
+    def slow_commit(i):
+        time.sleep(0.001)
+        out.append(i)
+
+    w = CaseWriter(slow_commit, maxsize=2)
+    for i in range(50):
+        w.submit(f"case{i}", i)
+    assert w.close() == []
+    assert out == list(range(50))
+    assert w.written == 50
+    assert w.backpressure_waits > 0  # the bound actually engaged
+
+
+def test_writer_retries_injected_transients():
+    from consensus_specs_tpu.resilience import inject
+
+    out = []
+    w = CaseWriter(out.append)
+    with inject("sched.writer", "transient", count=2):
+        w.submit("case0", "a")
+        assert w.close() == []
+    assert out == ["a"] and w.written == 1
+
+
+def test_writer_surfaces_terminal_failures():
+    calls = []
+
+    def commit(i):
+        calls.append(i)
+        if i == 1:
+            raise ValueError("disk on fire")
+
+    w = CaseWriter(commit)
+    for i in range(3):
+        w.submit(f"case{i}", i)
+    failures = w.close()
+    assert [label for label, _ in failures] == ["case1"]
+    assert "disk on fire" in failures[0][1]
+    assert w.written == 2  # the other cases still landed
+    # close() is idempotent and submit-after-close is refused
+    assert w.close() == failures
+    with pytest.raises(AssertionError):
+        w.submit("late", 9)
+
+
+def test_writer_runs_on_one_background_thread():
+    tids = set()
+    w = CaseWriter(lambda: tids.add(threading.get_ident()))
+    for i in range(5):
+        w.submit(f"c{i}")
+    w.close()
+    assert len(tids) == 1 and threading.get_ident() not in tids
+
+
+# ---------------------------------------------------------------------------
+# bucketed DeferredVerifier flush (fake cold backend)
+# ---------------------------------------------------------------------------
+
+class _FakeColdBackend:
+    """Reference-answering backend exposing the cold batch pipeline +
+    shape floors, recording the dispatched batch shapes."""
+
+    def __init__(self):
+        from consensus_specs_tpu.crypto.bls import ciphersuite
+
+        self._ref = ciphersuite
+        self.batches = []
+
+    def __getattr__(self, name):
+        return getattr(self._ref, name)
+
+    def cold_shape_floors(self):
+        return 4, 16, 2
+
+    def fast_aggregate_verify_batch_cold(self, pubkey_lists, messages, signatures):
+        self.batches.append([len(p) for p in pubkey_lists])
+        return [self._ref.FastAggregateVerify(list(p), m, s)
+                for p, m, s in zip(pubkey_lists, messages, signatures)]
+
+
+@pytest.fixture
+def fake_cold_backend(monkeypatch):
+    from consensus_specs_tpu.crypto import bls
+
+    fake = _FakeColdBackend()
+    monkeypatch.setattr(bls, "_backend", fake)
+    monkeypatch.setattr(bls, "_backend_name", "fake")
+    yield fake
+
+
+def test_flush_dispatches_per_width_bucket(fake_cold_backend):
+    from consensus_specs_tpu.crypto import bls
+
+    sks = list(range(1, 8))
+    pks = [bls.SkToPk(sk) for sk in sks]
+    msg = b"\x42" * 32
+    v = bls.DeferredVerifier()
+    with bls.deferring(v):
+        # width-1 rows (Verify) and width-5 rows (FastAggregateVerify)
+        for sk, pk in zip(sks[:4], pks[:4]):
+            assert bls.Verify(pk, msg, bls.Sign(sk, msg))
+        from consensus_specs_tpu.crypto.bls.fields import R as _R
+
+        agg_sk = sum(sks[:5]) % _R
+        assert bls.FastAggregateVerify(pks[:5], msg, bls.Sign(agg_sk, msg))
+        bad = bls.Sign(agg_sk + 1, msg)
+        assert bls.FastAggregateVerify(pks[:5], msg, bad)  # optimistic lie
+    v.flush()
+    assert v.results == [True] * 5 + [False]
+    # two width buckets -> two dispatches, never cross-padded
+    widths = sorted(tuple(sorted(b)) for b in fake_cold_backend.batches)
+    assert widths == [(1, 1, 1, 1), (5, 5)]
+
+
+def test_flush_dedups_repeated_checks(fake_cold_backend):
+    from consensus_specs_tpu.crypto import bls
+
+    sk, msg = 5, b"\x33" * 32
+    pk, sig = bls.SkToPk(sk), None
+    v = bls.DeferredVerifier()
+    with bls.deferring(v):
+        sig = bls.Sign(sk, msg)
+        for _ in range(6):  # the same check recorded by six "cases"
+            assert bls.Verify(pk, msg, sig)
+    v.flush()
+    assert v.results == [True] * 6
+    assert sum(len(b) for b in fake_cold_backend.batches) == 1  # one row total
+
+
+def test_flush_bucket_chaos_degrades_to_per_row(fake_cold_backend):
+    from consensus_specs_tpu.crypto import bls
+    from consensus_specs_tpu.resilience import inject
+
+    sk, msg = 9, b"\x77" * 32
+    pk = bls.SkToPk(sk)
+    v = bls.DeferredVerifier()
+    with bls.deferring(v):
+        assert bls.Verify(pk, msg, bls.Sign(sk, msg))
+        assert bls.Verify(pk, msg, bls.Sign(sk + 1, msg))  # actually invalid
+    with inject("sched.flush", "deterministic", count=1):
+        v.flush()
+    # the bucket dispatch failed; the per-row oracle path still answered
+    assert v.results == [True, False]
+
+
+# ---------------------------------------------------------------------------
+# compile cache
+# ---------------------------------------------------------------------------
+
+def test_compile_cache_knob_resolution(monkeypatch):
+    monkeypatch.delenv(compile_cache.COMPILE_CACHE_ENV, raising=False)
+    monkeypatch.delenv(compile_cache.LEGACY_CACHE_ENV, raising=False)
+    assert compile_cache.resolve_dir() == ""
+    assert compile_cache.resolve_dir(enable_by_default=True) \
+        == compile_cache.default_dir()
+    monkeypatch.setenv(compile_cache.COMPILE_CACHE_ENV, "off")
+    assert compile_cache.resolve_dir(enable_by_default=True) == ""
+    monkeypatch.setenv(compile_cache.COMPILE_CACHE_ENV, "1")
+    assert compile_cache.resolve_dir() == compile_cache.default_dir()
+    monkeypatch.setenv(compile_cache.COMPILE_CACHE_ENV, "/tmp/somewhere")
+    assert compile_cache.resolve_dir() == "/tmp/somewhere"
+    # explicit argument beats the env
+    assert compile_cache.resolve_dir("/tmp/else") == "/tmp/else"
+    # legacy knob honored when the new one is unset
+    monkeypatch.delenv(compile_cache.COMPILE_CACHE_ENV, raising=False)
+    monkeypatch.setenv(compile_cache.LEGACY_CACHE_ENV, "/tmp/legacy")
+    assert compile_cache.resolve_dir() == "/tmp/legacy"
+
+
+_CACHE_CHILD = r"""
+import json, os, sys
+sys.path.insert(0, {repo!r})
+from consensus_specs_tpu.sched import compile_cache as cc
+d = cc.configure_compile_cache({cache_dir!r}, min_compile_secs=0.0)
+assert d, "cache did not configure"
+import jax, jax.numpy as jnp
+val = int(jax.jit(lambda x: (x * 3 + 1).sum())(jnp.arange(257)))
+print(json.dumps({{"val": val, "stats": cc.compile_cache_stats()}}))
+"""
+
+
+def test_compile_cache_cross_process_reuse(tmp_path):
+    """Two fresh processes compile the same kernel: the first misses and
+    populates the cache, the second HITS — and the hit lands as a
+    sched.compile_cache instant in the armed trace."""
+    cache_dir = str(tmp_path / "xla-cache")
+    trace_dir = tmp_path / "trace"
+    env = dict(os.environ)
+    env.pop("CONSENSUS_SPECS_TPU_COMPILE_CACHE", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["CONSENSUS_SPECS_TPU_TRACE"] = str(trace_dir)
+    script = _CACHE_CHILD.format(repo=str(REPO), cache_dir=cache_dir)
+
+    outs = []
+    for _ in range(2):
+        proc = subprocess.run([sys.executable, "-c", script], env=env,
+                              capture_output=True, text=True, timeout=240)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        outs.append(json.loads(proc.stdout.strip().splitlines()[-1]))
+
+    assert outs[0]["val"] == outs[1]["val"]
+    assert outs[0]["stats"]["requests"] >= 1
+    assert outs[1]["stats"]["hits"] >= 1, outs
+    # the hit is visible in the trace (the obs instant the report tallies)
+    events = []
+    for f in trace_dir.glob("spans-*.jsonl"):
+        for line in f.read_text().splitlines():
+            rec = json.loads(line)
+            if rec.get("name") == "sched.compile_cache":
+                events.append(rec["attrs"]["event"])
+    assert "hit" in events and "request" in events

@@ -65,6 +65,56 @@ def test_truncated_span_file_reports_committed_spans(tmp_path, capsys):
     assert "torn" not in out
 
 
+def test_sched_bucket_table_and_cache_tally(tmp_path, capsys):
+    """The ISSUE-5 satellite table: sched.flush_bucket instants render a
+    per-bucket row (rows, pad, slot waste) joined with the bucket
+    dispatch span's compile/execute split, and sched.compile_cache
+    instants tally hit/miss traffic."""
+    d = tmp_path / "trace"
+    d.mkdir()
+    records = [
+        {"type": "span", "trace": "t", "span": "1.1", "parent": None,
+         "name": "sched.flush.k64", "ts": 1.0, "dur": 900000.0, "pid": 1,
+         "tid": 1, "attrs": {"jit_phase": "first_call", "k": 64, "rows": 5}},
+        {"type": "span", "trace": "t", "span": "1.2", "parent": None,
+         "name": "sched.flush.k64", "ts": 2e6, "dur": 40000.0, "pid": 1,
+         "tid": 1, "attrs": {"jit_phase": "steady", "k": 64, "rows": 8}},
+        {"type": "instant", "trace": "t", "span": "1.1", "name": "sched.flush_bucket",
+         "ts": 1.5, "pid": 1, "tid": 1,
+         "attrs": {"k": 64, "rows": 5, "row_bucket": 8, "pad_rows": 3,
+                   "slot_waste_pct": 40.0}},
+        {"type": "instant", "trace": "t", "span": "1.2", "name": "sched.flush_bucket",
+         "ts": 2.1e6, "pid": 1, "tid": 1,
+         "attrs": {"k": 64, "rows": 8, "row_bucket": 8, "pad_rows": 0,
+                   "slot_waste_pct": 10.0}},
+        {"type": "instant", "trace": "t", "span": "1.1", "name": "sched.compile_cache",
+         "ts": 1.1, "pid": 1, "tid": 1, "attrs": {"event": "request"}},
+        {"type": "instant", "trace": "t", "span": "1.2", "name": "sched.compile_cache",
+         "ts": 2.0e6, "pid": 1, "tid": 1, "attrs": {"event": "request"}},
+        {"type": "instant", "trace": "t", "span": "1.2", "name": "sched.compile_cache",
+         "ts": 2.0e6, "pid": 1, "tid": 1, "attrs": {"event": "hit"}},
+    ]
+    with open(d / "spans-1-s.jsonl", "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+    summary = trace_report.summarize(trace_report.load_records(d))
+    (bucket,) = summary["sched_flush_buckets"]
+    assert bucket["k"] == 64 and bucket["dispatches"] == 2
+    assert bucket["rows"] == 13 and bucket["pad_rows"] == 3
+    assert bucket["slot_waste_pct"] == 25.0  # mean of the two dispatches
+    assert bucket["first_call_ms"] == 900.0
+    assert bucket["steady_p50_ms"] == 40.0
+    assert bucket["compile_ms_est"] == 860.0
+    assert summary["compile_cache"] == {"requests": 2, "hits": 1, "misses": 1}
+
+    rc = trace_report.main([str(d)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "sched flush buckets" in out
+    assert "k=64" in out and "25.0% slot waste" in out
+    assert "compile cache: 1 hit(s) / 1 miss(es)" in out
+
+
 def test_degenerate_span_records_do_not_traceback(tmp_path, capsys):
     # committed-but-minimal records (no name/dur/pid): still a report
     d = tmp_path / "trace"

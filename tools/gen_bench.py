@@ -1,0 +1,163 @@
+"""Generation-pipeline bench: the minimal-preset operations suite
+generated in three modes, digests proven byte-identical, the speedup
+banked in the perf ledger.
+
+Usage:
+    python tools/gen_bench.py [--ledger P] [--json OUT] [--quick]
+
+Modes (all host-only, reference BLS — the number banks even with no
+device; the device path's bucket amortization rides the same scheduler
+and is measured by bench.py's generation section):
+
+- ``strict``    synchronous signature checks, serial inline writes —
+                the pre-pipeline shape;
+- ``percase``   ``--bls-defer --flush-every 1 --serial-writes`` — checks
+                defer but every case flushes its own tiny batch (the
+                per-case dispatch shape the round-5 verdict called out);
+- ``pipelined`` ``--bls-defer`` cross-case bucketed flush + the bounded
+                overlap writer — the sched pipeline (docs/GENPIPE.md).
+
+After the timed passes, the three output trees' digest journals are
+compared case-by-case: every mode must commit byte-identical parts
+(the resume/journal contract), or this tool exits 2 — a speedup that
+changes bytes is a bug, not a win.
+
+Ledger keys (source="gen_bench", backend="host"):
+    gen_pipeline_strict_s / gen_pipeline_percase_s /
+    gen_pipeline_pipelined_s / gen_pipeline_speedup
+``gen_pipeline_speedup`` = percase / pipelined — cross-case bucketing +
+overlapped serialization vs the per-case flush shape on identical work.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import pathlib
+import shutil
+import sys
+import tempfile
+import time
+from typing import Dict, List, Optional, Tuple
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from consensus_specs_tpu.resilience.journal import CaseJournal  # noqa: E402
+
+_HANDLERS: Tuple[Tuple[str, str], ...] = (
+    ("attestation", "tests.spec.test_operations_attestation"),
+    ("voluntary_exit", "tests.spec.test_operations_voluntary_exit"),
+)
+
+MODES: Dict[str, List[str]] = {
+    "strict": ["--serial-writes", "--flush-every", "1"],
+    "percase": ["--bls-defer", "--flush-every", "1", "--serial-writes"],
+    "pipelined": ["--bls-defer"],
+}
+
+
+def _providers(handlers):
+    from consensus_specs_tpu.generators.gen_from_tests import generate_from_tests
+    from consensus_specs_tpu.generators.gen_typing import TestProvider
+
+    def make_cases(handler_name: str, mod_name: str):
+        def cases():
+            yield from generate_from_tests(
+                runner_name="operations", handler_name=handler_name,
+                src=importlib.import_module(mod_name),
+                fork_name="phase0", preset_name="minimal", bls_active=True)
+
+        return cases
+
+    return [TestProvider(prepare=lambda: None, make_cases=make_cases(h, m))
+            for h, m in handlers]
+
+
+def run_mode(mode: str, out_dir: str, handlers) -> float:
+    """One timed generation pass; returns wall seconds."""
+    from consensus_specs_tpu.crypto import bls
+    from consensus_specs_tpu.generators.gen_runner import run_generator
+
+    bls.use_reference()
+    t0 = time.perf_counter()
+    run_generator("operations", _providers(handlers),
+                  args=["-o", out_dir] + MODES[mode])
+    return time.perf_counter() - t0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--ledger", default=None,
+                        help="perf-ledger path (default: the shared repo "
+                             "ledger; 'off' skips banking)")
+    parser.add_argument("--json", dest="json_path", type=pathlib.Path,
+                        default=None, help="also write the summary as JSON")
+    parser.add_argument("--quick", action="store_true",
+                        help="voluntary_exit handler only (fast smoke)")
+    ns = parser.parse_args(argv)
+
+    handlers = _HANDLERS[1:] if ns.quick else _HANDLERS
+
+    # spec-module compile happens once per process: pay it here so the
+    # FIRST timed mode isn't charged for what later modes get cached
+    from consensus_specs_tpu.specs import build
+
+    build.prebuild(forks=("phase0",), presets=("minimal",))
+
+    seconds: Dict[str, float] = {}
+    digests: Dict[str, Dict[str, Dict[str, str]]] = {}
+    for mode in MODES:
+        out = tempfile.mkdtemp(prefix=f"gen_bench_{mode}_")
+        try:
+            seconds[mode] = round(run_mode(mode, out, handlers), 3)
+            digests[mode] = CaseJournal(pathlib.Path(out)).entries()
+            print(f"gen_bench: {mode:<10} {seconds[mode]:7.2f}s  "
+                  f"({len(digests[mode])} journaled cases)")
+        finally:
+            shutil.rmtree(out, ignore_errors=True)
+
+    # byte-identity across ALL modes, via the journal's per-part digests
+    base = digests["strict"]
+    for mode in ("percase", "pipelined"):
+        if digests[mode] != base:
+            diff = {c for c in set(base) ^ set(digests[mode])}
+            diff |= {c for c in base
+                     if c in digests[mode] and digests[mode][c] != base[c]}
+            print(f"gen_bench: DIGEST MISMATCH strict vs {mode}: "
+                  f"{sorted(diff)[:10]}")
+            return 2
+    print(f"gen_bench: digests byte-identical across {len(MODES)} modes "
+          f"({len(base)} cases)")
+
+    speedup = (round(seconds["percase"] / seconds["pipelined"], 3)
+               if seconds["pipelined"] else None)
+    metrics = {
+        "gen_pipeline_strict_s": seconds["strict"],
+        "gen_pipeline_percase_s": seconds["percase"],
+        "gen_pipeline_pipelined_s": seconds["pipelined"],
+        "gen_pipeline_speedup": speedup,
+    }
+    print(f"gen_bench: pipelined vs per-case flush speedup: {speedup}x")
+
+    summary = {"metrics": metrics, "cases": len(base),
+               "handlers": [h for h, _ in handlers]}
+    if (ns.ledger or "").strip().lower() not in ("off", "none", "0"):
+        from consensus_specs_tpu.obs import ledger as ledger_mod
+
+        path = ns.ledger or ledger_mod.default_path()
+        if path:
+            run_id = ledger_mod.Ledger(path).record_run(
+                metrics, source="gen_bench", backend="host",
+                extra={"cases": len(base)})
+            summary["ledger"] = {"path": path, "run_id": run_id}
+            print(f"gen_bench: banked as {run_id} -> {path}")
+
+    if ns.json_path is not None:
+        with open(ns.json_path, "w") as f:
+            json.dump(summary, f, indent=2, sort_keys=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,7 +15,16 @@ jax import, no device, no tunnel):
                               backing's O(log n) path);
 - ``perfgate_epoch_kernel_ms`` the engine's flag-delta arithmetic over a
                               synthetic 2^17-validator registry (numpy
-                              host kernel — the SoA epoch hot loop).
+                              host kernel — the SoA epoch hot loop);
+- ``perfgate_gen_pipeline_ms`` a deterministic synthetic suite pushed
+                              through the REAL generation pipeline
+                              (encode -> INCOMPLETE sentinel -> overlap
+                              writer -> fsync'd journal) in cross-case
+                              overlapped mode, plus the sched flush
+                              planner over a mixed-width check
+                              population — the suite-generation
+                              throughput the sentinel watches from
+                              round 6 on (docs/GENPIPE.md).
 
 Each run appends one ledger run (git sha + environment fingerprint) and
 is classified by :mod:`consensus_specs_tpu.obs.sentinel` against the
@@ -148,10 +157,70 @@ def measure_epoch_kernel_ms() -> float:
     return _timed(run, repeats=3) * 1e3 * _chaos_factor("perfgate_epoch_kernel_ms")
 
 
+def measure_gen_pipeline_ms() -> float:
+    """The generation pipeline end-to-end on host, device-free: a
+    deterministic 96-case synthetic suite through run_generator's real
+    commit machinery (part encode, INCOMPLETE sentinel, the bounded
+    overlap writer, the fsync'd digest journal), plus the sched flush
+    planner over a realistic mixed-width check population. Watches the
+    per-case pipeline overhead the cross-case scheduler exists to
+    amortize — a slowed writer/journal/planner regresses this number."""
+    import contextlib
+    import io
+    import shutil
+    import tempfile
+
+    from consensus_specs_tpu.generators.gen_runner import run_generator
+    from consensus_specs_tpu.generators.gen_typing import TestCase, TestProvider
+    from consensus_specs_tpu.sched import plan_flush
+
+    n_cases = 96
+    rng = np.random.default_rng(13)
+    payloads = [rng.bytes(4096) for _ in range(n_cases)]
+
+    def make_cases():
+        for i in range(n_cases):
+            def case_fn(i=i, payload=payloads[i]):
+                return [
+                    ("pre", "ssz", payload),
+                    ("post", "ssz", payload[::-1]),
+                    ("roots", "data", {"i": i, "tag": "gen_pipeline"}),
+                ]
+
+            yield TestCase(
+                fork_name="phase0", preset_name="minimal",
+                runner_name="gen_pipeline", handler_name="bench",
+                suite_name="pyspec_tests", case_name=f"case_{i}",
+                case_fn=case_fn)
+
+    times = []
+    for _ in range(2):
+        out = tempfile.mkdtemp(prefix="perfgate_genpipe_")
+        try:
+            provider = TestProvider(prepare=lambda: None, make_cases=make_cases)
+            t0 = time.perf_counter()
+            with contextlib.redirect_stdout(io.StringIO()):
+                run_generator("gen_pipeline", [provider], args=["-o", out])
+            times.append(time.perf_counter() - t0)
+        finally:
+            shutil.rmtree(out, ignore_errors=True)
+
+    # the planner slice: block-shaped widths (attestation aggregates,
+    # single-key ops, 512-key sync committees), 50 plans
+    widths = ([1] * 512 + [64] * 128 + [512] * 8) * 2
+    t0 = time.perf_counter()
+    for _ in range(50):
+        plan_flush(widths, min_rows=8, max_rows=128, min_keys=2)
+    plan_ms = (time.perf_counter() - t0) * 1e3 / 50
+
+    return (min(times) * 1e3 + plan_ms) * _chaos_factor("perfgate_gen_pipeline_ms")
+
+
 MEASUREMENTS: Tuple[Tuple[str, Callable[[], float]], ...] = (
     ("perfgate_hash_mibs", measure_hash_mibs),
     ("perfgate_reroot_ms", measure_reroot_ms),
     ("perfgate_epoch_kernel_ms", measure_epoch_kernel_ms),
+    ("perfgate_gen_pipeline_ms", measure_gen_pipeline_ms),
 )
 
 

@@ -1,6 +1,7 @@
 """Summarize an obs trace: top spans by self-time, jit compile-vs-
 execute split, resilience retry/quarantine tally, per-fork generator
-case latency percentiles.
+case latency percentiles, the sched flush's per-bucket pad/compile
+table, and the persistent compile cache's hit traffic.
 
 Usage:
     python tools/trace_report.py <trace-dir | trace.json> [--json <path>]
@@ -105,6 +106,47 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         for fork, vals in sorted(gen.items())
     }
 
+    # --- sched flush buckets: pad waste measured, not guessed — one row
+    # per (k, row_bucket) shape, joined with its dispatch span's jit
+    # split (the sched.flush.k<k> kernel spans)
+    buckets: Dict[tuple, Dict[str, Any]] = {}
+    for i in instants:
+        if i.get("name") != "sched.flush_bucket":
+            continue
+        a = i.get("attrs") or {}
+        key = (int(a.get("k") or 0), int(a.get("row_bucket") or 0))
+        acc2 = buckets.setdefault(key, {
+            "k": key[0], "row_bucket": key[1], "dispatches": 0,
+            "rows": 0, "pad_rows": 0, "waste_pcts": []})
+        acc2["dispatches"] += 1
+        acc2["rows"] += int(a.get("rows") or 0)
+        acc2["pad_rows"] += int(a.get("pad_rows") or 0)
+        if a.get("slot_waste_pct") is not None:
+            acc2["waste_pcts"].append(float(a["slot_waste_pct"]))
+    sched_buckets = []
+    for key in sorted(buckets):
+        b = buckets[key]
+        split = jit_split.get(f"sched.flush.k{b['k']}", {})
+        sched_buckets.append({
+            "k": b["k"], "row_bucket": b["row_bucket"],
+            "dispatches": b["dispatches"], "rows": b["rows"],
+            "pad_rows": b["pad_rows"],
+            "slot_waste_pct": (round(sum(b["waste_pcts"]) / len(b["waste_pcts"]), 2)
+                               if b["waste_pcts"] else None),
+            "first_call_ms": split.get("first_call_ms"),
+            "steady_p50_ms": split.get("steady_p50_ms"),
+            "compile_ms_est": split.get("compile_ms_est"),
+        })
+
+    # --- persistent compile cache traffic (sched.compile_cache instants:
+    # every request that found a cached executable skipped its compile)
+    cache_requests = sum(1 for i in instants
+                         if i.get("name") == "sched.compile_cache"
+                         and (i.get("attrs") or {}).get("event") == "request")
+    cache_hits = sum(1 for i in instants
+                     if i.get("name") == "sched.compile_cache"
+                     and (i.get("attrs") or {}).get("event") == "hit")
+
     n_pids = len({s.get("pid") for s in spans})
     return {
         "spans": len(spans),
@@ -120,6 +162,12 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "resilience_events": tally,
         "chaos_hits": chaos_hits,
         "gen_case_latency_by_fork": gen_pcts,
+        "sched_flush_buckets": sched_buckets,
+        "compile_cache": {
+            "requests": cache_requests,
+            "hits": cache_hits,
+            "misses": max(0, cache_requests - cache_hits),
+        },
     }
 
 
@@ -150,6 +198,23 @@ def print_summary(summary: Dict[str, Any]) -> None:
         for fork, e in summary["gen_case_latency_by_fork"].items():
             print(f"  {fork}: {e['cases']} cases  p50 {e['p50_ms']}ms  "
                   f"p90 {e['p90_ms']}ms  p99 {e['p99_ms']}ms")
+    if summary.get("sched_flush_buckets"):
+        print("\nsched flush buckets (rows x keys shapes, pad measured):")
+        for b in summary["sched_flush_buckets"]:
+            split = ""
+            if b.get("first_call_ms") is not None:
+                split = (f"  first_call {b['first_call_ms']}ms"
+                         f" steady p50 {b['steady_p50_ms']}ms")
+                if b.get("compile_ms_est") is not None:
+                    split += f" compile~{b['compile_ms_est']}ms"
+            print(f"  k={b['k']:<4} rows<={b['row_bucket']:<4} "
+                  f"{b['dispatches']} dispatch(es)  {b['rows']} rows "
+                  f"(+{b['pad_rows']} pad, {b['slot_waste_pct']}% slot waste)"
+                  f"{split}")
+    cache = summary.get("compile_cache") or {}
+    if cache.get("requests"):
+        print(f"\ncompile cache: {cache['hits']} hit(s) / "
+              f"{cache['misses']} miss(es) over {cache['requests']} request(s)")
 
 
 def main(argv=None) -> int:

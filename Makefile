@@ -23,7 +23,8 @@ DEVICE_TESTS = tests/test_bls_device.py tests/test_curve_device.py \
                tests/test_multichip.py
 
 .PHONY: test citest test-fast test-device test-mainnet lint docs generate_tests gen_% replay bench \
-        dryrun detect_generator_incomplete clean-vectors chaos trace perfgate perf-report gen-bench help
+        dryrun detect_generator_incomplete clean-vectors chaos trace perfgate perf-report gen-bench \
+        warm-cache serve serve-smoke serve-bench help
 
 # the fault-injection suite: supervisor/taxonomy units, chaos replay
 # (tampered vectors), induced backend failures, generator crash/resume
@@ -46,6 +47,10 @@ help:
 	@echo "perfgate              host-only micro-bench slice -> $(LEDGER); FAILS on a sentinel-confirmed regression"
 	@echo "perf-report           render the perf ledger trajectory -> perf-report.html (+ stdout summary)"
 	@echo "gen-bench             generation-pipeline bench: operations suite in 3 modes, byte-identity proven, speedup -> $(LEDGER)"
+	@echo "warm-cache            prebuild the spec matrix + prime the persistent XLA compile cache (standalone warm start)"
+	@echo "serve                 run the resident verification daemon (docs/SERVE.md; Ctrl-C drains)"
+	@echo "serve-smoke           boot the daemon, drive 4 concurrent clients, scrape /metrics, assert clean SIGTERM drain"
+	@echo "serve-bench           concurrent-client serving bench: p50/p99 latency + verifies/s -> $(LEDGER)"
 
 # parallelize like the reference (ref Makefile:100-106) when pytest-xdist
 # is present; degrade to single-process so the suite stays runnable cold
@@ -64,6 +69,7 @@ citest:
 	$(if $(fork),,$(error citest requires fork=<name>, e.g. make citest fork=phase0))
 	$(PYTHON) -m pytest tests/spec -q --fork $(fork) $(if $(engine),--engine $(engine))
 	$(MAKE) trace
+	$(MAKE) serve-smoke
 	$(MAKE) perfgate
 
 trace:
@@ -85,6 +91,22 @@ perf-report:
 # journals compared byte-for-byte, the speedup banked in the ledger
 gen-bench:
 	$(PYTHON) tools/gen_bench.py --ledger $(LEDGER)
+
+# standalone warm start (ROADMAP #2's first half): the spec matrix +
+# persistent XLA compile cache the resident daemon primes at startup,
+# payable ahead of time by CI or an operator (docs/SERVE.md)
+warm-cache:
+	CONSENSUS_SPECS_TPU_COMPILE_CACHE=$(COMPILE_CACHE) $(PYTHON) tools/warm_cache.py $(WARM_FLAGS)
+
+# the resident verification service (docs/SERVE.md)
+serve:
+	CONSENSUS_SPECS_TPU_COMPILE_CACHE=$(COMPILE_CACHE) $(PYTHON) -m consensus_specs_tpu.serve --port 8799 --verbose
+
+serve-smoke:
+	$(PYTHON) tools/serve_smoke.py
+
+serve-bench:
+	$(PYTHON) tools/serve_bench.py --ledger $(LEDGER)
 
 test-fast:
 	$(PYTHON) -m pytest tests/ -q $(addprefix --ignore=,$(DEVICE_TESTS)) $(PYTEST_EXTRA)

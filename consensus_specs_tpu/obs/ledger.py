@@ -108,10 +108,12 @@ def infer_unit(metric: str) -> Optional[str]:
         return "ms"
     if metric.endswith("_us"):
         return "us"
+    # rates before the bare "_s" suffix: serve_verifies_per_s is a rate,
+    # not seconds (polarity inverts on this distinction)
+    if "per_sec" in metric or "per_s" in metric or metric.endswith("_rate"):
+        return "/s"
     if metric.endswith("_s") or metric.endswith("_seconds"):
         return "s"
-    if "per_sec" in metric or metric.endswith("_rate"):
-        return "/s"
     if "speedup" in metric or metric == "vs_baseline":
         return "x"
     return None

@@ -22,6 +22,10 @@ Sites are plain strings; the convention is plane.point:
   hash.dispatch  gen.case  bench.section  dryrun.child  replay.case
   sched.flush (per bucket dispatch of the cross-case deferred flush)
   sched.writer (per case written by the overlap writer thread)
+  serve.request (per request executed by the resident daemon)
+  serve.flush (per cross-client micro-batch dispatched by the daemon's
+               flusher thread; a fault here degrades that batch to the
+               host oracle — docs/SERVE.md)
 
 ``chaos(site)`` is a no-op dict probe when nothing is armed — cheap
 enough for hot paths.

@@ -1,0 +1,40 @@
+"""Resident verification service — "specs as a service" (docs/SERVE.md).
+
+The sched/ plane (PR 5) gave the repo cross-request shape-bucketed BLS
+batching, a persistent compile cache, and overlapped serialization —
+but its only client was the offline suite generator. This package
+promotes it to a long-lived daemon: the spec matrix stays built, the
+XLA cache stays warm, and a bounded request queue feeds the SAME
+bucketed flush across *concurrent clients* — the continuous-batching
+shape inference stacks use to amortize compilation and dispatch.
+
+- :mod:`protocol` — the versioned JSON wire contract (v1), shared by
+  daemon, client, and tools.
+- :mod:`batcher` — bounded queue + micro-batcher: per-request futures,
+  cross-client accumulation into ``DeferredVerifier`` /
+  ``sched.bucketing.plan_flush`` dispatches, admission-control 429s,
+  a bounded pure-function result cache, host-oracle degradation for a
+  faulted batch (chaos site ``serve.flush``).
+- :mod:`service` — wire methods → spec paths (verify / hash_tree_root /
+  process_block + batched variants), bit-identical to the direct path
+  by construction; chaos site ``serve.request``.
+- :mod:`daemon` — localhost HTTP front-end, ``/metrics`` +
+  ``/healthz`` + ``/readyz``, SIGTERM drain that answers every accepted
+  request; ``python -m consensus_specs_tpu.serve`` CLI.
+- :mod:`lifecycle` — warm start (compile cache + spec matrix + opt-in
+  jit probes), shared with ``make warm-cache``.
+- :mod:`client` — stdlib client used by tests and the bench/smoke
+  tools (``tools/serve_bench.py``, ``tools/serve_smoke.py``).
+
+Perf evidence: ``make serve-bench`` banks ``serve_p50_ms`` /
+``serve_p99_ms`` / ``serve_verifies_per_s`` in the ledger;
+``make perfgate`` gates ``perfgate_serve_rtt_ms`` on the sentinel.
+"""
+from __future__ import annotations
+
+from .batcher import Draining, QueueFull, VerifyBatcher  # noqa: F401
+from .client import ServeClient, ServeError  # noqa: F401
+from .daemon import ServeDaemon  # noqa: F401
+from .lifecycle import warm_start  # noqa: F401
+from .protocol import WIRE_VERSION, RequestError  # noqa: F401
+from .service import SpecService  # noqa: F401

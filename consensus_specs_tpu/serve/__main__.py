@@ -1,0 +1,9 @@
+"""``python -m consensus_specs_tpu.serve`` — run the resident daemon."""
+from __future__ import annotations
+
+import sys
+
+from .daemon import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,307 @@
+"""Bounded request queue + micro-batcher: the continuous-batching core
+of the resident verification service.
+
+Concurrent clients submit individual signature checks; a single flusher
+thread accumulates them (up to ``max_batch`` rows or a ``linger_ms``
+window, whichever fills first) and dispatches the whole accumulation as
+ONE cross-client flush through the facade's ``DeferredVerifier`` — the
+same dedup + ``sched.bucketing.plan_flush`` canonical-bucket pipeline
+the offline generator uses, so a request mix of 1-key exits and 512-key
+sync aggregates compiles O(#buckets) programs and pads nothing to the
+widest row (docs/GENPIPE.md). Per-request futures resolve when their
+flush lands.
+
+Admission control: the queue is bounded (``max_queue``); a submit
+against a full queue raises :class:`QueueFull` immediately (the daemon
+maps it to a 429) instead of queueing unbounded work — counted under
+``serve.rejected`` so backpressure is visible in /metrics.
+
+Result cache: a verify check is a pure function of its key (the same
+rationale that lets the flush dedup rows), so resolved answers populate
+a bounded LRU keyed by check key. Repeat traffic — the validator
+registry repeats across a workload — is answered at queue-free latency
+and counted under ``serve.cache_hits``.
+
+Degradation: the flush body runs under ``resilience.supervised`` with
+the per-row host oracle as fallback — a chaos-injected or real backend
+fault mid-flight (site ``serve.flush``) degrades THAT batch to the
+always-correct reference path; concurrent clients still get bit-exact
+answers, and the event lands in the trace. Faults inside a single row's
+oracle evaluation answer that row ``False`` (the facade's invalid-input
+contract) without poisoning the batch.
+
+Drain: ``drain()`` closes intake (later submits raise
+:class:`Draining`), flushes every accepted entry, resolves every
+future, and joins the flusher thread — no accepted check is ever
+dropped or dispatched twice (each entry is popped exactly once).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+from .. import obs
+from ..resilience import chaos, record_event, supervised
+
+DEFAULT_MAX_QUEUE = 1024
+DEFAULT_MAX_BATCH = 256
+DEFAULT_LINGER_MS = 5.0
+DEFAULT_CACHE_SIZE = 4096
+
+
+class QueueFull(Exception):
+    """Admission control: the bounded queue is at capacity."""
+
+
+class Draining(Exception):
+    """Intake is closed: the daemon is shutting down."""
+
+
+class _Pending:
+    """One accepted check: resolved exactly once by the flusher."""
+
+    __slots__ = ("key", "done", "result", "error", "t_submit")
+
+    def __init__(self, key: Tuple) -> None:
+        self.key = key
+        self.done = threading.Event()
+        self.result: Optional[bool] = None
+        self.error: Optional[BaseException] = None
+        self.t_submit = time.monotonic()
+
+    def resolve(self, result: bool) -> None:
+        self.result = result
+        self.done.set()
+
+    def fail(self, error: BaseException) -> None:
+        self.error = error
+        self.done.set()
+
+
+class VerifyBatcher:
+    """The bounded queue + flusher thread. One instance per daemon."""
+
+    def __init__(
+        self,
+        *,
+        max_queue: int = DEFAULT_MAX_QUEUE,
+        max_batch: int = DEFAULT_MAX_BATCH,
+        linger_ms: float = DEFAULT_LINGER_MS,
+        cache_size: int = DEFAULT_CACHE_SIZE,
+    ) -> None:
+        self.max_queue = max(1, int(max_queue))
+        self.max_batch = max(1, int(max_batch))
+        self.linger_s = max(0.0, float(linger_ms)) / 1e3
+        self.cache_size = max(0, int(cache_size))
+        self._q: Deque[_Pending] = deque()
+        self._cond = threading.Condition()
+        self._cache: "OrderedDict[Tuple, bool]" = OrderedDict()
+        self._closing = False
+        self._thread: Optional[threading.Thread] = None
+        self.stats_lock = threading.Lock()
+        self.accepted = 0
+        self.rejected = 0
+        self.cache_hits = 0
+        self.flushes = 0
+        self.flushed_rows = 0
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "VerifyBatcher":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="serve-flusher", daemon=True)
+            self._thread.start()
+        return self
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Close intake, flush everything accepted, join the flusher.
+        Returns True when the queue fully drained within the timeout."""
+        with self._cond:
+            self._closing = True
+            self._cond.notify_all()
+        t = self._thread
+        if t is not None:
+            t.join(timeout_s)
+        with self._cond:
+            return not self._q and (t is None or not t.is_alive())
+
+    @property
+    def draining(self) -> bool:
+        return self._closing
+
+    def depth(self) -> int:
+        with self._cond:
+            return len(self._q)
+
+    def cache_stats(self) -> Dict[str, int]:
+        with self.stats_lock:
+            return {"size": len(self._cache), "hits": self.cache_hits,
+                    "capacity": self.cache_size}
+
+    # -- intake --------------------------------------------------------
+
+    def submit(self, key: Tuple, timeout_s: Optional[float] = None) -> bool:
+        """Submit one check key (the DeferredVerifier key shape) and
+        block until its flush resolves. Raises :class:`QueueFull` /
+        :class:`Draining` at admission time, TimeoutError if the result
+        does not land within ``timeout_s``."""
+        if self.cache_size:
+            with self.stats_lock:
+                cached = self._cache.get(key)
+                if cached is not None:
+                    self._cache.move_to_end(key)
+                    self.cache_hits += 1
+            if cached is not None:
+                obs.count("serve.cache_hits")
+                return cached
+        pending = self._enqueue([key])[0]
+        return self._await(pending, timeout_s)
+
+    def submit_many(self, keys: List[Tuple],
+                    timeout_s: Optional[float] = None) -> List[bool]:
+        """Batched submit: all-or-nothing admission (a 429 must never
+        leave half a client batch queued), one future per key."""
+        results: Dict[int, bool] = {}
+        misses: List[Tuple[int, Tuple]] = []
+        if self.cache_size:
+            with self.stats_lock:
+                for i, key in enumerate(keys):
+                    cached = self._cache.get(key)
+                    if cached is None:
+                        misses.append((i, key))
+                    else:
+                        self._cache.move_to_end(key)
+                        self.cache_hits += 1
+                        results[i] = cached
+        else:
+            misses = list(enumerate(keys))
+        if results:
+            obs.count("serve.cache_hits", len(results))
+        if misses:
+            pendings = self._enqueue([k for _, k in misses])
+            for (i, _), pending in zip(misses, pendings):
+                results[i] = self._await(pending, timeout_s)
+        return [results[i] for i in range(len(keys))]
+
+    def _enqueue(self, keys: List[Tuple]) -> List[_Pending]:
+        with self._cond:
+            if self._closing:
+                raise Draining("serve batcher is draining")
+            if len(self._q) + len(keys) > self.max_queue:
+                with self.stats_lock:
+                    self.rejected += len(keys)
+                obs.count("serve.rejected", len(keys))
+                raise QueueFull(
+                    f"verify queue full ({len(self._q)}/{self.max_queue})")
+            pendings = [_Pending(k) for k in keys]
+            self._q.extend(pendings)
+            with self.stats_lock:
+                self.accepted += len(keys)
+            obs.count("serve.accepted", len(keys))
+            self._cond.notify_all()
+        return pendings
+
+    @staticmethod
+    def _await(pending: _Pending, timeout_s: Optional[float]) -> bool:
+        if not pending.done.wait(timeout_s):
+            raise TimeoutError("verify result did not land in time")
+        if pending.error is not None:
+            raise pending.error
+        assert pending.result is not None
+        return pending.result
+
+    # -- the flusher thread --------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            batch = self._collect()
+            if not batch:
+                return  # closing and empty: done
+            self._flush(batch)
+
+    def _collect(self) -> List[_Pending]:
+        """Block for the first entry, then linger up to ``linger_s`` for
+        the batch to fill (skipped when closing: drain flushes at full
+        speed). Pops at most ``max_batch`` entries — each exactly once."""
+        with self._cond:
+            while not self._q and not self._closing:
+                self._cond.wait()
+            if self._q and not self._closing and self.linger_s > 0:
+                deadline = time.monotonic() + self.linger_s
+                while len(self._q) < self.max_batch and not self._closing:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(remaining)
+            batch = [self._q.popleft()
+                     for _ in range(min(len(self._q), self.max_batch))]
+        return batch
+
+    def _flush(self, batch: List[_Pending]) -> None:
+        t0 = time.monotonic()
+        for p in batch:
+            obs.observe("serve.queue_wait_ms", (t0 - p.t_submit) * 1e3)
+
+        def dispatch() -> Dict[Tuple, bool]:
+            chaos("serve.flush")
+            from ..crypto import bls
+
+            verifier = bls.DeferredVerifier()
+            for p in batch:
+                verifier.record(p.key)
+            verifier.flush()
+            return verifier.table()
+
+        with obs.span("serve.flush", rows=len(batch)):
+            try:
+                table = supervised(
+                    dispatch, domain="serve.flush",
+                    fallback=lambda: self._oracle_flush(batch))
+            except BaseException as e:  # a fallback that itself failed
+                for p in batch:
+                    p.fail(e)
+                return
+        with self.stats_lock:
+            self.flushes += 1
+            self.flushed_rows += len(batch)
+        obs.count("serve.flushes")
+        obs.count("serve.flush_rows", len(batch))
+        obs.observe("serve.flush_ms", (time.monotonic() - t0) * 1e3)
+        if self.cache_size:
+            with self.stats_lock:
+                for key, result in table.items():
+                    self._cache[key] = result
+                    self._cache.move_to_end(key)
+                while len(self._cache) > self.cache_size:
+                    self._cache.popitem(last=False)
+        for p in batch:
+            p.resolve(bool(table[p.key]))
+
+    @staticmethod
+    def _oracle_flush(batch: List[_Pending]) -> Dict[Tuple, bool]:
+        """Per-row host-oracle degradation: answer every check straight
+        from the reference ciphersuite (never the installed backend — it
+        just faulted). A row the oracle rejects-by-raising is False, the
+        facade's invalid-input contract."""
+        from ..crypto.bls import ciphersuite as oracle
+
+        ops = {"v": oracle.Verify, "fav": oracle.FastAggregateVerify,
+               "av": oracle.AggregateVerify}
+        record_event("fallback", domain="serve.flush", capability="serve.flush",
+                     detail=f"batch of {len(batch)} degraded to the host oracle")
+        obs.count("serve.flush_degraded")
+        table: Dict[Tuple, bool] = {}
+        for p in batch:
+            if p.key in table:
+                continue
+            kind, a, b, sig = p.key
+            try:
+                table[p.key] = bool(ops[kind](
+                    list(a) if isinstance(a, tuple) else a,
+                    list(b) if isinstance(b, tuple) else b, sig))
+            except Exception:
+                table[p.key] = False
+        return table

@@ -1,0 +1,143 @@
+"""Minimal stdlib client for the serve wire contract — the consumer
+side used by tests, tools/serve_bench.py and tools/serve_smoke.py (and
+a reasonable starting point for real callers; the contract itself is
+documented in docs/SERVE.md, this is just http.client plumbing).
+
+One :class:`ServeClient` holds ONE keep-alive connection and is NOT
+thread-safe — each concurrent client thread owns its own instance,
+which is exactly the N-clients shape the daemon's micro-batcher
+amortizes across.
+"""
+from __future__ import annotations
+
+import http.client
+import json
+import socket
+from typing import Any, Dict, List, Optional, Sequence
+
+from . import protocol
+
+
+class ServeError(Exception):
+    """A structured error response from the daemon."""
+
+    def __init__(self, status: int, code: str, message: str) -> None:
+        super().__init__(f"[{status}/{code}] {message}")
+        self.status = status
+        self.code = code
+        self.message = message
+
+
+class ServeClient:
+    def __init__(self, port: int, host: str = "127.0.0.1",
+                 timeout_s: float = 120.0) -> None:
+        self.host = host
+        self.port = port
+        self.timeout_s = timeout_s
+        self._conn: Optional[http.client.HTTPConnection] = None
+
+    # -- plumbing ------------------------------------------------------
+
+    def _connection(self) -> http.client.HTTPConnection:
+        if self._conn is None:
+            self._conn = http.client.HTTPConnection(
+                self.host, self.port, timeout=self.timeout_s)
+            self._conn.connect()
+            # see daemon._Handler.disable_nagle_algorithm: without this a
+            # loopback round-trip stalls ~40ms in delayed-ACK territory
+            self._conn.sock.setsockopt(
+                socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return self._conn
+
+    def close(self) -> None:
+        if self._conn is not None:
+            self._conn.close()
+            self._conn = None
+
+    def __enter__(self) -> "ServeClient":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+    def _roundtrip(self, method: str, path: str,
+                   body: Optional[Dict[str, Any]] = None) -> Any:
+        conn = self._connection()
+        payload = protocol.dumps(body) if body is not None else None
+        headers = {"Content-Type": "application/json"} if payload else {}
+        try:
+            conn.request(method, path, body=payload, headers=headers)
+            resp = conn.getresponse()
+            raw = resp.read()
+        except Exception:
+            self.close()  # a torn connection must not poison the next call
+            raise
+        if path == "/metrics":
+            if resp.status != 200:
+                raise ServeError(resp.status, protocol.INTERNAL,
+                                 raw.decode(errors="replace")[:200])
+            return raw.decode()
+        try:
+            obj = json.loads(raw.decode())
+        except ValueError:
+            raise ServeError(resp.status, protocol.INTERNAL,
+                             f"non-JSON response: {raw[:200]!r}")
+        if isinstance(obj, dict) and obj.get("ok") is False:
+            err = obj.get("error") or {}
+            raise ServeError(resp.status, err.get("code", protocol.INTERNAL),
+                             err.get("message", ""))
+        return obj
+
+    def call(self, method: str, params: Dict[str, Any]) -> Dict[str, Any]:
+        return self._roundtrip("POST", protocol.route_for(method), params)
+
+    # -- the wire methods ----------------------------------------------
+
+    def verify(self, *, pubkeys: Optional[Sequence[bytes]] = None,
+               pubkey: Optional[bytes] = None,
+               message: Optional[bytes] = None,
+               messages: Optional[Sequence[bytes]] = None,
+               signature: bytes) -> bool:
+        params: Dict[str, Any] = {"signature": protocol.to_hex(signature)}
+        if pubkey is not None:
+            params["pubkey"] = protocol.to_hex(pubkey)
+        if pubkeys is not None:
+            params["pubkeys"] = [protocol.to_hex(p) for p in pubkeys]
+        if message is not None:
+            params["message"] = protocol.to_hex(message)
+        if messages is not None:
+            params["messages"] = [protocol.to_hex(m) for m in messages]
+        return bool(self.call("verify", params)["valid"])
+
+    def verify_batch(self, checks: List[Dict[str, Any]]) -> List[bool]:
+        return list(self.call("verify_batch", {"checks": checks})["results"])
+
+    def hash_tree_root(self, fork: str, preset: str, type_name: str,
+                       ssz_bytes: bytes) -> bytes:
+        out = self.call("hash_tree_root", {
+            "fork": fork, "preset": preset, "type": type_name,
+            "ssz": protocol.to_hex(ssz_bytes)})
+        return protocol.from_hex(out["root"], "root")
+
+    def process_block(self, fork: str, preset: str, pre_ssz: bytes,
+                      block_ssz: bytes) -> Dict[str, bytes]:
+        out = self.call("process_block", {
+            "fork": fork, "preset": preset,
+            "pre": protocol.to_hex(pre_ssz),
+            "block": protocol.to_hex(block_ssz)})
+        return {"post": protocol.from_hex(out["post"], "post"),
+                "root": protocol.from_hex(out["root"], "root")}
+
+    # -- observability -------------------------------------------------
+
+    def metrics(self) -> str:
+        return self._roundtrip("GET", "/metrics")
+
+    def health(self) -> Dict[str, Any]:
+        return self._roundtrip("GET", "/healthz")
+
+    def ready(self) -> bool:
+        try:
+            return bool(self._roundtrip("GET", "/readyz").get("ready"))
+        except (ServeError, OSError):
+            return False

@@ -1,0 +1,381 @@
+"""The resident daemon: localhost HTTP front-end over
+:class:`~.service.SpecService`, lifecycle management, clean drain.
+
+Endpoints (wire contract v1 — docs/SERVE.md):
+
+- ``POST /v1/<method>`` — verify / verify_batch / hash_tree_root /
+  hash_tree_root_batch / process_block (JSON bodies, protocol.py).
+- ``GET /metrics`` — ``obs.metrics.prometheus_text()``: every
+  ``serve.*`` counter plus the auto-maintained ``span.*`` latency
+  histograms (p50/p90/p99 summaries).
+- ``GET /healthz`` — health JSON: backend, quarantine state, queue
+  depth/capacity, result+compile cache stats, served matrix, uptime.
+- ``GET /readyz`` — 200 once the matrix is prebuilt and the flusher
+  runs; 503 while starting or draining (load-balancer semantics).
+
+Drain: SIGTERM/SIGINT flips the daemon to ``draining`` — new POSTs get
+a structured 503, requests already accepted (including every check
+sitting in the verify queue) complete and are answered, the batcher
+flushes to empty, and the process exits 0. The drill in
+tests/test_serve_drain.py SIGTERMs a daemon with a deliberately full
+queue and asserts every accepted request got its answer — none
+dropped, none double-dispatched.
+
+A request handler thread is tracked while a request is in flight so the
+drain can wait for the tail; an idle keep-alive connection holds no
+in-flight slot and never blocks shutdown.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+from .. import obs
+from . import protocol
+from .batcher import Draining, QueueFull, VerifyBatcher
+from .service import DEFAULT_FORKS, DEFAULT_PRESETS, SpecService
+
+MAX_BODY_BYTES = 64 << 20  # a mainnet BeaconState is ~tens of MiB
+
+ENV_MAX_QUEUE = "CONSENSUS_SPECS_TPU_SERVE_MAX_QUEUE"
+ENV_MAX_BATCH = "CONSENSUS_SPECS_TPU_SERVE_MAX_BATCH"
+ENV_LINGER_MS = "CONSENSUS_SPECS_TPU_SERVE_LINGER_MS"
+ENV_CACHE = "CONSENSUS_SPECS_TPU_SERVE_RESULT_CACHE"
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """One instance per request (http.server contract); the daemon hangs
+    off the server object."""
+
+    protocol_version = "HTTP/1.1"
+    # loopback request/response ping-pong: Nagle + delayed ACK adds ~40ms
+    # per round-trip; the payloads are single writes, so just disable it
+    disable_nagle_algorithm = True
+
+    # -- plumbing ------------------------------------------------------
+
+    def log_message(self, fmt: str, *args: Any) -> None:
+        if self.server.daemon_ref.verbose:  # type: ignore[attr-defined]
+            sys.stderr.write("serve: %s\n" % (fmt % args))
+
+    def _send_json(self, status: int, payload: Dict[str, Any]) -> None:
+        body = protocol.dumps(payload)
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_text(self, status: int, text: str,
+                   content_type: str = "text/plain; charset=utf-8") -> None:
+        body = text.encode()
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    # -- routes --------------------------------------------------------
+
+    def do_GET(self) -> None:  # noqa: N802 (http.server API)
+        daemon = self.server.daemon_ref  # type: ignore[attr-defined]
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            self._send_text(200, obs.prometheus_text(),
+                            "text/plain; version=0.0.4; charset=utf-8")
+        elif path == "/healthz":
+            self._send_json(200, daemon.service.health(draining=daemon.draining))
+        elif path == "/readyz":
+            ready = daemon.service.ready and not daemon.draining
+            self._send_json(200 if ready else 503,
+                            {"ready": ready,
+                             "status": "draining" if daemon.draining
+                             else "ready" if daemon.service.ready
+                             else "starting"})
+        else:
+            self._send_json(404, protocol.error_response(
+                protocol.NOT_FOUND, f"no route {path!r}"))
+
+    def do_POST(self) -> None:  # noqa: N802 (http.server API)
+        daemon = self.server.daemon_ref  # type: ignore[attr-defined]
+        path = self.path.split("?", 1)[0]
+        method = protocol.method_for(path)
+        if method is None:
+            self._send_json(404, protocol.error_response(
+                protocol.NOT_FOUND, f"no method at {path!r}"))
+            return
+        if daemon.draining:
+            obs.count("serve.rejected_draining")
+            self._send_json(503, protocol.error_response(
+                protocol.DRAINING, "daemon is draining; request not accepted"))
+            return
+        with daemon.track_request():
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+                if length > MAX_BODY_BYTES:
+                    raise protocol.bad_request(
+                        f"body too large ({length} > {MAX_BODY_BYTES})")
+                params = protocol.loads(self.rfile.read(length))
+                protocol.check_version(params)
+                result = daemon.service.handle(method, params)
+            except protocol.RequestError as e:
+                obs.count("serve.errors.bad_request")
+                self._send_json(e.http_status,
+                                protocol.error_response(e.code, e.message))
+            except QueueFull as e:
+                self._send_json(429, protocol.error_response(
+                    protocol.QUEUE_FULL, str(e)))
+            except Draining as e:
+                self._send_json(503, protocol.error_response(
+                    protocol.DRAINING, str(e)))
+            except Exception as e:
+                from ..resilience import classify, record_event
+
+                kind = classify(e)
+                record_event("gave_up", domain="serve.request", kind=kind,
+                             detail=f"{type(e).__name__}: {e}")
+                obs.count("serve.errors.internal")
+                self._send_json(500, protocol.error_response(
+                    protocol.INTERNAL,
+                    f"[{kind}] {type(e).__name__}: {e}"))
+            else:
+                obs.count("serve.responses")
+                self._send_json(200, protocol.ok_response(result))
+
+
+class _Server(ThreadingHTTPServer):
+    daemon_threads = True
+    # socketserver's default listen backlog is 5: a burst of N concurrent
+    # clients connecting at once gets RSTs on some boxes (observed: 16
+    # simultaneous connects -> 3 ECONNRESET). A serving daemon wants a
+    # real accept queue.
+    request_queue_size = 128
+    daemon_ref: "ServeDaemon"
+
+
+class ServeDaemon:
+    """Owns the HTTP server + service lifecycle. Usable in-process (tests,
+    perfgate) or as the __main__ CLI process."""
+
+    def __init__(
+        self,
+        service: Optional[SpecService] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        verbose: bool = False,
+    ) -> None:
+        self.service = service or SpecService()
+        self.host = host
+        self.requested_port = port
+        self.verbose = verbose
+        self.draining = False
+        self._server: Optional[_Server] = None
+        self._serve_thread: Optional[threading.Thread] = None
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self._inflight_zero = threading.Event()
+        self._inflight_zero.set()
+
+    # -- in-flight accounting ------------------------------------------
+
+    def track_request(self) -> "_Tracked":
+        return _Tracked(self)
+
+    def _enter(self) -> None:
+        with self._inflight_lock:
+            self._inflight += 1
+            self._inflight_zero.clear()
+
+    def _leave(self) -> None:
+        with self._inflight_lock:
+            self._inflight -= 1
+            if self._inflight == 0:
+                self._inflight_zero.set()
+
+    @property
+    def inflight(self) -> int:
+        with self._inflight_lock:
+            return self._inflight
+
+    # -- lifecycle -----------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        assert self._server is not None, "start() first"
+        return self._server.server_address[1]
+
+    def start(self, warm: bool = True) -> "ServeDaemon":
+        """Bind, warm, prebuild, serve. Returns self once /readyz is
+        green."""
+        self._server = _Server((self.host, self.requested_port), _Handler)
+        self._server.daemon_ref = self
+        if warm:
+            from .lifecycle import warm_start
+
+            report = warm_start(self.service.forks, self.service.presets,
+                                jit_probe=False)
+            if self.verbose:
+                sys.stderr.write(f"serve: warm start {report}\n")
+        self.service.start()
+        self._serve_thread = threading.Thread(
+            target=self._server.serve_forever, name="serve-http", daemon=True)
+        self._serve_thread.start()
+        obs.count("serve.started")
+        return self
+
+    def drain(self, timeout_s: float = 30.0) -> Dict[str, Any]:
+        """Stop intake, answer the tail, flush the queue, stop serving.
+        Idempotent. Returns a drain report."""
+        if self.draining and self._server is None:
+            return {"already": True}
+        self.draining = True
+        self.service.stop()
+        t0 = time.monotonic()
+        # order matters: verify handlers block on futures the batcher
+        # resolves — flush the queue FIRST, then wait for the tail of
+        # in-flight handler threads to write their responses
+        queue_drained = self.service.batcher.drain(timeout_s)
+        tail_done = self._inflight_zero.wait(
+            max(0.1, timeout_s - (time.monotonic() - t0)))
+        server, self._server = self._server, None
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        if self._serve_thread is not None:
+            self._serve_thread.join(5)
+        report = {
+            "inflight_answered": tail_done,
+            "queue_drained": queue_drained,
+            "drain_s": round(time.monotonic() - t0, 3),
+            "accepted": self.service.batcher.accepted,
+            # == accepted iff every accepted check was dispatched exactly
+            # once (the no-drop / no-double-dispatch drill reads this)
+            "flushed_rows": self.service.batcher.flushed_rows,
+            "rejected": self.service.batcher.rejected,
+        }
+        obs.count("serve.drained")
+        return report
+
+
+class _Tracked:
+    __slots__ = ("_daemon",)
+
+    def __init__(self, daemon: ServeDaemon) -> None:
+        self._daemon = daemon
+
+    def __enter__(self) -> None:
+        self._daemon._enter()
+
+    def __exit__(self, *exc: Any) -> None:
+        self._daemon._leave()
+
+
+# ---------------------------------------------------------------------------
+# CLI: python -m consensus_specs_tpu.serve
+# ---------------------------------------------------------------------------
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m consensus_specs_tpu.serve",
+        description="resident spec verification daemon (docs/SERVE.md)")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0,
+                        help="0 = ephemeral (printed on the READY line)")
+    parser.add_argument("--forks", default=",".join(DEFAULT_FORKS),
+                        help="comma-separated served forks")
+    parser.add_argument("--presets", default=",".join(DEFAULT_PRESETS),
+                        help="comma-separated served presets")
+    parser.add_argument("--backend", default="reference",
+                        choices=("reference", "jax"),
+                        help="BLS backend (jax degrades to reference when "
+                             "unavailable, with a recorded event)")
+    parser.add_argument("--max-queue", type=int,
+                        default=int(_env_float(ENV_MAX_QUEUE, 1024)))
+    parser.add_argument("--max-batch", type=int,
+                        default=int(_env_float(ENV_MAX_BATCH, 256)))
+    parser.add_argument("--linger-ms", type=float,
+                        default=_env_float(ENV_LINGER_MS, 5.0))
+    parser.add_argument("--result-cache", type=int,
+                        default=int(_env_float(ENV_CACHE, 4096)))
+    parser.add_argument("--no-warm", action="store_true",
+                        help="skip the compile-cache/jit warm start")
+    parser.add_argument("--jit-probe", action="store_true",
+                        help="also prime small per-plane kernels at startup")
+    parser.add_argument("--ready-file", default=None,
+                        help="write {port,pid} JSON here once ready")
+    parser.add_argument("--drain-timeout-s", type=float, default=30.0)
+    parser.add_argument("--verbose", action="store_true")
+    ns = parser.parse_args(argv)
+
+    from ..crypto import bls
+
+    batcher = VerifyBatcher(max_queue=ns.max_queue, max_batch=ns.max_batch,
+                            linger_ms=ns.linger_ms, cache_size=ns.result_cache)
+    service = SpecService(
+        forks=tuple(f for f in ns.forks.split(",") if f),
+        presets=tuple(p for p in ns.presets.split(",") if p),
+        batcher=batcher)
+    daemon = ServeDaemon(service, host=ns.host, port=ns.port,
+                         verbose=ns.verbose)
+
+    if ns.backend == "jax":
+        bls.use_jax()  # degrades to reference + recorded event if broken
+    if ns.jit_probe and not ns.no_warm:
+        from .lifecycle import warm_start
+
+        warm_start(service.forks, service.presets, jit_probe=True)
+        daemon.start(warm=False)
+    else:
+        daemon.start(warm=not ns.no_warm)
+
+    stop = threading.Event()
+
+    def _on_signal(signum: int, frame: Any) -> None:
+        sys.stderr.write(f"serve: signal {signum} -> draining\n")
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    # operator escape hatch: SIGUSR2 dumps every thread's stack to
+    # stderr (a resident process should be debuggable without gdb)
+    import faulthandler
+
+    faulthandler.register(signal.SIGUSR2, all_threads=True)
+
+    ready_line = (f"SERVE READY port={daemon.port} pid={os.getpid()} "
+                  f"backend={bls.backend_name()} "
+                  f"matrix={','.join(service.matrix_labels())}")
+    print(ready_line, flush=True)
+    if ns.ready_file:
+        tmp = f"{ns.ready_file}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"port": daemon.port, "pid": os.getpid(),
+                       "backend": bls.backend_name()}, f)
+        os.replace(tmp, ns.ready_file)
+
+    # NOT a bare stop.wait(): the kernel may deliver SIGTERM to any
+    # non-blocking thread, and Python-level handlers only ever run on
+    # the MAIN thread — which a bare Event.wait() parks in an
+    # uninterruptible lock acquire (observed: a daemon with busy
+    # handler threads ignored SIGTERM forever). Waking every 200ms
+    # guarantees pending handlers run within one tick.
+    while not stop.is_set():
+        stop.wait(0.2)
+    report = daemon.drain(ns.drain_timeout_s)
+    print(f"SERVE DRAINED {json.dumps(report, sort_keys=True)}", flush=True)
+    return 0 if (report.get("queue_drained", True)
+                 and report.get("inflight_answered", True)) else 1

@@ -1,0 +1,126 @@
+"""Warm start: everything a resident daemon (or CI) pays ONCE so no
+request ever does — shared by the daemon's startup and the standalone
+``make warm-cache`` (tools/warm_cache.py).
+
+Three stages, each skippable and each reported:
+
+1. **compile cache** — point jax's persistent compilation cache at the
+   shared directory (sched/compile_cache.py) BEFORE any backend builds
+   its jits, so executables compiled by any prior process load instead
+   of compile.
+2. **spec matrix** — ``specs.build.prebuild`` of the served fork×preset
+   slice (each build lands a ``spec.build`` span).
+3. **jit probe** (opt-in) — run a small representative kernel per
+   accelerated plane (the ssz device hasher, the engine delta kernel)
+   so their XLA programs land in the persistent cache while nobody is
+   waiting. The big BLS pairing graphs are deliberately NOT compiled
+   here by default: minutes of cold compile belong to an explicit
+   ``--bls-shapes`` opt-in, not to every daemon start on a laptop.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional, Sequence
+
+from .. import obs
+
+
+def warm_start(
+    forks: Optional[Sequence[str]] = None,
+    presets: Sequence[str] = ("minimal",),
+    *,
+    compile_cache: bool = True,
+    jit_probe: bool = False,
+    bls_shapes: bool = False,
+) -> Dict[str, Any]:
+    """Prime caches; return a report of what got warm. Never raises for
+    an optional stage — a cold cache is a slower first request, not a
+    startup failure."""
+    from ..specs import build
+
+    report: Dict[str, Any] = {}
+    if compile_cache:
+        from ..sched import compile_cache as cc
+
+        cache_dir = cc.configure_compile_cache(enable_by_default=True)
+        report["compile_cache_dir"] = cache_dir or None
+
+    t0 = time.perf_counter()
+    forks = list(forks) if forks is not None else build.available_forks()
+    built = build.prebuild(forks=forks, presets=presets)
+    report["spec_modules"] = built
+    report["spec_matrix_s"] = round(time.perf_counter() - t0, 3)
+
+    if jit_probe:
+        report["jit_probe"] = _jit_probe(bls_shapes=bls_shapes)
+    return report
+
+
+def _jit_probe(bls_shapes: bool = False) -> Dict[str, Any]:
+    """Compile one small kernel per accelerated plane into the (already
+    configured) persistent cache. Returns per-plane status strings."""
+    out: Dict[str, Any] = {}
+    with obs.span("serve.warm.jit_probe"):
+        try:
+            import jax.numpy as jnp
+
+            (jnp.arange(8) * 2).block_until_ready()
+            out["jax"] = "ok"
+        except Exception as e:
+            out["jax"] = f"unavailable: {e!r}"
+            return out
+        try:
+            import numpy as np
+
+            from ..ops import sha256 as dev_hash
+
+            dev_hash.hash_many_device(np.zeros((8, 64), dtype=np.uint8).tobytes())
+            out["hash"] = "ok"
+        except Exception as e:
+            out["hash"] = f"skipped: {e!r}"
+        try:
+            import numpy as np
+
+            from ..engine import stages
+
+            n = 1 << 8
+            stages._flag_deltas(
+                np.full(n, 32, dtype=np.uint64),
+                np.zeros(n, dtype=bool), np.ones(n, dtype=bool),
+                25_000, 14, 0, n * 32, 64, False, True)
+            out["engine"] = "ok"
+        except Exception as e:
+            out["engine"] = f"skipped: {e!r}"
+        if bls_shapes:
+            out["bls"] = _warm_bls_shapes()
+    return out
+
+
+def _warm_bls_shapes() -> str:
+    """Opt-in: compile the smallest canonical BLS bucket shape (rows and
+    keys at the planner floors) so a device daemon's first flush loads
+    the pairing executable from cache. Minutes cold; seconds warm."""
+    try:
+        from ..crypto import bls
+        from ..crypto.bls import ciphersuite as oracle
+
+        prev = bls.backend_name()
+        bls.use_jax()
+        try:
+            if bls.backend_name() != "jax":
+                return "jax backend unavailable (quarantined or unimportable)"
+            sks = [1, 2]
+            pks = [oracle.SkToPk(sk) for sk in sks]
+            msg = b"\x42" * 32
+            from ..crypto.bls.fields import R as _R
+
+            sig = oracle.Sign(sum(sks) % _R, msg)
+            verifier = bls.DeferredVerifier()
+            verifier.record(("fav", tuple(pks), msg, sig))
+            verifier.flush()
+            return "ok" if all(verifier.results) else "verify returned False"
+        finally:
+            if prev == "reference":
+                bls.use_reference()
+    except Exception as e:
+        return f"failed: {e!r}"

@@ -1,0 +1,180 @@
+"""The serve wire contract, version 1 (docs/SERVE.md).
+
+JSON bodies over localhost HTTP. Every response carries ``v`` (the wire
+version) and ``ok``; successful responses carry the method result,
+failures an ``error`` object::
+
+    {"v": 1, "ok": true,  ...result fields...}
+    {"v": 1, "ok": false, "error": {"code": "...", "message": "..."}}
+
+Error codes map onto HTTP statuses (and, for faults, onto the
+resilience taxonomy so a client can tell a bad request from a degraded
+backend):
+
+    bad_request   400  malformed params / undecodable SSZ / unknown type
+    not_found     404  unknown route or method
+    queue_full    429  admission control: the bounded verify queue is full
+    draining      503  daemon is shutting down; request was NOT accepted
+    internal      500  a fault the service could not degrade around
+
+This module is pure stdlib and imported by both sides of the socket
+(daemon and client) plus the bench/smoke tools — the contract lives in
+exactly one place.
+"""
+from __future__ import annotations
+
+import binascii
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+WIRE_VERSION = 1
+
+# route prefix for versioned methods; bumping WIRE_VERSION bumps this
+API_PREFIX = f"/v{WIRE_VERSION}"
+
+# method name -> route (POST). GET routes: /metrics /healthz /readyz
+METHODS = ("verify", "verify_batch", "hash_tree_root",
+           "hash_tree_root_batch", "process_block")
+
+BAD_REQUEST = "bad_request"
+NOT_FOUND = "not_found"
+QUEUE_FULL = "queue_full"
+DRAINING = "draining"
+INTERNAL = "internal"
+
+HTTP_STATUS = {
+    BAD_REQUEST: 400,
+    NOT_FOUND: 404,
+    QUEUE_FULL: 429,
+    DRAINING: 503,
+    INTERNAL: 500,
+}
+
+
+class RequestError(Exception):
+    """A request the service rejects — carries the wire error code."""
+
+    def __init__(self, code: str, message: str) -> None:
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+    @property
+    def http_status(self) -> int:
+        return HTTP_STATUS.get(self.code, 500)
+
+
+def bad_request(message: str) -> RequestError:
+    return RequestError(BAD_REQUEST, message)
+
+
+# ---------------------------------------------------------------------------
+# encoding helpers (hex on the wire, bytes in the service)
+# ---------------------------------------------------------------------------
+
+def to_hex(data: bytes) -> str:
+    return "0x" + bytes(data).hex()
+
+
+def from_hex(value: Any, field: str) -> bytes:
+    if not isinstance(value, str):
+        raise bad_request(f"{field}: expected a hex string")
+    raw = value[2:] if value.startswith("0x") else value
+    try:
+        return binascii.unhexlify(raw)
+    except (binascii.Error, ValueError) as e:
+        raise bad_request(f"{field}: invalid hex ({e})")
+
+
+def hex_list(value: Any, field: str) -> List[bytes]:
+    if not isinstance(value, (list, tuple)):
+        raise bad_request(f"{field}: expected a list of hex strings")
+    return [from_hex(v, f"{field}[{i}]") for i, v in enumerate(value)]
+
+
+# ---------------------------------------------------------------------------
+# verify-check parsing: wire params -> the facade's deferred-check key
+# (the same key shape crypto.bls.DeferredVerifier records, so the served
+# path and the direct path dedup/bucket/dispatch identically)
+# ---------------------------------------------------------------------------
+
+def parse_check(params: Dict[str, Any], field: str = "params") -> Tuple:
+    """One verify check -> a DeferredVerifier key:
+
+    - ``{"pubkey", "message", "signature"}``              -> ``("v", ...)``
+    - ``{"pubkeys", "message", "signature"}``             -> ``("fav", ...)``
+    - ``{"pubkeys", "messages", "signature"}``            -> ``("av", ...)``
+    """
+    if not isinstance(params, dict):
+        raise bad_request(f"{field}: expected an object")
+    sig = from_hex(params.get("signature"), f"{field}.signature")
+    if "pubkey" in params:
+        return ("v", from_hex(params["pubkey"], f"{field}.pubkey"),
+                from_hex(params.get("message"), f"{field}.message"), sig)
+    if "pubkeys" not in params:
+        raise bad_request(f"{field}: needs 'pubkey' or 'pubkeys'")
+    pks = tuple(hex_list(params["pubkeys"], f"{field}.pubkeys"))
+    if "messages" in params:
+        msgs = tuple(hex_list(params["messages"], f"{field}.messages"))
+        if len(msgs) != len(pks):
+            raise bad_request(f"{field}: len(messages) != len(pubkeys)")
+        return ("av", pks, msgs, sig)
+    if not pks:
+        raise bad_request(f"{field}.pubkeys: must be non-empty")
+    return ("fav", pks, from_hex(params.get("message"), f"{field}.message"), sig)
+
+
+def require_str(params: Dict[str, Any], field: str) -> str:
+    value = params.get(field)
+    if not isinstance(value, str) or not value:
+        raise bad_request(f"{field}: expected a non-empty string")
+    return value
+
+
+# ---------------------------------------------------------------------------
+# response envelopes
+# ---------------------------------------------------------------------------
+
+def ok_response(result: Dict[str, Any]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {"v": WIRE_VERSION, "ok": True}
+    out.update(result)
+    return out
+
+
+def error_response(code: str, message: str) -> Dict[str, Any]:
+    return {"v": WIRE_VERSION, "ok": False,
+            "error": {"code": code, "message": message[:800]}}
+
+
+def dumps(obj: Dict[str, Any]) -> bytes:
+    return json.dumps(obj, sort_keys=True).encode()
+
+
+def loads(body: bytes) -> Dict[str, Any]:
+    try:
+        obj = json.loads(body.decode())
+    except (ValueError, UnicodeDecodeError) as e:
+        raise bad_request(f"body is not valid JSON ({e})")
+    if not isinstance(obj, dict):
+        raise bad_request("body must be a JSON object")
+    return obj
+
+
+def check_version(obj: Dict[str, Any]) -> None:
+    """Bodies MAY pin ``v``; a mismatched pin is a bad request (the route
+    prefix is the primary version channel)."""
+    v = obj.get("v")
+    if v is not None and v != WIRE_VERSION:
+        raise bad_request(f"wire version {v} not supported (have {WIRE_VERSION})")
+
+
+def route_for(method: str) -> str:
+    return f"{API_PREFIX}/{method}"
+
+
+def method_for(path: str) -> Optional[str]:
+    """The method a POST path names, or None."""
+    if not path.startswith(API_PREFIX + "/"):
+        return None
+    name = path[len(API_PREFIX) + 1:].strip("/")
+    return name if name in METHODS else None

@@ -1,0 +1,243 @@
+"""Request execution for the resident verification service: wire params
+in, spec-path results out — through exactly the same facade machinery
+the direct (non-served) path uses, so served answers are bit-identical
+by construction.
+
+- ``verify`` / ``verify_batch`` — checks parse to the facade's deferred
+  keys and ride the :class:`~.batcher.VerifyBatcher` (cross-client
+  micro-batching, admission control, host-oracle degradation). The rare
+  ``AggregateVerify`` form resolves scalar, like the flush path does.
+- ``hash_tree_root`` (+ batch) — decode the SSZ payload as the named
+  container of a (fork, preset) spec module and return its root; the
+  hashing backend (SHA-NI host / device) is whatever the process has
+  installed, faults degrade inside the ssz plane itself.
+- ``process_block`` — decode pre-state + block, run the spec module's
+  ``process_block`` on a copy, return the post-state SSZ + root.
+
+The (fork, preset) matrix is prebuilt at startup (``spec.build`` spans)
+so no request pays a spec compile; requests for pairs outside the
+served matrix are 400s, not lazy builds — the daemon's memory footprint
+is an operator decision, not a client side effect.
+
+Every request runs under a ``serve.request`` span (method/fork attrs →
+``span.serve.request`` latency histograms feed /metrics) and passes the
+``serve.request`` chaos site, so a fault injected here proves the error
+surface: the request fails structured, the daemon lives on.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .. import obs
+from ..resilience import chaos
+from . import protocol
+from .batcher import VerifyBatcher
+
+DEFAULT_FORKS = ("phase0", "altair")
+DEFAULT_PRESETS = ("minimal",)
+
+# spec-module attributes a client may name as an SSZ type: any public
+# SSZType subclass in the built namespace (BeaconState, BeaconBlock,
+# Attestation, ...). Resolved per request against the matrix module.
+_TYPE_BLOCKLIST_PREFIX = "_"
+
+
+class SpecService:
+    """The method surface one daemon serves. Thread-safe: handler
+    threads call :meth:`handle` concurrently."""
+
+    def __init__(
+        self,
+        forks: Sequence[str] = DEFAULT_FORKS,
+        presets: Sequence[str] = DEFAULT_PRESETS,
+        batcher: Optional[VerifyBatcher] = None,
+        request_timeout_s: float = 120.0,
+    ) -> None:
+        self.forks = tuple(forks)
+        self.presets = tuple(presets)
+        self.batcher = batcher or VerifyBatcher()
+        self.request_timeout_s = request_timeout_s
+        self._matrix: Dict[Tuple[str, str], Any] = {}
+        self._build_lock = threading.Lock()
+        self.started_at = time.time()
+        self.ready = False
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "SpecService":
+        """Prebuild the served spec matrix and start the flusher. The
+        compile cache is configured by the daemon's warm start (see
+        serve.lifecycle) before any backend import."""
+        from ..specs import build
+
+        with obs.span("serve.startup", forks=",".join(self.forks),
+                      presets=",".join(self.presets)):
+            for preset in self.presets:
+                for fork in self.forks:
+                    self._matrix[(fork, preset)] = build.build_spec(fork, preset)
+        self.batcher.start()
+        self.ready = True
+        return self
+
+    def stop(self) -> None:
+        self.ready = False
+
+    def matrix_labels(self) -> List[str]:
+        return [f"{fork}/{preset}" for fork, preset in self._matrix]
+
+    def _spec(self, params: Dict[str, Any]) -> Any:
+        fork = protocol.require_str(params, "fork")
+        preset = protocol.require_str(params, "preset")
+        spec = self._matrix.get((fork, preset))
+        if spec is None:
+            raise protocol.bad_request(
+                f"({fork}, {preset}) is not in the served matrix "
+                f"{self.matrix_labels()}")
+        return spec
+
+    # -- dispatch ------------------------------------------------------
+
+    def handle(self, method: str, params: Dict[str, Any]) -> Dict[str, Any]:
+        """Execute one wire method. Raises protocol.RequestError for
+        client-side errors; batcher admission errors propagate for the
+        daemon to map (QueueFull -> 429, Draining -> 503)."""
+        fn = getattr(self, f"_do_{method}", None)
+        if fn is None:
+            raise protocol.RequestError(protocol.NOT_FOUND,
+                                        f"unknown method {method!r}")
+        t0 = time.monotonic()
+        try:
+            with obs.span("serve.request", method=method,
+                          fork=params.get("fork"), preset=params.get("preset")):
+                chaos("serve.request")
+                obs.count(f"serve.requests.{method}")
+                return fn(params)
+        finally:
+            # span histograms only feed when tracing is armed; /metrics
+            # must expose request latency unconditionally
+            obs.observe("serve.request_ms", (time.monotonic() - t0) * 1e3)
+
+    # -- methods -------------------------------------------------------
+
+    def _resolve_check(self, key: Tuple) -> bool:
+        if key[0] == "av":
+            # never appears in spec-level state-transition code; resolve
+            # scalar through the facade, same as DeferredVerifier.flush
+            from ..crypto import bls
+
+            try:
+                return bool(bls.AggregateVerify(list(key[1]), list(key[2]),
+                                                key[3]))
+            except Exception:
+                return False
+        return self.batcher.submit(key, timeout_s=self.request_timeout_s)
+
+    def _do_verify(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        key = protocol.parse_check(params)
+        return {"valid": self._resolve_check(key)}
+
+    def _do_verify_batch(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        checks = params.get("checks")
+        if not isinstance(checks, list) or not checks:
+            raise protocol.bad_request("checks: expected a non-empty list")
+        keys = [protocol.parse_check(c, f"checks[{i}]")
+                for i, c in enumerate(checks)]
+        scalar = {i: self._resolve_check(k)
+                  for i, k in enumerate(keys) if k[0] == "av"}
+        batched = [(i, k) for i, k in enumerate(keys) if k[0] != "av"]
+        if batched:
+            answers = self.batcher.submit_many(
+                [k for _, k in batched], timeout_s=self.request_timeout_s)
+            scalar.update({i: a for (i, _), a in zip(batched, answers)})
+        return {"results": [scalar[i] for i in range(len(keys))]}
+
+    def _resolve_type(self, spec: Any, name: str) -> Any:
+        from ..ssz import SSZType
+
+        if name.startswith(_TYPE_BLOCKLIST_PREFIX):
+            raise protocol.bad_request(f"type: {name!r} is not servable")
+        obj = getattr(spec, name, None)
+        if not (isinstance(obj, type) and issubclass(obj, SSZType)):
+            raise protocol.bad_request(
+                f"type: {name!r} is not an SSZ type of {spec.fork}")
+        return obj
+
+    def _do_hash_tree_root(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        spec = self._spec(params)
+        ssz_type = self._resolve_type(spec, protocol.require_str(params, "type"))
+        data = protocol.from_hex(params.get("ssz"), "ssz")
+        try:
+            obj = ssz_type.decode_bytes(data)
+        except Exception as e:
+            raise protocol.bad_request(f"ssz: does not decode as "
+                                       f"{params['type']} ({e})")
+        return {"root": protocol.to_hex(obj.hash_tree_root())}
+
+    def _do_hash_tree_root_batch(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        items = params.get("items")
+        if not isinstance(items, list) or not items:
+            raise protocol.bad_request("items: expected a non-empty list")
+        roots = []
+        for i, item in enumerate(items):
+            if not isinstance(item, dict):
+                raise protocol.bad_request(f"items[{i}]: expected an object")
+            merged = dict(params)
+            merged.update(item)
+            merged.pop("items", None)
+            roots.append(self._do_hash_tree_root(merged)["root"])
+        return {"roots": roots}
+
+    def _do_process_block(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        spec = self._spec(params)
+        pre_bytes = protocol.from_hex(params.get("pre"), "pre")
+        block_bytes = protocol.from_hex(params.get("block"), "block")
+        try:
+            state = spec.BeaconState.decode_bytes(pre_bytes)
+        except Exception as e:
+            raise protocol.bad_request(f"pre: does not decode as BeaconState ({e})")
+        try:
+            block = spec.BeaconBlock.decode_bytes(block_bytes)
+        except Exception as e:
+            raise protocol.bad_request(f"block: does not decode as BeaconBlock ({e})")
+        try:
+            spec.process_block(state, block)
+        except (AssertionError, IndexError, ValueError) as e:
+            # the spec's invalid-block surface: a structured rejection,
+            # not a daemon fault (mirrors how the generators classify it)
+            raise protocol.bad_request(f"block rejected by {spec.fork} "
+                                       f"process_block: {e!r}")
+        return {"post": protocol.to_hex(state.encode_bytes()),
+                "root": protocol.to_hex(state.hash_tree_root())}
+
+    # -- health --------------------------------------------------------
+
+    def health(self, draining: bool = False) -> Dict[str, Any]:
+        from ..crypto import bls
+        from ..resilience import quarantined
+        from ..sched import compile_cache_stats
+
+        snap = obs.snapshot()
+        counters = {k: v for k, v in snap["counters"].items()
+                    if k.startswith("serve.")}
+        status = ("draining" if draining
+                  else "ready" if self.ready else "starting")
+        return {
+            "status": status,
+            "wire_version": protocol.WIRE_VERSION,
+            "pid": os.getpid(),
+            "uptime_s": round(time.time() - self.started_at, 3),
+            "backend": bls.backend_name(),
+            "quarantined": quarantined(),
+            "matrix": self.matrix_labels(),
+            "queue": {"depth": self.batcher.depth(),
+                      "capacity": self.batcher.max_queue,
+                      "accepted": self.batcher.accepted,
+                      "rejected": self.batcher.rejected,
+                      "flushes": self.batcher.flushes},
+            "result_cache": self.batcher.cache_stats(),
+            "compile_cache": compile_cache_stats(),
+            "counters": counters,
+        }

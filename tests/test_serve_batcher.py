@@ -1,0 +1,140 @@
+"""Micro-batcher units (consensus_specs_tpu/serve/batcher.py):
+admission control on the bounded queue, cross-client accumulation +
+dedup, the pure-function result cache, host-oracle degradation of a
+chaos-faulted flush, and drain semantics (every accepted check answered
+exactly once)."""
+import os
+import sys
+import threading
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from consensus_specs_tpu import resilience
+from consensus_specs_tpu.serve.batcher import (
+    Draining,
+    QueueFull,
+    VerifyBatcher,
+)
+
+
+@pytest.fixture(scope="module")
+def valid_check():
+    """One real valid FastAggregateVerify check (module-scoped: the
+    pure-python pairing is ~0.5s)."""
+    from consensus_specs_tpu.crypto.bls import ciphersuite as oracle
+    from consensus_specs_tpu.crypto.bls.fields import R
+
+    sks = [21, 22]
+    pks = tuple(oracle.SkToPk(sk) for sk in sks)
+    msg = b"\x77" * 32
+    sig = oracle.Sign(sum(sks) % R, msg)
+    return ("fav", pks, msg, sig)
+
+
+def garbage_check(i: int):
+    """Well-formed but invalid key: resolves False fast (the reference
+    oracle rejects the pubkey) — no pairing cost in queue-shape tests."""
+    return ("fav", (bytes([i % 251 + 1]) * 48,), b"\x01" * 32, b"\x02" * 96)
+
+
+def test_queue_full_rejects_at_admission():
+    b = VerifyBatcher(max_queue=4, cache_size=0)  # flusher NOT started
+    b._enqueue([garbage_check(i) for i in range(4)])
+    with pytest.raises(QueueFull):
+        b._enqueue([garbage_check(99)])
+    assert b.rejected == 1 and b.accepted == 4
+    # all-or-nothing: a 2-key batch against 1 free slot rejects BOTH
+    b2 = VerifyBatcher(max_queue=5, cache_size=0)
+    b2._enqueue([garbage_check(i) for i in range(4)])
+    with pytest.raises(QueueFull):
+        b2._enqueue([garbage_check(8), garbage_check(9)])
+    assert b2.depth() == 4
+
+
+def test_flush_resolves_and_caches(valid_check):
+    b = VerifyBatcher(linger_ms=1).start()
+    try:
+        assert b.submit(valid_check, timeout_s=60) is True
+        assert b.cache_stats()["size"] >= 1
+        hits_before = b.cache_hits
+        assert b.submit(valid_check, timeout_s=60) is True  # cache hit
+        assert b.cache_hits == hits_before + 1
+        assert b.flushed_rows == 1  # the hit never re-dispatched
+    finally:
+        b.drain(10)
+
+
+def test_concurrent_submits_share_one_flush(valid_check):
+    """N threads submitting the same key while the flusher lingers must
+    collapse to ONE dispatched row (the facade dedups by key)."""
+    b = VerifyBatcher(linger_ms=150, cache_size=0).start()
+    results = []
+    try:
+        def worker():
+            results.append(b.submit(valid_check, timeout_s=60))
+
+        threads = [threading.Thread(target=worker) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert results == [True] * 6
+        assert b.flushes == 1, "one linger window -> one flush"
+        assert b.flushed_rows == 6  # six accepted entries, one dispatch row
+    finally:
+        b.drain(10)
+
+
+def test_chaos_faulted_flush_degrades_to_oracle(valid_check):
+    """A fault injected at the serve.flush site mid-batch: the whole
+    batch degrades to the per-row host oracle and every client still
+    gets the bit-exact answer (valid -> True, garbage -> False)."""
+    b = VerifyBatcher(linger_ms=150, cache_size=0).start()
+    try:
+        with resilience.inject("serve.flush", "deterministic", count=1):
+            results = {}
+
+            def worker(name, key):
+                results[name] = b.submit(key, timeout_s=60)
+
+            threads = [
+                threading.Thread(target=worker, args=("valid", valid_check)),
+                threading.Thread(target=worker, args=("bad", garbage_check(3))),
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(60)
+        assert results == {"valid": True, "bad": False}
+        events = [e for e in resilience.events()
+                  if e["event"] == "fallback" and e["domain"] == "serve.flush"]
+        assert events, "oracle degradation must be a recorded event"
+    finally:
+        b.drain(10)
+
+
+def test_drain_answers_everything_once():
+    """Checks queued behind a long linger window at drain time: drain()
+    flushes them all — answered exactly once, none dropped."""
+    b = VerifyBatcher(linger_ms=60_000, cache_size=0).start()
+    keys = [garbage_check(i) for i in range(12)]
+    answers = {}
+
+    def worker(i):
+        answers[i] = b.submit(keys[i], timeout_s=60)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(12)]
+    for t in threads:
+        t.start()
+    while b.depth() < 12:
+        pass
+    assert b.drain(30) is True
+    for t in threads:
+        t.join(30)
+    assert sorted(answers) == list(range(12))
+    assert set(answers.values()) == {False}
+    assert b.accepted == 12 and b.flushed_rows == 12
+    with pytest.raises(Draining):
+        b.submit(garbage_check(50))

@@ -1,0 +1,143 @@
+"""Chaos coverage for the serving plane (ISSUE 6 satellite): a backend
+fault injected mid-flight at the ``serve.flush`` site degrades THAT
+batch to the host oracle while concurrent clients still get correct
+(bit-identical) answers; a ``serve.request`` fault surfaces as a
+structured 500 and the daemon keeps serving; a full queue produces
+counted 429s, not hangs."""
+import os
+import sys
+import threading
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from consensus_specs_tpu import obs, resilience
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.serve import (
+    ServeClient,
+    ServeDaemon,
+    ServeError,
+    SpecService,
+    VerifyBatcher,
+)
+from consensus_specs_tpu.serve.protocol import to_hex
+
+
+@pytest.fixture()
+def daemon():
+    service = SpecService(forks=("phase0",), presets=("minimal",),
+                          batcher=VerifyBatcher(linger_ms=120, cache_size=0))
+    d = ServeDaemon(service).start(warm=False)
+    yield d
+    d.drain(10)
+
+
+@pytest.fixture(scope="module")
+def checks():
+    from consensus_specs_tpu.crypto.bls import ciphersuite as oracle
+    from consensus_specs_tpu.crypto.bls.fields import R
+
+    sks = [41, 42]
+    pks = [oracle.SkToPk(sk) for sk in sks]
+    msg = b"\x5d" * 32
+    sig = oracle.Sign(sum(sks) % R, msg)
+    return pks, msg, sig
+
+
+def test_midflight_backend_fault_degrades_batch_to_oracle(daemon, checks):
+    """Four concurrent clients land in one linger window; the flush they
+    share is chaos-faulted. The batch must degrade to the host oracle:
+    every client still gets the answer the direct path computes, the
+    degradation is counted, and the NEXT flush is clean."""
+    pks, msg, sig = checks
+    direct = {
+        "valid": bls.FastAggregateVerify(pks, msg, sig),
+        "tampered": bls.FastAggregateVerify(pks, b"\x5e" * 32, sig),
+    }
+    assert direct == {"valid": True, "tampered": False}
+
+    answers = {}
+    errors = []
+
+    def worker(name, message):
+        try:
+            with ServeClient(daemon.port) as c:
+                answers[name] = c.verify(pubkeys=pks, message=message,
+                                         signature=sig)
+        except Exception as e:  # a dropped/errored request fails the drill
+            errors.append(f"{name}: {e}")
+
+    with resilience.inject("serve.flush", "deterministic", count=1):
+        threads = [
+            threading.Thread(target=worker, args=(f"valid{i}", msg))
+            for i in range(3)
+        ] + [threading.Thread(target=worker, args=("tampered", b"\x5e" * 32))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+
+    assert not errors, errors
+    assert answers == {"valid0": True, "valid1": True, "valid2": True,
+                       "tampered": False}
+    snap = obs.snapshot()
+    assert snap["counters"].get("serve.flush_degraded", 0) >= 1
+    fallbacks = [e for e in resilience.events()
+                 if e["event"] == "fallback" and e["domain"] == "serve.flush"]
+    assert fallbacks, "degradation must be a recorded resilience event"
+
+    # the breaker did NOT open for the serve plane: the next request
+    # flushes normally (fault was injected, not systemic)
+    with ServeClient(daemon.port) as c:
+        assert c.verify(pubkeys=pks, message=msg, signature=sig) is True
+
+
+def test_request_fault_is_structured_500_and_daemon_survives(daemon):
+    with ServeClient(daemon.port) as c:
+        with resilience.inject("serve.request", "deterministic", count=1):
+            with pytest.raises(ServeError) as e:
+                c.call("hash_tree_root", {"fork": "phase0",
+                                          "preset": "minimal",
+                                          "type": "Fork", "ssz": "0x" + "00" * 16})
+        assert e.value.status == 500 and e.value.code == "internal"
+        assert "deterministic" in e.value.message
+        # same request, chaos disarmed: the daemon still serves
+        spec = daemon.service._matrix[("phase0", "minimal")]
+        ssz = spec.Fork().encode_bytes()
+        assert c.hash_tree_root("phase0", "minimal", "Fork", ssz) \
+            == bytes(spec.Fork().hash_tree_root())
+
+
+def test_queue_full_is_counted_429(daemon, checks):
+    """Admission control over the wire: with a 1-slot queue and a held
+    flusher window, the second concurrent distinct check is rejected as
+    a structured 429 and counted — never queued unbounded, never hung."""
+    pks, msg, sig = checks
+    b = daemon.service.batcher
+    b.max_queue = 1
+    try:
+        statuses = {}
+
+        def worker(i):
+            try:
+                with ServeClient(daemon.port) as c:
+                    c.verify(pubkeys=pks,
+                             message=bytes([i]) * 32, signature=sig)
+                statuses[i] = 200
+            except ServeError as e:
+                statuses[i] = e.status
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert sorted(statuses.values()).count(429) >= 1
+        assert 200 in statuses.values()
+        assert b.rejected >= 1
+        with ServeClient(daemon.port) as c:
+            assert c.health()["queue"]["rejected"] >= 1
+    finally:
+        b.max_queue = 1024

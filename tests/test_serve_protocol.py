@@ -1,0 +1,96 @@
+"""Wire-contract units (consensus_specs_tpu/serve/protocol.py): check
+parsing, hex round-trips, version pinning, route mapping, error
+envelopes — the contract both sides of the socket compile against."""
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from consensus_specs_tpu.serve import protocol
+
+
+def test_hex_roundtrip():
+    assert protocol.from_hex(protocol.to_hex(b"\x00\xff\x42"), "x") == b"\x00\xff\x42"
+    assert protocol.from_hex("00ff", "x") == b"\x00\xff"  # 0x prefix optional
+    with pytest.raises(protocol.RequestError) as e:
+        protocol.from_hex("0xzz", "field")
+    assert e.value.code == protocol.BAD_REQUEST
+    assert "field" in e.value.message
+    with pytest.raises(protocol.RequestError):
+        protocol.from_hex(123, "field")
+
+
+def test_parse_check_shapes():
+    pk, msg, sig = b"\x01" * 48, b"\x02" * 32, b"\x03" * 96
+    single = protocol.parse_check({
+        "pubkey": protocol.to_hex(pk), "message": protocol.to_hex(msg),
+        "signature": protocol.to_hex(sig)})
+    assert single == ("v", pk, msg, sig)
+
+    fav = protocol.parse_check({
+        "pubkeys": [protocol.to_hex(pk)] * 3, "message": protocol.to_hex(msg),
+        "signature": protocol.to_hex(sig)})
+    assert fav[0] == "fav" and len(fav[1]) == 3
+
+    av = protocol.parse_check({
+        "pubkeys": [protocol.to_hex(pk)] * 2,
+        "messages": [protocol.to_hex(msg)] * 2,
+        "signature": protocol.to_hex(sig)})
+    assert av[0] == "av" and len(av[2]) == 2
+
+    # the parsed key is EXACTLY what bls.Verify/FastAggregateVerify
+    # record under deferral — served and direct paths share dedup keys
+    from consensus_specs_tpu.crypto import bls
+
+    verifier = bls.DeferredVerifier()
+    with bls.deferring(verifier):
+        bls.Verify(pk, msg, sig)
+        bls.FastAggregateVerify([pk, pk, pk], msg, sig)
+    assert verifier.entries[0] == single
+    assert verifier.entries[1] == fav
+
+
+@pytest.mark.parametrize("params, what", [
+    ({}, "signature"),
+    ({"signature": "0x00"}, "pubkey"),
+    ({"signature": "0x00", "pubkeys": "nope"}, "list"),
+    ({"signature": "0x00", "pubkeys": []}, "non-empty"),
+    ({"signature": "0x00", "pubkeys": ["0x01"], "messages": []}, "len"),
+])
+def test_parse_check_rejects(params, what):
+    with pytest.raises(protocol.RequestError) as e:
+        protocol.parse_check(params)
+    assert e.value.code == protocol.BAD_REQUEST
+    assert what in e.value.message
+
+
+def test_version_and_routes():
+    protocol.check_version({"v": protocol.WIRE_VERSION})
+    protocol.check_version({})  # unpinned is fine
+    with pytest.raises(protocol.RequestError):
+        protocol.check_version({"v": 999})
+
+    assert protocol.route_for("verify") == "/v1/verify"
+    assert protocol.method_for("/v1/process_block") == "process_block"
+    assert protocol.method_for("/v1/nope") is None
+    assert protocol.method_for("/v2/verify") is None
+    assert protocol.method_for("/metrics") is None
+
+
+def test_envelopes_and_status_mapping():
+    ok = protocol.ok_response({"valid": True})
+    assert ok["ok"] is True and ok["v"] == protocol.WIRE_VERSION
+    err = protocol.error_response(protocol.QUEUE_FULL, "x" * 2000)
+    assert err["ok"] is False
+    assert len(err["error"]["message"]) <= 800
+    assert protocol.RequestError(protocol.QUEUE_FULL, "").http_status == 429
+    assert protocol.RequestError(protocol.DRAINING, "").http_status == 503
+    assert protocol.RequestError("??", "").http_status == 500
+    # body loads reject non-objects
+    with pytest.raises(protocol.RequestError):
+        protocol.loads(b"[1,2]")
+    with pytest.raises(protocol.RequestError):
+        protocol.loads(b"{bad")
+    assert protocol.loads(protocol.dumps(ok)) == ok

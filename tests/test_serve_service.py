@@ -1,0 +1,187 @@
+"""The serving plane's acceptance corpus: daemon answers must be
+bit-identical to the direct (non-served) spec path for verify /
+hash_tree_root / process_block across >=2 forks — including while a
+chaos-injected backend fault degrades a batch to the host oracle
+(tests/test_serve_chaos.py drills the fault half; this file proves the
+clean half and the error surface)."""
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.serve import (
+    ServeClient,
+    ServeDaemon,
+    ServeError,
+    SpecService,
+    VerifyBatcher,
+)
+from consensus_specs_tpu.serve.protocol import to_hex
+
+FORKS = ("phase0", "altair")
+
+
+@pytest.fixture(scope="module")
+def daemon():
+    service = SpecService(forks=FORKS, presets=("minimal",),
+                          batcher=VerifyBatcher(linger_ms=2))
+    d = ServeDaemon(service).start(warm=False)
+    yield d
+    d.drain(10)
+
+
+@pytest.fixture(scope="module")
+def client(daemon):
+    with ServeClient(daemon.port) as c:
+        yield c
+
+
+@pytest.fixture(scope="module")
+def checks():
+    from consensus_specs_tpu.crypto.bls import ciphersuite as oracle
+    from consensus_specs_tpu.crypto.bls.fields import R
+
+    sks = [31, 32]
+    pks = [oracle.SkToPk(sk) for sk in sks]
+    msg = b"\x2a" * 32
+    sig = oracle.Sign(sum(sks) % R, msg)
+    return pks, msg, sig
+
+
+@pytest.fixture(scope="module")
+def block_corpus(daemon):
+    """Per fork: (pre_state, block) with a real randao reveal — the
+    direct path and the served path both run full process_block."""
+    from consensus_specs_tpu.test_framework.block import (
+        apply_randao_reveal,
+        build_empty_block_for_next_slot,
+    )
+    from consensus_specs_tpu.test_framework.context import (
+        _prepare_state,
+        default_activation_threshold,
+        default_balances,
+    )
+    from consensus_specs_tpu.test_framework.state import next_slot, transition_to
+
+    corpus = {}
+    for fork in FORKS:
+        spec = daemon.service._matrix[(fork, "minimal")]
+        bls.bls_active = False
+        state = _prepare_state(default_balances,
+                               default_activation_threshold, spec).copy()
+        next_slot(spec, state)
+        block = build_empty_block_for_next_slot(spec, state)
+        transition_to(spec, state, block.slot)
+        bls.bls_active = True
+        apply_randao_reveal(spec, state, block)
+        corpus[fork] = (spec, state, block)
+    return corpus
+
+
+def test_verify_matches_direct(client, checks):
+    pks, msg, sig = checks
+    assert client.verify(pubkeys=pks, message=msg, signature=sig) \
+        == bls.FastAggregateVerify(pks, msg, sig) is True
+    assert client.verify(pubkey=pks[0], message=msg, signature=sig) \
+        == bls.Verify(pks[0], msg, sig) is False
+    tampered = b"\x2b" * 32
+    assert client.verify(pubkeys=pks, message=tampered, signature=sig) \
+        == bls.FastAggregateVerify(pks, tampered, sig) is False
+
+
+def test_verify_batch_matches_direct(client, checks):
+    pks, msg, sig = checks
+    wire = [
+        {"pubkeys": [to_hex(p) for p in pks], "message": to_hex(msg),
+         "signature": to_hex(sig)},
+        {"pubkeys": [to_hex(pks[0])], "message": to_hex(msg),
+         "signature": to_hex(sig)},
+        {"pubkeys": [to_hex(p) for p in pks],
+         "messages": [to_hex(msg)] * 2, "signature": to_hex(sig)},
+    ]
+    direct = [
+        bls.FastAggregateVerify(pks, msg, sig),
+        bls.FastAggregateVerify([pks[0]], msg, sig),
+        bls.AggregateVerify(pks, [msg, msg], sig),
+    ]
+    assert client.verify_batch(wire) == direct
+
+
+@pytest.mark.parametrize("fork", FORKS)
+def test_hash_tree_root_matches_direct(client, daemon, fork):
+    spec = daemon.service._matrix[(fork, "minimal")]
+    for type_name, obj in (
+        ("Checkpoint", spec.Checkpoint(epoch=9, root=b"\x09" * 32)),
+        ("Attestation", spec.Attestation()),
+        ("BeaconBlockHeader", spec.BeaconBlockHeader(slot=3)),
+    ):
+        served = client.hash_tree_root(fork, "minimal", type_name,
+                                       obj.encode_bytes())
+        assert served == bytes(obj.hash_tree_root())
+
+
+def test_hash_tree_root_batch(client, daemon):
+    spec = daemon.service._matrix[("phase0", "minimal")]
+    cp = spec.Checkpoint(epoch=1, root=b"\x01" * 32)
+    out = client.call("hash_tree_root_batch", {
+        "fork": "phase0", "preset": "minimal",
+        "items": [{"type": "Checkpoint", "ssz": to_hex(cp.encode_bytes())},
+                  {"type": "Fork", "ssz": to_hex(spec.Fork().encode_bytes())}],
+    })
+    assert out["roots"] == [to_hex(cp.hash_tree_root()),
+                            to_hex(spec.Fork().hash_tree_root())]
+
+
+@pytest.mark.parametrize("fork", FORKS)
+def test_process_block_bit_identical(client, block_corpus, fork):
+    spec, state, block = block_corpus[fork]
+    direct = state.copy()
+    spec.process_block(direct, block)
+    served = client.process_block(fork, "minimal", state.encode_bytes(),
+                                  block.encode_bytes())
+    assert served["post"] == direct.encode_bytes()
+    assert served["root"] == bytes(direct.hash_tree_root())
+
+
+def test_process_block_invalid_block_is_400(client, block_corpus):
+    spec, state, block = block_corpus["phase0"]
+    wrong_slot = block.copy()
+    wrong_slot.slot = block.slot + 1
+    with pytest.raises(ServeError) as e:
+        client.process_block("phase0", "minimal", state.encode_bytes(),
+                             wrong_slot.encode_bytes())
+    assert e.value.status == 400 and e.value.code == "bad_request"
+
+
+def test_error_surface(client):
+    with pytest.raises(ServeError) as e:
+        client.call("hash_tree_root", {"fork": "phase0", "preset": "minimal",
+                                       "type": "no_such_type", "ssz": "0x00"})
+    assert e.value.status == 400
+    with pytest.raises(ServeError) as e:
+        client.call("hash_tree_root", {"fork": "phase0", "preset": "minimal",
+                                       "type": "_cache", "ssz": "0x00"})
+    assert e.value.status == 400  # private names never resolve
+    with pytest.raises(ServeError) as e:
+        client.call("hash_tree_root", {"fork": "bellatrix", "preset": "minimal",
+                                       "type": "Checkpoint", "ssz": "0x00"})
+    assert e.value.status == 400 and "matrix" in e.value.message
+    with pytest.raises(ServeError) as e:
+        client.call("verify", {"v": 99, "pubkey": "0x00", "message": "0x00",
+                               "signature": "0x00"})
+    assert e.value.status == 400 and "version" in e.value.message
+
+
+def test_health_and_metrics_surface(client, daemon):
+    health = client.health()
+    assert health["status"] == "ready"
+    assert health["wire_version"] == 1
+    assert set(health["matrix"]) == {f"{f}/minimal" for f in FORKS}
+    assert health["queue"]["capacity"] == daemon.service.batcher.max_queue
+    text = client.metrics()
+    assert "# TYPE serve_accepted counter" in text
+    assert "serve_request_ms" in text
+    assert client.ready() is True

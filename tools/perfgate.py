@@ -24,7 +24,13 @@ jax import, no device, no tunnel):
                               planner over a mixed-width check
                               population — the suite-generation
                               throughput the sentinel watches from
-                              round 6 on (docs/GENPIPE.md).
+                              round 6 on (docs/GENPIPE.md);
+- ``perfgate_serve_rtt_ms``   median round-trip of a mixed verify +
+                              hash_tree_root workload against a real
+                              in-process serve daemon under 4
+                              concurrent clients — the serving
+                              machinery's latency floor, gated from
+                              round 7 on (docs/SERVE.md).
 
 Each run appends one ledger run (git sha + environment fingerprint) and
 is classified by :mod:`consensus_specs_tpu.obs.sentinel` against the
@@ -216,11 +222,82 @@ def measure_gen_pipeline_ms() -> float:
     return (min(times) * 1e3 + plan_ms) * _chaos_factor("perfgate_gen_pipeline_ms")
 
 
+def measure_serve_rtt_ms() -> float:
+    """The resident verification daemon end-to-end on host, jax-free: a
+    REAL in-process daemon (ephemeral port, reference BLS) driven by 4
+    concurrent keep-alive clients issuing hash_tree_root + verify
+    requests; the metric is the median round-trip. The 2-check verify
+    population resolves once in warmup, so the timed window watches the
+    serving machinery the daemon adds — HTTP framing, admission, the
+    micro-batcher queue, result-cache lookup — not pairing crypto. A
+    slowed daemon (chaos: ``perfgate_serve=3``) regresses this number
+    and fails the gate (docs/SERVE.md)."""
+    import threading
+
+    from consensus_specs_tpu.crypto.bls import ciphersuite as oracle
+    from consensus_specs_tpu.crypto.bls.fields import R
+    from consensus_specs_tpu.serve import (
+        ServeClient, ServeDaemon, SpecService, VerifyBatcher,
+    )
+    from consensus_specs_tpu.serve.protocol import to_hex
+
+    service = SpecService(forks=("phase0",), presets=("minimal",),
+                          batcher=VerifyBatcher(linger_ms=1))
+    daemon = ServeDaemon(service).start(warm=False)  # stays jax-free
+    try:
+        spec = service._matrix[("phase0", "minimal")]
+        checkpoint_ssz = to_hex(
+            spec.Checkpoint(epoch=7, root=b"\x07" * 32).encode_bytes())
+        checks = []
+        for i in (1, 2):
+            msg = b"perfgate-serve" + bytes([i]) + b"\x00" * 17
+            checks.append({"pubkeys": [to_hex(oracle.SkToPk(i))],
+                           "message": to_hex(msg),
+                           "signature": to_hex(oracle.Sign(i % R, msg))})
+
+        warm = ServeClient(daemon.port)
+        assert warm.verify_batch(checks) == [True, True]
+        warm.close()
+
+        n_clients, n_requests = 4, 60
+        lat: List[List[float]] = [[] for _ in range(n_clients)]
+
+        def worker(idx: int) -> None:
+            with ServeClient(daemon.port) as client:
+                for r in range(n_requests):
+                    t0 = time.perf_counter()
+                    if r % 2:
+                        ok = client.call("verify", checks[r % len(checks)])
+                        assert ok["valid"]
+                    else:
+                        client.call("hash_tree_root", {
+                            "fork": "phase0", "preset": "minimal",
+                            "type": "Checkpoint", "ssz": checkpoint_ssz})
+                    lat[idx].append((time.perf_counter() - t0) * 1e3)
+
+        threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+                   for i in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        flat = sorted(x for ls in lat for x in ls)
+        assert len(flat) == n_clients * n_requests, "requests went missing"
+        from consensus_specs_tpu.obs.metrics import percentile
+
+        p50 = percentile(flat, 50)
+        assert p50 is not None
+    finally:
+        daemon.drain(10)
+    return p50 * _chaos_factor("perfgate_serve_rtt_ms")
+
+
 MEASUREMENTS: Tuple[Tuple[str, Callable[[], float]], ...] = (
     ("perfgate_hash_mibs", measure_hash_mibs),
     ("perfgate_reroot_ms", measure_reroot_ms),
     ("perfgate_epoch_kernel_ms", measure_epoch_kernel_ms),
     ("perfgate_gen_pipeline_ms", measure_gen_pipeline_ms),
+    ("perfgate_serve_rtt_ms", measure_serve_rtt_ms),
 )
 
 

@@ -1,0 +1,266 @@
+"""`make serve-bench`: the concurrent-client harness for the resident
+verification daemon — thousands of in-flight requests from N client
+threads, latency percentiles + sustained throughput banked in the perf
+ledger (docs/SERVE.md).
+
+Usage:
+    python tools/serve_bench.py [--clients N] [--requests R]
+                                [--distinct D] [--batch-every K]
+                                [--ledger P] [--json OUT] [--quick]
+
+Shape: a daemon subprocess (reference BLS on a host-only box — the
+number banks either way, and the micro-batcher path is identical) is
+driven by N threads, each holding one keep-alive connection and issuing
+R requests: mostly single ``verify`` calls, every K-th a 32-check
+``verify_batch`` (so the bounded queue actually fills and the
+cross-client flush sees real depth). The check population has D
+distinct keys across mixed aggregate widths (1/2/4 pubkeys) — repeat
+traffic over a bounded key population is the workload's real shape (the
+validator registry repeats), and it exercises the batcher's dedup +
+pure-function result cache; D controls how much actual pairing work the
+run pays. A warmup pass resolves the population once so the timed
+window measures steady-state serving, not one-time crypto.
+
+Ledger keys (source="serve_bench"):
+    serve_p50_ms / serve_p99_ms    per-request round-trip percentiles
+    serve_verifies_per_s           answered checks (incl. batch rows) / wall
+``extra`` records clients/requests/distinct/rejected/cache-hit stats so
+a trajectory point is interpretable. The sentinel gates the
+``perfgate_serve_rtt_ms`` twin in `make perfgate`; this harness banks
+the heavier concurrent evidence.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from consensus_specs_tpu import obs  # noqa: E402
+from consensus_specs_tpu.serve.client import ServeClient  # noqa: E402
+from consensus_specs_tpu.serve.protocol import to_hex  # noqa: E402
+
+
+def build_population(distinct: int) -> List[Dict[str, Any]]:
+    """D distinct valid checks with mixed aggregate widths (1/2/4 keys),
+    as wire params. Deterministic keys; every check verifies True."""
+    from consensus_specs_tpu.crypto.bls import ciphersuite as oracle
+    from consensus_specs_tpu.crypto.bls.fields import R
+
+    pop: List[Dict[str, Any]] = []
+    widths = (1, 2, 4)
+    pk_cache: Dict[int, bytes] = {}
+
+    def pk(sk: int) -> bytes:
+        if sk not in pk_cache:
+            pk_cache[sk] = oracle.SkToPk(sk)
+        return pk_cache[sk]
+
+    for i in range(distinct):
+        width = widths[i % len(widths)]
+        sks = [((i * 7 + j) % 61) + 1 for j in range(width)]
+        msg = b"serve-bench." + i.to_bytes(4, "little") + b"\x00" * 20
+        sig = oracle.Sign(sum(sks) % R, msg)
+        check: Dict[str, Any] = {"message": to_hex(msg),
+                                 "signature": to_hex(sig)}
+        if width == 1:
+            check["pubkey"] = to_hex(pk(sks[0]))
+        else:
+            check["pubkeys"] = [to_hex(pk(sk)) for sk in sks]
+        pop.append(check)
+    return pop
+
+
+def start_daemon(tmp: pathlib.Path, forks: str = "phase0",
+                 verbose: bool = False) -> Tuple[subprocess.Popen, int]:
+    ready_file = tmp / "ready.json"
+    cmd = [sys.executable, "-m", "consensus_specs_tpu.serve",
+           "--port", "0", "--forks", forks, "--presets", "minimal",
+           "--linger-ms", "2", "--ready-file", str(ready_file)]
+    proc = subprocess.Popen(cmd, cwd=str(REPO), env=obs.child_env(),
+                            stdout=subprocess.PIPE, stderr=(
+                                None if verbose else subprocess.DEVNULL),
+                            text=True)
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if ready_file.exists():
+            port = json.loads(ready_file.read_text())["port"]
+            return proc, port
+        if proc.poll() is not None:
+            raise RuntimeError(f"daemon died at startup (rc={proc.returncode})")
+        time.sleep(0.05)
+    proc.kill()
+    raise RuntimeError("daemon did not become ready within 120s")
+
+
+def drive(port: int, clients: int, requests: int, batch_every: int,
+          population: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """The timed window: N threads, R requests each; returns latencies
+    (ms, per request) + answered-check count + error tally."""
+    latencies: List[List[float]] = [[] for _ in range(clients)]
+    answered = [0] * clients
+    errors = [0] * clients
+    barrier = threading.Barrier(clients + 1)
+
+    def worker(idx: int) -> None:
+        client = ServeClient(port)
+        lat = latencies[idx]
+        barrier.wait()
+        for r in range(requests):
+            check = population[(idx + r * clients) % len(population)]
+            t0 = time.perf_counter()
+            try:
+                if batch_every and r % batch_every == batch_every - 1:
+                    rows = [population[(idx + r * clients + j)
+                                       % len(population)] for j in range(32)]
+                    results = client.verify_batch(rows)
+                    if not all(results):
+                        raise AssertionError("valid check answered False")
+                    answered[idx] += len(results)
+                else:
+                    if not client.call("verify", check)["valid"]:
+                        raise AssertionError("valid check answered False")
+                    answered[idx] += 1
+            except Exception:
+                errors[idx] += 1
+            lat.append((time.perf_counter() - t0) * 1e3)
+        client.close()
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(clients)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    flat = sorted(x for ls in latencies for x in ls)
+    return {"wall_s": wall, "latencies_ms": flat,
+            "answered": sum(answered), "errors": sum(errors)}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--clients", type=int, default=8)
+    parser.add_argument("--requests", type=int, default=250,
+                        help="requests per client thread")
+    parser.add_argument("--distinct", type=int, default=12,
+                        help="distinct checks in the population (each "
+                             "costs one real pairing on a host box)")
+    parser.add_argument("--batch-every", type=int, default=10,
+                        help="every K-th request is a 32-check "
+                             "verify_batch (0 = singles only)")
+    parser.add_argument("--ledger", default=None,
+                        help="perf-ledger path ('off' skips banking)")
+    parser.add_argument("--json", dest="json_path", type=pathlib.Path,
+                        default=None)
+    parser.add_argument("--quick", action="store_true",
+                        help="4 clients x 40 requests, 4 distinct checks")
+    parser.add_argument("--verbose", action="store_true")
+    ns = parser.parse_args(argv)
+    if ns.quick:
+        ns.clients, ns.requests, ns.distinct = 4, 40, 4
+
+    import tempfile
+
+    return run_bench(ns, pathlib.Path(tempfile.mkdtemp(prefix="serve_bench_")))
+
+
+def run_bench(ns: argparse.Namespace, tmp: pathlib.Path) -> int:
+    from consensus_specs_tpu.obs.metrics import percentile
+
+    t0 = time.perf_counter()
+    population = build_population(ns.distinct)
+    print(f"serve_bench: {ns.distinct}-check population built in "
+          f"{time.perf_counter() - t0:.1f}s")
+
+    proc, port = start_daemon(tmp, verbose=ns.verbose)
+    exit_code = 1
+    try:
+        client = ServeClient(port)
+        t0 = time.perf_counter()
+        warm = client.verify_batch(population)
+        assert all(warm), "population must verify True"
+        print(f"serve_bench: population resolved (one-time crypto) in "
+              f"{time.perf_counter() - t0:.1f}s")
+
+        stats = drive(port, ns.clients, ns.requests, ns.batch_every,
+                      population)
+        lat = stats["latencies_ms"]
+        health = client.health()
+        metrics_text = client.metrics()
+        client.close()
+
+        p50 = percentile(lat, 50)
+        p99 = percentile(lat, 99)
+        rate = stats["answered"] / stats["wall_s"] if stats["wall_s"] else None
+        print(f"serve_bench: {ns.clients} clients x {ns.requests} requests "
+              f"-> {stats['answered']} checks in {stats['wall_s']:.2f}s "
+              f"({stats['errors']} errors)")
+        print(f"serve_bench: p50={p50:.2f}ms p99={p99:.2f}ms "
+              f"rate={rate:.0f} verifies/s "
+              f"cache={health['result_cache']} queue={health['queue']}")
+
+        metrics = {
+            "serve_p50_ms": round(p50, 3) if p50 is not None else None,
+            "serve_p99_ms": round(p99, 3) if p99 is not None else None,
+            "serve_verifies_per_s": round(rate, 1) if rate else None,
+        }
+        backend = "host" if health.get("backend") == "reference" else "jax"
+        summary: Dict[str, Any] = {
+            "metrics": metrics, "backend": backend,
+            "clients": ns.clients, "requests_per_client": ns.requests,
+            "distinct_checks": ns.distinct,
+            "answered": stats["answered"], "errors": stats["errors"],
+            "rejected": health["queue"]["rejected"],
+            "result_cache": health["result_cache"],
+            "prometheus_bytes": len(metrics_text),
+        }
+        if stats["errors"]:
+            print("serve_bench: FAILED — errored requests in the timed window")
+        else:
+            exit_code = 0
+
+        if (ns.ledger or "").strip().lower() not in ("off", "none", "0"):
+            from consensus_specs_tpu.obs import ledger as ledger_mod
+
+            path = ns.ledger or ledger_mod.default_path()
+            if path and exit_code == 0:
+                run_id = ledger_mod.Ledger(path).record_run(
+                    metrics, source="serve_bench", backend=backend,
+                    extra={k: summary[k] for k in
+                           ("clients", "requests_per_client",
+                            "distinct_checks", "answered", "rejected")})
+                summary["ledger"] = {"path": path, "run_id": run_id}
+                print(f"serve_bench: banked as {run_id} -> {path}")
+
+        if ns.json_path is not None:
+            with open(ns.json_path, "w") as f:
+                json.dump(summary, f, indent=2, sort_keys=True)
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            out, _ = proc.communicate(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, _ = proc.communicate()
+        if proc.returncode != 0:
+            print(f"serve_bench: daemon exit rc={proc.returncode} "
+                  f"(tail: {out[-300:] if out else ''})")
+            exit_code = exit_code or 1
+        elif "SERVE DRAINED" in (out or ""):
+            print("serve_bench: daemon drained cleanly")
+    return exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

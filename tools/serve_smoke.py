@@ -1,0 +1,159 @@
+"""`make serve-smoke` (wired into `make citest`): boot the resident
+daemon, drive a short mixed workload from 4 concurrent clients, scrape
+/metrics, SIGTERM, and assert a clean drain — the serving plane's
+observability smoke, sibling to tools/trace_smoke.py.
+
+Asserts (exit 1 on any failure):
+- the daemon reaches /readyz within the deadline;
+- 4 concurrent clients each complete a verify + verify_batch +
+  hash_tree_root mix with correct answers (valid checks True, tampered
+  check False, roots matching the locally computed root);
+- /metrics is Prometheus text exposing serve.* counters and the
+  span-fed serve.request latency summary;
+- /healthz reports ready, the served matrix, and queue/cache stats;
+- SIGTERM produces "SERVE DRAINED", exit code 0, and a drained queue.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from consensus_specs_tpu import obs  # noqa: E402
+from consensus_specs_tpu.serve.client import ServeClient  # noqa: E402
+from consensus_specs_tpu.serve.protocol import to_hex  # noqa: E402
+
+
+def fail(msg: str) -> None:
+    print(f"serve_smoke: FAIL — {msg}")
+    sys.exit(1)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--clients", type=int, default=4)
+    parser.add_argument("--rounds", type=int, default=6,
+                        help="mixed-workload rounds per client")
+    ns = parser.parse_args(argv)
+
+    from consensus_specs_tpu.crypto.bls import ciphersuite as oracle
+    from consensus_specs_tpu.crypto.bls.fields import R
+    from consensus_specs_tpu.specs.build import build_spec
+
+    # the differential fixtures, computed BEFORE the daemon exists
+    sks = [5, 6]
+    pks = [oracle.SkToPk(sk) for sk in sks]
+    msg = b"\x5c" * 32
+    sig = oracle.Sign(sum(sks) % R, msg)
+    spec = build_spec("phase0", "minimal")
+    checkpoint = spec.Checkpoint(epoch=11, root=b"\x0b" * 32)
+    expect_root = to_hex(checkpoint.hash_tree_root())
+    checkpoint_ssz = to_hex(checkpoint.encode_bytes())
+
+    tmp = pathlib.Path(tempfile.mkdtemp(prefix="serve_smoke_"))
+    ready_file = tmp / "ready.json"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "consensus_specs_tpu.serve",
+         "--port", "0", "--forks", "phase0", "--presets", "minimal",
+         "--linger-ms", "2", "--ready-file", str(ready_file)],
+        cwd=str(REPO), env=obs.child_env(), stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+
+    deadline = time.monotonic() + 120
+    while not ready_file.exists():
+        if proc.poll() is not None:
+            out, _ = proc.communicate()
+            fail(f"daemon died at startup rc={proc.returncode}: {out[-800:]}")
+        if time.monotonic() > deadline:
+            proc.kill()
+            fail("daemon not ready within 120s")
+        time.sleep(0.05)
+    port = json.loads(ready_file.read_text())["port"]
+    print(f"serve_smoke: daemon ready on :{port}")
+
+    errors: List[str] = []
+
+    def client_worker(idx: int) -> None:
+        try:
+            with ServeClient(port) as client:
+                if not client.ready():
+                    raise AssertionError("readyz not green")
+                for _ in range(ns.rounds):
+                    if not client.verify(pubkeys=pks, message=msg,
+                                         signature=sig):
+                        raise AssertionError("valid verify answered False")
+                    results = client.verify_batch([
+                        {"pubkeys": [to_hex(p) for p in pks],
+                         "message": to_hex(msg), "signature": to_hex(sig)},
+                        {"pubkeys": [to_hex(p) for p in pks],
+                         "message": to_hex(b"\x66" * 32),
+                         "signature": to_hex(sig)},
+                    ])
+                    if results != [True, False]:
+                        raise AssertionError(f"batch answers {results}")
+                    root = client.call("hash_tree_root", {
+                        "fork": "phase0", "preset": "minimal",
+                        "type": "Checkpoint", "ssz": checkpoint_ssz})["root"]
+                    if root != expect_root:
+                        raise AssertionError(f"root {root} != {expect_root}")
+        except Exception as e:
+            errors.append(f"client {idx}: {type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=client_worker, args=(i,))
+               for i in range(ns.clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    if errors:
+        proc.kill()
+        fail("; ".join(errors[:4]))
+    print(f"serve_smoke: {ns.clients} clients x {ns.rounds} rounds OK in "
+          f"{time.perf_counter() - t0:.2f}s")
+
+    scrape = ServeClient(port)
+    health: Dict[str, Any] = scrape.health()
+    if health.get("status") != "ready" or "phase0/minimal" not in health.get("matrix", []):
+        proc.kill()
+        fail(f"healthz wrong: {health}")
+    metrics_text = scrape.metrics()
+    for needle in ("serve_accepted", "serve_requests_verify",
+                   "serve_request_ms", "serve_queue_wait_ms"):
+        if needle not in metrics_text:
+            proc.kill()
+            fail(f"/metrics missing {needle}; got:\n{metrics_text[:1200]}")
+    scrape.close()
+    print(f"serve_smoke: /metrics OK ({len(metrics_text)} bytes), "
+          f"queue={health['queue']} cache={health['result_cache']}")
+
+    proc.send_signal(signal.SIGTERM)
+    try:
+        out, _ = proc.communicate(timeout=60)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        fail("daemon did not exit within 60s of SIGTERM")
+    if proc.returncode != 0:
+        fail(f"daemon exit rc={proc.returncode}: {(out or '')[-800:]}")
+    if "SERVE DRAINED" not in (out or ""):
+        fail(f"no drain line in output: {(out or '')[-800:]}")
+    drained = json.loads(out.split("SERVE DRAINED", 1)[1].strip().splitlines()[0])
+    if not (drained.get("queue_drained") and drained.get("inflight_answered")):
+        fail(f"unclean drain: {drained}")
+    print(f"serve_smoke: clean drain {drained}")
+    print("serve_smoke: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

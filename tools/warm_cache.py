@@ -1,0 +1,76 @@
+"""`make warm-cache`: prebuild the spec matrix + prime the persistent
+XLA compile cache, standalone (ROADMAP #2's first half).
+
+The same warm start the resident daemon performs
+(consensus_specs_tpu/serve/lifecycle.py), runnable on its own so CI and
+operators can pay the one-time costs outside any serving or timed
+window:
+
+    python tools/warm_cache.py [--forks phase0,altair,...]
+                               [--presets minimal[,mainnet]]
+                               [--jit-probe] [--bls-shapes] [--json OUT]
+
+- default: configure the persistent compile cache
+  (CONSENSUS_SPECS_TPU_COMPILE_CACHE, default perf-ledger/xla-cache)
+  and build every available fork for the requested presets;
+- ``--jit-probe``: additionally compile one small kernel per
+  accelerated plane (hash, engine) into the cache;
+- ``--bls-shapes``: additionally compile the smallest canonical BLS
+  pairing bucket (minutes when cold — device boxes only, or CI jobs
+  that cache perf-ledger/ across runs).
+
+Exit 0 unless the spec matrix itself fails to build — a cold or
+unconfigurable jit cache is a lost optimization, not an error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+from typing import List, Optional
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--forks", default=None,
+                        help="comma-separated (default: every available fork)")
+    parser.add_argument("--presets", default="minimal",
+                        help="comma-separated preset names")
+    parser.add_argument("--jit-probe", action="store_true",
+                        help="prime small per-plane kernels into the cache")
+    parser.add_argument("--bls-shapes", action="store_true",
+                        help="also compile the smallest BLS pairing bucket "
+                             "(implies --jit-probe; minutes when cold)")
+    parser.add_argument("--json", dest="json_path", type=pathlib.Path,
+                        default=None, help="write the warm report as JSON")
+    ns = parser.parse_args(argv)
+
+    from consensus_specs_tpu.serve.lifecycle import warm_start
+
+    t0 = time.perf_counter()
+    report = warm_start(
+        forks=[f for f in ns.forks.split(",") if f] if ns.forks else None,
+        presets=tuple(p for p in ns.presets.split(",") if p),
+        jit_probe=ns.jit_probe or ns.bls_shapes,
+        bls_shapes=ns.bls_shapes,
+    )
+    report["total_s"] = round(time.perf_counter() - t0, 3)
+
+    print(f"warm-cache: {report['spec_modules']} spec modules in "
+          f"{report['spec_matrix_s']}s; compile cache: "
+          f"{report.get('compile_cache_dir') or 'disabled'}")
+    for plane, status in (report.get("jit_probe") or {}).items():
+        print(f"warm-cache: jit {plane}: {status}")
+    if ns.json_path is not None:
+        with open(ns.json_path, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,7 +24,7 @@ DEVICE_TESTS = tests/test_bls_device.py tests/test_curve_device.py \
 
 .PHONY: test citest test-fast test-device test-mainnet lint docs generate_tests gen_% replay bench \
         dryrun detect_generator_incomplete clean-vectors chaos trace perfgate perf-report gen-bench \
-        warm-cache serve serve-smoke serve-bench help
+        warm-cache serve serve-smoke serve-bench serve-canary slo-report help
 
 # the fault-injection suite: supervisor/taxonomy units, chaos replay
 # (tampered vectors), induced backend failures, generator crash/resume
@@ -51,6 +51,8 @@ help:
 	@echo "serve                 run the resident verification daemon (docs/SERVE.md; Ctrl-C drains)"
 	@echo "serve-smoke           boot the daemon, drive 4 concurrent clients, scrape /metrics, assert clean SIGTERM drain"
 	@echo "serve-bench           concurrent-client serving bench: p50/p99 latency + verifies/s -> $(LEDGER)"
+	@echo "serve-canary          black-box daemon prober (incl. invalid-signature correctness probe): availability/latency -> $(LEDGER)"
+	@echo "slo-report            serve SLO report: objectives, latest observations, 1h/6h/24h burn rates over $(LEDGER)"
 
 # parallelize like the reference (ref Makefile:100-106) when pytest-xdist
 # is present; degrade to single-process so the suite stays runnable cold
@@ -70,7 +72,9 @@ citest:
 	$(PYTHON) -m pytest tests/spec -q --fork $(fork) $(if $(engine),--engine $(engine))
 	$(MAKE) trace
 	$(MAKE) serve-smoke
+	$(MAKE) serve-canary
 	$(MAKE) perfgate
+	$(MAKE) slo-report
 
 trace:
 	CONSENSUS_SPECS_TPU_COMPILE_CACHE=$(COMPILE_CACHE) $(PYTHON) tools/trace_smoke.py --out $(TRACE_DIR)
@@ -107,6 +111,16 @@ serve-smoke:
 
 serve-bench:
 	$(PYTHON) tools/serve_bench.py --ledger $(LEDGER)
+
+# the SLO plane (docs/OBSERVABILITY.md "SLO plane"): the canary banks
+# black-box availability/latency probes (incl. one deliberately-invalid
+# signature proving correctness, not just liveness); the report renders
+# objectives + multi-window burn rates over the accumulated series
+serve-canary:
+	$(PYTHON) tools/serve_canary.py --ledger $(LEDGER)
+
+slo-report:
+	$(PYTHON) tools/slo_report.py --ledger $(LEDGER)
 
 test-fast:
 	$(PYTHON) -m pytest tests/ -q $(addprefix --ignore=,$(DEVICE_TESTS)) $(PYTEST_EXTRA)

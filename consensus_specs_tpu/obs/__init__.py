@@ -42,16 +42,22 @@ from .core import (  # noqa: F401
     TRACE_ENV,
     Span,
     child_env,
+    current_span,
     current_span_id,
+    emit_span,
     enabled,
     event,
     events,
     instant,
     is_root_process,
     kernel_span,
+    mono_to_us,
+    parse_traceparent,
+    remote_span,
     span,
     trace_dir,
     traced,
+    traceparent,
 )
 from .export import (  # noqa: F401
     export_chrome,
@@ -63,3 +69,4 @@ from .export import (  # noqa: F401
 )
 from .metrics import count, observe, prometheus_text, publish, snapshot  # noqa: F401
 from . import ledger, sentinel  # noqa: F401  (perf evidence plane)
+from . import flightrec, slo  # noqa: F401  (request observability plane)

@@ -84,6 +84,8 @@ def to_chrome(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             args["span"] = rec.get("span")
             if rec.get("parent"):
                 args["parent"] = rec["parent"]
+            if rec.get("links"):
+                args["links"] = list(rec["links"])
             events.append({
                 "ph": "X",
                 "name": rec.get("name", "?"),
@@ -95,7 +97,9 @@ def to_chrome(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                 "args": args,
             })
             parent = spans.get(rec.get("parent") or "")
-            if parent is not None and parent.get("pid") != rec.get("pid"):
+            if parent is not None and (parent.get("pid") != rec.get("pid")
+                                       or (parent.get("tid") != rec.get("tid")
+                                           and rec.get("remote"))):
                 # parent lives in another process: draw the flow arrow
                 flow_id += 1
                 ts = rec.get("ts", 0)
@@ -107,6 +111,25 @@ def to_chrome(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                 })
                 events.append({
                     "ph": "f", "bp": "e", "id": flow_id, "name": "spawn",
+                    "cat": "flow", "ts": ts,
+                    "pid": rec.get("pid", 0), "tid": rec.get("tid", 0),
+                })
+            # explicit causal links (a shared flush span serving many
+            # requests): arrow from each linked span to this one
+            for linked_id in rec.get("links") or ():
+                linked = spans.get(linked_id)
+                if linked is None:
+                    continue
+                flow_id += 1
+                ts = rec.get("ts", 0)
+                events.append({
+                    "ph": "s", "id": flow_id, "name": "link", "cat": "flow",
+                    "ts": max(linked.get("ts", 0), min(
+                        ts, linked.get("ts", 0) + linked.get("dur", 0))),
+                    "pid": linked.get("pid", 0), "tid": linked.get("tid", 0),
+                })
+                events.append({
+                    "ph": "f", "bp": "e", "id": flow_id, "name": "link",
                     "cat": "flow", "ts": ts,
                     "pid": rec.get("pid", 0), "tid": rec.get("tid", 0),
                 })
@@ -160,14 +183,17 @@ def records_from_chrome(trace: Dict[str, Any]) -> List[Dict[str, Any]]:
         ph = ev.get("ph")
         args = ev.get("args") or {}
         if ph == "X":
-            records.append({
+            rec = {
                 "type": "span", "name": ev.get("name", "?"),
                 "span": args.get("span"), "parent": args.get("parent"),
                 "ts": ev.get("ts", 0), "dur": ev.get("dur", 0),
                 "pid": ev.get("pid"), "tid": ev.get("tid"),
                 "attrs": {k: v for k, v in args.items()
-                          if k not in ("span", "parent")},
-            })
+                          if k not in ("span", "parent", "links")},
+            }
+            if args.get("links"):
+                rec["links"] = list(args["links"])
+            records.append(rec)
         elif ph == "i":
             records.append({
                 "type": "instant", "name": ev.get("name", "?"),

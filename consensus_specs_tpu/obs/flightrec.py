@@ -1,0 +1,170 @@
+"""Flight recorder: a bounded in-process ring buffer of the last N
+completed serve requests, so a latency spike is diagnosable *after the
+fact* without having had tracing armed.
+
+Tracing answers "what happened inside this request" but costs an env
+knob armed ahead of time; the always-on metrics answer "how is the
+fleet doing" but aggregate away the individual request. The recorder
+is the missing middle: every completed wire request leaves one small
+record (trace id, method, queue-wait / flush / total ms, cache hits,
+degradation, bucket shape, outcome) in a fixed-size ring — the black
+box an operator reads via ``GET /debug/requests`` / ``/debug/slowest``
+on the daemon, on ``SIGUSR2``, or in the drain dump.
+
+Threading model: the daemon's handler threads each carry at most one
+in-flight request, so the recorder keeps the *open* entry in a
+thread-local (:func:`begin` / :func:`note` / :func:`commit`) and only
+the commit touches the shared ring (one lock, one deque append). Code
+that learns something about the request mid-flight — the batcher's
+submit path knows the queue wait and bucket shape after its future
+resolves — calls :func:`note` from the handler thread and the fields
+merge into that request's record.
+
+The ring is process-global (like the metrics aggregates): one daemon
+per process is the deployment shape, and an in-process test daemon
+sharing the ring is a feature (the drill reads what the daemon wrote).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+DEFAULT_CAPACITY = 256
+
+# fields small enough to keep per request; anything else is the trace's job
+_FIELD_CAP = 200
+
+
+class FlightRecorder:
+    """Bounded ring of completed-request records (thread-safe)."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY) -> None:
+        self.capacity = max(1, int(capacity))
+        self._ring: "deque[Dict[str, Any]]" = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self._seq = 0
+        self.recorded = 0  # total ever committed (ring only keeps the tail)
+
+    # -- the in-flight entry (handler-thread-local) --------------------
+
+    def begin(self, method: str, trace: Optional[str] = None,
+              span: Optional[str] = None) -> Dict[str, Any]:
+        """Open this thread's in-flight record. Returns the entry dict
+        (callers may mutate it directly; :func:`note` is the convenience
+        for code that doesn't hold a reference)."""
+        entry: Dict[str, Any] = {
+            "method": method,
+            "trace": trace,
+            "span": span,
+            "t_wall": round(time.time(), 3),
+            "_t0": time.monotonic(),
+        }
+        self._tls.entry = entry
+        return entry
+
+    def note(self, **fields: Any) -> None:
+        """Merge fields into this thread's in-flight record (no-op when
+        no request is open on the thread — e.g. a direct batcher user)."""
+        entry = getattr(self._tls, "entry", None)
+        if entry is None:
+            return
+        for k, v in fields.items():
+            if isinstance(v, str):
+                v = v[:_FIELD_CAP]
+            entry[k] = v
+
+    def commit(self, status: str = "ok",
+               error: Optional[str] = None) -> Optional[Dict[str, Any]]:
+        """Close this thread's in-flight record into the ring. Returns
+        the committed record (None when no request was open)."""
+        entry = getattr(self._tls, "entry", None)
+        if entry is None:
+            return None
+        self._tls.entry = None
+        entry["total_ms"] = round(
+            (time.monotonic() - entry.pop("_t0")) * 1e3, 3)
+        entry["status"] = status
+        if error:
+            entry["error"] = str(error)[:_FIELD_CAP]
+        with self._lock:
+            self._seq += 1
+            entry["seq"] = self._seq
+            self.recorded += 1
+            self._ring.append(entry)
+        return entry
+
+    # -- reads ---------------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def requests(self, n: Optional[int] = None,
+                 trace: Optional[str] = None) -> List[Dict[str, Any]]:
+        """The most recent completed requests, newest first, optionally
+        filtered by trace id."""
+        with self._lock:
+            entries = list(self._ring)
+        entries.reverse()
+        if trace is not None:
+            entries = [e for e in entries if e.get("trace") == trace]
+        return entries[: n if n is not None else self.capacity]
+
+    def slowest(self, n: int = 10) -> List[Dict[str, Any]]:
+        """The slowest recorded requests by total ms, slowest first."""
+        with self._lock:
+            entries = list(self._ring)
+        entries.sort(key=lambda e: e.get("total_ms") or 0.0, reverse=True)
+        return entries[:max(0, n)]
+
+    def dump(self, n: int = 32) -> Dict[str, Any]:
+        """A JSON-able snapshot for the SIGUSR2 / drain dump."""
+        return {
+            "capacity": self.capacity,
+            "recorded": self.recorded,
+            "buffered": len(self),
+            "slowest": self.slowest(min(n, 10)),
+            "recent": self.requests(n),
+        }
+
+    def clear(self) -> None:
+        """Test hook: drop the ring and any in-flight entry."""
+        with self._lock:
+            self._ring.clear()
+            self._seq = 0
+            self.recorded = 0
+        self._tls.entry = None
+
+
+# the process-wide recorder the serving plane writes to
+RECORDER = FlightRecorder()
+
+
+def begin(method: str, trace: Optional[str] = None,
+          span: Optional[str] = None) -> Dict[str, Any]:
+    return RECORDER.begin(method, trace=trace, span=span)
+
+
+def note(**fields: Any) -> None:
+    RECORDER.note(**fields)
+
+
+def commit(status: str = "ok",
+           error: Optional[str] = None) -> Optional[Dict[str, Any]]:
+    return RECORDER.commit(status=status, error=error)
+
+
+def requests(n: Optional[int] = None,
+             trace: Optional[str] = None) -> List[Dict[str, Any]]:
+    return RECORDER.requests(n=n, trace=trace)
+
+
+def slowest(n: int = 10) -> List[Dict[str, Any]]:
+    return RECORDER.slowest(n)
+
+
+def dump(n: int = 32) -> Dict[str, Any]:
+    return RECORDER.dump(n)

@@ -57,8 +57,19 @@ def percentile(samples: List[float], q: float) -> Optional[float]:
     return ordered[max(0, rank - 1)]
 
 
+# cumulative-bucket ladder for the Prometheus histogram exposition: the
+# repo's histograms are millisecond latencies (span.* / serve.*_ms), so
+# a log-ish ladder from 100µs to 10s covers queue waits through cold
+# compiles; observations outside land in +Inf like any prom histogram
+DEFAULT_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
+                   100.0, 250.0, 500.0, 1000.0, 2500.0, 10000.0)
+
+
 def snapshot(clear: bool = False) -> Dict[str, Any]:
-    """{counters: {...}, histograms: {name: {count,min,p50,p90,p99,max}}}."""
+    """{counters: {...}, histograms: {name: {count,min,p50,p90,p99,max,
+    sum,samples,buckets}}} — ``buckets`` are CUMULATIVE counts per
+    ``le`` bound over the bounded sample window (``samples`` many;
+    ``count`` keeps the unbounded total so rates stay truthful)."""
     with _lock:
         counters = dict(_counters)
         hists = {name: list(vals) for name, vals in _histograms.items()}
@@ -69,13 +80,23 @@ def snapshot(clear: bool = False) -> Dict[str, Any]:
     for name, vals in hists.items():
         if not vals:
             continue
+        ordered = sorted(vals)
+        buckets = []
+        i = 0
+        for bound in DEFAULT_BUCKETS:
+            while i < len(ordered) and ordered[i] <= bound:
+                i += 1
+            buckets.append((bound, i))
         out_h[name] = {
             "count": int(counters.get(name + ".count", len(vals))),
-            "min": min(vals),
-            "p50": percentile(vals, 50),
-            "p90": percentile(vals, 90),
-            "p99": percentile(vals, 99),
-            "max": max(vals),
+            "min": ordered[0],
+            "p50": percentile(ordered, 50),
+            "p90": percentile(ordered, 90),
+            "p99": percentile(ordered, 99),
+            "max": ordered[-1],
+            "sum": sum(ordered),
+            "samples": len(ordered),
+            "buckets": buckets,
         }
     return {"counters": counters, "histograms": out_h}
 
@@ -123,6 +144,15 @@ def prometheus_text(snap: Optional[Dict[str, Any]] = None) -> str:
     ``_max`` gauges. The auto-maintained ``<hist>.count`` counters are
     folded into their histogram's ``_count`` line rather than emitted
     twice under a colliding name.
+
+    Each histogram ALSO exposes a true Prometheus histogram family
+    ``<name>_hist`` — cumulative ``_bucket{le="..."}`` lines over
+    :data:`DEFAULT_BUCKETS` (+Inf == ``_count``), ``_sum`` and
+    ``_count`` — because quantile summaries cannot be aggregated across
+    scrapes/instances while buckets can (the standard histogram_quantile
+    path). A separate family name keeps promtool's one-TYPE-per-family
+    rule intact next to the summary. Bucket counts cover the bounded
+    sample window (the summary's ``_count`` stays unbounded).
     """
     if snap is None:
         snap = snapshot()
@@ -148,6 +178,14 @@ def prometheus_text(snap: Optional[Dict[str, Any]] = None) -> str:
             if h.get(suffix) is not None:
                 lines.append(f"# TYPE {pname}_{suffix} gauge")
                 lines.append(f"{pname}_{suffix} {h[suffix]:g}")
+        if h.get("buckets"):
+            lines.append(f"# TYPE {pname}_hist histogram")
+            for bound, cum in h["buckets"]:
+                lines.append(f'{pname}_hist_bucket{{le="{bound:g}"}} {cum:g}')
+            samples = h.get("samples", h.get("count", 0))
+            lines.append(f'{pname}_hist_bucket{{le="+Inf"}} {samples:g}')
+            lines.append(f"{pname}_hist_sum {h.get('sum', 0):.10g}")
+            lines.append(f"{pname}_hist_count {samples:g}")
     return "\n".join(lines) + ("\n" if lines else "")
 
 

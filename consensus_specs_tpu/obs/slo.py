@@ -1,0 +1,284 @@
+"""SLO plane: service-level objectives for the serving plane, computed
+from the always-on ``serve.*`` aggregates and the perf ledger.
+
+The sentinel (obs/sentinel.py) answers a *relative* question — "is this
+run slower than comparable history?". An SLO answers the *absolute*
+one an operator actually promises: "did we serve ≥99.9% of requests
+without a 5xx, under the latency objective?" — with an **error budget**
+(the tolerated failure fraction) and **burn rates** (how fast a window
+is spending that budget, the multi-window SRE alerting pattern).
+
+Objectives (env-overridable, docs/OBSERVABILITY.md):
+
+- ``serve_availability`` — fraction of served wire requests answered
+  without a 5xx. Denominator = ``serve.responses`` +
+  ``serve.errors.internal``: client-side 400/404/429 rejections are
+  *correct* behavior and never burn the budget, and introspection GETs
+  (``/metrics`` etc.) never reach the counters at all
+  (``serve/protocol.is_introspection``).
+- ``serve_latency_p99`` — p99 of the always-on ``serve.request_ms``
+  histogram (host objective; the histogram exists without tracing
+  armed, so the SLO needs no env knob).
+
+Ledger series (banked by ``make perfgate``'s SLO gate,
+``tools/serve_canary.py`` and ``tools/slo_report.py --port``):
+
+- ``serve_slo_availability`` — observed availability fraction (1.0 =
+  no budget spent); higher is better.
+- ``serve_slo_p99_budget`` — remaining latency budget as a fraction
+  (``1 - p99/objective``; ≤0 = budget exhausted); higher is better.
+
+Gate contract (``tools/perfgate.py``): FAIL iff an objective is
+*burning* (availability below target / latency budget exhausted) on a
+run that actually exercised the serving slice. A run that could not
+(environmental skip, zero served requests) is an environment gap —
+recorded, visible, never gate-failing — exactly like the sentinel's
+``environmental`` verdict.
+"""
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+AVAILABILITY_TARGET_ENV = "CONSENSUS_SPECS_TPU_SLO_AVAILABILITY"
+P99_OBJECTIVE_ENV = "CONSENSUS_SPECS_TPU_SLO_P99_MS"
+
+DEFAULT_AVAILABILITY_TARGET = 0.999   # 99.9% non-5xx
+DEFAULT_P99_OBJECTIVE_MS = 25.0       # host objective (loopback daemon)
+
+# the multi-window burn-rate ladder (SRE workbook shape): a fast window
+# catches a cliff, the slow window catches a slow leak
+BURN_WINDOWS_S: Tuple[Tuple[str, float], ...] = (
+    ("1h", 3600.0), ("6h", 21600.0), ("24h", 86400.0))
+
+AVAILABILITY_POINT = "serve_slo_availability"
+P99_BUDGET_POINT = "serve_slo_p99_budget"
+
+# gate verdicts (mirror the sentinel's vocabulary)
+OK = "ok"
+BURNING = "burning"
+ENV_GAP = "environmental"
+NO_DATA = "no_data"
+
+
+@dataclass(frozen=True)
+class Objective:
+    name: str
+    kind: str          # "availability" | "latency_p99"
+    target: float      # availability fraction / latency objective ms
+    description: str
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def serve_objectives() -> Tuple[Objective, ...]:
+    """The serving plane's declared objectives (env-overridable so an
+    operator can tighten/loosen without a code change)."""
+    return (
+        Objective(
+            name="serve_availability", kind="availability",
+            target=min(1.0, _env_float(AVAILABILITY_TARGET_ENV,
+                                       DEFAULT_AVAILABILITY_TARGET)),
+            description="non-5xx fraction of served wire requests"),
+        Objective(
+            name="serve_latency_p99", kind="latency_p99",
+            target=_env_float(P99_OBJECTIVE_ENV, DEFAULT_P99_OBJECTIVE_MS),
+            description="p99 serve.request_ms (always-on histogram, host)"),
+    )
+
+
+# ---------------------------------------------------------------------------
+# observation: the always-on aggregates -> one observed dict
+# ---------------------------------------------------------------------------
+
+def observed_from_snapshot(snap: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Availability + p99 from an ``obs.snapshot()`` (default: live).
+
+    The denominator is served wire traffic only: ``serve.responses``
+    (2xx) + ``serve.errors.internal`` (5xx). 4xx-class refusals and
+    introspection scrapes are excluded by construction."""
+    if snap is None:
+        from . import metrics
+
+        snap = metrics.snapshot()
+    counters = snap.get("counters", {})
+    ok = float(counters.get("serve.responses", 0))
+    err = float(counters.get("serve.errors.internal", 0))
+    total = ok + err
+    hist = (snap.get("histograms") or {}).get("serve.request_ms") or {}
+    return {
+        "requests": int(total),
+        "errors_5xx": int(err),
+        "availability": (ok / total) if total else None,
+        "p99_ms": hist.get("p99"),
+    }
+
+
+def observed_from_prometheus(text: str) -> Dict[str, Any]:
+    """The same observed dict from a scraped ``/metrics`` exposition
+    (the black-box path: slo_report probing a live daemon)."""
+    values: Dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        name, _, value = line.rpartition(" ")
+        try:
+            values[name] = float(value)
+        except ValueError:
+            continue
+    ok = values.get("serve_responses", 0.0)
+    err = values.get("serve_errors_internal", 0.0)
+    total = ok + err
+    return {
+        "requests": int(total),
+        "errors_5xx": int(err),
+        "availability": (ok / total) if total else None,
+        "p99_ms": values.get('serve_request_ms{quantile="0.99"}'),
+    }
+
+
+# ---------------------------------------------------------------------------
+# evaluation: observed vs objectives
+# ---------------------------------------------------------------------------
+
+def evaluate(observed: Dict[str, Any],
+             objectives: Optional[Sequence[Objective]] = None) -> List[Dict[str, Any]]:
+    """Per-objective status dicts: observed value, remaining budget
+    fraction, and whether the objective is *burning* right now."""
+    statuses: List[Dict[str, Any]] = []
+    for obj in objectives or serve_objectives():
+        status: Dict[str, Any] = {
+            "objective": obj.name, "kind": obj.kind, "target": obj.target,
+            "description": obj.description,
+        }
+        if obj.kind == "availability":
+            avail = observed.get("availability")
+            status["observed"] = avail
+            if avail is None:
+                status.update(verdict=NO_DATA, burning=False)
+            else:
+                budget = 1.0 - obj.target
+                burn = ((1.0 - avail) / budget) if budget > 0 else (
+                    0.0 if avail >= 1.0 else float("inf"))
+                status["burn"] = round(burn, 4)
+                status["budget_remaining"] = round(1.0 - burn, 4)
+                status["burning"] = avail < obj.target
+                status["verdict"] = BURNING if status["burning"] else OK
+        elif obj.kind == "latency_p99":
+            p99 = observed.get("p99_ms")
+            status["observed"] = p99
+            if p99 is None:
+                status.update(verdict=NO_DATA, burning=False)
+            else:
+                status["budget_remaining"] = round(1.0 - p99 / obj.target, 4)
+                status["burning"] = p99 > obj.target
+                status["verdict"] = BURNING if status["burning"] else OK
+        else:  # unknown kind: visible, never gating
+            status.update(observed=None, verdict=NO_DATA, burning=False)
+        statuses.append(status)
+    return statuses
+
+
+def ledger_points(statuses: Sequence[Dict[str, Any]]) -> Dict[str, float]:
+    """The SLO ledger series for one evaluated run (empty when there is
+    no data — a degraded run records what it has)."""
+    points: Dict[str, float] = {}
+    for status in statuses:
+        if status.get("verdict") == NO_DATA:
+            continue
+        if status["kind"] == "availability":
+            points[AVAILABILITY_POINT] = round(float(status["observed"]), 6)
+        elif status["kind"] == "latency_p99":
+            points[P99_BUDGET_POINT] = float(status["budget_remaining"])
+    return points
+
+
+# ---------------------------------------------------------------------------
+# burn rates: how fast recent windows spend the availability budget
+# ---------------------------------------------------------------------------
+
+def burn_rates(points: Sequence[Dict[str, Any]],
+               target: Optional[float] = None,
+               now: Optional[float] = None,
+               windows: Sequence[Tuple[str, float]] = BURN_WINDOWS_S,
+               ) -> Dict[str, Dict[str, Any]]:
+    """Multi-window burn rates over ledger ``serve_slo_availability``
+    points. Burn rate 1.0 = spending the budget exactly at the rate
+    that exhausts it over the window; >1 = burning faster.
+
+    ``points`` are ledger point dicts (``ts``/``value``); sources mix
+    freely (perfgate runs, canary probes, slo_report scrapes) — each is
+    one availability observation on the timeline."""
+    if target is None:
+        target = serve_objectives()[0].target
+    budget = 1.0 - target
+    samples = [(float(p["ts"]), float(p["value"])) for p in points
+               if isinstance(p.get("value"), (int, float))
+               and isinstance(p.get("ts"), (int, float))]
+    if now is None:
+        now = max([ts for ts, _ in samples], default=time.time())
+    out: Dict[str, Dict[str, Any]] = {}
+    for label, window_s in windows:
+        in_window = [v for ts, v in samples if now - ts <= window_s]
+        entry: Dict[str, Any] = {"window_s": window_s, "points": len(in_window)}
+        if in_window:
+            mean_avail = sum(in_window) / len(in_window)
+            entry["mean_availability"] = round(mean_avail, 6)
+            entry["burn_rate"] = (round((1.0 - mean_avail) / budget, 4)
+                                  if budget > 0 else
+                                  (0.0 if mean_avail >= 1.0 else float("inf")))
+        out[label] = entry
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the CI gate hook (tools/perfgate.py)
+# ---------------------------------------------------------------------------
+
+def gate(snap: Optional[Dict[str, Any]] = None, *,
+         skipped_environmental: bool = False,
+         chaos_factor: Optional[Callable[[str], float]] = None,
+         ) -> Dict[str, Any]:
+    """Evaluate the serve SLOs for one just-measured run.
+
+    ``chaos_factor`` is perfgate's ``CONSENSUS_SPECS_TPU_PERF_CHAOS``
+    hook: a clause matching ``serve_slo_availability`` multiplies the
+    observed availability (e.g. ``=0.5`` simulates a daemon burning its
+    budget), one matching ``serve_slo_p99_ms`` multiplies the observed
+    p99 — so the gate itself is drillable without a real outage.
+
+    Returns ``{"ok", "verdict", "observed", "statuses", "points"}``:
+    ``ok`` is False only for a confirmed burn; an environmental skip or
+    a run with zero served requests is an environment gap that never
+    fails the gate."""
+    observed = observed_from_snapshot(snap)
+    if chaos_factor is not None:
+        if observed.get("availability") is not None:
+            observed["availability"] = min(
+                1.0, observed["availability"] * chaos_factor(AVAILABILITY_POINT))
+        if observed.get("p99_ms") is not None:
+            observed["p99_ms"] = observed["p99_ms"] * chaos_factor("serve_slo_p99_ms")
+    statuses = evaluate(observed)
+    if skipped_environmental or not observed["requests"]:
+        return {
+            "ok": True, "verdict": ENV_GAP, "observed": observed,
+            "statuses": statuses, "points": {},
+            "detail": "serving slice not exercised this run "
+                      "(environment gap, not a burn)",
+        }
+    burning = [s for s in statuses if s.get("burning")]
+    return {
+        "ok": not burning,
+        "verdict": BURNING if burning else OK,
+        "observed": observed,
+        "statuses": statuses,
+        "points": ledger_points(statuses),
+    }

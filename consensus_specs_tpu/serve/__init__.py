@@ -26,9 +26,21 @@ shape inference stacks use to amortize compilation and dispatch.
 - :mod:`client` — stdlib client used by tests and the bench/smoke
   tools (``tools/serve_bench.py``, ``tools/serve_smoke.py``).
 
+Request observability (ISSUE 7): every wire body MAY carry an optional
+W3C-shaped ``trace`` field — ``ServeClient`` injects it from the active
+obs span, the daemon adopts it, and one merged Perfetto trace links
+client → daemon request → synthesized queue-wait → the shared flush
+(with the other clients that shared the bucket). The flight recorder
+(``obs/flightrec.py``) keeps the last N completed requests for
+``/debug/requests`` / ``/debug/slowest`` / SIGUSR2 / drain dumps, and
+``obs/slo.py`` declares the availability + latency objectives gated by
+``make perfgate`` and probed by ``tools/serve_canary.py``.
+
 Perf evidence: ``make serve-bench`` banks ``serve_p50_ms`` /
 ``serve_p99_ms`` / ``serve_verifies_per_s`` in the ledger;
-``make perfgate`` gates ``perfgate_serve_rtt_ms`` on the sentinel.
+``make perfgate`` gates ``perfgate_serve_rtt_ms`` on the sentinel and
+the serve SLOs (``serve_slo_availability`` / ``serve_slo_p99_budget``)
+on their absolute objectives.
 """
 from __future__ import annotations
 

@@ -43,6 +43,7 @@ from collections import OrderedDict, deque
 from typing import Deque, Dict, List, Optional, Tuple
 
 from .. import obs
+from ..obs import flightrec
 from ..resilience import chaos, record_event, supervised
 
 DEFAULT_MAX_QUEUE = 1024
@@ -60,16 +61,27 @@ class Draining(Exception):
 
 
 class _Pending:
-    """One accepted check: resolved exactly once by the flusher."""
+    """One accepted check: resolved exactly once by the flusher.
 
-    __slots__ = ("key", "done", "result", "error", "t_submit")
+    ``origin`` carries the submitting request's identity — (trace id,
+    span id, thread id), captured only when tracing is armed — so the
+    flusher can attribute the queue wait and the shared flush back to
+    the request span. ``stats`` is filled at flush time (queue-wait /
+    flush ms, bucket shape, degradation) and read back on the handler
+    thread for the flight recorder."""
 
-    def __init__(self, key: Tuple) -> None:
+    __slots__ = ("key", "done", "result", "error", "t_submit",
+                 "origin", "stats")
+
+    def __init__(self, key: Tuple,
+                 origin: Optional[Tuple[Optional[str], str, int]] = None) -> None:
         self.key = key
         self.done = threading.Event()
         self.result: Optional[bool] = None
         self.error: Optional[BaseException] = None
         self.t_submit = time.monotonic()
+        self.origin = origin
+        self.stats: Optional[Dict[str, object]] = None
 
     def resolve(self, result: bool) -> None:
         self.result = result
@@ -156,9 +168,13 @@ class VerifyBatcher:
                     self.cache_hits += 1
             if cached is not None:
                 obs.count("serve.cache_hits")
+                flightrec.note(cache_hit=True)
                 return cached
         pending = self._enqueue([key])[0]
-        return self._await(pending, timeout_s)
+        result = self._await(pending, timeout_s)
+        if pending.stats is not None:
+            flightrec.note(**pending.stats)
+        return result
 
     def submit_many(self, keys: List[Tuple],
                     timeout_s: Optional[float] = None) -> List[bool]:
@@ -180,13 +196,22 @@ class VerifyBatcher:
             misses = list(enumerate(keys))
         if results:
             obs.count("serve.cache_hits", len(results))
+            flightrec.note(cache_hits=len(results))
         if misses:
             pendings = self._enqueue([k for _, k in misses])
             for (i, _), pending in zip(misses, pendings):
                 results[i] = self._await(pending, timeout_s)
+            if pendings[0].stats is not None:
+                flightrec.note(**pendings[0].stats)
         return [results[i] for i in range(len(keys))]
 
     def _enqueue(self, keys: List[Tuple]) -> List[_Pending]:
+        origin: Optional[Tuple[Optional[str], str, int]] = None
+        if obs.enabled():
+            sp = obs.current_span()
+            if sp is not None:
+                origin = (sp.remote_trace, sp.span_id,
+                          threading.get_ident() & 0xFFFFFFFF)
         with self._cond:
             if self._closing:
                 raise Draining("serve batcher is draining")
@@ -196,7 +221,7 @@ class VerifyBatcher:
                 obs.count("serve.rejected", len(keys))
                 raise QueueFull(
                     f"verify queue full ({len(self._q)}/{self.max_queue})")
-            pendings = [_Pending(k) for k in keys]
+            pendings = [_Pending(k, origin) for k in keys]
             self._q.extend(pendings)
             with self.stats_lock:
                 self.accepted += len(keys)
@@ -245,6 +270,28 @@ class VerifyBatcher:
         for p in batch:
             obs.observe("serve.queue_wait_ms", (t0 - p.t_submit) * 1e3)
 
+        # request-scoped attribution (tracing armed): a synthesized
+        # serve.queue_wait child under each member's request span, and
+        # the shared flush span linked to EVERY member — the merged
+        # trace shows which other clients' checks shared this bucket
+        member_spans: List[str] = []
+        member_traces: List[str] = []
+        if obs.enabled():
+            for p in batch:
+                if p.origin is None:
+                    continue
+                trace_id, span_id, tid = p.origin
+                member_spans.append(span_id)
+                if trace_id and trace_id not in member_traces:
+                    member_traces.append(trace_id)
+                ts = obs.mono_to_us(p.t_submit)
+                if ts is not None:
+                    obs.emit_span("serve.queue_wait", ts,
+                                  (t0 - p.t_submit) * 1e6, parent=span_id,
+                                  trace=trace_id, tid=tid)
+
+        degraded = {"hit": False}
+
         def dispatch() -> Dict[Tuple, bool]:
             chaos("serve.flush")
             from ..crypto import bls
@@ -255,11 +302,17 @@ class VerifyBatcher:
             verifier.flush()
             return verifier.table()
 
-        with obs.span("serve.flush", rows=len(batch)):
+        def fallback() -> Dict[Tuple, bool]:
+            degraded["hit"] = True
+            return self._oracle_flush(batch)
+
+        with obs.span("serve.flush", rows=len(batch),
+                      members=len(member_spans),
+                      client_traces=",".join(member_traces) or None) as fsp:
+            fsp.link(*member_spans)
             try:
                 table = supervised(
-                    dispatch, domain="serve.flush",
-                    fallback=lambda: self._oracle_flush(batch))
+                    dispatch, domain="serve.flush", fallback=fallback)
             except BaseException as e:  # a fallback that itself failed
                 for p in batch:
                     p.fail(e)
@@ -269,7 +322,8 @@ class VerifyBatcher:
             self.flushed_rows += len(batch)
         obs.count("serve.flushes")
         obs.count("serve.flush_rows", len(batch))
-        obs.observe("serve.flush_ms", (time.monotonic() - t0) * 1e3)
+        flush_ms = (time.monotonic() - t0) * 1e3
+        obs.observe("serve.flush_ms", flush_ms)
         if self.cache_size:
             with self.stats_lock:
                 for key, result in table.items():
@@ -278,6 +332,13 @@ class VerifyBatcher:
                 while len(self._cache) > self.cache_size:
                     self._cache.popitem(last=False)
         for p in batch:
+            p.stats = {
+                "queue_wait_ms": round((t0 - p.t_submit) * 1e3, 3),
+                "flush_ms": round(flush_ms, 3),
+                "batch_rows": len(batch),
+            }
+            if degraded["hit"]:
+                p.stats["degraded"] = True
             p.resolve(bool(table[p.key]))
 
     @staticmethod

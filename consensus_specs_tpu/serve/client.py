@@ -15,6 +15,7 @@ import json
 import socket
 from typing import Any, Dict, List, Optional, Sequence
 
+from .. import obs
 from . import protocol
 
 
@@ -89,7 +90,20 @@ class ServeClient:
         return obj
 
     def call(self, method: str, params: Dict[str, Any]) -> Dict[str, Any]:
-        return self._roundtrip("POST", protocol.route_for(method), params)
+        """One wire method round trip. With tracing armed, the call runs
+        under a ``serve.client`` span and injects its trace context as
+        the optional ``trace`` wire field, so the daemon-side request
+        span files under THIS span in the merged trace (docs/SERVE.md).
+        Disabled cost: one env check."""
+        if not obs.enabled():
+            return self._roundtrip("POST", protocol.route_for(method), params)
+        with obs.span("serve.client", method=method,
+                      host=self.host, port=self.port):
+            tp = obs.traceparent()
+            if tp is not None and protocol.TRACE_FIELD not in params:
+                params = dict(params)
+                params[protocol.TRACE_FIELD] = tp
+            return self._roundtrip("POST", protocol.route_for(method), params)
 
     # -- the wire methods ----------------------------------------------
 

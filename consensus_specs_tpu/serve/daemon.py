@@ -12,6 +12,15 @@ Endpoints (wire contract v1 — docs/SERVE.md):
   depth/capacity, result+compile cache stats, served matrix, uptime.
 - ``GET /readyz`` — 200 once the matrix is prebuilt and the flusher
   runs; 503 while starting or draining (load-balancer semantics).
+- ``GET /debug/requests[?trace=<id>&n=<k>]`` / ``GET /debug/slowest`` —
+  the flight recorder (obs/flightrec.py): the last N completed wire
+  requests with queue-wait/flush/total ms, cache hits, degradation and
+  bucket shape; also dumped to stderr on SIGUSR2 and at drain.
+
+Introspection routes (``/metrics`` ``/healthz`` ``/readyz``
+``/debug/*``) are excluded from ``serve.request_ms`` and the SLO
+denominators (``protocol.is_introspection``): scrapers cannot skew the
+served-traffic histograms.
 
 Drain: SIGTERM/SIGINT flips the daemon to ``draining`` — new POSTs get
 a structured 503, requests already accepted (including every check
@@ -38,6 +47,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
 from .. import obs
+from ..obs import flightrec
 from . import protocol
 from .batcher import Draining, QueueFull, VerifyBatcher
 from .service import DEFAULT_FORKS, DEFAULT_PRESETS, SpecService
@@ -93,7 +103,13 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self) -> None:  # noqa: N802 (http.server API)
         daemon = self.server.daemon_ref  # type: ignore[attr-defined]
-        path = self.path.split("?", 1)[0]
+        path, _, query = self.path.partition("?")
+        if protocol.is_introspection(path):
+            # scrape/debug traffic: counted on its own, NEVER in
+            # serve.request_ms or the SLO denominators — a tight scrape
+            # loop must not skew the served-traffic histograms
+            obs.count("serve.introspection")
+            obs.count(f"serve.introspection.{path.strip('/').replace('/', '_')}")
         if path == "/metrics":
             self._send_text(200, obs.prometheus_text(),
                             "text/plain; version=0.0.4; charset=utf-8")
@@ -106,9 +122,39 @@ class _Handler(BaseHTTPRequestHandler):
                              "status": "draining" if daemon.draining
                              else "ready" if daemon.service.ready
                              else "starting"})
+        elif path == "/debug/requests":
+            params = self._query_params(query)
+            self._send_json(200, {
+                "requests": flightrec.requests(
+                    n=params.get("n"), trace=params.get("trace")),
+                "recorded": flightrec.RECORDER.recorded,
+                "capacity": flightrec.RECORDER.capacity,
+            })
+        elif path == "/debug/slowest":
+            params = self._query_params(query)
+            self._send_json(200, {
+                "requests": flightrec.slowest(params.get("n") or 10),
+                "recorded": flightrec.RECORDER.recorded,
+            })
         else:
             self._send_json(404, protocol.error_response(
                 protocol.NOT_FOUND, f"no route {path!r}"))
+
+    @staticmethod
+    def _query_params(query: str) -> Dict[str, Any]:
+        """``n`` (int) and ``trace`` (str) from a query string."""
+        from urllib.parse import parse_qs
+
+        out: Dict[str, Any] = {}
+        parsed = parse_qs(query)
+        if parsed.get("trace"):
+            out["trace"] = parsed["trace"][0]
+        if parsed.get("n"):
+            try:
+                out["n"] = max(1, int(parsed["n"][0]))
+            except ValueError:
+                pass
+        return out
 
     def do_POST(self) -> None:  # noqa: N802 (http.server API)
         daemon = self.server.daemon_ref  # type: ignore[attr-defined]
@@ -124,6 +170,7 @@ class _Handler(BaseHTTPRequestHandler):
                 protocol.DRAINING, "daemon is draining; request not accepted"))
             return
         with daemon.track_request():
+            flightrec.begin(method)
             try:
                 length = int(self.headers.get("Content-Length") or 0)
                 if length > MAX_BODY_BYTES:
@@ -131,15 +178,22 @@ class _Handler(BaseHTTPRequestHandler):
                         f"body too large ({length} > {MAX_BODY_BYTES})")
                 params = protocol.loads(self.rfile.read(length))
                 protocol.check_version(params)
+                wire_trace = obs.parse_traceparent(
+                    params.get(protocol.TRACE_FIELD))
+                if wire_trace is not None:
+                    flightrec.note(trace=wire_trace["trace_id"])
                 result = daemon.service.handle(method, params)
             except protocol.RequestError as e:
                 obs.count("serve.errors.bad_request")
+                flightrec.commit(status=e.code, error=e.message)
                 self._send_json(e.http_status,
                                 protocol.error_response(e.code, e.message))
             except QueueFull as e:
+                flightrec.commit(status=protocol.QUEUE_FULL, error=str(e))
                 self._send_json(429, protocol.error_response(
                     protocol.QUEUE_FULL, str(e)))
             except Draining as e:
+                flightrec.commit(status=protocol.DRAINING, error=str(e))
                 self._send_json(503, protocol.error_response(
                     protocol.DRAINING, str(e)))
             except Exception as e:
@@ -149,11 +203,14 @@ class _Handler(BaseHTTPRequestHandler):
                 record_event("gave_up", domain="serve.request", kind=kind,
                              detail=f"{type(e).__name__}: {e}")
                 obs.count("serve.errors.internal")
+                flightrec.commit(status=protocol.INTERNAL,
+                                 error=f"[{kind}] {type(e).__name__}: {e}")
                 self._send_json(500, protocol.error_response(
                     protocol.INTERNAL,
                     f"[{kind}] {type(e).__name__}: {e}"))
             else:
                 obs.count("serve.responses")
+                flightrec.commit(status="ok")
                 self._send_json(200, protocol.ok_response(result))
 
 
@@ -266,6 +323,7 @@ class ServeDaemon:
             # once (the no-drop / no-double-dispatch drill reads this)
             "flushed_rows": self.service.batcher.flushed_rows,
             "rejected": self.service.batcher.rejected,
+            "flightrec_recorded": flightrec.RECORDER.recorded,
         }
         obs.count("serve.drained")
         return report
@@ -350,11 +408,19 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     signal.signal(signal.SIGTERM, _on_signal)
     signal.signal(signal.SIGINT, _on_signal)
-    # operator escape hatch: SIGUSR2 dumps every thread's stack to
-    # stderr (a resident process should be debuggable without gdb)
+    # operator escape hatch: SIGUSR2 dumps every thread's stack AND the
+    # flight recorder's last-N-requests ring to stderr (a resident
+    # process should be debuggable without gdb, and a p99 spike should
+    # be diagnosable without having had tracing armed)
     import faulthandler
 
-    faulthandler.register(signal.SIGUSR2, all_threads=True)
+    def _on_usr2(signum: int, frame: Any) -> None:
+        faulthandler.dump_traceback(all_threads=True)
+        sys.stderr.write("SERVE FLIGHTREC "
+                         + json.dumps(flightrec.dump(), sort_keys=True) + "\n")
+        sys.stderr.flush()
+
+    signal.signal(signal.SIGUSR2, _on_usr2)
 
     ready_line = (f"SERVE READY port={daemon.port} pid={os.getpid()} "
                   f"backend={bls.backend_name()} "
@@ -376,6 +442,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     while not stop.is_set():
         stop.wait(0.2)
     report = daemon.drain(ns.drain_timeout_s)
+    # the drain dump: the flight recorder's tail survives to stderr so a
+    # post-mortem has the last requests even without /debug access
+    sys.stderr.write("SERVE FLIGHTREC "
+                     + json.dumps(flightrec.dump(), sort_keys=True) + "\n")
+    sys.stderr.flush()
     print(f"SERVE DRAINED {json.dumps(report, sort_keys=True)}", flush=True)
     return 0 if (report.get("queue_drained", True)
                  and report.get("inflight_answered", True)) else 1

@@ -33,8 +33,21 @@ WIRE_VERSION = 1
 API_PREFIX = f"/v{WIRE_VERSION}"
 
 # method name -> route (POST). GET routes: /metrics /healthz /readyz
+# /debug/requests /debug/slowest
 METHODS = ("verify", "verify_batch", "hash_tree_root",
            "hash_tree_root_batch", "process_block")
+
+# introspection surface: scraped by monitors, never served traffic —
+# excluded from serve.request_ms accounting, the flight recorder, and
+# SLO denominators so a tight scrape loop cannot skew the histograms
+INTROSPECTION_ROUTES = ("/metrics", "/healthz", "/readyz")
+DEBUG_PREFIX = "/debug/"
+
+# every request body MAY carry a trace context field (v1 clients that
+# omit it are unaffected): a W3C-traceparent-shaped string
+# ``00-<trace-id>-<parent-span-id>-01`` linking the daemon-side spans
+# under the client's request span (obs.traceparent / obs.remote_span)
+TRACE_FIELD = "trace"
 
 BAD_REQUEST = "bad_request"
 NOT_FOUND = "not_found"
@@ -166,6 +179,24 @@ def check_version(obj: Dict[str, Any]) -> None:
     v = obj.get("v")
     if v is not None and v != WIRE_VERSION:
         raise bad_request(f"wire version {v} not supported (have {WIRE_VERSION})")
+
+
+def is_introspection(path: str) -> bool:
+    """True for the scrape/debug surface (never served traffic)."""
+    return path in INTROSPECTION_ROUTES or path.startswith(DEBUG_PREFIX)
+
+
+def trace_context(params: Dict[str, Any]) -> Optional[str]:
+    """The optional wire trace field. Present-but-not-a-string is a bad
+    request (a typed contract violation); an unparseable traceparent
+    STRING is the W3C restart-the-trace case and is handled downstream
+    (obs.remote_span degrades to a fresh span)."""
+    value = params.get(TRACE_FIELD)
+    if value is None:
+        return None
+    if not isinstance(value, str):
+        raise bad_request(f"{TRACE_FIELD}: expected a traceparent string")
+    return value
 
 
 def route_for(method: str) -> str:

@@ -32,6 +32,7 @@ import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from .. import obs
+from ..obs import flightrec
 from ..resilience import chaos
 from . import protocol
 from .batcher import VerifyBatcher
@@ -110,14 +111,22 @@ class SpecService:
                                         f"unknown method {method!r}")
         t0 = time.monotonic()
         try:
-            with obs.span("serve.request", method=method,
-                          fork=params.get("fork"), preset=params.get("preset")):
+            # an optional wire trace field adopts the CLIENT's context:
+            # this request span parents under the client's span id and
+            # carries its trace id, so the merged trace links
+            # client -> daemon -> shared flush with flow arrows
+            with obs.remote_span("serve.request", protocol.trace_context(params),
+                                 method=method, fork=params.get("fork"),
+                                 preset=params.get("preset")) as sp:
+                flightrec.note(span=sp.span_id)
                 chaos("serve.request")
                 obs.count(f"serve.requests.{method}")
                 return fn(params)
         finally:
             # span histograms only feed when tracing is armed; /metrics
-            # must expose request latency unconditionally
+            # must expose request latency unconditionally. Introspection
+            # endpoints never reach handle(), so scrapers cannot skew
+            # this histogram (protocol.is_introspection).
             obs.observe("serve.request_ms", (time.monotonic() - t0) * 1e3)
 
     # -- methods -------------------------------------------------------

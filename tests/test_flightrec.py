@@ -1,0 +1,171 @@
+"""Flight recorder (obs/flightrec.py) satellites: the bounded ring,
+thread-local note/commit, the daemon's /debug endpoints, and the
+introspection-exclusion bugfix — scrapers must never skew the
+served-traffic histograms or SLO denominators."""
+import os
+import sys
+import threading
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from consensus_specs_tpu import obs
+from consensus_specs_tpu.obs import flightrec
+from consensus_specs_tpu.obs.flightrec import FlightRecorder
+from consensus_specs_tpu.serve import (
+    ServeClient,
+    ServeDaemon,
+    SpecService,
+    VerifyBatcher,
+)
+from consensus_specs_tpu.serve.protocol import is_introspection
+
+
+# -- the ring ---------------------------------------------------------------
+
+def test_ring_is_bounded_and_newest_first():
+    rec = FlightRecorder(capacity=4)
+    for i in range(10):
+        rec.begin(f"m{i}", trace=f"t{i}")
+        rec.commit()
+    assert len(rec) == 4
+    assert rec.recorded == 10
+    got = rec.requests()
+    assert [e["method"] for e in got] == ["m9", "m8", "m7", "m6"]
+    assert rec.requests(n=2)[0]["method"] == "m9"
+    assert rec.requests(trace="t8")[0]["method"] == "m8"
+    assert rec.requests(trace="t0") == []  # evicted
+
+
+def test_note_merges_into_open_entry_and_commit_closes_it():
+    rec = FlightRecorder()
+    rec.begin("verify", trace="abc")
+    rec.note(cache_hit=True, queue_wait_ms=1.5)
+    rec.note(batch_rows=3)
+    entry = rec.commit(status="ok")
+    assert entry["cache_hit"] is True and entry["batch_rows"] == 3
+    assert entry["total_ms"] >= 0
+    # no open entry: note/commit are safe no-ops
+    rec.note(ignored=True)
+    assert rec.commit() is None
+    assert len(rec) == 1
+
+
+def test_error_commit_and_slowest_ordering():
+    rec = FlightRecorder()
+    rec.begin("a")
+    rec.commit(status="internal", error="x" * 500)
+    a, = rec.requests()
+    assert a["status"] == "internal" and len(a["error"]) == 200  # capped
+    # slowest sorts by total_ms regardless of commit order
+    for ms, name in ((5.0, "mid"), (9.0, "slow"), (1.0, "fast")):
+        rec.begin(name)
+        entry = rec.commit()
+        entry["total_ms"] = ms  # deterministic ordering for the test
+    assert [e["method"] for e in rec.slowest(2)] == ["slow", "mid"]
+    dump = rec.dump()
+    assert dump["recorded"] == 4 and dump["buffered"] == 4
+    assert dump["slowest"][0]["method"] == "slow"
+
+
+def test_entries_are_thread_local():
+    rec = FlightRecorder()
+    seen = {}
+
+    def worker(name):
+        rec.begin(name)
+        rec.note(who=name)
+        seen[name] = rec.commit()
+
+    threads = [threading.Thread(target=worker, args=(f"w{i}",))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10)
+    assert all(seen[f"w{i}"]["who"] == f"w{i}" for i in range(4))
+
+
+# -- the daemon surface ------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def daemon():
+    service = SpecService(forks=("phase0",), presets=("minimal",),
+                          batcher=VerifyBatcher(linger_ms=2))
+    d = ServeDaemon(service).start(warm=False)
+    yield d
+    d.drain(10)
+
+
+@pytest.fixture(scope="module")
+def checks():
+    from consensus_specs_tpu.crypto.bls import ciphersuite as oracle
+    from consensus_specs_tpu.crypto.bls.fields import R
+
+    sks = [61, 62]
+    pks = [oracle.SkToPk(sk) for sk in sks]
+    msg = b"\x6a" * 32
+    return pks, msg, oracle.Sign(sum(sks) % R, msg)
+
+
+def test_debug_endpoints_expose_completed_requests(daemon, checks):
+    flightrec.RECORDER.clear()
+    pks, msg, sig = checks
+    with ServeClient(daemon.port) as client:
+        assert client.verify(pubkeys=pks, message=msg, signature=sig) is True
+        out = client._roundtrip("GET", "/debug/requests")
+        assert out["recorded"] >= 1 and out["capacity"] == 256
+        entry = out["requests"][0]
+        assert entry["method"] == "verify" and entry["status"] == "ok"
+        assert entry["total_ms"] > 0
+        slowest = client._roundtrip("GET", "/debug/slowest?n=1")
+        assert len(slowest["requests"]) == 1
+        # bad n is ignored, not a 500
+        assert client._roundtrip("GET", "/debug/requests?n=zzz")["requests"]
+
+
+def test_failed_requests_are_recorded_with_status(daemon):
+    flightrec.RECORDER.clear()
+    from consensus_specs_tpu.serve.client import ServeError
+
+    with ServeClient(daemon.port) as client:
+        with pytest.raises(ServeError):
+            client.call("hash_tree_root", {"fork": "phase0",
+                                           "preset": "minimal",
+                                           "type": "Nope", "ssz": "0x00"})
+        out = client._roundtrip("GET", "/debug/requests?n=1")
+    assert out["requests"][0]["status"] == "bad_request"
+    assert "Nope" in out["requests"][0]["error"]
+
+
+def test_introspection_routes_never_skew_served_histograms(daemon, checks):
+    """The ISSUE 7 bugfix satellite: /metrics //healthz //readyz //debug
+    scrapes are counted apart and excluded from serve.request_ms, the
+    flight recorder, and the SLO denominators."""
+    for route in ("/metrics", "/healthz", "/readyz", "/debug/requests",
+                  "/debug/slowest"):
+        assert is_introspection(route)
+    assert not is_introspection("/v1/verify")
+
+    pks, msg, sig = checks
+    with ServeClient(daemon.port) as client:
+        # one served request so the histogram exists
+        client.verify(pubkeys=pks, message=msg, signature=sig)
+        before = obs.snapshot()["counters"]
+        recorded_before = flightrec.RECORDER.recorded
+        for _ in range(5):
+            client.metrics()
+            client.health()
+            client.ready()
+            client._roundtrip("GET", "/debug/requests")
+    after = obs.snapshot()["counters"]
+    assert after.get("serve.request_ms.count") == \
+        before.get("serve.request_ms.count")
+    assert after.get("serve.responses") == before.get("serve.responses")
+    assert after.get("serve.errors.internal", 0) == \
+        before.get("serve.errors.internal", 0)
+    # scrapes are visible on their own counter, not invisible
+    assert after.get("serve.introspection", 0) >= \
+        before.get("serve.introspection", 0) + 20
+    assert flightrec.RECORDER.recorded == recorded_before

@@ -102,3 +102,70 @@ def test_prometheus_accepts_external_snapshot():
     assert "x 2" in text
     assert 'h{quantile="0.9"}' not in text  # None quantiles skipped
     assert 'h{quantile="0.99"} 1' in text
+    # external snapshots without raw buckets simply skip the histogram
+    # family; no _bucket lines are fabricated
+    assert "_hist_bucket" not in text
+
+
+# -- true histogram exposition (cumulative _bucket lines) --------------------
+
+def _parse_exposition(text):
+    """promtool-style mini-parser: {family: type} from # TYPE lines and
+    {sample name incl labels: value} from sample lines."""
+    types, samples = {}, {}
+    for line in text.strip().splitlines():
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            family, ftype = rest.rsplit(" ", 1)
+            assert family not in types, f"duplicate TYPE for {family}"
+            types[family] = ftype
+        elif line and not line.startswith("#"):
+            name, _, value = line.rpartition(" ")
+            samples[name] = float(value)
+    return types, samples
+
+
+def test_histogram_bucket_exposition_follows_promtool_rules():
+    for v in (0.05, 0.3, 0.3, 3.0, 40.0, 9999.0, 123456.0):
+        metrics.observe("serve.request_ms", v)
+    text = metrics.prometheus_text()
+    types, samples = _parse_exposition(text)
+
+    # one TYPE per family: the summary and the histogram are SEPARATE
+    # families (promtool rejects a name typed both ways)
+    assert types["serve_request_ms"] == "summary"
+    assert types["serve_request_ms_hist"] == "histogram"
+
+    buckets = [(name, v) for name, v in samples.items()
+               if name.startswith('serve_request_ms_hist_bucket{le="')]
+    assert buckets, text
+    # le bounds ascend and counts are cumulative (monotonic nondecreasing)
+    bounds = []
+    counts = []
+    for name, v in buckets:
+        le = name.split('le="', 1)[1].rstrip('"}')
+        bounds.append(float("inf") if le == "+Inf" else float(le))
+        counts.append(v)
+    assert bounds == sorted(bounds)
+    assert bounds[-1] == float("inf"), "+Inf bucket is mandatory"
+    assert counts == sorted(counts), "bucket counts must be cumulative"
+    # +Inf == _count, _sum present (promtool's histogram contract)
+    assert counts[-1] == samples["serve_request_ms_hist_count"] == 7
+    assert samples["serve_request_ms_hist_sum"] == pytest.approx(
+        0.05 + 0.3 + 0.3 + 3.0 + 40.0 + 9999.0 + 123456.0)
+    # spot-check cumulativity against the known samples
+    by_bound = dict(zip(bounds, counts))
+    assert by_bound[0.1] == 1       # 0.05
+    assert by_bound[0.5] == 3       # + two 0.3s
+    assert by_bound[5.0] == 4       # + 3.0
+    assert by_bound[50.0] == 5      # + 40.0
+    assert by_bound[10000.0] == 6   # + 9999.0; 123456 only in +Inf
+
+
+def test_histogram_quantile_summary_still_present_alongside_buckets():
+    for v in range(1, 11):
+        metrics.observe("lat_ms", float(v))
+    text = metrics.prometheus_text()
+    assert 'lat_ms{quantile="0.5"} 5' in text
+    assert 'lat_ms_hist_bucket{le="5"} 5' in text
+    assert "lat_ms_hist_count 10" in text

@@ -111,6 +111,27 @@ def test_slowed_serve_daemon_fails_gate(tmp_path):
     assert "gate FAILED" in proc.stdout
 
 
+def test_budget_burning_daemon_fails_slo_gate(tmp_path):
+    """The ISSUE-7 drill: `make perfgate` includes the serve SLO gate.
+    A chaos-burned availability (0.5 vs the 0.999 objective) fails the
+    gate even on a COLD ledger — the SLO is absolute, not baseline-
+    relative — and the banked SLO points carry the burned value as
+    evidence."""
+    ledger_path = str(tmp_path / "ledger.jsonl")
+    summary_path = tmp_path / "summary.json"
+    proc = _run(["--ledger", ledger_path, "--json", str(summary_path)],
+                env_extra={"CONSENSUS_SPECS_TPU_PERF_CHAOS":
+                           "serve_slo_availability=0.5"})
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "burning" in proc.stdout
+    assert "gate FAILED" in proc.stdout
+    summary = json.loads(summary_path.read_text())
+    assert summary["slo"]["ok"] is False
+    assert summary["metrics"]["serve_slo_availability"] == 0.5
+    led = ledger_mod.Ledger(ledger_path)
+    assert len(led.series("serve_slo_availability")) == 1  # evidence banked
+
+
 def test_environmental_gap_does_not_fail_gate(tmp_path):
     """The device-unreachable shape at the gate level: an established
     jax-backend baseline that this (host-only) run cannot exercise is an

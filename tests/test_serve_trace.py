@@ -1,0 +1,200 @@
+"""ISSUE 7 tentpole acceptance: cross-wire trace linkage. A client with
+tracing armed drives an in-process daemon; the exported trace.json must
+contain the daemon-side request span parented under the client's
+request span (same trace id), a synthesized queue-wait child, and the
+shared flush span linked to the member request — including under a
+chaos-degraded flush — with flow arrows in the Chrome export, and
+``/debug/requests`` must return the same request by trace id."""
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from consensus_specs_tpu import obs, resilience
+from consensus_specs_tpu.obs import flightrec
+from consensus_specs_tpu.obs.core import parse_traceparent
+from consensus_specs_tpu.serve import (
+    ServeClient,
+    ServeDaemon,
+    ServeError,
+    SpecService,
+    VerifyBatcher,
+)
+
+
+@pytest.fixture(scope="module")
+def daemon():
+    service = SpecService(forks=("phase0",), presets=("minimal",),
+                          batcher=VerifyBatcher(linger_ms=2))
+    d = ServeDaemon(service).start(warm=False)
+    yield d
+    d.drain(10)
+
+
+@pytest.fixture(scope="module")
+def checks():
+    from consensus_specs_tpu.crypto.bls import ciphersuite as oracle
+    from consensus_specs_tpu.crypto.bls.fields import R
+
+    sks = [51, 52]
+    pks = [oracle.SkToPk(sk) for sk in sks]
+    msg = b"\x5a" * 32
+    sig = oracle.Sign(sum(sks) % R, msg)
+    return pks, msg, sig
+
+
+@pytest.fixture()
+def trace_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv(obs.TRACE_ENV, str(tmp_path))
+    flightrec.RECORDER.clear()
+    yield tmp_path
+
+
+def _spans(trace_dir):
+    return [r for r in obs.read_records(str(trace_dir))
+            if r.get("type") == "span"]
+
+
+def _span_map(spans):
+    return {s["name"]: s for s in spans}
+
+
+# -- traceparent helpers -----------------------------------------------------
+
+def test_traceparent_round_trip(trace_dir):
+    with obs.span("client.root") as sp:
+        tp = obs.traceparent()
+        assert tp is not None and tp.startswith("00-") and tp.endswith("-01")
+        parsed = parse_traceparent(tp)
+        assert parsed is not None
+        assert parsed["parent_id"] == sp.span_id
+        # the zfilled 32-char trace field recovers the native 16-char id
+        assert len(parsed["trace_id"]) == 16
+        assert tp.split("-")[1].endswith(parsed["trace_id"].lstrip("0") or "0")
+
+
+def test_traceparent_none_without_span_or_tracing(trace_dir, monkeypatch):
+    assert obs.traceparent() is None  # armed, but no open span
+    monkeypatch.delenv(obs.TRACE_ENV)
+    assert obs.traceparent() is None  # disarmed
+
+
+@pytest.mark.parametrize("bad", [
+    None, 7, "", "garbage", "01-aa-bb-01", "00-zz-bb",  # wrong shape
+    "00-" + "0" * 32 + "-x-01",                          # all-zero trace
+])
+def test_parse_traceparent_rejects_malformed(bad):
+    assert parse_traceparent(bad) is None
+
+
+# -- the linkage drill -------------------------------------------------------
+
+def _drive_and_export(daemon, trace_dir, checks, message=None):
+    pks, msg, sig = checks
+    with obs.span("drill.root"):
+        with ServeClient(daemon.port) as client:
+            assert client.verify(pubkeys=pks, message=message or msg,
+                                 signature=sig) in (True, False)
+    spans = _spans(trace_dir)
+    by_name = _span_map(spans)
+    for required in ("drill.root", "serve.client", "serve.request",
+                     "serve.queue_wait", "serve.flush"):
+        assert required in by_name, \
+            f"{required} missing from {sorted(by_name)}"
+    return spans, by_name
+
+
+def test_cross_wire_linkage(daemon, trace_dir, checks):
+    spans, by_name = _drive_and_export(daemon, trace_dir, checks)
+    client_span = by_name["serve.client"]
+    request = by_name["serve.request"]
+    queue_wait = by_name["serve.queue_wait"]
+    flush = by_name["serve.flush"]
+
+    # daemon request adopts the client's context: parent AND trace id
+    assert request["parent"] == client_span["span"]
+    assert request["trace"] == client_span["trace"]
+    assert request.get("remote") is True
+    # the synthesized queue-wait child hangs under the daemon request
+    assert queue_wait["parent"] == request["span"]
+    assert queue_wait["trace"] == client_span["trace"]
+    # the shared flush links the member request and names its trace
+    assert request["span"] in flush.get("links", [])
+    assert client_span["trace"] in str(flush["attrs"].get("client_traces"))
+
+    # the Chrome export draws the flow arrows (client->daemon + link)
+    path = obs.export_chrome(str(trace_dir))
+    with open(path) as f:
+        trace = json.load(f)
+    ok, why = obs.validate_chrome(trace)
+    assert ok, why
+    flows = [e for e in trace["traceEvents"] if e.get("ph") in ("s", "f")]
+    assert {e["name"] for e in flows} >= {"spawn", "link"}
+    # round trip: links survive trace.json -> records
+    rt = obs.records_from_chrome(trace)
+    rt_flush = [r for r in rt if r["name"] == "serve.flush"][0]
+    assert request["span"] in rt_flush.get("links", [])
+
+
+def test_debug_requests_returns_same_request_by_trace_id(daemon, trace_dir,
+                                                         checks):
+    # a fresh message: a result-cache hit would answer without a flush
+    spans, by_name = _drive_and_export(daemon, trace_dir, checks,
+                                       message=b"\x5d" * 32)
+    trace_id = by_name["serve.client"]["trace"]
+    with ServeClient(daemon.port) as client:
+        out = client._roundtrip("GET", f"/debug/requests?trace={trace_id}")
+    assert out["requests"], f"no flight-recorder entry for trace {trace_id}"
+    entry = out["requests"][0]
+    assert entry["trace"] == trace_id
+    assert entry["method"] == "verify"
+    assert entry["span"] == by_name["serve.request"]["span"]
+    assert entry["status"] == "ok"
+    assert entry["queue_wait_ms"] >= 0 and entry["flush_ms"] >= 0
+    assert entry["batch_rows"] >= 1
+
+
+def test_linkage_survives_chaos_degraded_flush(daemon, trace_dir, checks):
+    pks, msg, sig = checks
+    tampered = b"\x5b" * 32
+    with resilience.inject("serve.flush", "deterministic", count=1):
+        spans, by_name = _drive_and_export(daemon, trace_dir, checks,
+                                           message=tampered)
+    request = by_name["serve.request"]
+    flush = by_name["serve.flush"]
+    assert request["parent"] == by_name["serve.client"]["span"]
+    assert request["span"] in flush.get("links", [])
+    # the degradation is visible on the SAME request: resilience instant
+    # in the trace + degraded flag in the flight recorder
+    instants = [r for r in obs.read_records(str(trace_dir))
+                if r.get("type") == "instant"
+                and str(r.get("name", "")).startswith("resilience.")]
+    assert instants, "chaos-degraded flush left no resilience instant"
+    entry = flightrec.requests(trace=request["trace"])[0]
+    assert entry.get("degraded") is True
+    assert entry["status"] == "ok"  # degraded, still answered correctly
+
+
+# -- v1 compatibility: the trace field is optional ---------------------------
+
+def test_untraced_client_and_malformed_trace_are_served(daemon, checks):
+    pks, msg, sig = checks
+    with ServeClient(daemon.port) as client:
+        # no tracing armed: no trace field, served as before
+        assert client.verify(pubkeys=pks, message=msg, signature=sig) is True
+        # malformed traceparent STRING: ignored (trace restarts), served
+        from consensus_specs_tpu.serve.protocol import to_hex
+
+        out = client.call("verify", {
+            "pubkeys": [to_hex(p) for p in pks], "message": to_hex(msg),
+            "signature": to_hex(sig), "trace": "not-a-traceparent"})
+        assert out["valid"] is True
+        # non-string trace: a typed contract violation -> 400
+        with pytest.raises(ServeError) as e:
+            client.call("verify", {
+                "pubkeys": [to_hex(p) for p in pks], "message": to_hex(msg),
+                "signature": to_hex(sig), "trace": 12345})
+        assert e.value.status == 400

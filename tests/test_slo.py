@@ -1,0 +1,201 @@
+"""SLO plane (obs/slo.py + tools/slo_report.py + the perfgate hook):
+objective math, multi-window burn rates over ledger points, the gate's
+burning / ok / environmental verdicts (chaos-drillable), and the
+prometheus-scrape observation path."""
+import json
+import os
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from consensus_specs_tpu.obs import ledger as ledger_mod
+from consensus_specs_tpu.obs import metrics, slo
+
+
+@pytest.fixture(autouse=True)
+def _clean_metrics():
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+def _snap(responses=0, internal=0, request_ms=()):
+    metrics.reset()
+    if responses:
+        metrics.count("serve.responses", responses)
+    if internal:
+        metrics.count("serve.errors.internal", internal)
+    for v in request_ms:
+        metrics.observe("serve.request_ms", v)
+    return metrics.snapshot()
+
+
+# -- objectives + evaluation -------------------------------------------------
+
+def test_objectives_defaults_and_env_override(monkeypatch):
+    avail, latency = slo.serve_objectives()
+    assert avail.target == 0.999 and avail.kind == "availability"
+    assert latency.target == 25.0 and latency.kind == "latency_p99"
+    monkeypatch.setenv(slo.AVAILABILITY_TARGET_ENV, "0.99")
+    monkeypatch.setenv(slo.P99_OBJECTIVE_ENV, "50")
+    avail, latency = slo.serve_objectives()
+    assert avail.target == 0.99 and latency.target == 50.0
+
+
+def test_observed_from_snapshot_excludes_4xx_from_denominator():
+    snap = _snap(responses=99, internal=1, request_ms=[1.0] * 10)
+    metrics.count("serve.errors.bad_request", 50)  # 4xx: never counted
+    observed = slo.observed_from_snapshot(metrics.snapshot())
+    assert observed["requests"] == 100
+    assert observed["availability"] == 0.99
+    assert observed["p99_ms"] == 1.0
+
+
+def test_evaluate_budget_math():
+    ok = slo.evaluate({"availability": 1.0, "p99_ms": 5.0, "requests": 10})
+    by_name = {s["objective"]: s for s in ok}
+    avail = by_name["serve_availability"]
+    assert avail["verdict"] == slo.OK and avail["budget_remaining"] == 1.0
+    lat = by_name["serve_latency_p99"]
+    assert lat["verdict"] == slo.OK
+    assert lat["budget_remaining"] == pytest.approx(0.8)
+
+    burning = slo.evaluate({"availability": 0.99, "p99_ms": 50.0,
+                            "requests": 100})
+    by_name = {s["objective"]: s for s in burning}
+    assert by_name["serve_availability"]["verdict"] == slo.BURNING
+    assert by_name["serve_availability"]["burn"] == pytest.approx(10.0)
+    assert by_name["serve_latency_p99"]["verdict"] == slo.BURNING
+    assert by_name["serve_latency_p99"]["budget_remaining"] == pytest.approx(-1.0)
+
+    nodata = {s["objective"]: s
+              for s in slo.evaluate({"availability": None, "p99_ms": None})}
+    assert all(s["verdict"] == slo.NO_DATA and not s["burning"]
+               for s in nodata.values())
+
+
+def test_ledger_points_shape():
+    statuses = slo.evaluate({"availability": 0.9995, "p99_ms": 5.0,
+                             "requests": 10})
+    points = slo.ledger_points(statuses)
+    assert points[slo.AVAILABILITY_POINT] == pytest.approx(0.9995)
+    assert points[slo.P99_BUDGET_POINT] == pytest.approx(0.8)
+    assert slo.ledger_points(slo.evaluate({"availability": None,
+                                           "p99_ms": None})) == {}
+
+
+# -- burn rates --------------------------------------------------------------
+
+def test_burn_rates_multi_window():
+    now = 1_000_000.0
+    points = [
+        # 30 min ago: a bad probe (availability 0.99 vs target 0.999)
+        {"ts": now - 1800, "value": 0.99},
+        # 3h ago: perfect
+        {"ts": now - 3 * 3600, "value": 1.0},
+        # 20h ago: perfect
+        {"ts": now - 20 * 3600, "value": 1.0},
+        # outside every window
+        {"ts": now - 90 * 3600, "value": 0.0},
+    ]
+    rates = slo.burn_rates(points, target=0.999, now=now)
+    assert rates["1h"]["points"] == 1
+    assert rates["1h"]["burn_rate"] == pytest.approx(10.0)
+    assert rates["6h"]["points"] == 2
+    assert rates["6h"]["burn_rate"] == pytest.approx(5.0)
+    assert rates["24h"]["points"] == 3
+    assert rates["24h"]["burn_rate"] == pytest.approx(10.0 / 3, abs=1e-3)
+    empty = slo.burn_rates([], target=0.999, now=now)
+    assert empty["1h"]["points"] == 0 and "burn_rate" not in empty["1h"]
+
+
+# -- the gate (perfgate hook) ------------------------------------------------
+
+def test_gate_ok_burning_and_chaos_drill():
+    snap = _snap(responses=200, request_ms=[1.0] * 50)
+    assert slo.gate(snap)["ok"] is True
+
+    # the CONSENSUS_SPECS_TPU_PERF_CHAOS drill shape: a factor matching
+    # serve_slo_availability simulates a budget-burning daemon
+    def chaos(metric):
+        return 0.5 if "serve_slo_availability" in metric else 1.0
+
+    burned = slo.gate(snap, chaos_factor=chaos)
+    assert burned["ok"] is False and burned["verdict"] == slo.BURNING
+    assert burned["observed"]["availability"] == 0.5
+    # the latency drill: p99 inflated past the objective
+    slowed = slo.gate(snap, chaos_factor=lambda m: (
+        1000.0 if "serve_slo_p99_ms" in m else 1.0))
+    assert slowed["ok"] is False
+
+    # a real burn (5xx fraction above budget) with no chaos
+    bad = slo.gate(_snap(responses=90, internal=10, request_ms=[1.0] * 50))
+    assert bad["ok"] is False
+    assert bad["points"][slo.AVAILABILITY_POINT] == pytest.approx(0.9)
+
+
+def test_gate_environmental_gap_never_fails():
+    # an environmentally-skipped serving slice passes with no points
+    snap = _snap(responses=100, request_ms=[1.0])
+    gap = slo.gate(snap, skipped_environmental=True)
+    assert gap["ok"] is True and gap["verdict"] == slo.ENV_GAP
+    assert gap["points"] == {}
+    # zero served requests (slice never ran) is the same gap — even
+    # under a chaos factor that WOULD burn a real run
+    empty = slo.gate(_snap(), chaos_factor=lambda m: 0.0)
+    assert empty["ok"] is True and empty["verdict"] == slo.ENV_GAP
+
+
+# -- black-box observation (scraped /metrics) --------------------------------
+
+def test_observed_from_prometheus_round_trip():
+    _snap(responses=40, internal=10, request_ms=[2.0] * 90 + [80.0] * 10)
+    text = metrics.prometheus_text()
+    observed = slo.observed_from_prometheus(text)
+    assert observed["requests"] == 50
+    assert observed["availability"] == pytest.approx(0.8)
+    assert observed["p99_ms"] == pytest.approx(80.0)
+    assert slo.observed_from_prometheus("")["availability"] is None
+
+
+# -- tools/slo_report.py -----------------------------------------------------
+
+def _report_main(argv):
+    import importlib.util
+    import pathlib
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    spec = importlib.util.spec_from_file_location(
+        "slo_report", repo / "tools" / "slo_report.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.main(argv)
+
+
+def test_slo_report_cold_then_banked(tmp_path, capsys):
+    ledger_path = str(tmp_path / "ledger.jsonl")
+    assert _report_main(["--ledger", ledger_path]) == 2  # no data at all
+
+    led = ledger_mod.Ledger(ledger_path)
+    now = time.time()
+    led.record_run({slo.AVAILABILITY_POINT: 1.0, slo.P99_BUDGET_POINT: 0.9},
+                   source="serve_canary", backend="host", ts=now - 600)
+    led.record_run({slo.AVAILABILITY_POINT: 0.998,
+                    slo.P99_BUDGET_POINT: 0.8},
+                   source="perfgate", backend="host", ts=now)
+    json_out = tmp_path / "slo.json"
+    assert _report_main(["--ledger", ledger_path, "--json",
+                         str(json_out), "--gate"]) == 1  # latest is burning
+    report = json.loads(json_out.read_text())
+    assert report["history"][slo.AVAILABILITY_POINT] == 2
+    assert report["latest_availability"] == pytest.approx(0.998)
+    assert report["burn_rates"]["1h"]["points"] == 2
+    out = capsys.readouterr().out
+    assert "burn" in out and "GATE FAILED" in out
+
+    led.record_run({slo.AVAILABILITY_POINT: 1.0, slo.P99_BUDGET_POINT: 0.9},
+                   source="perfgate", backend="host", ts=now + 1)
+    assert _report_main(["--ledger", ledger_path, "--gate"]) == 0

@@ -18,7 +18,10 @@ device-unreachable round lands as a first-class host-only datapoint
 - ``--html OUT``: a single self-contained HTML file with an inline-SVG
   series per metric — host-only datapoints (degraded runs) drawn as
   open markers so an environment gap is visually distinct from a
-  regression;
+  regression; the ``serve_*`` series (bench p50/p99/verifies_per_s,
+  canary probes, SLO availability/latency-budget points) render in
+  their own "Serving plane" section with absolute SLO badges next to
+  the relative sentinel verdicts;
 - ``--prom OUT``: Prometheus text exposition of the latest datapoint
   per metric (plus run counters), for scraping into a dashboard.
 
@@ -38,7 +41,7 @@ REPO = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
 from consensus_specs_tpu.obs import ledger as ledger_mod  # noqa: E402
-from consensus_specs_tpu.obs import sentinel  # noqa: E402
+from consensus_specs_tpu.obs import sentinel, slo  # noqa: E402
 
 
 def _open_ledger(path: Optional[str]) -> ledger_mod.Ledger:
@@ -163,27 +166,45 @@ def html_report(led: ledger_mod.Ledger) -> str:
         sentinel.REGRESSED: "#b91c1c", sentinel.NO_BASELINE: "#64748b",
         sentinel.ENV_GAP: "#c2410c",
     }
-    rows = []
-    for metric in sorted(series):
+    def _badge(text: str, color: str) -> str:
+        return (f'<span style="background:{color};color:#fff;'
+                f'border-radius:4px;padding:1px 6px;font-size:11px">'
+                f'{html_mod.escape(text)}</span>')
+
+    def _metric_row(metric: str, slo_col: bool = False) -> str:
         pts = series[metric]
         latest = pts[-1]
         v = verdicts.get((metric, latest.get("backend")))
         badge = ""
         if v is not None:
-            color = badge_colors.get(v.verdict, "#475569")
-            badge = (f'<span style="background:{color};color:#fff;'
-                     f'border-radius:4px;padding:1px 6px;font-size:11px">'
-                     f'{v.verdict}</span>')
+            badge = _badge(v.verdict, badge_colors.get(v.verdict, "#475569"))
         unit = html_mod.escape(latest.get("unit") or "")
-        rows.append(
+        row = (
             "<tr>"
             f"<td><code>{html_mod.escape(metric)}</code></td>"
             f"<td>{_svg_series(pts)}</td>"
             f"<td style='text-align:right'>{latest['value']:g}{unit}</td>"
             f"<td>{html_mod.escape(str(latest.get('backend')))}</td>"
             f"<td>{len(pts)}</td>"
-            f"<td>{badge}</td>"
-            "</tr>")
+            f"<td>{badge}</td>")
+        if slo_col:
+            # absolute SLO status next to the relative sentinel badge
+            status = ""
+            value = float(latest["value"])
+            if metric == slo.AVAILABILITY_POINT:
+                target = slo.serve_objectives()[0].target
+                status = (_badge("burning", "#b91c1c") if value < target
+                          else _badge(f"≥{target:g}", "#15803d"))
+            elif metric == slo.P99_BUDGET_POINT:
+                status = (_badge("exhausted", "#b91c1c") if value <= 0
+                          else _badge(f"{value:+.0%} left", "#15803d"))
+            row += f"<td>{status}</td>"
+        return row + "</tr>"
+
+    serve_metric_names = sorted(m for m in series if m.startswith("serve_"))
+    serve_rows = [_metric_row(m, slo_col=True) for m in serve_metric_names]
+    rows = [_metric_row(m) for m in sorted(series)
+            if m not in serve_metric_names]
     run_rows = []
     for run in runs:
         env = run.get("environment") or {}
@@ -215,6 +236,11 @@ h1 {{ font-size: 20px; }} h2 {{ font-size: 16px; margin-top: 2rem; }}
 Filled markers = normal datapoints; open orange markers = degraded runs
 (device unreachable / compile failed) recorded as first-class host-only
 datapoints.</p>
+{(f'''<h2>Serving plane (serve_*)</h2>
+<table><tr><th>metric</th><th>trajectory</th><th>latest</th><th>backend</th>
+<th>points</th><th>sentinel</th><th>SLO</th></tr>
+{''.join(serve_rows)}
+</table>''' if serve_rows else '')}
 <h2>Metric trajectories</h2>
 <table><tr><th>metric</th><th>trajectory</th><th>latest</th><th>backend</th>
 <th>points</th><th>sentinel</th></tr>

@@ -40,11 +40,22 @@ the gate (exit 1); ``no_baseline`` (cold ledger), ``improved``,
 fails with an ENVIRONMENTAL fault (missing native lib, say) is skipped
 with a recorded event instead of failing CI.
 
+The run ALSO passes the serve SLO gate (obs/slo.py): the serving slice
+above leaves a full run's served traffic in the always-on ``serve.*``
+aggregates, so availability (non-5xx fraction) and the p99 latency
+budget are evaluated against their absolute objectives and banked as
+``serve_slo_availability`` / ``serve_slo_p99_budget`` ledger points.
+A *burning* objective fails the gate like a confirmed regression; an
+environmentally-skipped serving slice is an environment gap and never
+does.
+
 Chaos knob (tests drill the gate itself):
     CONSENSUS_SPECS_TPU_PERF_CHAOS="<metric-substr>=<factor>[,...]"
 multiplies the measured duration of matching metrics — e.g.
 ``perfgate_hash=2`` makes the hash slice report half its real
-throughput, which an established baseline must flag ``regressed``.
+throughput, which an established baseline must flag ``regressed``;
+``serve_slo_availability=0.5`` halves the observed availability, which
+the SLO gate must flag ``burning``.
 
 Exit status: 0 = gate passed (or --no-gate); 1 = sentinel flagged a
 regression; 2 = a measurement failed deterministically.
@@ -65,7 +76,7 @@ sys.path.insert(0, str(REPO))
 import numpy as np  # noqa: E402  (host-only; never jax)
 
 from consensus_specs_tpu.obs import ledger as ledger_mod  # noqa: E402
-from consensus_specs_tpu.obs import sentinel  # noqa: E402
+from consensus_specs_tpu.obs import sentinel, slo  # noqa: E402
 from consensus_specs_tpu.resilience import classify, record_event  # noqa: E402
 from consensus_specs_tpu.resilience.taxonomy import ENVIRONMENTAL  # noqa: E402
 
@@ -333,9 +344,24 @@ def run_gate(
     report = sentinel.evaluate_run(history, current,
                                    run_environment=env, policy=policy)
     verdict_counts = report.counts()
+
+    # the SLO gate (docs/OBSERVABILITY.md "SLO plane"): absolute
+    # availability/latency objectives over the serving slice this run
+    # just exercised (measure_serve_rtt_ms drives a real in-process
+    # daemon, so the always-on serve.* aggregates hold a full run's
+    # served traffic). Burning the error budget fails the gate like a
+    # confirmed perf regression; an environmentally-skipped serving
+    # slice is an environment gap and never does.
+    slo_result = slo.gate(
+        skipped_environmental="perfgate_serve_rtt_ms" in skipped,
+        chaos_factor=_chaos_factor)
+    metrics.update(slo_result["points"])  # banked alongside the slice
+
     run_id = led.record_run(
         metrics, source="perfgate", backend="host", environment=env,
-        extra={"skipped": skipped or None, "sentinel": verdict_counts})
+        extra={"skipped": skipped or None, "sentinel": verdict_counts,
+               "slo": {"ok": slo_result["ok"],
+                       "verdict": slo_result["verdict"]}})
 
     summary = {
         "run_id": run_id,
@@ -343,8 +369,9 @@ def run_gate(
         "metrics": metrics,
         "skipped": skipped,
         "report": report.to_dict(),
+        "slo": slo_result,
     }
-    code = 1 if (gate and not report.ok) else 0
+    code = 1 if (gate and not (report.ok and slo_result["ok"])) else 0
     return code, summary
 
 
@@ -355,6 +382,8 @@ def print_summary(summary: Dict[str, Any]) -> None:
     print(f"perfgate: run {summary['run_id']} -> {summary['ledger']}")
     verdicts = {v["metric"]: v for v in summary["report"]["verdicts"]}
     for metric, value in sorted(summary["metrics"].items()):
+        if metric.startswith("serve_slo_"):
+            continue  # rendered in the slo section below (absolute gate)
         v = verdicts.get(metric, {})
         base = v.get("baseline_median")
         base_txt = (f"baseline {base:g} (n={v.get('baseline_n', 0)})"
@@ -370,8 +399,23 @@ def print_summary(summary: Dict[str, Any]) -> None:
         if v["verdict"] == sentinel.ENV_GAP:
             print(f"  {v['metric']:<26} {'(gap)':>12}  [environmental] {v.get('detail', '')}")
     counts = summary["report"]["counts"]
-    ok = summary["report"]["ok"]
-    print(f"sentinel: {counts} -> gate {'PASSED' if ok else 'FAILED'}")
+    sentinel_ok = summary["report"]["ok"]
+    print(f"sentinel: {counts} -> "
+          f"{'ok' if sentinel_ok else 'regression confirmed'}")
+    slo_sum = summary.get("slo") or {}
+    slo_ok = slo_sum.get("ok", True)
+    for s in slo_sum.get("statuses", ()):
+        observed = s.get("observed")
+        obs_txt = f"{observed:g}" if observed is not None else "no data"
+        budget = s.get("budget_remaining")
+        budget_txt = (f"  budget remaining {budget:+.2%}"
+                      if budget is not None else "")
+        print(f"  slo {s['objective']:<24} {obs_txt:>10} "
+              f"(target {s['target']:g})  [{s.get('verdict', '?')}]{budget_txt}")
+    if slo_sum:
+        print(f"slo: {slo_sum.get('verdict', '?')}"
+              + (f" — {slo_sum['detail']}" if slo_sum.get("detail") else ""))
+    print(f"perfgate: gate {'PASSED' if (sentinel_ok and slo_ok) else 'FAILED'}")
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -1,0 +1,243 @@
+"""`make serve-canary`: a synthetic black-box prober for the resident
+verification daemon — the canary that feeds the SLO plane.
+
+Usage:
+    python tools/serve_canary.py [--port N | --spawn] [--rounds R]
+                                 [--ledger P] [--json OUT]
+
+Each round drives a FIXED mixed workload through a real client:
+
+- a valid single-key ``verify``          -> must answer True
+- a valid fast-aggregate ``verify``      -> must answer True
+- a **deliberately-invalid signature**   -> must answer False — the
+  canary proves *correctness*, not just liveness: a daemon that blindly
+  200s everything fails the probe
+- a ``hash_tree_root`` with a locally-computed expected root
+- a ``verify_batch`` mixing the above
+
+Every probe is scored: a correct answer inside the latency budget is
+good; a 5xx, a torn connection, or a WRONG answer is bad (a wrong
+answer is worse than an error — it burns availability AND trips the
+correctness flag). Availability = good/total; latencies feed p50/p99.
+
+Ledger (source ``serve_canary``): ``serve_canary_availability``,
+``serve_canary_p50_ms``, ``serve_canary_p99_ms``, plus the SLO series
+``serve_slo_availability`` / ``serve_slo_p99_budget`` (obs/slo.py) so
+canary probes accumulate the burn-rate timeline slo_report renders.
+
+Exit status: 0 = every probe correct; 1 = availability below target or
+any correctness failure; 2 = daemon unreachable.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from consensus_specs_tpu import obs  # noqa: E402
+from consensus_specs_tpu.obs import slo  # noqa: E402
+from consensus_specs_tpu.serve.client import ServeClient, ServeError  # noqa: E402
+from consensus_specs_tpu.serve.protocol import to_hex  # noqa: E402
+
+
+def build_workload() -> List[Dict[str, Any]]:
+    """The fixed probe set: (name, method, params, expected) tuples.
+    Deterministic keys so repeat rounds hit the daemon's result cache —
+    the canary watches the serving machinery, not pairing crypto."""
+    from consensus_specs_tpu.crypto.bls import ciphersuite as oracle
+    from consensus_specs_tpu.crypto.bls.fields import R
+    from consensus_specs_tpu.specs.build import build_spec
+
+    sks = [17, 18]
+    pks = [oracle.SkToPk(sk) for sk in sks]
+    msg = b"serve-canary" + b"\x00" * 20
+    sig = oracle.Sign(sum(sks) % R, msg)
+    single_sig = oracle.Sign(sks[0], msg)
+    # the deliberate tamper: flip the message under a real signature
+    bad_msg = b"serve-canarY" + b"\x00" * 20
+
+    spec = build_spec("phase0", "minimal")
+    checkpoint = spec.Checkpoint(epoch=23, root=b"\x17" * 32)
+
+    valid_single = {"pubkey": to_hex(pks[0]), "message": to_hex(msg),
+                    "signature": to_hex(single_sig)}
+    valid_agg = {"pubkeys": [to_hex(p) for p in pks], "message": to_hex(msg),
+                 "signature": to_hex(sig)}
+    invalid = {"pubkeys": [to_hex(p) for p in pks], "message": to_hex(bad_msg),
+               "signature": to_hex(sig)}
+    return [
+        {"name": "verify_valid_single", "method": "verify",
+         "params": valid_single, "expect": {"valid": True}},
+        {"name": "verify_valid_aggregate", "method": "verify",
+         "params": valid_agg, "expect": {"valid": True}},
+        {"name": "verify_invalid_signature", "method": "verify",
+         "params": invalid, "expect": {"valid": False}},
+        {"name": "hash_tree_root", "method": "hash_tree_root",
+         "params": {"fork": "phase0", "preset": "minimal",
+                    "type": "Checkpoint",
+                    "ssz": to_hex(checkpoint.encode_bytes())},
+         "expect": {"root": to_hex(checkpoint.hash_tree_root())}},
+        {"name": "verify_batch_mixed", "method": "verify_batch",
+         "params": {"checks": [valid_agg, invalid, valid_single]},
+         "expect": {"results": [True, False, True]}},
+    ]
+
+
+def spawn_daemon(tmp: pathlib.Path) -> Tuple[subprocess.Popen, int]:
+    ready_file = tmp / "ready.json"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "consensus_specs_tpu.serve",
+         "--port", "0", "--forks", "phase0", "--presets", "minimal",
+         "--linger-ms", "2", "--ready-file", str(ready_file)],
+        cwd=str(REPO), env=obs.child_env(), stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True)
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if ready_file.exists():
+            return proc, json.loads(ready_file.read_text())["port"]
+        if proc.poll() is not None:
+            raise RuntimeError(f"daemon died at startup rc={proc.returncode}")
+        time.sleep(0.05)
+    proc.kill()
+    raise RuntimeError("daemon not ready within 120s")
+
+
+def run_probes(port: int, rounds: int,
+               workload: List[Dict[str, Any]]) -> Dict[str, Any]:
+    latencies: List[float] = []
+    good = bad = 0
+    failures: List[str] = []
+    with ServeClient(port) as client:
+        # unscored warmup: the first resolution of each distinct check
+        # pays one-time pairing crypto; the scored window watches the
+        # serving machinery (HTTP + queue + flush + cache), like
+        # serve_bench's warmup pass
+        for probe in workload:
+            try:
+                client.call(probe["method"], dict(probe["params"]))
+            except (ServeError, OSError):
+                pass  # scored rounds will see and count it
+        for r in range(rounds):
+            for probe in workload:
+                t0 = time.perf_counter()
+                try:
+                    got = client.call(probe["method"], dict(probe["params"]))
+                except ServeError as e:
+                    bad += 1
+                    failures.append(f"r{r} {probe['name']}: [{e.status}] {e.code}")
+                    continue
+                except OSError as e:
+                    bad += 1
+                    failures.append(f"r{r} {probe['name']}: {type(e).__name__}: {e}")
+                    continue
+                finally:
+                    latencies.append((time.perf_counter() - t0) * 1e3)
+                wrong = [k for k, v in probe["expect"].items()
+                         if got.get(k) != v]
+                if wrong:
+                    bad += 1
+                    failures.append(
+                        f"r{r} {probe['name']}: WRONG ANSWER "
+                        f"{ {k: got.get(k) for k in wrong} } != "
+                        f"{ {k: probe['expect'][k] for k in wrong} }")
+                else:
+                    good += 1
+    return {"good": good, "bad": bad, "failures": failures,
+            "latencies_ms": sorted(latencies)}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--port", type=int, default=None,
+                        help="probe an already-running daemon")
+    parser.add_argument("--spawn", action="store_true",
+                        help="spawn a fresh daemon to probe (default when "
+                             "--port is absent)")
+    parser.add_argument("--rounds", type=int, default=12)
+    parser.add_argument("--ledger", default=None,
+                        help="perf-ledger path ('off' skips banking)")
+    parser.add_argument("--json", dest="json_path", type=pathlib.Path,
+                        default=None)
+    ns = parser.parse_args(argv)
+
+    workload = build_workload()
+    proc: Optional[subprocess.Popen] = None
+    port = ns.port
+    if port is None:
+        tmp = pathlib.Path(tempfile.mkdtemp(prefix="serve_canary_"))
+        try:
+            proc, port = spawn_daemon(tmp)
+        except RuntimeError as e:
+            print(f"serve_canary: UNREACHABLE — {e}")
+            return 2
+        print(f"serve_canary: spawned daemon on :{port}")
+
+    try:
+        stats = run_probes(port, ns.rounds, workload)
+    finally:
+        if proc is not None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.communicate(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    from consensus_specs_tpu.obs.metrics import percentile
+
+    total = stats["good"] + stats["bad"]
+    availability = stats["good"] / total if total else 0.0
+    lat = stats["latencies_ms"]
+    p50, p99 = percentile(lat, 50), percentile(lat, 99)
+    print(f"serve_canary: {total} probes over {ns.rounds} rounds -> "
+          f"availability {availability:.4f}, p50 {p50:.2f}ms p99 {p99:.2f}ms")
+    for failure in stats["failures"][:8]:
+        print(f"serve_canary:   FAIL {failure}")
+
+    observed = {"requests": total, "errors_5xx": stats["bad"],
+                "availability": availability, "p99_ms": p99}
+    statuses = slo.evaluate(observed)
+    metrics: Dict[str, Any] = {
+        "serve_canary_availability": round(availability, 6),
+        "serve_canary_p50_ms": round(p50, 3) if p50 is not None else None,
+        "serve_canary_p99_ms": round(p99, 3) if p99 is not None else None,
+    }
+    metrics.update(slo.ledger_points(statuses))
+
+    summary = {"rounds": ns.rounds, "probes": total,
+               "availability": availability, "failures": stats["failures"],
+               "metrics": metrics, "slo": statuses}
+    if (ns.ledger or "").strip().lower() not in ("off", "none", "0"):
+        from consensus_specs_tpu.obs import ledger as ledger_mod
+
+        path = ns.ledger or ledger_mod.default_path()
+        if path:
+            run_id = ledger_mod.Ledger(path).record_run(
+                metrics, source="serve_canary", backend="host",
+                extra={"rounds": ns.rounds, "probes": total,
+                       "correctness_failures": sum(
+                           1 for f in stats["failures"] if "WRONG ANSWER" in f)})
+            summary["ledger"] = {"path": path, "run_id": run_id}
+            print(f"serve_canary: banked as {run_id} -> {path}")
+    if ns.json_path is not None:
+        with open(ns.json_path, "w") as f:
+            json.dump(summary, f, indent=2, sort_keys=True)
+
+    target = slo.serve_objectives()[0].target
+    if stats["failures"] or availability < target:
+        print("serve_canary: FAIL")
+        return 1
+    print("serve_canary: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,10 +8,15 @@ Asserts (exit 1 on any failure):
 - 4 concurrent clients each complete a verify + verify_batch +
   hash_tree_root mix with correct answers (valid checks True, tampered
   check False, roots matching the locally computed root);
-- /metrics is Prometheus text exposing serve.* counters and the
-  span-fed serve.request latency summary;
+- /metrics is Prometheus text exposing serve.* counters, the
+  span-fed serve.request latency summary, and cumulative
+  serve_request_ms_hist_bucket lines;
 - /healthz reports ready, the served matrix, and queue/cache stats;
-- SIGTERM produces "SERVE DRAINED", exit code 0, and a drained queue.
+- /debug/requests and /debug/slowest expose the flight recorder's ring
+  of completed requests, and introspection GETs never move the
+  served-traffic serve.request_ms histogram;
+- SIGTERM produces "SERVE DRAINED" (plus the "SERVE FLIGHTREC" drain
+  dump), exit code 0, and a drained queue.
 """
 from __future__ import annotations
 
@@ -129,12 +134,33 @@ def main(argv: Optional[List[str]] = None) -> int:
         fail(f"healthz wrong: {health}")
     metrics_text = scrape.metrics()
     for needle in ("serve_accepted", "serve_requests_verify",
-                   "serve_request_ms", "serve_queue_wait_ms"):
+                   "serve_request_ms", "serve_queue_wait_ms",
+                   'serve_request_ms_hist_bucket{le="'):
         if needle not in metrics_text:
             proc.kill()
             fail(f"/metrics missing {needle}; got:\n{metrics_text[:1200]}")
+    # the flight recorder: the workload above must be in the ring, and
+    # scraping /metrics (an introspection route) must NOT have entered
+    # the served-traffic request histogram
+    debug = scrape._roundtrip("GET", "/debug/requests?n=8")
+    if not debug.get("requests"):
+        proc.kill()
+        fail(f"/debug/requests empty after the workload: {debug}")
+    slowest = scrape._roundtrip("GET", "/debug/slowest?n=3")
+    if not slowest.get("requests"):
+        proc.kill()
+        fail(f"/debug/slowest empty after the workload: {slowest}")
+    count_line = [l for l in scrape.metrics().splitlines()
+                  if l.startswith("serve_request_ms_count ")]
+    before_line = [l for l in metrics_text.splitlines()
+                   if l.startswith("serve_request_ms_count ")]
+    if count_line != before_line:
+        proc.kill()
+        fail(f"introspection GETs moved serve_request_ms: "
+             f"{before_line} -> {count_line}")
     scrape.close()
     print(f"serve_smoke: /metrics OK ({len(metrics_text)} bytes), "
+          f"flightrec={debug['recorded']} recorded, "
           f"queue={health['queue']} cache={health['result_cache']}")
 
     proc.send_signal(signal.SIGTERM)
@@ -147,6 +173,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         fail(f"daemon exit rc={proc.returncode}: {(out or '')[-800:]}")
     if "SERVE DRAINED" not in (out or ""):
         fail(f"no drain line in output: {(out or '')[-800:]}")
+    if "SERVE FLIGHTREC" not in (out or ""):
+        fail(f"no flight-recorder drain dump in output: {(out or '')[-800:]}")
     drained = json.loads(out.split("SERVE DRAINED", 1)[1].strip().splitlines()[0])
     if not (drained.get("queue_drained") and drained.get("inflight_answered")):
         fail(f"unclean drain: {drained}")

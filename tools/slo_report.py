@@ -1,0 +1,173 @@
+"""SLO report: the serving plane's error-budget story — objectives,
+latest observations, and multi-window burn rates over the perf ledger.
+
+Usage:
+    python tools/slo_report.py [--ledger P] [--json OUT] [--port N]
+                               [--no-bank] [--gate]
+
+Without ``--port`` the report is purely historical: it reads the
+ledger's ``serve_slo_availability`` / ``serve_slo_p99_budget`` series
+(banked by ``make perfgate``'s SLO gate and ``tools/serve_canary.py``)
+and renders per-objective status plus 1h/6h/24h burn rates.
+
+With ``--port`` it ALSO probes a live daemon black-box: scrapes
+``GET /metrics``, computes availability + p99 from the always-on
+``serve.*`` exposition (obs.slo.observed_from_prometheus), and banks
+the resulting SLO points to the ledger (source ``slo_report``; skip
+with ``--no-bank``) so scheduled scrapes accumulate the burn-rate
+timeline.
+
+``--gate`` exits 1 when the latest observation is burning an objective
+(the standalone twin of `make perfgate`'s SLO gate). Exit 2 = no SLO
+data at all (cold ledger and no live probe).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+from typing import Any, Dict, List, Optional
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from consensus_specs_tpu.obs import ledger as ledger_mod  # noqa: E402
+from consensus_specs_tpu.obs import slo  # noqa: E402
+
+
+def probe_live(port: int, host: str = "127.0.0.1") -> Dict[str, Any]:
+    """Black-box observation of a running daemon via /metrics."""
+    from consensus_specs_tpu.serve.client import ServeClient
+
+    with ServeClient(port, host=host) as client:
+        return slo.observed_from_prometheus(client.metrics())
+
+
+def build_report(led: Optional[ledger_mod.Ledger],
+                 live: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    objectives = slo.serve_objectives()
+    availability_points: List[Dict[str, Any]] = []
+    budget_points: List[Dict[str, Any]] = []
+    if led is not None:
+        availability_points = led.points(metric=slo.AVAILABILITY_POINT)
+        budget_points = led.points(metric=slo.P99_BUDGET_POINT)
+
+    report: Dict[str, Any] = {
+        "objectives": [o.__dict__ for o in objectives],
+        "history": {
+            slo.AVAILABILITY_POINT: len(availability_points),
+            slo.P99_BUDGET_POINT: len(budget_points),
+        },
+        "burn_rates": slo.burn_rates(availability_points,
+                                     target=objectives[0].target),
+    }
+    if availability_points:
+        report["latest_availability"] = availability_points[-1]["value"]
+    if budget_points:
+        report["latest_p99_budget"] = budget_points[-1]["value"]
+    if live is not None:
+        report["live"] = {"observed": live, "statuses": slo.evaluate(live)}
+    return report
+
+
+def print_report(report: Dict[str, Any]) -> None:
+    print("serve SLOs:")
+    for obj in report["objectives"]:
+        print(f"  {obj['name']:<22} target {obj['target']:g}  "
+              f"({obj['description']})")
+    live = report.get("live")
+    if live:
+        obs_d = live["observed"]
+        print(f"\nlive probe: {obs_d['requests']} served requests, "
+              f"{obs_d['errors_5xx']} 5xx")
+        for s in live["statuses"]:
+            observed = s.get("observed")
+            obs_txt = f"{observed:g}" if observed is not None else "no data"
+            budget = s.get("budget_remaining")
+            budget_txt = (f"  budget remaining {budget:+.2%}"
+                          if budget is not None else "")
+            print(f"  {s['objective']:<22} {obs_txt:>10}  "
+                  f"[{s.get('verdict', '?')}]{budget_txt}")
+    print(f"\nledger history: "
+          f"{report['history'][slo.AVAILABILITY_POINT]} availability point(s), "
+          f"{report['history'][slo.P99_BUDGET_POINT]} latency-budget point(s)")
+    if "latest_availability" in report:
+        print(f"  latest availability : {report['latest_availability']:g}")
+    if "latest_p99_budget" in report:
+        print(f"  latest p99 budget   : {report['latest_p99_budget']:+.2%} remaining")
+    print("\nburn rates (availability budget; 1.0 = exhausts the budget "
+          "over the window):")
+    for label, entry in report["burn_rates"].items():
+        if entry.get("burn_rate") is not None:
+            print(f"  {label:>4}: burn {entry['burn_rate']:g}  "
+                  f"(mean availability {entry['mean_availability']:g} "
+                  f"over {entry['points']} point(s))")
+        else:
+            print(f"  {label:>4}: no points in window")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--ledger", default=None, help="ledger path override")
+    parser.add_argument("--json", dest="json_path", type=pathlib.Path,
+                        default=None, help="also write the report as JSON")
+    parser.add_argument("--port", type=int, default=None,
+                        help="probe a live daemon on this port")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--no-bank", action="store_true",
+                        help="with --port: do not append SLO points")
+    parser.add_argument("--gate", action="store_true",
+                        help="exit 1 when the latest observation burns "
+                             "an objective")
+    ns = parser.parse_args(argv)
+
+    led: Optional[ledger_mod.Ledger] = None
+    ledger_path = ns.ledger or ledger_mod.default_path()
+    if ledger_path:
+        led = ledger_mod.Ledger(ledger_path)
+
+    live: Optional[Dict[str, Any]] = None
+    if ns.port is not None:
+        try:
+            live = probe_live(ns.port, host=ns.host)
+        except OSError as e:
+            print(f"ERROR: live probe of :{ns.port} failed: {e}")
+            return 2
+        if led is not None and not ns.no_bank:
+            points = slo.ledger_points(slo.evaluate(live))
+            if points:
+                run_id = led.record_run(points, source="slo_report",
+                                        backend="host")
+                print(f"slo_report: banked {sorted(points)} as {run_id}")
+
+    report = build_report(led, live)
+    print_report(report)
+    if ns.json_path is not None:
+        with open(ns.json_path, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True, default=repr)
+        print(f"\njson report written to {ns.json_path}")
+
+    has_data = bool(live) or report["history"][slo.AVAILABILITY_POINT]
+    if not has_data:
+        print("slo_report: no SLO data (run `make perfgate` or "
+              "`make serve-canary` first)")
+        return 2
+    if ns.gate:
+        statuses = (report.get("live") or {}).get("statuses")
+        if statuses is None:
+            # gate on the latest banked points instead of a live probe
+            burning = (report.get("latest_availability", 1.0)
+                       < slo.serve_objectives()[0].target
+                       or report.get("latest_p99_budget", 1.0) <= 0)
+        else:
+            burning = any(s.get("burning") for s in statuses)
+        if burning:
+            print("slo_report: GATE FAILED — error budget burning")
+            return 1
+        print("slo_report: gate passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,7 +1,9 @@
 """Summarize an obs trace: top spans by self-time, jit compile-vs-
 execute split, resilience retry/quarantine tally, per-fork generator
 case latency percentiles, the sched flush's per-bucket pad/compile
-table, and the persistent compile cache's hit traffic.
+table, the serve section (per-endpoint latency percentiles, queue-wait
+vs flush split, bucket-sharing fan-in per request), and the persistent
+compile cache's hit traffic.
 
 Usage:
     python tools/trace_report.py <trace-dir | trace.json> [--json <path>]
@@ -138,6 +140,60 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             "compile_ms_est": split.get("compile_ms_est"),
         })
 
+    # --- serve section: the request-scoped serving story (docs/SERVE.md)
+    # per-endpoint latency percentiles over serve.request spans, the
+    # queue-wait vs flush-time split, and per-request bucket-sharing
+    # fan-in (how many requests shared each cross-client flush)
+    serve_by_method: Dict[str, List[float]] = {}
+    queue_waits: List[float] = []
+    flush_durs: List[float] = []
+    fanins: List[int] = []
+    flush_client_counts: List[int] = []
+    for s in spans:
+        name = s.get("name")
+        dur_ms = float(s.get("dur") or 0) / 1e3
+        if name == "serve.request":
+            method = str((s.get("attrs") or {}).get("method", "?"))
+            serve_by_method.setdefault(method, []).append(dur_ms)
+        elif name == "serve.queue_wait":
+            queue_waits.append(dur_ms)
+        elif name == "serve.flush":
+            flush_durs.append(dur_ms)
+            a = s.get("attrs") or {}
+            members = int(a.get("members") or len(s.get("links") or ()))
+            rows = int(a.get("rows") or 0)
+            if members:
+                # every member request shared a bucket with members-1 others
+                fanins.extend([members] * members)
+            traces = str(a.get("client_traces") or "")
+            flush_client_counts.append(
+                len([t for t in traces.split(",") if t]) if traces else 0)
+
+    def _pcts(vals: List[float]) -> Dict[str, Any]:
+        return {
+            "count": len(vals),
+            "p50_ms": round(percentile(vals, 50), 3),
+            "p90_ms": round(percentile(vals, 90), 3),
+            "p99_ms": round(percentile(vals, 99), 3),
+        }
+
+    serve: Dict[str, Any] = {}
+    if serve_by_method:
+        serve["requests_by_method"] = {
+            m: _pcts(vals) for m, vals in sorted(serve_by_method.items())}
+    if queue_waits or flush_durs:
+        serve["queue_wait_vs_flush"] = {
+            "queue_wait": _pcts(queue_waits) if queue_waits else None,
+            "flush": _pcts(flush_durs) if flush_durs else None,
+        }
+    if fanins:
+        serve["flush_fanin"] = {
+            "requests": len(fanins),
+            "mean": round(sum(fanins) / len(fanins), 2),
+            "max": max(fanins),
+            "shared_client_traces_max": max(flush_client_counts, default=0),
+        }
+
     # --- persistent compile cache traffic (sched.compile_cache instants:
     # every request that found a cached executable skipped its compile)
     cache_requests = sum(1 for i in instants
@@ -163,6 +219,7 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "chaos_hits": chaos_hits,
         "gen_case_latency_by_fork": gen_pcts,
         "sched_flush_buckets": sched_buckets,
+        "serve": serve,
         "compile_cache": {
             "requests": cache_requests,
             "hits": cache_hits,
@@ -211,6 +268,25 @@ def print_summary(summary: Dict[str, Any]) -> None:
                   f"{b['dispatches']} dispatch(es)  {b['rows']} rows "
                   f"(+{b['pad_rows']} pad, {b['slot_waste_pct']}% slot waste)"
                   f"{split}")
+    serve = summary.get("serve") or {}
+    if serve.get("requests_by_method"):
+        print("\nserve requests (per endpoint):")
+        for method, e in serve["requests_by_method"].items():
+            print(f"  {method}: {e['count']} request(s)  p50 {e['p50_ms']}ms  "
+                  f"p90 {e['p90_ms']}ms  p99 {e['p99_ms']}ms")
+    split = serve.get("queue_wait_vs_flush") or {}
+    if split:
+        for label, key in (("queue wait", "queue_wait"), ("flush", "flush")):
+            e = split.get(key)
+            if e:
+                print(f"  serve {label}: {e['count']} span(s)  "
+                      f"p50 {e['p50_ms']}ms  p99 {e['p99_ms']}ms")
+    fanin = serve.get("flush_fanin")
+    if fanin:
+        print(f"  serve flush fan-in: mean {fanin['mean']} max {fanin['max']} "
+              f"request(s)/bucket over {fanin['requests']} request(s) "
+              f"(max {fanin['shared_client_traces_max']} distinct client "
+              f"trace(s) in one flush)")
     cache = summary.get("compile_cache") or {}
     if cache.get("requests"):
         print(f"\ncompile cache: {cache['hits']} hit(s) / "

@@ -19,7 +19,16 @@ What runs:
   child-span plumbing is exercised, not simulated;
 - one generator child running a tiny 4-case suite with ``gen.case``
   chaos armed (child-side chaos instants), then a SECOND run over the
-  same output dir so the journal-admit path marks resumed cases.
+  same output dir so the journal-admit path marks resumed cases;
+- a serve wire-trace drill (ISSUE 7): an in-process daemon driven by a
+  traced client, asserting ONE trace id links the client request span
+  -> the daemon request span -> its synthesized queue-wait child -> the
+  shared ``serve.flush`` (linked to the member request) -> a
+  ``sched.flush.k<K>`` bucket span, with flow arrows — and that
+  ``/debug/requests`` returns the same request by trace id. The bucket
+  dispatch uses a host-backed cold-pipeline stub (the real oracle,
+  batched) so the linkage machinery is exercised without a device
+  pairing compile.
 """
 from __future__ import annotations
 
@@ -55,6 +64,51 @@ def _gen_child(out_dir: str) -> None:
     ]
     provider = TestProvider(prepare=lambda: None, make_cases=lambda: iter(cases))
     run_generator("trace_smoke", [provider], args=["-o", out_dir])
+
+
+def _serve_drill() -> None:
+    """Wire-trace propagation through a real in-process daemon (the
+    serve half of the smoke's acceptance contract; asserted on the
+    merged trace in main())."""
+    from consensus_specs_tpu.crypto.bls import ciphersuite as oracle
+    from consensus_specs_tpu.crypto.bls.fields import R
+    from consensus_specs_tpu.obs import core as obs_core
+    from consensus_specs_tpu.serve import (
+        ServeClient, ServeDaemon, SpecService, VerifyBatcher,
+    )
+
+    def host_cold(pks_lists, msgs, sigs):
+        # a cold-pipeline stub backed by the oracle itself: answers are
+        # bit-identical, but the flush takes the bucketed dispatch path
+        # that emits sched.flush.k<K> kernel spans
+        return [oracle.FastAggregateVerify(list(p), m, s)
+                for p, m, s in zip(pks_lists, msgs, sigs)]
+
+    oracle.fast_aggregate_verify_batch_cold = host_cold
+    try:
+        service = SpecService(forks=("phase0",), presets=("minimal",),
+                              batcher=VerifyBatcher(linger_ms=2))
+        daemon = ServeDaemon(service).start(warm=False)
+        try:
+            sks = [71, 72]
+            pks = [oracle.SkToPk(sk) for sk in sks]
+            msg = b"\x7a" * 32
+            sig = oracle.Sign(sum(sks) % R, msg)
+            ctx = obs_core._context()
+            assert ctx is not None
+            with ServeClient(daemon.port) as client:
+                assert client.verify(pubkeys=pks, message=msg,
+                                     signature=sig) is True, \
+                    "served verify answered False for a valid check"
+                by_trace = client._roundtrip(
+                    "GET", f"/debug/requests?trace={ctx.trace_id}")
+                assert by_trace.get("requests"), \
+                    f"/debug/requests empty for trace {ctx.trace_id}"
+                assert by_trace["requests"][0]["method"] == "verify"
+        finally:
+            daemon.drain(10)
+    finally:
+        del oracle.fast_aggregate_verify_batch_cold
 
 
 def main(argv=None) -> int:
@@ -150,6 +204,10 @@ def main(argv=None) -> int:
                     env=obs.child_env(), cwd=str(REPO), check=True,
                     stdout=subprocess.DEVNULL, timeout=240)
 
+        # (5) the serve wire-trace drill (assertions on the merge below)
+        with obs.span("smoke.serve"):
+            _serve_drill()
+
     obs.publish()
     trace_path = obs.export_chrome(str(out))
 
@@ -184,6 +242,33 @@ def main(argv=None) -> int:
                            and str(e.get("name", "")).startswith("resilience.")]
     assert resilience_instants, "no resilience/chaos instant events in the trace"
     child_instants = [e for e in resilience_instants if e["pid"] != my_pid]
+
+    # (5) serve wire-trace linkage: ONE trace id carries client span ->
+    # daemon request -> queue-wait child -> shared flush (linked) ->
+    # sched.flush.k<K> bucket span, with flow arrows for the request
+    # adoption and the flush membership link
+    by_serve_name = {}
+    for e in spans:
+        by_serve_name.setdefault(e["name"], e)
+    for required in ("serve.client", "serve.request", "serve.queue_wait",
+                     "serve.flush"):
+        assert required in by_serve_name, f"serve drill left no {required} span"
+    client_span = by_serve_name["serve.client"]["args"]
+    request = by_serve_name["serve.request"]["args"]
+    queue_wait = by_serve_name["serve.queue_wait"]["args"]
+    flush = by_serve_name["serve.flush"]["args"]
+    assert request["parent"] == client_span["span"], \
+        "daemon request span not parented under the client span"
+    assert queue_wait["parent"] == request["span"], \
+        "queue-wait span not a child of the daemon request span"
+    assert request["span"] in (flush.get("links") or ()), \
+        "shared flush span not linked to its member request"
+    buckets = [e for e in spans if str(e["name"]).startswith("sched.flush.k")
+               and (e.get("args") or {}).get("parent") == flush["span"]]
+    assert buckets, "no sched.flush.k<K> bucket span under the shared flush"
+    flow_names = {e.get("name") for e in events if e.get("ph") in ("s", "f")}
+    assert {"spawn", "link"} <= flow_names, \
+        f"missing flow arrows (have {flow_names})"
 
     print(f"trace smoke OK: {trace_path}")
     print(f"  {len(spans)} spans over {len({e['pid'] for e in spans})} processes; "

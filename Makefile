@@ -24,7 +24,7 @@ DEVICE_TESTS = tests/test_bls_device.py tests/test_curve_device.py \
 
 .PHONY: test citest test-fast test-device test-mainnet lint docs generate_tests gen_% replay bench \
         dryrun detect_generator_incomplete clean-vectors chaos trace perfgate perf-report gen-bench \
-        warm-cache serve serve-smoke serve-bench serve-canary slo-report help
+        warm-cache serve serve-smoke serve-bench serve-canary slo-report sim sim-smoke device-probe help
 
 # the fault-injection suite: supervisor/taxonomy units, chaos replay
 # (tampered vectors), induced backend failures, generator crash/resume
@@ -53,6 +53,9 @@ help:
 	@echo "serve-bench           concurrent-client serving bench: p50/p99 latency + verifies/s -> $(LEDGER)"
 	@echo "serve-canary          black-box daemon prober (incl. invalid-signature correctness probe): availability/latency -> $(LEDGER)"
 	@echo "slo-report            serve SLO report: objectives, latest observations, 1h/6h/24h burn rates over $(LEDGER)"
+	@echo "sim                   2048-slot seeded chain simulation (forks/reorgs/equivocations), vectorized-vs-oracle differential + chaos drill -> $(LEDGER)"
+	@echo "sim-smoke             short chain-sim differential + chaos drill (the citest slice; docs/SIM.md)"
+	@echo "device-probe          opportunistic device probe: bank backend:jax ledger points for the headline keys when the tunnel is healthy"
 
 # parallelize like the reference (ref Makefile:100-106) when pytest-xdist
 # is present; degrade to single-process so the suite stays runnable cold
@@ -71,6 +74,7 @@ citest:
 	$(if $(fork),,$(error citest requires fork=<name>, e.g. make citest fork=phase0))
 	$(PYTHON) -m pytest tests/spec -q --fork $(fork) $(if $(engine),--engine $(engine))
 	$(MAKE) trace
+	$(MAKE) sim-smoke
 	$(MAKE) serve-smoke
 	$(MAKE) serve-canary
 	$(MAKE) perfgate
@@ -121,6 +125,24 @@ serve-canary:
 
 slo-report:
 	$(PYTHON) tools/slo_report.py --ledger $(LEDGER)
+
+# the chain simulator (docs/SIM.md, ROADMAP #5): a seeded long-horizon
+# "mainnet day" through fork choice + full state transitions, the
+# vectorized engine differentially checked against the interpreted
+# oracle at every epoch checkpoint, with a proven chaos-degradation
+# drill; slots/s + the vectorized-vs-oracle speedup bank in the ledger
+sim:
+	$(PYTHON) tools/sim_run.py --slots 2048 --chaos-drill --ledger $(LEDGER)
+
+sim-smoke:
+	$(PYTHON) tools/sim_run.py --slots 96 --chaos-drill --ledger $(LEDGER)
+
+# ROADMAP #2's second half: the moment the tunnel is healthy, bank
+# backend:"jax" datapoints for the round-4 headline keys by running just
+# the three sections that produce them (killable children; an
+# unreachable device is an environment gap, exit 0)
+device-probe:
+	CONSENSUS_SPECS_TPU_COMPILE_CACHE=$(COMPILE_CACHE) $(PYTHON) tools/device_probe.py --ledger $(LEDGER)
 
 test-fast:
 	$(PYTHON) -m pytest tests/ -q $(addprefix --ignore=,$(DEVICE_TESTS)) $(PYTEST_EXTRA)

@@ -27,6 +27,11 @@ Extra keys:
   (consensus_specs_tpu/engine) on mainnet-preset randomized states,
   HOST-only and root-checked — a protocol-plane speedup that banks even
   when the tunnel is dead.
+- chain_sim (docs/SIM.md): a seeded multi-epoch chain simulation (forks,
+  reorgs, equivocations, late/empty slots) through fork choice + full
+  state transitions, vectorized engine vs interpreted oracle with every
+  epoch checkpoint root-compared; banks chain_sim_slots_per_s and the
+  vectorized-vs-oracle speedup, HOST-only.
 
 Budget discipline (the round-4 AND round-5 lesson): the parent process
 is a pure-stdlib SUPERVISOR that never imports jax and never opens the
@@ -919,6 +924,53 @@ def bench_epoch_vectorized() -> None:
     RESULTS["epoch_vectorized_speedup"] = speedups.get("altair")
 
 
+def bench_chain_sim() -> None:
+    """Long-horizon chain simulation (docs/SIM.md): a seeded multi-epoch
+    scenario — forks, reorgs, equivocation slashings, empty/late slots —
+    driven through the fork-choice Store and the full state-transition
+    path, ENTIRELY on host. The oracle pass and the vectorized pass
+    (SoA epoch stages + batched attestation sweep) run the SAME scenario
+    and every epoch checkpoint is compared bit-for-bit (head root +
+    head-state hash_tree_root), so a wrong-but-fast engine can never
+    post a slots/s number."""
+    import time as _time
+
+    from consensus_specs_tpu.sim import ScenarioConfig, Scenario, seed_from_env
+    from consensus_specs_tpu.sim.driver import compare_checkpoints, run_sim
+
+    slots = int(os.environ.get("BENCH_SIM_SLOTS", "384"))
+    cfg = ScenarioConfig(seed=seed_from_env(7), slots=slots)
+    scenario = Scenario(cfg)
+    _note(f"chain_sim: {slots} slots, scenario {scenario.summary()}")
+
+    t0 = _time.perf_counter()
+    oracle = run_sim(cfg, "interpreted", scenario=scenario)
+    _note(f"chain_sim: oracle pass {oracle.seconds:.1f}s "
+          f"({oracle.slots_per_s:.1f} slots/s)")
+    vectorized = run_sim(cfg, "vectorized", scenario=scenario)
+    _note(f"chain_sim: vectorized pass {vectorized.seconds:.1f}s "
+          f"({vectorized.slots_per_s:.1f} slots/s)")
+    mismatches = compare_checkpoints(oracle, vectorized)
+    if mismatches:
+        raise AssertionError(
+            f"chain_sim: vectorized diverged from oracle at "
+            f"{len(mismatches)} checkpoint field(s): {mismatches[:3]}")
+
+    RESULTS["chain_sim_slots"] = slots
+    RESULTS["chain_sim_slots_per_s"] = round(vectorized.slots_per_s, 2)
+    RESULTS["chain_sim_oracle_slots_per_s"] = round(oracle.slots_per_s, 2)
+    RESULTS["chain_sim_speedup"] = (
+        round(oracle.seconds / vectorized.seconds, 2)
+        if vectorized.seconds else None)
+    RESULTS["chain_sim_checkpoints"] = len(oracle.checkpoints)
+    stats = oracle.stats
+    RESULTS["chain_sim_events"] = {
+        k: stats[k] for k in ("blocks_delivered", "reorgs", "equivocations",
+                              "late_delivered", "empty_slots", "pruned_blocks")}
+    _note(f"chain_sim: {len(oracle.checkpoints)} checkpoints bit-identical, "
+          f"total {_time.perf_counter() - t0:.1f}s")
+
+
 def _device_alive(timeout_s: int = 90) -> bool:
     """Open the device in a DISPOSABLE CHILD first: a wedged tunnel (hung
     server-side compile / dead worker) blocks `jax.devices()` forever,
@@ -1018,6 +1070,7 @@ SECTIONS = {
     "kzg": bench_kzg,
     "incremental_reroot": bench_incremental_reroot,
     "epoch_vectorized": bench_epoch_vectorized,
+    "chain_sim": bench_chain_sim,
     "pallas_probe": bench_pallas_probe,
     "host_fallback": bench_host_fallback,
 }
@@ -1028,7 +1081,7 @@ SECTIONS = {
 # wedged mid-run, and the grandchild inherits no per-process cache
 # config anyway)
 HOST_ONLY_SECTIONS = {"incremental_reroot", "host_fallback", "pallas_probe",
-                      "epoch_vectorized", "sync_aggregate_host"}
+                      "epoch_vectorized", "sync_aggregate_host", "chain_sim"}
 
 
 def _child_main(name: str) -> None:
@@ -1094,6 +1147,7 @@ def main() -> None:
         run("host_fallback", 150, 320, keep_s=45)
         run("sync_aggregate_host", 45, 120)  # config #4 host datapoint
         run("epoch_vectorized", 120, 300)
+        run("chain_sim", 60, 180)
         run("incremental_reroot", 30, 90)
     else:
         host_keep = 220.0  # host_fallback (incl. config #3 host) + reroot stay fundable
@@ -1146,6 +1200,7 @@ def main() -> None:
             run("host_fallback", 150, 320, keep_s=45)
             run("sync_aggregate_host", 45, 120)
         run("epoch_vectorized", 120, 300)
+        run("chain_sim", 60, 180)
         run("incremental_reroot", 30, 90)
         if os.environ.get("BENCH_PALLAS") == "1":
             run("pallas_probe", 75, 85)

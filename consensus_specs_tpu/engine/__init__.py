@@ -9,7 +9,13 @@ subsystem is the protocol plane's batching layer:
   registry-axis columns, with exact (overflow-guarded) uint64 helpers
   and sparse write-back that preserves SSZ dirty-tracking.
 - :mod:`stages` — vectorized ``process_*`` implementations of the hot
-  epoch sub-transitions for the phase0 and altair fork families.
+  epoch sub-transitions across the production fork families (phase0 /
+  altair / bellatrix+capella quotient deltas, capella's
+  full-withdrawals registry sweep).
+- :mod:`attestations` — committee resolution in array form, plus the
+  batched block-path ``process_attestations_batch`` installed by
+  :func:`use_batched_attestations` (sequentially exact, incl.
+  rejection order — the chain simulator's hot loop, docs/SIM.md).
 - :mod:`backend` / :mod:`ops_jax` — the NumPy-always / jnp-opt-in
   backend hook, the ``ops/`` convention applied to protocol math.
 - :mod:`crosscheck` — the differential harness that holds every stage
@@ -39,6 +45,9 @@ __all__ = [
     "use_vectorized_epoch",
     "use_interpreted_epoch",
     "is_vectorized",
+    "use_batched_attestations",
+    "use_direct_attestations",
+    "is_batched_attestations",
     "use_backend",
     "backend_name",
     "STAGE_NAMES",
@@ -46,6 +55,8 @@ __all__ = [
 ]
 
 # The hot registry-axis sub-transitions with SoA implementations.
+# process_full_withdrawals exists only on the capella family; _install_on
+# skips the name on spec modules that lack it.
 STAGE_NAMES = (
     "process_justification_and_finalization",
     "process_rewards_and_penalties",
@@ -53,6 +64,7 @@ STAGE_NAMES = (
     "process_effective_balance_updates",
     "process_registry_updates",
     "process_slashings",
+    "process_full_withdrawals",
 )
 
 # Production chain only: R&D branches (sharding/custody_game/das/eip4844)
@@ -115,6 +127,81 @@ def use_interpreted_epoch() -> None:
 
 def is_vectorized() -> bool:
     return _enabled
+
+
+# ---------------------------------------------------------------------------
+# Batched block-path attestations (engine/attestations.py)
+# ---------------------------------------------------------------------------
+
+_batched_atts = False
+
+
+def _wrap_process_operations(spec):
+    from .attestations import process_attestations_batch
+
+    interpreted = spec.process_operations
+
+    def wrapped(state, body):
+        with obs.span("block.process_operations", fork=spec.fork,
+                      engine="batched", attestations=len(body.attestations)):
+            # the fork modules share this operation ORDER (capella appends
+            # one op family); the attestation sweep is the batched path
+            assert len(body.deposits) == min(
+                spec.MAX_DEPOSITS,
+                state.eth1_data.deposit_count - state.eth1_deposit_index,
+            )
+            for op in body.proposer_slashings:
+                spec.process_proposer_slashing(state, op)
+            for op in body.attester_slashings:
+                spec.process_attester_slashing(state, op)
+            process_attestations_batch(spec, state, body.attestations)
+            for op in body.deposits:
+                spec.process_deposit(state, op)
+            for op in body.voluntary_exits:
+                spec.process_voluntary_exit(state, op)
+            if hasattr(body, "bls_to_execution_changes"):  # capella family
+                for op in body.bls_to_execution_changes:
+                    spec.process_bls_to_execution_change(state, op)
+
+    wrapped.__name__ = "process_operations"
+    wrapped.__qualname__ = f"engine.process_operations[{spec.fork}]"
+    wrapped.__doc__ = interpreted.__doc__
+    wrapped.__wrapped__ = interpreted
+    wrapped.engine_batched_atts = True
+    return wrapped
+
+
+def _install_batched_atts_on(spec) -> None:
+    if getattr(spec, "fork", None) not in SUPPORTED_FORKS:
+        return
+    current = getattr(spec, "process_operations", None)
+    if current is None or getattr(current, "engine_batched_atts", False):
+        return
+    spec.process_operations = _wrap_process_operations(spec)
+
+
+def use_batched_attestations() -> None:
+    """Route every built (and future) spec module's block-body
+    attestation sweep through the batched committee-cached path
+    (engine/attestations.process_attestations_batch). Idempotent."""
+    global _batched_atts
+    _batched_atts = True
+    _build.register_module_hook(_install_batched_atts_on)
+
+
+def use_direct_attestations() -> None:
+    """Restore the interpreted per-attestation loop everywhere."""
+    global _batched_atts
+    _batched_atts = False
+    _build.unregister_module_hook(_install_batched_atts_on)
+    for mod in _build.cached_modules():
+        current = getattr(mod, "process_operations", None)
+        if current is not None and getattr(current, "engine_batched_atts", False):
+            mod.process_operations = current.__wrapped__
+
+
+def is_batched_attestations() -> bool:
+    return _batched_atts
 
 
 def stage_status(spec) -> Dict[str, bool]:

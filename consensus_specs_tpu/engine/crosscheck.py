@@ -64,12 +64,17 @@ def _random_validator(spec, rng, i: int, current_epoch: int):
         eligibility = int(rng.integers(0, current_epoch + 1))
 
     r = rng.random()
-    if r < 0.75:
+    if r < 0.70:
         exit_epoch: int = far
         withdrawable = far
-    else:
+    elif r < 0.85:
         exit_epoch = int(rng.integers(max(1, current_epoch - 2), current_epoch + 8))
         withdrawable = exit_epoch + int(spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY)
+    else:
+        # long-exited and ALREADY withdrawable — the rows capella's
+        # full-withdrawals sweep must actually withdraw
+        exit_epoch = int(rng.integers(0, max(1, current_epoch)))
+        withdrawable = int(rng.integers(exit_epoch, current_epoch + 1))
     if slashed and rng.random() < 0.5:
         # land exactly on the proportional-penalty epoch
         withdrawable = current_epoch + epsv // 2

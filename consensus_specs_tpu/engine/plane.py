@@ -97,6 +97,7 @@ class StatePlane:
         "previous_participation",
         "current_participation",
         "inactivity_scores",
+        "fully_withdrawn_epoch",
     )
 
     def __init__(self, state) -> None:
@@ -114,6 +115,11 @@ class StatePlane:
         self.previous_participation: Optional[np.ndarray] = None
         self.current_participation: Optional[np.ndarray] = None
         self.inactivity_scores: Optional[np.ndarray] = None
+        self.fully_withdrawn_epoch: Optional[np.ndarray] = None
+        if vals and hasattr(vals[0], "fully_withdrawn_epoch"):  # capella family
+            self.fully_withdrawn_epoch = u64(
+                (v.fully_withdrawn_epoch for v in vals), n
+            )
         if hasattr(state, "previous_epoch_participation"):
             self.previous_participation = np.fromiter(
                 state.previous_epoch_participation, dtype=np.uint8, count=n
@@ -181,4 +187,5 @@ _FIELD_NAMES = {
     "activation_epoch": "activation_epoch",
     "exit_epoch": "exit_epoch",
     "withdrawable_epoch": "withdrawable_epoch",
+    "fully_withdrawn_epoch": "fully_withdrawn_epoch",  # capella family
 }

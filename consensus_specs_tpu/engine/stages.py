@@ -45,10 +45,20 @@ def _is_leak(spec, state, prev: int) -> bool:
     return _finality_delay(spec, state, prev) > int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY)
 
 
+# Fork-delta quotient resolution: the flat spec modules carry the
+# suffixed constant their own fork resolved (altair re-tuned both
+# quotients, bellatrix re-tuned them again, capella kept bellatrix's) —
+# dispatch must name every production fork explicitly so a new fork
+# can't silently inherit the wrong penalty family.
+_BELLATRIX_FAMILY = ("bellatrix", "capella")
+
+
 def _inactivity_quotient(spec) -> int:
     if spec.fork == "altair":
         return int(spec.INACTIVITY_PENALTY_QUOTIENT_ALTAIR)
-    return int(spec.INACTIVITY_PENALTY_QUOTIENT_BELLATRIX)
+    if spec.fork in _BELLATRIX_FAMILY:
+        return int(spec.INACTIVITY_PENALTY_QUOTIENT_BELLATRIX)
+    raise ValueError(f"no inactivity-quotient family for fork {spec.fork!r}")
 
 
 def _slashings_multiplier(spec) -> int:
@@ -56,7 +66,9 @@ def _slashings_multiplier(spec) -> int:
         return int(spec.PROPORTIONAL_SLASHING_MULTIPLIER)
     if spec.fork == "altair":
         return int(spec.PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR)
-    return int(spec.PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX)
+    if spec.fork in _BELLATRIX_FAMILY:
+        return int(spec.PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX)
+    raise ValueError(f"no slashing-multiplier family for fork {spec.fork!r}")
 
 
 # ---------------------------------------------------------------------------
@@ -419,3 +431,34 @@ def vectorized_process_slashings(spec, state) -> None:
     hit = balances[mask]
     balances[mask] = np.where(penalties > hit, np.uint64(0), hit - penalties)
     plane.writeback_balances(state, balances)
+
+
+# ---------------------------------------------------------------------------
+# Full withdrawals (capella family)
+# ---------------------------------------------------------------------------
+
+def vectorized_process_full_withdrawals(spec, state) -> None:
+    """Capella's registry sweep: the fully-withdrawable mask (eth1
+    credential prefix, withdrawable_epoch <= epoch < fully_withdrawn_epoch)
+    is computed as one vector compare; only the hit rows take the spec's
+    sequential withdraw_balance path (the withdrawals_queue append order
+    and withdrawal_index increments are sequential state, exactly like
+    the exit queue in registry updates)."""
+    plane = StatePlane(state)
+    cur = int(spec.get_current_epoch(state))
+    prefix = bytes(spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX)[:1]
+    eth1_credentialed = np.fromiter(
+        (bytes(v.withdrawal_credentials)[:1] == prefix for v in state.validators),
+        dtype=bool,
+        count=plane.n,
+    )
+    e = np.uint64(cur)
+    mask = (
+        eth1_credentialed
+        & (plane.withdrawable_epoch <= e)
+        & (e < plane.fully_withdrawn_epoch)
+    )
+    for i in np.nonzero(mask)[0]:  # index order == the spec's loop order
+        idx = int(i)
+        spec.withdraw_balance(state, spec.ValidatorIndex(idx), state.balances[idx])
+        state.validators[idx].fully_withdrawn_epoch = cur

@@ -26,6 +26,13 @@ Sites are plain strings; the convention is plane.point:
   serve.flush (per cross-client micro-batch dispatched by the daemon's
                flusher thread; a fault here degrades that batch to the
                host oracle — docs/SERVE.md)
+  sim.step (top of every chain-simulator slot step, BEFORE any state
+            mutation: transients retry the clean step, deterministic
+            faults quarantine the site and every later step degrades to
+            the interpreted-oracle path — docs/SIM.md)
+  sim.epoch (every chain-simulator epoch rollover; a deterministic
+             fault parks the REMAINDER of the run on the oracle path —
+             the circuit-breaker response at epoch granularity)
 
 ``chaos(site)`` is a no-op dict probe when nothing is armed — cheap
 enough for hot paths.

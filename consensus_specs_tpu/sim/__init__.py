@@ -1,0 +1,60 @@
+"""Chain simulator: a long-horizon "mainnet day" under chaos, on the
+vectorized hot path (docs/SIM.md, ROADMAP #5).
+
+Every plane of this repo — the SoA epoch engine, the resilience
+quarantine machinery, the tracing/metrics/ledger evidence stack —
+existed but was exercised by *single-shot* workloads (one epoch, one
+block, one request). This package drives them together the way real
+consensus clients are stressed: thousands of slots of proposals on
+competing forks, attestation committees voting across reorgs,
+equivocation slashings, empty slots and late blocks, all fed through
+the phase0 fork-choice Store (``on_tick``/``on_block``/
+``on_attestation``/``on_attester_slashing``, ``get_head``, proposer
+boost) and the full state-transition path.
+
+- :mod:`scenario` — the seeded event-stream generator. The whole
+  timeline (fork windows, empty slots, late deliveries, equivocation
+  slots, committee vote splits) is precomputed from ONE
+  ``random.Random(seed)`` stream, so a scenario is a pure function of
+  its seed: byte-reproducible across processes, machines and engine
+  modes (knob: ``CONSENSUS_SPECS_TPU_SIM_SEED``).
+- :mod:`driver` — ``ChainSim`` interprets the scenario against the
+  live Store, records an epoch-boundary checkpoint digest
+  (``get_head`` root + head-state ``hash_tree_root`` + FFG
+  checkpoints), and prunes the Store at finality like a real client.
+  ``run_differential`` runs the same scenario twice — interpreted
+  oracle vs the vectorized engine (SoA epoch stages + batched
+  attestation path) — and asserts bit-identity at every checkpoint.
+  Chaos sites ``sim.step`` / ``sim.epoch`` let resilience faults fire
+  mid-simulation; quarantine degrades the run to the oracle path and
+  the chain must stay bit-identical.
+
+Evidence: ``sim.*`` spans/counters in the trace plane,
+``chain_sim_slots_per_s`` (+ vectorized-vs-oracle speedup) banked in
+the perf ledger by ``bench.py --section chain_sim`` and
+``tools/sim_run.py``, and ``perfgate_chain_sim_ms`` gating CI.
+"""
+from __future__ import annotations
+
+from .driver import ChainSim, SimResult, run_differential, run_sim  # noqa: F401
+from .scenario import (  # noqa: F401
+    SEED_ENV,
+    ForkWindow,
+    Scenario,
+    ScenarioConfig,
+    SlotPlan,
+    seed_from_env,
+)
+
+__all__ = [
+    "SEED_ENV",
+    "ChainSim",
+    "ForkWindow",
+    "Scenario",
+    "ScenarioConfig",
+    "SimResult",
+    "SlotPlan",
+    "run_differential",
+    "run_sim",
+    "seed_from_env",
+]

@@ -1,0 +1,619 @@
+"""Simulation driver: feed a seeded scenario through the fork-choice
+Store and the full state-transition path, with a differential mode that
+holds the vectorized engine to bit-identity against the interpreted
+oracle at every epoch checkpoint.
+
+One :class:`ChainSim` owns one ``spec.Store`` and interprets the
+scenario slot by slot, the way a (drastically simplified) honest client
+plus a minority adversary would:
+
+- ``on_tick`` advances store time every slot; proposer boost applies to
+  timely blocks exactly as in the spec.
+- honest proposers build on ``get_head(store)`` (so a winning fork
+  branch is adopted — a real reorg); the fork-window adversary builds
+  its own competing chain.
+- committees attest every slot, split between the branches by the
+  scenario's pure ``vote_split``; attestations arrive over the wire the
+  NEXT slot (``on_attestation``) and ride along in blocks
+  (``is_from_block=True``), both exactly like the spec's intake paths.
+- equivocation events deliver attester-slashing evidence to the Store
+  (``equivocating_indices``) and into the next canonical block
+  (in-state slashing).
+- at every epoch boundary the sim records a checkpoint digest —
+  ``get_head`` root, head-state ``hash_tree_root``, FFG checkpoints —
+  and prunes the Store at finality like a real client (the naive
+  spec-shaped ``get_head`` is quadratic in live blocks; pruning keeps
+  the live set bounded, and votes for pruned branches can never weigh a
+  surviving candidate, so pruning is weight-neutral by construction).
+
+Chaos sites (docs/RESILIENCE.md): ``sim.step`` fires at the top of
+every slot step, ``sim.epoch`` at every epoch rollover — both BEFORE
+any state mutation, so retries re-run a clean step. A deterministic
+fault quarantines the site and the supervisor's fallback re-runs the
+step on the interpreted-oracle path (counted in
+``stats["degraded_steps"]``/``["degraded_epochs"]``); the engine's
+bit-identity contract means degradation may slow the run but can never
+change a checkpoint — the chaos differential tests assert exactly that.
+
+Determinism: all simulation randomness comes from the scenario's
+seed-derived streams; BLS signing is stubbed (``bls_active=False``)
+unless ``config.sign``, so a run is a pure function of
+``(config, engine mode)`` — and engine modes are bit-identical.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+from .. import engine, obs
+from ..crypto import bls
+from ..obs import metrics
+from ..resilience import chaos, supervised
+from ..specs import build_spec
+from .scenario import Scenario, ScenarioConfig
+
+ENGINE_MODES = ("interpreted", "vectorized")
+
+# exception classes the spec's intake paths use as rejection control flow
+_REJECTED = (AssertionError, KeyError, IndexError, ValueError)
+
+
+@dataclass
+class SimResult:
+    engine: str
+    fork: str
+    preset: str
+    seed: int
+    slots: int
+    checkpoints: List[Dict[str, Any]]
+    stats: Dict[str, int]
+    scenario: Dict[str, int]
+    seconds: float
+
+    @property
+    def slots_per_s(self) -> float:
+        return self.slots / self.seconds if self.seconds > 0 else float("inf")
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "engine": self.engine,
+            "fork": self.fork,
+            "preset": self.preset,
+            "seed": self.seed,
+            "slots": self.slots,
+            "seconds": round(self.seconds, 3),
+            "slots_per_s": round(self.slots_per_s, 2),
+            "scenario": dict(self.scenario),
+            "stats": dict(self.stats),
+            "checkpoints": list(self.checkpoints),
+        }
+
+
+@contextlib.contextmanager
+def _engine_mode(mode: str):
+    """Install one engine mode for the duration, restoring the previous
+    installation after (the sim must never leak engine state into the
+    caller's process)."""
+    if mode not in ENGINE_MODES:
+        raise ValueError(f"unknown engine mode {mode!r} (have {ENGINE_MODES})")
+    was_vec = engine.is_vectorized()
+    was_batch = engine.is_batched_attestations()
+    if mode == "vectorized":
+        engine.use_vectorized_epoch()
+        engine.use_batched_attestations()
+    else:
+        engine.use_interpreted_epoch()
+        engine.use_direct_attestations()
+    try:
+        yield
+    finally:
+        (engine.use_vectorized_epoch if was_vec else engine.use_interpreted_epoch)()
+        (engine.use_batched_attestations if was_batch else engine.use_direct_attestations)()
+
+
+class ChainSim:
+    """One simulated chain run. Build with a config (or a prebuilt
+    :class:`Scenario`), call :meth:`run` under the engine mode you want
+    — or use :func:`run_sim` / :func:`run_differential` which manage
+    the engine installation for you."""
+
+    def __init__(self, config: ScenarioConfig,
+                 scenario: Optional[Scenario] = None,
+                 engine_label: str = "interpreted") -> None:
+        from ..test_framework.genesis import create_genesis_state
+
+        self.config = config
+        self.scenario = scenario or Scenario(config)
+        self.engine_label = engine_label
+        self.spec = build_spec(config.fork, config.preset)
+        spec = self.spec
+        genesis = create_genesis_state(
+            spec,
+            [spec.MAX_EFFECTIVE_BALANCE] * config.validators,
+            spec.MAX_EFFECTIVE_BALANCE,
+        )
+        anchor_block = spec.BeaconBlock(state_root=spec.hash_tree_root(genesis))
+        self.store = spec.get_forkchoice_store(genesis, anchor_block)
+        self.anchor_root = spec.hash_tree_root(anchor_block)
+
+        self.fork_tip: Optional[bytes] = None
+        self.prev_head: Optional[bytes] = None
+        self.wire: List[Any] = []                   # attestations, next-slot delivery
+        self.pools: Dict[str, List[Any]] = {"canonical": [], "fork": []}
+        self.late_queue: List[Tuple[int, Any]] = []  # (deliver_slot, signed block)
+        self.slashing_queue: List[Any] = []
+        self.checkpoints: List[Dict[str, Any]] = []
+        self.stats: Dict[str, int] = {
+            "blocks_proposed": 0, "blocks_delivered": 0, "blocks_dropped": 0,
+            "late_blocks": 0, "late_delivered": 0, "failed_proposals": 0,
+            "attestations_sent": 0, "attestations_rejected": 0,
+            "fork_blocks": 0, "reorgs": 0, "equivocations": 0,
+            "slashings_included": 0, "empty_slots": 0,
+            "slashed_proposer_slots": 0,
+            "degraded_steps": 0, "degraded_epochs": 0, "pruned_blocks": 0,
+        }
+        self._oracle_forced = False
+        self._last_pruned_epoch = 0
+        # deterministic pool of never-yet-slashed equivocators
+        import random as _random
+
+        eq_rng = _random.Random(f"chain-sim:{config.seed}:equiv")
+        self._equivocators = list(range(config.validators))
+        eq_rng.shuffle(self._equivocators)
+        self._step_states: Dict[Tuple[bytes, int], Any] = {}
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _state_at(self, root: bytes, slot: int):
+        """The chain state of ``root``'s branch advanced to ``slot``
+        (read-only use; store states are never mutated). Cached per step."""
+        key = (bytes(root), slot)
+        cached = self._step_states.get(key)
+        if cached is not None:
+            return cached
+        st = self.store.block_states[root]
+        if int(st.slot) < slot:
+            st = st.copy()
+            self.spec.process_slots(st, self.spec.Slot(slot))
+        self._step_states[key] = st
+        return st
+
+    def _is_ancestor(self, ancestor: bytes, root: bytes) -> bool:
+        spec, store = self.spec, self.store
+        try:
+            slot = store.blocks[ancestor].slot
+            return bytes(spec.get_ancestor(store, root, slot)) == bytes(ancestor)
+        except KeyError:
+            return False
+
+    def _deliver_block(self, signed_block, late: bool = False) -> bool:
+        """on_block + the spec's implied intake of the block's
+        attestations and attester slashings (test_framework/fork_choice
+        add_block semantics)."""
+        spec, store = self.spec, self.store
+        try:
+            spec.on_block(store, signed_block)
+        except _REJECTED:
+            self.stats["blocks_dropped"] += 1
+            return False
+        for att in signed_block.message.body.attestations:
+            try:
+                spec.on_attestation(store, att, is_from_block=True)
+            except _REJECTED:
+                self.stats["attestations_rejected"] += 1
+        for slashing in signed_block.message.body.attester_slashings:
+            try:
+                spec.on_attester_slashing(store, slashing)
+            except _REJECTED:
+                pass
+        self.stats["blocks_delivered"] += 1
+        if late:
+            self.stats["late_delivered"] += 1
+        return True
+
+    def _includable(self, state, att) -> bool:
+        """process_attestation's rejection ladder (minus the signature,
+        which the builder already made valid) against the proposal state
+        — anything passing here is includable on that branch."""
+        spec = self.spec
+        data = att.data
+        try:
+            assert data.target.epoch in (spec.get_previous_epoch(state),
+                                         spec.get_current_epoch(state))
+            assert data.target.epoch == spec.compute_epoch_at_slot(data.slot)
+            assert (data.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY
+                    <= state.slot <= data.slot + spec.SLOTS_PER_EPOCH)
+            assert data.index < spec.get_committee_count_per_slot(state, data.target.epoch)
+            committee = spec.get_beacon_committee(state, data.slot, data.index)
+            assert len(att.aggregation_bits) == len(committee)
+            if hasattr(state, "current_epoch_participation"):
+                spec.get_attestation_participation_flag_indices(
+                    state, data, state.slot - data.slot)
+            elif data.target.epoch == spec.get_current_epoch(state):
+                assert data.source == state.current_justified_checkpoint
+            else:
+                assert data.source == state.previous_justified_checkpoint
+            return True
+        except _REJECTED:
+            return False
+
+    def _slashing_includable(self, state, slashing) -> bool:
+        spec = self.spec
+        try:
+            att_1, att_2 = slashing.attestation_1, slashing.attestation_2
+            assert spec.is_slashable_attestation_data(att_1.data, att_2.data)
+            assert spec.is_valid_indexed_attestation(state, att_1)
+            assert spec.is_valid_indexed_attestation(state, att_2)
+            epoch = spec.get_current_epoch(state)
+            indices = set(att_1.attesting_indices) & set(att_2.attesting_indices)
+            return any(spec.is_slashable_validator(state.validators[i], epoch)
+                       for i in indices)
+        except _REJECTED:
+            return False
+
+    # -- per-slot mechanics -------------------------------------------------
+
+    def _open_fork(self, slot: int) -> None:
+        """The adversary forks from the canonical head's parent (a
+        sibling contest) — or from the head itself when the parent is
+        already pruned/unknown."""
+        head = self.spec.get_head(self.store)
+        parent = self.store.blocks[head].parent_root
+        self.fork_tip = parent if parent in self.store.blocks else head
+        metrics.count("sim.fork_windows")
+        obs.instant("sim.fork_start", slot=slot)
+
+    def _propose(self, slot: int, branch: str, late_by: int = 0) -> None:
+        from ..test_framework.block import build_empty_block
+        from ..test_framework.block_processing import state_transition_and_sign_block
+
+        spec = self.spec
+        tip = self.fork_tip if branch == "fork" else self.spec.get_head(self.store)
+        if tip is None:
+            return
+        # the proposer's view at the proposal slot (read-only, cached):
+        # attestation/slashing admission is judged against it, exactly as
+        # process_attestation will judge it inside the transition below
+        view = self._state_at(tip, slot)
+        block = build_empty_block(spec, view, spec.Slot(slot))
+        if view.validators[block.proposer_index].slashed:
+            # a slashed proposer cannot propose (process_block_header
+            # rejects it): the slot goes empty on this branch — the same
+            # thing mainnet sees after a proposer is slashed
+            self.stats["slashed_proposer_slots"] += 1
+            return
+
+        pool = self.pools[branch]
+        included = 0
+        for att in pool:
+            if included >= int(spec.MAX_ATTESTATIONS):
+                break
+            if self._includable(view, att):
+                block.body.attestations.append(att)
+                included += 1
+        if branch == "canonical" and self.slashing_queue:
+            kept = []
+            for slashing in self.slashing_queue:
+                if (len(block.body.attester_slashings) < int(spec.MAX_ATTESTER_SLASHINGS)
+                        and self._slashing_includable(view, slashing)):
+                    block.body.attester_slashings.append(slashing)
+                    self.stats["slashings_included"] += 1
+                else:
+                    kept.append(slashing)
+            self.slashing_queue = kept
+
+        try:
+            pre = self.store.block_states[tip].copy()
+            signed = state_transition_and_sign_block(spec, pre, block)
+        except Exception:
+            self.stats["failed_proposals"] += 1
+            return
+        self.stats["blocks_proposed"] += 1
+        metrics.count("sim.blocks_proposed")
+        if branch == "fork":
+            self.stats["fork_blocks"] += 1
+            self.fork_tip = spec.hash_tree_root(block)
+        if late_by > 0:
+            self.stats["late_blocks"] += 1
+            self.late_queue.append((slot + late_by, signed))
+        else:
+            self._deliver_block(signed)
+
+    def _attest(self, slot: int, plan) -> None:
+        from ..test_framework.attestations import get_valid_attestation
+
+        spec = self.spec
+        head = spec.get_head(self.store)
+        head_state = self._state_at(head, slot)
+        fork_live = (plan.fork is not None and self.fork_tip is not None
+                     and bytes(self.fork_tip) != bytes(head))
+        support = plan.fork.support_at(slot) if fork_live else 0.0
+        fork_state = self._state_at(self.fork_tip, slot) if fork_live else None
+
+        epoch = spec.compute_epoch_at_slot(spec.Slot(slot))
+        committees = int(spec.get_committee_count_per_slot(head_state, epoch))
+        for index in range(committees):
+            committee = spec.get_beacon_committee(
+                head_state, spec.Slot(slot), spec.CommitteeIndex(index))
+            fork_voters = (self.scenario.vote_split(slot, committee, support)
+                           if support > 0 else set())
+            canonical_voters = {int(i) for i in committee} - fork_voters
+            for voters, state in ((canonical_voters, head_state),
+                                  (fork_voters, fork_state)):
+                if not voters or state is None:
+                    continue
+                try:
+                    att = get_valid_attestation(
+                        spec, state, slot=spec.Slot(slot),
+                        index=spec.CommitteeIndex(index),
+                        filter_participant_set=lambda comm, v=voters: comm & v,
+                        signed=self.config.sign,
+                    )
+                except _REJECTED:
+                    continue
+                if not any(att.aggregation_bits):
+                    continue
+                self.wire.append(att)
+                self.pools["canonical" if state is head_state else "fork"].append(att)
+                self.stats["attestations_sent"] += 1
+                metrics.count("sim.attestations")
+
+    def _emit_equivocation(self, slot: int) -> None:
+        from ..test_framework.attester_slashings import (
+            get_valid_attester_slashing_by_indices,
+        )
+
+        spec = self.spec
+        width = max(1, int(self.config.equivocation_width))
+        if len(self._equivocators) < width:
+            return
+        indices = sorted(self._equivocators[:width])
+        del self._equivocators[:width]
+        state = self._state_at(spec.get_head(self.store), slot)
+        try:
+            slashing = get_valid_attester_slashing_by_indices(
+                spec, state, indices, slot=spec.Slot(slot),
+                signed_1=self.config.sign, signed_2=self.config.sign,
+            )
+        except _REJECTED:
+            return
+        try:
+            spec.on_attester_slashing(self.store, slashing)
+        except _REJECTED:
+            return
+        self.slashing_queue.append(slashing)
+        self.stats["equivocations"] += 1
+        metrics.count("sim.equivocations")
+        obs.instant("sim.equivocation", slot=slot, width=width)
+
+    def _step(self, slot: int, plan) -> None:
+        spec, store = self.spec, self.store
+        self._step_states.clear()
+        spec.on_tick(store, store.genesis_time
+                     + slot * int(spec.config.SECONDS_PER_SLOT))
+
+        due = [entry for entry in self.late_queue if entry[0] <= slot]
+        if due:
+            self.late_queue = [e for e in self.late_queue if e[0] > slot]
+            for _, signed in due:
+                self._deliver_block(signed, late=True)
+
+        wire, self.wire = self.wire, []
+        for att in wire:
+            try:
+                spec.on_attestation(store, att, is_from_block=False)
+            except _REJECTED:
+                self.stats["attestations_rejected"] += 1
+
+        if plan.equivocate:
+            self._emit_equivocation(slot)
+
+        if plan.fork is not None and slot == plan.fork.start:
+            self._open_fork(slot)
+        if plan.propose:
+            self._propose(slot, "canonical", late_by=plan.late_by)
+        else:
+            self.stats["empty_slots"] += 1
+        if plan.fork is not None and self.fork_tip is not None:
+            self._propose(slot, "fork")
+        if plan.fork is not None and slot == plan.fork.end:
+            # window closes: surviving fork attestations compete for
+            # inclusion on whichever branch won (the includable filter
+            # rejects the rest); the adversary stops proposing
+            self.pools["canonical"].extend(self.pools["fork"])
+            self.pools["fork"] = []
+            self.fork_tip = None
+
+        self._attest(slot, plan)
+
+        head = spec.get_head(store)
+        if (self.prev_head is not None and bytes(head) != bytes(self.prev_head)
+                and not self._is_ancestor(self.prev_head, head)):
+            self.stats["reorgs"] += 1
+            metrics.count("sim.reorgs")
+            obs.instant("sim.reorg", slot=slot)
+        self.prev_head = head
+
+    # -- degradation + epoch rollover --------------------------------------
+
+    @contextlib.contextmanager
+    def _forced_oracle(self):
+        """Quarantine response: the step runs on the interpreted oracle
+        (bit-identical by the engine's contract), then the previous
+        installation is restored."""
+        was_vec = engine.is_vectorized()
+        was_batch = engine.is_batched_attestations()
+        engine.use_interpreted_epoch()
+        engine.use_direct_attestations()
+        try:
+            yield
+        finally:
+            if was_vec:
+                engine.use_vectorized_epoch()
+            if was_batch:
+                engine.use_batched_attestations()
+
+    def _run_step(self, slot: int, plan) -> None:
+        def attempt():
+            chaos("sim.step")  # pre-mutation: a retry re-runs a clean step
+            if self._oracle_forced:
+                with self._forced_oracle():
+                    self._step(slot, plan)
+            else:
+                self._step(slot, plan)
+
+        def degraded():
+            self.stats["degraded_steps"] += 1
+            metrics.count("sim.degraded_steps")
+            obs.instant("sim.degraded", site="sim.step", slot=slot)
+            with self._forced_oracle():
+                self._step(slot, plan)
+
+        supervised(attempt, domain="sim", capability="sim.step",
+                   fallback=degraded)
+
+    def _epoch_rollover(self, slot: int) -> None:
+        spec, store = self.spec, self.store
+
+        def attempt():
+            chaos("sim.epoch")
+
+        def degraded():
+            # a deterministic fault at epoch granularity parks the whole
+            # remaining run on the oracle path (circuit-breaker response)
+            self.stats["degraded_epochs"] += 1
+            self._oracle_forced = True
+            metrics.count("sim.degraded_epochs")
+            obs.instant("sim.degraded", site="sim.epoch", slot=slot)
+
+        supervised(attempt, domain="sim", capability="sim.epoch",
+                   fallback=degraded)
+
+        epoch = slot // int(spec.SLOTS_PER_EPOCH)
+        head = spec.get_head(store)
+        head_state = store.block_states[head]
+        self.checkpoints.append({
+            "epoch": epoch,
+            "slot": slot,
+            "head": bytes(head).hex(),
+            "head_slot": int(store.blocks[head].slot),
+            "state_root": bytes(spec.hash_tree_root(head_state)).hex(),
+            "justified_epoch": int(store.justified_checkpoint.epoch),
+            "finalized_epoch": int(store.finalized_checkpoint.epoch),
+        })
+        metrics.count("sim.epochs")
+        self._prune(slot)
+
+    def _prune(self, slot: int) -> None:
+        """Drop everything not descending from the finalized checkpoint
+        (weight-neutral: a vote for a pruned branch forked off below the
+        finalized slot, so its ancestor at any surviving candidate's slot
+        can never equal that candidate)."""
+        spec, store = self.spec, self.store
+        fin = store.finalized_checkpoint
+        fin_epoch = int(fin.epoch)
+        if fin_epoch <= self._last_pruned_epoch:
+            return
+        self._last_pruned_epoch = fin_epoch
+        fin_slot = spec.compute_start_slot_at_epoch(fin.epoch)
+        keep = set()
+        for root in list(store.blocks):
+            try:
+                if bytes(spec.get_ancestor(store, root, fin_slot)) == bytes(fin.root):
+                    keep.add(bytes(root))
+            except KeyError:
+                continue
+        dropped = [r for r in list(store.blocks) if bytes(r) not in keep]
+        for root in dropped:
+            del store.blocks[root]
+            del store.block_states[root]
+        for index in [i for i, m in store.latest_messages.items()
+                      if bytes(m.root) not in keep]:
+            del store.latest_messages[index]
+        for cp in [c for c in store.checkpoint_states
+                   if int(c.epoch) < fin_epoch and c != store.justified_checkpoint]:
+            del store.checkpoint_states[cp]
+        horizon = slot - int(spec.SLOTS_PER_EPOCH)
+        for name in ("canonical", "fork"):
+            self.pools[name] = [a for a in self.pools[name]
+                                if int(a.data.slot) >= horizon]
+        if dropped:
+            self.stats["pruned_blocks"] += len(dropped)
+            metrics.count("sim.pruned_blocks", len(dropped))
+
+    # -- entry point --------------------------------------------------------
+
+    def run(self) -> SimResult:
+        cfg = self.config
+        spec = self.spec
+        spe = int(spec.SLOTS_PER_EPOCH)
+        was_bls = bls.bls_active
+        bls.bls_active = bool(cfg.sign)
+        t0 = time.perf_counter()
+        try:
+            with obs.span("sim.run", engine=self.engine_label, fork=cfg.fork,
+                          preset=cfg.preset, seed=cfg.seed, slots=cfg.slots):
+                for slot in range(1, cfg.slots + 1):
+                    plan = self.scenario.plan(slot)
+                    with obs.span("sim.slot", slot=slot):
+                        self._run_step(slot, plan)
+                    if (slot + 1) % spe == 0:
+                        with obs.span("sim.epoch", slot=slot):
+                            self._epoch_rollover(slot)
+        finally:
+            bls.bls_active = was_bls
+        seconds = time.perf_counter() - t0
+        return SimResult(
+            engine=self.engine_label, fork=cfg.fork, preset=cfg.preset,
+            seed=cfg.seed, slots=cfg.slots, checkpoints=self.checkpoints,
+            stats=self.stats, scenario=self.scenario.summary(),
+            seconds=seconds,
+        )
+
+
+def run_sim(config: ScenarioConfig, engine_mode: str = "interpreted",
+            scenario: Optional[Scenario] = None) -> SimResult:
+    """One full run under one engine mode (installation scoped + restored)."""
+    sim = ChainSim(config, scenario=scenario, engine_label=engine_mode)
+    with _engine_mode(engine_mode):
+        return sim.run()
+
+
+def compare_checkpoints(a: SimResult, b: SimResult) -> List[Dict[str, Any]]:
+    """Field-level mismatches between two checkpoint streams."""
+    mismatches: List[Dict[str, Any]] = []
+    if len(a.checkpoints) != len(b.checkpoints):
+        mismatches.append({"field": "checkpoint_count",
+                           a.engine: len(a.checkpoints),
+                           b.engine: len(b.checkpoints)})
+    for ca, cb in zip(a.checkpoints, b.checkpoints):
+        for fld in ("head", "state_root", "head_slot",
+                    "justified_epoch", "finalized_epoch"):
+            if ca[fld] != cb[fld]:
+                mismatches.append({"epoch": ca["epoch"], "field": fld,
+                                   a.engine: ca[fld], b.engine: cb[fld]})
+    return mismatches
+
+
+def run_differential(config: ScenarioConfig) -> Dict[str, Any]:
+    """The acceptance contract: the same scenario through the interpreted
+    oracle and through the vectorized engine (SoA epoch stages + batched
+    attestations) must be bit-identical — same ``get_head`` root, same
+    head-state ``hash_tree_root``, same FFG checkpoints — at EVERY epoch
+    checkpoint. Returns both results plus the mismatch list (empty on
+    success) and the vectorized-vs-oracle wall-clock speedup."""
+    scenario = Scenario(config)
+    oracle = run_sim(config, "interpreted", scenario=scenario)
+    vectorized = run_sim(config, "vectorized", scenario=scenario)
+    mismatches = compare_checkpoints(oracle, vectorized)
+    return {
+        "identical": not mismatches,
+        "checkpoints": len(oracle.checkpoints),
+        "mismatches": mismatches,
+        "speedup": (round(oracle.seconds / vectorized.seconds, 3)
+                    if vectorized.seconds > 0 else None),
+        "oracle": oracle,
+        "vectorized": vectorized,
+    }

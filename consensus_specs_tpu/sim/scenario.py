@@ -1,0 +1,187 @@
+"""Seeded scenario generator: the deterministic event timeline of one
+simulated "mainnet day".
+
+Determinism contract (docs/SIM.md): the ENTIRE timeline — which slots
+are empty, where competing fork windows open and close, which fraction
+of each committee votes the fork branch, which blocks arrive late and
+by how much, which slots emit equivocation slashings, and every
+per-validator vote assignment — is drawn from ``random.Random`` streams
+derived only from ``(config.seed, slot)``. Nothing is drawn from chain
+state, wall clocks, or global RNGs, so the same config replays the same
+timeline in every process and under every engine mode; the driver's
+differential pass depends on this. ``CONSENSUS_SPECS_TPU_SIM_SEED``
+overrides the default seed for CI byte-reproducibility.
+
+Grammar (one :class:`SlotPlan` per slot):
+
+- ``propose`` — the canonical branch proposes at this slot (False =
+  empty slot; the tip carries across the gap).
+- ``late_by`` — the canonical proposal is withheld and delivered that
+  many slots later (the proposer's block misses its slot: the next
+  proposer builds on the OLD tip, and the late arrival becomes either
+  an uncle or a short reorg).
+- ``fork`` — the :class:`ForkWindow` covering this slot, if any: a
+  competing branch forked from the canonical head's parent, proposing
+  its own blocks while ``support`` of each committee votes for it.
+  Windows that ``win`` swing (almost) the whole committee to the fork
+  branch for their final slots — the reorg case; windows that lose
+  starve and die.
+- ``equivocate`` — this slot emits an attester-slashing pair (the
+  double-vote evidence) for a few fresh validators: delivered to the
+  Store (``equivocating_indices``) and included in the next canonical
+  block (in-state slashing).
+"""
+from __future__ import annotations
+
+import os
+import random
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, Optional, Set
+
+SEED_ENV = "CONSENSUS_SPECS_TPU_SIM_SEED"
+
+
+def seed_from_env(default: int = 0) -> int:
+    """The explicit seed knob (satellite: CI reruns are byte-identical
+    because the seed is pinned in the environment, not implicit)."""
+    raw = os.environ.get(SEED_ENV, "").strip()
+    if not raw:
+        return default
+    return int(raw, 0)
+
+
+@dataclass(frozen=True)
+class ScenarioConfig:
+    """Knobs of one simulated chain run (defaults: a lively minimal-preset
+    chain that still finalizes)."""
+
+    seed: int = 0
+    slots: int = 256
+    fork: str = "altair"
+    preset: str = "minimal"
+    validators: int = 64
+    # event densities (probabilities per slot unless noted)
+    p_empty: float = 0.06
+    p_fork: float = 0.05          # chance a fork window OPENS at an eligible slot
+    fork_len_min: int = 3
+    fork_len_max: int = 6
+    fork_support_min: float = 0.2  # committee fraction voting the fork branch
+    fork_support_max: float = 0.45
+    p_fork_wins: float = 0.35     # fork windows that end in a reorg
+    p_late: float = 0.05
+    late_max: int = 3
+    equivocations: int = 4        # attester-slashing events over the whole run
+    equivocation_width: int = 2   # validators double-voting per event
+    sign: bool = False            # real BLS signatures (slow; short runs only)
+
+    def with_slots(self, slots: int) -> "ScenarioConfig":
+        return replace(self, slots=slots)
+
+
+@dataclass(frozen=True)
+class ForkWindow:
+    """One competing-branch episode."""
+
+    start: int      # first slot the fork branch proposes at
+    end: int        # last slot of the window (inclusive)
+    support: float  # committee fraction voting the fork branch
+    wins: bool      # True: votes swing to the fork at the end (reorg)
+
+    # the final slots where a winning fork gets (almost) all votes
+    SWING_SLOTS = 2
+    SWING_SUPPORT = 0.9
+
+    def support_at(self, slot: int) -> float:
+        if self.wins and slot > self.end - self.SWING_SLOTS:
+            return self.SWING_SUPPORT
+        return self.support
+
+
+@dataclass(frozen=True)
+class SlotPlan:
+    slot: int
+    propose: bool = True
+    late_by: int = 0
+    fork: Optional[ForkWindow] = None
+    equivocate: bool = False
+
+
+@dataclass
+class Scenario:
+    """The precomputed timeline. ``plan(slot)`` is a pure lookup."""
+
+    config: ScenarioConfig
+    empty_slots: Set[int] = field(default_factory=set)
+    late_blocks: Dict[int, int] = field(default_factory=dict)
+    fork_windows: List[ForkWindow] = field(default_factory=list)
+    equivocation_slots: Set[int] = field(default_factory=set)
+
+    def __post_init__(self) -> None:
+        cfg = self.config
+        rng = random.Random(f"chain-sim:{cfg.seed}")
+        windows: List[ForkWindow] = []
+        slot = 2  # slot 0 is the anchor; leave slot 1 clean so the chain roots
+        guard = max(0, cfg.slots - cfg.fork_len_max - 2)
+        while slot < guard:
+            if rng.random() < cfg.p_fork:
+                length = rng.randint(cfg.fork_len_min, cfg.fork_len_max)
+                windows.append(ForkWindow(
+                    start=slot,
+                    end=slot + length - 1,
+                    support=rng.uniform(cfg.fork_support_min, cfg.fork_support_max),
+                    wins=rng.random() < cfg.p_fork_wins,
+                ))
+                slot += length + 2  # windows never touch (one live fork at a time)
+            else:
+                slot += 1
+        self.fork_windows = windows
+        in_fork = {s for w in windows for s in range(w.start, w.end + 1)}
+
+        for s in range(2, cfg.slots):
+            if s in in_fork:
+                continue  # fork slots always propose (the contest needs blocks)
+            r = rng.random()
+            if r < cfg.p_empty:
+                self.empty_slots.add(s)
+            elif r < cfg.p_empty + cfg.p_late:
+                self.late_blocks[s] = rng.randint(1, cfg.late_max)
+
+        # equivocation events: spread over the run, clear of the first two
+        # epochs (the chain needs a justified base before slashing drama)
+        eligible = [s for s in range(16, cfg.slots)
+                    if s not in self.empty_slots and s not in in_fork]
+        rng.shuffle(eligible)
+        self.equivocation_slots = set(sorted(eligible[: cfg.equivocations]))
+
+    def window_at(self, slot: int) -> Optional[ForkWindow]:
+        for w in self.fork_windows:
+            if w.start <= slot <= w.end:
+                return w
+        return None
+
+    def plan(self, slot: int) -> SlotPlan:
+        return SlotPlan(
+            slot=slot,
+            propose=slot not in self.empty_slots,
+            late_by=self.late_blocks.get(slot, 0),
+            fork=self.window_at(slot),
+            equivocate=slot in self.equivocation_slots,
+        )
+
+    def vote_split(self, slot: int, members, support: float) -> Set[int]:
+        """The fork-branch voter subset of one committee: a pure function
+        of (seed, slot, member index) so both differential passes split
+        identically."""
+        rng = random.Random(f"chain-sim:{self.config.seed}:votes:{slot}")
+        return {int(m) for m in sorted(int(x) for x in members)
+                if rng.random() < support}
+
+    def summary(self) -> Dict[str, int]:
+        return {
+            "slots": self.config.slots,
+            "empty_slots": len(self.empty_slots),
+            "late_blocks": len(self.late_blocks),
+            "fork_windows": len(self.fork_windows),
+            "planned_reorgs": sum(1 for w in self.fork_windows if w.wins),
+            "equivocation_events": len(self.equivocation_slots),
+        }

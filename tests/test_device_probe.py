@@ -1,0 +1,103 @@
+"""tools/device_probe.py (ROADMAP #2): the opportunistic device probe's
+degradation contract and ledger plumbing — without a device, the probe
+reports an environment gap and exits 0; with a (faked) healthy device,
+it banks whatever headline keys its section children produced as
+backend-tagged ledger points.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import device_probe  # noqa: E402
+
+from consensus_specs_tpu.obs import ledger as ledger_mod  # noqa: E402
+
+
+def test_cpu_only_is_an_environment_gap(tmp_path, monkeypatch, capsys):
+    monkeypatch.setattr(device_probe, "probe_backend", lambda timeout_s: "cpu")
+    out = tmp_path / "summary.json"
+    rc = device_probe.main(["--ledger", str(tmp_path / "l.jsonl"),
+                            "--json", str(out)])
+    assert rc == 0
+    assert "environment gap" in capsys.readouterr().out
+    summary = json.loads(out.read_text())
+    assert summary["backend"] == "cpu"
+    assert "cpu-only" in summary["gap"]
+    assert not (tmp_path / "l.jsonl").exists()  # nothing banked
+
+
+def test_unreachable_tunnel_is_an_environment_gap(tmp_path, monkeypatch):
+    monkeypatch.setattr(device_probe, "probe_backend", lambda timeout_s: None)
+    rc = device_probe.main(["--ledger", str(tmp_path / "l.jsonl")])
+    assert rc == 0
+    assert not (tmp_path / "l.jsonl").exists()
+
+
+def test_healthy_device_banks_headline_keys(tmp_path, monkeypatch):
+    """A healthy (faked tpu) backend: section children report the round-4
+    headline keys; the probe appends them as backend:'tpu' ledger points
+    under source device_probe."""
+    monkeypatch.setattr(device_probe, "probe_backend", lambda timeout_s: "tpu")
+
+    fake_payload = {
+        "block_mainnet": {"block_128atts_speedup": 3.4,
+                          "block_128atts_mainnet_s": 1.2},
+        "sync_aggregate": {"sync_aggregate_512_speedup": 5.1},
+        "generation": {"gen_operations_speedup": 1.9},
+    }
+    monkeypatch.setattr(device_probe, "run_section",
+                        lambda name, cap_s: fake_payload.get(name, {}))
+
+    ledger_path = tmp_path / "ledger.jsonl"
+    out = tmp_path / "summary.json"
+    rc = device_probe.main(["--ledger", str(ledger_path), "--json", str(out)])
+    assert rc == 0
+    summary = json.loads(out.read_text())
+    assert set(summary["banked"]) == {
+        "block_128atts_speedup", "block_128atts_mainnet_s",
+        "sync_aggregate_512_speedup", "gen_operations_speedup"}
+
+    led = ledger_mod.Ledger(str(ledger_path))
+    run = led.runs()[-1]
+    assert run["source"] == "device_probe"
+    assert run["backend"] == "tpu"
+    points = led.series("block_128atts_speedup")
+    assert points and points[-1]["value"] == 3.4
+
+
+def test_healthy_device_with_dead_sections_fails(tmp_path, monkeypatch):
+    monkeypatch.setattr(device_probe, "probe_backend", lambda timeout_s: "tpu")
+    monkeypatch.setattr(device_probe, "run_section",
+                        lambda name, cap_s: {"section_errors": {name: "rc=70"}})
+    rc = device_probe.main(["--ledger", str(tmp_path / "l.jsonl")])
+    assert rc == 1
+
+
+def test_partial_sections_still_bank(tmp_path, monkeypatch):
+    """One dead section doesn't lose the others' datapoints — the probe
+    is opportunistic per key, exit 0 with a missing-keys note."""
+    monkeypatch.setattr(device_probe, "probe_backend", lambda timeout_s: "tpu")
+    payload = {"sync_aggregate": {"sync_aggregate_512_speedup": 4.0}}
+    monkeypatch.setattr(device_probe, "run_section",
+                        lambda name, cap_s: payload.get(name, {}))
+    ledger_path = tmp_path / "ledger.jsonl"
+    rc = device_probe.main(["--ledger", str(ledger_path)])
+    assert rc == 0
+    led = ledger_mod.Ledger(str(ledger_path))
+    assert led.series("sync_aggregate_512_speedup")
+    assert not led.series("block_128atts_speedup")
+
+
+def test_probe_backend_real_subprocess():
+    """The real aliveness child against this box's CPU jax: it must
+    resolve a backend name without wedging (the disposable-child
+    contract)."""
+    backend = device_probe.probe_backend(timeout_s=120)
+    assert backend == "cpu"

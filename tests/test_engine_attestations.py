@@ -1,0 +1,212 @@
+"""Differential tests for the engine's batched ``process_attestation``
+path (engine/attestations.process_attestations_batch + the
+use_batched_attestations() install): random attestation batches across
+all four production forks must leave a bit-identical state vs the
+interpreted per-attestation oracle loop — including the partial state an
+INVALID attestation leaves behind when it is rejected mid-batch.
+Host-only and fast (tier-1 CI).
+"""
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from consensus_specs_tpu import engine
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.engine.attestations import process_attestations_batch
+from consensus_specs_tpu.specs import build_spec
+from consensus_specs_tpu.test_framework import context as tf_context
+from consensus_specs_tpu.test_framework.attestations import (
+    get_valid_attestation,
+    next_slots_with_attestations,
+)
+
+FORKS = engine.SUPPORTED_FORKS
+
+
+@pytest.fixture(autouse=True)
+def _clean_engine_and_bls():
+    engine.use_interpreted_epoch()
+    engine.use_direct_attestations()
+    was = bls.bls_active
+    bls.bls_active = False  # protocol-plane parity; signatures stubbed
+    yield
+    bls.bls_active = was
+    engine.use_interpreted_epoch()
+    engine.use_direct_attestations()
+
+
+def _advanced_state(spec, slots=12):
+    state = tf_context._prepare_state(
+        tf_context.default_balances, tf_context.default_activation_threshold, spec)
+    _, blocks, post = next_slots_with_attestations(spec, state, slots, True, True)
+    return post, blocks
+
+
+def _random_batch(spec, state, rng, n=8):
+    """Random valid attestations over the includable slot window, mixed
+    committees and participation subsets (duplicates included — the spec
+    processes them; repeated flags must yield no double proposer reward)."""
+    atts = []
+    spe = int(spec.SLOTS_PER_EPOCH)
+    lo = max(0, int(state.slot) - spe + 1)
+    hi = int(state.slot) - int(spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    for _ in range(n):
+        slot = rng.randint(lo, hi)
+        committees = int(spec.get_committee_count_per_slot(
+            state, spec.compute_epoch_at_slot(spec.Slot(slot))))
+        index = rng.randrange(committees)
+        frac = rng.choice([0.4, 0.8, 1.0])
+        try:
+            att = get_valid_attestation(
+                spec, state, slot=spec.Slot(slot),
+                index=spec.CommitteeIndex(index),
+                filter_participant_set=lambda comm: {
+                    i for i in comm if rng.random() < frac},
+            )
+        except AssertionError:
+            continue
+        if any(att.aggregation_bits):
+            atts.append(att)
+    # duplicates: the same attestation twice exercises the already-set
+    # flag path (proposer reward must NOT be granted twice)
+    if atts:
+        atts.append(atts[0])
+    return atts
+
+
+def _roots_after(spec, state, atts, use_batch):
+    st = state.copy()
+    if use_batch:
+        process_attestations_batch(spec, st, atts)
+    else:
+        for a in atts:
+            spec.process_attestation(st, a)
+    return bytes(st.hash_tree_root()), st
+
+
+@pytest.mark.parametrize("fork", FORKS)
+def test_random_batches_bit_identical(fork):
+    spec = build_spec(fork, "minimal")
+    state, _ = _advanced_state(spec)
+    for seed in (0, 1, 2):
+        rng = random.Random(seed)
+        atts = _random_batch(spec, state, rng)
+        assert atts, "workload generator produced no attestations"
+        oracle_root, _ = _roots_after(spec, state, atts, use_batch=False)
+        batch_root, _ = _roots_after(spec, state, atts, use_batch=True)
+        assert oracle_root == batch_root, f"{fork} seed={seed} diverged"
+
+
+@pytest.mark.parametrize("fork", FORKS)
+def test_real_block_attestations_bit_identical(fork):
+    """The batch on real block bodies: every attestation-carrying block
+    from a 12-slot chain, replayed through both paths."""
+    spec = build_spec(fork, "minimal")
+    state = tf_context._prepare_state(
+        tf_context.default_balances, tf_context.default_activation_threshold, spec)
+    _, blocks, _ = next_slots_with_attestations(spec, state, 12, True, True)
+    carrier = [b for b in blocks if len(b.message.body.attestations)]
+    assert carrier
+    # rebuild the pre-state of the last carrier block
+    st = tf_context._prepare_state(
+        tf_context.default_balances, tf_context.default_activation_threshold, spec)
+    target = carrier[-1]
+    for b in blocks:
+        if b is target:
+            break
+        spec.state_transition(st, b, True)
+    spec.process_slots(st, target.message.slot)
+    atts = list(target.message.body.attestations)
+    oracle_root, _ = _roots_after(spec, st, atts, use_batch=False)
+    batch_root, _ = _roots_after(spec, st, atts, use_batch=True)
+    assert oracle_root == batch_root
+
+
+def _tampered(spec, att, mode):
+    bad = att.copy()
+    if mode == "bad_index":
+        bad.data.index = spec.get_committee_count_per_slot(
+            spec.BeaconState(), spec.Epoch(0)) + 64
+    elif mode == "bad_source":
+        bad.data.source = spec.Checkpoint(epoch=bad.data.source.epoch,
+                                          root=b"\x66" * 32)
+    elif mode == "bad_target_epoch":
+        bad.data.target = spec.Checkpoint(epoch=int(bad.data.target.epoch) + 3,
+                                          root=bad.data.target.root)
+    elif mode == "short_bits":
+        bad.aggregation_bits = bad.aggregation_bits[:-1]
+    return bad
+
+
+@pytest.mark.parametrize("fork", ("phase0", "altair", "capella"))
+@pytest.mark.parametrize("mode", ("bad_index", "bad_source",
+                                  "bad_target_epoch", "short_bits"))
+def test_invalid_attestation_rejection_parity(fork, mode):
+    """An invalid attestation mid-batch must (a) raise in BOTH paths and
+    (b) leave the SAME partial state behind — the oracle applies earlier
+    valid attestations before raising, and so must the batch."""
+    spec = build_spec(fork, "minimal")
+    state, _ = _advanced_state(spec)
+    rng = random.Random(42)
+    atts = _random_batch(spec, state, rng, n=5)
+    assert len(atts) >= 3
+    atts[2] = _tampered(spec, atts[2], mode)
+
+    def run(use_batch):
+        st = state.copy()
+        try:
+            if use_batch:
+                process_attestations_batch(spec, st, atts)
+            else:
+                for a in atts:
+                    spec.process_attestation(st, a)
+        except AssertionError:
+            return "rejected", bytes(st.hash_tree_root())
+        return "accepted", bytes(st.hash_tree_root())
+
+    oracle = run(use_batch=False)
+    batch = run(use_batch=True)
+    assert oracle[0] == "rejected", f"tamper mode {mode} was not rejected"
+    assert oracle == batch, f"{fork}/{mode}: rejection wreckage diverged"
+
+
+@pytest.mark.parametrize("fork", FORKS)
+def test_install_hook_routes_process_operations(fork):
+    """use_batched_attestations(): the installed wrapper must make the
+    FULL state_transition of a real attestation-carrying signed block
+    bit-identical to the direct path, and uninstall must restore the
+    spec function."""
+    spec = build_spec(fork, "minimal")
+    state = tf_context._prepare_state(
+        tf_context.default_balances, tf_context.default_activation_threshold, spec)
+    _, blocks, _ = next_slots_with_attestations(spec, state, 10, True, True)
+    carrier = [b for b in blocks if len(b.message.body.attestations)]
+
+    def replay():
+        st = tf_context._prepare_state(
+            tf_context.default_balances, tf_context.default_activation_threshold, spec)
+        for b in blocks:
+            spec.state_transition(st, b, True)
+        return bytes(st.hash_tree_root())
+
+    assert carrier
+    direct = replay()
+    engine.use_batched_attestations()
+    try:
+        assert engine.is_batched_attestations()
+        assert getattr(spec.process_operations, "engine_batched_atts", False)
+        batched = replay()
+    finally:
+        engine.use_direct_attestations()
+    assert not getattr(spec.process_operations, "engine_batched_atts", False)
+    assert direct == batched
+
+
+def test_empty_batch_is_noop():
+    spec = build_spec("altair", "minimal")
+    state, _ = _advanced_state(spec, slots=4)
+    before = bytes(state.hash_tree_root())
+    process_attestations_batch(spec, state, [])
+    assert bytes(state.hash_tree_root()) == before

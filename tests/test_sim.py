@@ -1,0 +1,196 @@
+"""Chain simulator tier-1 tests (docs/SIM.md): scenario determinism,
+driver liveness (finality advances through forks/reorgs/equivocations),
+the differential contract — vectorized engine bit-identical to the
+interpreted oracle at every epoch checkpoint — and Store pruning. The
+full 2048-slot acceptance run is `make sim`; a @slow test pins it here
+for opt-in runs.
+"""
+from __future__ import annotations
+
+import pytest
+
+from consensus_specs_tpu import engine
+from consensus_specs_tpu.sim import (
+    Scenario,
+    ScenarioConfig,
+    seed_from_env,
+)
+from consensus_specs_tpu.sim.driver import (
+    ChainSim,
+    compare_checkpoints,
+    run_differential,
+    run_sim,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_engine():
+    engine.use_interpreted_epoch()
+    engine.use_direct_attestations()
+    yield
+    engine.use_interpreted_epoch()
+    engine.use_direct_attestations()
+
+
+# ---------------------------------------------------------------------------
+# scenario generator
+# ---------------------------------------------------------------------------
+
+def test_scenario_is_pure_function_of_seed():
+    cfg = ScenarioConfig(seed=5, slots=128)
+    a, b = Scenario(cfg), Scenario(cfg)
+    assert a.empty_slots == b.empty_slots
+    assert a.late_blocks == b.late_blocks
+    assert a.fork_windows == b.fork_windows
+    assert a.equivocation_slots == b.equivocation_slots
+    for slot in range(1, 129):
+        assert a.plan(slot) == b.plan(slot)
+
+
+def test_scenario_seeds_differ():
+    base = Scenario(ScenarioConfig(seed=1, slots=256))
+    other = Scenario(ScenarioConfig(seed=2, slots=256))
+    assert (base.empty_slots, base.late_blocks, base.fork_windows) != (
+        other.empty_slots, other.late_blocks, other.fork_windows)
+
+
+def test_scenario_contains_all_event_classes():
+    """The default densities must actually produce forks, reorg windows,
+    late blocks, empty slots and equivocations over a few epochs — a
+    scenario without them tests nothing."""
+    sc = Scenario(ScenarioConfig(seed=1, slots=96))
+    summary = sc.summary()
+    assert summary["fork_windows"] >= 1
+    assert summary["planned_reorgs"] >= 1
+    assert summary["late_blocks"] >= 1
+    assert summary["empty_slots"] >= 1
+    assert summary["equivocation_events"] >= 1
+
+
+def test_fork_windows_never_overlap():
+    sc = Scenario(ScenarioConfig(seed=9, slots=512))
+    spans = sorted((w.start, w.end) for w in sc.fork_windows)
+    for (s1, e1), (s2, _) in zip(spans, spans[1:]):
+        assert e1 < s2
+
+
+def test_vote_split_is_deterministic_and_bounded():
+    sc = Scenario(ScenarioConfig(seed=3, slots=32))
+    members = list(range(40))
+    a = sc.vote_split(7, members, 0.5)
+    b = sc.vote_split(7, members, 0.5)
+    assert a == b
+    assert a <= set(members)
+    assert sc.vote_split(8, members, 0.5) != a  # per-slot substreams
+
+
+def test_seed_from_env(monkeypatch):
+    monkeypatch.delenv("CONSENSUS_SPECS_TPU_SIM_SEED", raising=False)
+    assert seed_from_env(7) == 7
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_SIM_SEED", "41")
+    assert seed_from_env(7) == 41
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_SIM_SEED", "0x10")
+    assert seed_from_env() == 16
+
+
+# ---------------------------------------------------------------------------
+# driver liveness
+# ---------------------------------------------------------------------------
+
+def test_chain_advances_and_finalizes():
+    cfg = ScenarioConfig(seed=1, slots=64)
+    result = run_sim(cfg, "interpreted")
+    assert len(result.checkpoints) == 8  # one per epoch (minimal: 8 slots)
+    last = result.checkpoints[-1]
+    assert last["head_slot"] >= 56          # the head tracks the horizon
+    assert last["finalized_epoch"] >= 3     # FFG finality advances
+    stats = result.stats
+    assert stats["blocks_delivered"] > 48
+    assert stats["fork_blocks"] >= 1
+    assert stats["equivocations"] >= 1
+    assert stats["slashings_included"] >= 1
+    assert stats["late_delivered"] >= 1
+    assert stats["failed_proposals"] == 0   # every failure class is explicit
+
+
+def test_store_is_pruned_at_finality():
+    cfg = ScenarioConfig(seed=1, slots=64)
+    sim = ChainSim(cfg)
+    from consensus_specs_tpu.sim.driver import _engine_mode
+
+    with _engine_mode("interpreted"):
+        result = sim.run()
+    assert result.stats["pruned_blocks"] > 0
+    # the live block set stays bounded by the finality horizon, not the
+    # total chain length (the naive get_head walk is quadratic in this)
+    assert len(sim.store.blocks) < 48
+    # every surviving block is at/after the last-pruned finality horizon
+    # (finality may advance again between the final rollover's prune and
+    # the end of the run — those newer ancestors legitimately remain)
+    spec, store = sim.spec, sim.store
+    assert sim._last_pruned_epoch >= 3
+    pruned_slot = spec.compute_start_slot_at_epoch(spec.Epoch(sim._last_pruned_epoch))
+    fin_roots = [r for r in store.blocks
+                 if int(store.blocks[r].slot) <= int(pruned_slot)]
+    assert len(fin_roots) <= 1  # exactly the pruned-to finalized root survives below it
+
+
+def test_run_is_reproducible():
+    cfg = ScenarioConfig(seed=4, slots=32)
+    a = run_sim(cfg, "interpreted")
+    b = run_sim(cfg, "interpreted")
+    assert a.checkpoints == b.checkpoints
+    assert a.stats == b.stats
+    c = run_sim(ScenarioConfig(seed=5, slots=32), "interpreted")
+    assert c.checkpoints != a.checkpoints
+
+
+def test_engine_mode_is_restored():
+    assert not engine.is_vectorized()
+    run_sim(ScenarioConfig(seed=0, slots=8), "vectorized")
+    assert not engine.is_vectorized()
+    assert not engine.is_batched_attestations()
+
+
+# ---------------------------------------------------------------------------
+# the differential contract
+# ---------------------------------------------------------------------------
+
+def test_differential_identity_altair():
+    """The acceptance pin (short horizon): forks, a reorg and an
+    equivocation in-window, vectorized == oracle at every checkpoint."""
+    cfg = ScenarioConfig(seed=1, slots=48, equivocations=2)
+    diff = run_differential(cfg)
+    assert diff["checkpoints"] == 6
+    assert diff["identical"], diff["mismatches"][:5]
+    assert diff["oracle"].stats == diff["vectorized"].stats
+    assert diff["oracle"].stats["fork_blocks"] >= 1
+
+
+@pytest.mark.parametrize("fork", ("phase0", "bellatrix", "capella"))
+def test_differential_identity_other_forks(fork):
+    cfg = ScenarioConfig(seed=3, slots=24, fork=fork, equivocations=1)
+    diff = run_differential(cfg)
+    assert diff["identical"], f"{fork}: {diff['mismatches'][:5]}"
+    assert diff["checkpoints"] == 3
+
+
+def test_compare_checkpoints_reports_field_mismatch():
+    cfg = ScenarioConfig(seed=0, slots=16)
+    a = run_sim(cfg, "interpreted")
+    b = run_sim(cfg, "interpreted")
+    b.checkpoints[-1] = dict(b.checkpoints[-1], state_root="00" * 32)
+    mism = compare_checkpoints(a, b)
+    assert mism and mism[0]["field"] == "state_root"
+
+
+@pytest.mark.slow
+def test_differential_identity_mainnet_day():
+    """The full acceptance run (also `make sim`): >= 2048 slots with
+    forks, reorgs and equivocations, bit-identical end to end."""
+    cfg = ScenarioConfig(seed=seed_from_env(0), slots=2048, equivocations=6)
+    diff = run_differential(cfg)
+    assert diff["checkpoints"] >= 255
+    assert diff["identical"], diff["mismatches"][:5]
+    assert diff["oracle"].stats["reorgs"] >= 1
+    assert diff["oracle"].stats["equivocations"] >= 4

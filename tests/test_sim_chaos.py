@@ -1,0 +1,150 @@
+"""Chaos drills for the chain simulator (docs/SIM.md + RESILIENCE.md):
+resilience faults fired at the new ``sim.step`` / ``sim.epoch``
+injection sites mid-simulation must degrade through the quarantine
+machinery — and the chain must stay bit-identical to a clean run,
+because the degraded path IS the interpreted oracle.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from consensus_specs_tpu import engine, resilience
+from consensus_specs_tpu.resilience import injection
+from consensus_specs_tpu.sim import Scenario, ScenarioConfig
+from consensus_specs_tpu.sim.driver import run_sim
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CFG = ScenarioConfig(seed=1, slots=32, equivocations=1)
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    for cap in ("sim.step", "sim.epoch"):
+        resilience.clear(cap)
+    injection.disarm()
+    engine.use_interpreted_epoch()
+    engine.use_direct_attestations()
+    yield
+    for cap in ("sim.step", "sim.epoch"):
+        resilience.clear(cap)
+    injection.disarm()
+    engine.use_interpreted_epoch()
+    engine.use_direct_attestations()
+
+
+@pytest.fixture(scope="module")
+def clean_run():
+    scenario = Scenario(CFG)
+    return scenario, run_sim(CFG, "vectorized", scenario=scenario)
+
+
+def test_deterministic_fault_quarantines_and_chain_stays_identical(clean_run):
+    """A deterministic fault at sim.step opens the breaker: every later
+    step degrades to the oracle path (counted), the quarantine is
+    recorded, and every checkpoint still matches the clean run."""
+    scenario, clean = clean_run
+    with injection.inject("sim.step", "deterministic", count=1, after=10):
+        chaotic = run_sim(CFG, "vectorized", scenario=scenario)
+    assert chaotic.stats["degraded_steps"] == CFG.slots - 10
+    assert resilience.is_quarantined("sim.step")
+    assert chaotic.checkpoints == clean.checkpoints
+    assert chaotic.stats["blocks_delivered"] == clean.stats["blocks_delivered"]
+
+
+def test_transient_fault_retries_without_degradation(clean_run):
+    """A transient fault at sim.step is retried in place (the site fires
+    BEFORE any mutation, so the retry replays a clean step): no
+    degradation, no quarantine, identical chain."""
+    scenario, clean = clean_run
+    with injection.inject("sim.step", "transient", count=1, after=5):
+        result = run_sim(CFG, "vectorized", scenario=scenario)
+    assert result.stats["degraded_steps"] == 0
+    assert not resilience.is_quarantined("sim.step")
+    assert result.checkpoints == clean.checkpoints
+    events = [e for e in resilience.events() if e.get("event") == "retry"
+              and e.get("capability") == "sim.step"]
+    assert events, "the retry must be a recorded resilience event"
+
+
+def test_epoch_fault_parks_run_on_oracle_path(clean_run):
+    """A deterministic fault at sim.epoch is the circuit-breaker case:
+    the rest of the run is forced onto the interpreted oracle
+    (degraded_epochs counts every subsequent rollover) — bit-identical."""
+    scenario, clean = clean_run
+    with injection.inject("sim.epoch", "deterministic", count=1):
+        result = run_sim(CFG, "vectorized", scenario=scenario)
+    assert result.stats["degraded_epochs"] >= 1
+    assert resilience.is_quarantined("sim.epoch")
+    assert result.checkpoints == clean.checkpoints
+
+
+def test_quarantined_site_degrades_from_first_step(clean_run):
+    """breaker already open when the run starts: every step degrades,
+    chain identical (the differential second pass under chaos)."""
+    scenario, clean = clean_run
+    resilience.quarantine("sim.step", "pre-opened by test", domain="sim")
+    result = run_sim(CFG, "vectorized", scenario=scenario)
+    assert result.stats["degraded_steps"] == CFG.slots
+    assert result.checkpoints == clean.checkpoints
+
+
+def test_sim_run_cli_chaos_drill_and_seed_knob(tmp_path):
+    """tools/sim_run.py end-to-end in a subprocess: differential +
+    chaos drill on a short horizon, seed pinned via
+    CONSENSUS_SPECS_TPU_SIM_SEED, metrics banked to a scratch ledger."""
+    env = dict(os.environ)
+    env["CONSENSUS_SPECS_TPU_SIM_SEED"] = "1"
+    env.pop("CONSENSUS_SPECS_TPU_CHAOS", None)
+    ledger = tmp_path / "ledger.jsonl"
+    out = tmp_path / "summary.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "sim_run.py"),
+         "--slots", "48", "--chaos-drill",
+         "--ledger", str(ledger), "--json", str(out)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "seed 1" in proc.stdout
+    assert "BIT-IDENTICAL" in proc.stdout
+    assert "chaos drill" in proc.stdout
+
+    import json
+
+    summary = json.loads(out.read_text())
+    assert summary["identical"] is True
+    assert summary["chaos_drill"]["identical"] is True
+    assert summary["chaos_drill"]["degraded_steps"] > 0
+
+    from consensus_specs_tpu.obs import ledger as ledger_mod
+
+    led = ledger_mod.Ledger(str(ledger))
+    assert led.series("chain_sim_slots_per_s")
+    run = led.runs()[-1]
+    assert run["source"] == "chain_sim"
+
+
+def test_sim_spans_and_degradation_land_in_trace_report(tmp_path, monkeypatch):
+    """The evidence loop closes: an armed trace over a chaos-degraded sim
+    run yields sim.slot/sim.epoch spans plus sim.degraded instants, and
+    tools/trace_report.py renders the sim section from them."""
+    from consensus_specs_tpu import obs
+
+    monkeypatch.setenv(obs.TRACE_ENV, str(tmp_path))
+    scenario = Scenario(CFG)
+    with injection.inject("sim.step", "deterministic", count=1, after=20):
+        run_sim(CFG, "vectorized", scenario=scenario)
+    obs.publish()
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import trace_report
+
+    summary = trace_report.summarize(trace_report.load_records(tmp_path))
+    sim_section = summary["sim"]
+    assert sim_section["slot_latency"]["count"] == CFG.slots
+    assert sim_section["epoch_rollover_latency"]["count"] == CFG.slots // 8
+    assert sim_section["degraded_steps_by_site"].get("sim.step") == CFG.slots - 20
+    assert "equivocation" in sim_section["events"]

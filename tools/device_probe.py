@@ -1,0 +1,181 @@
+"""Opportunistic device probe (ROADMAP #2): bank ``backend:"jax"``
+ledger datapoints for the round-4 headline keys the moment the tunnel
+is healthy — without waiting for (or risking) a full bench run.
+
+The full ``bench.py`` run orders its sections around the cold BLS
+compile and the pallas hazard; when the tunnel only comes up
+mid-session, the headline keys (``block_128atts_speedup``,
+``sync_aggregate_512_speedup``, ``gen_operations_speedup``) never get a
+device datapoint. This probe is the narrow path: check the device is
+reachable from a DISPOSABLE child (a wedged tunnel blocks
+``jax.devices()`` forever while holding the GIL — bench.py's round-5
+lesson), then run ONLY the three sections that produce those keys, each
+as a killable ``bench.py --section`` child, and append whatever real
+values came back to the perf ledger as ``backend:"jax"`` points.
+
+Degradation contract: an unreachable device or a CPU-only jax is an
+ENVIRONMENT GAP — recorded, reported, exit 0 (the probe is
+opportunistic; absence of a device is not a failure). A healthy device
+whose sections all fail IS a failure (exit 1): the tunnel answered but
+the measurement machinery didn't.
+
+Usage:
+    python tools/device_probe.py [--ledger P] [--cap S] [--timeout S]
+                                 [--sections a,b,c] [--allow-cpu] [--json OUT]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+from typing import Any, Dict, List, Optional
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from consensus_specs_tpu.obs import ledger as ledger_mod  # noqa: E402
+from consensus_specs_tpu.resilience import record_event  # noqa: E402
+
+# section child -> the headline ledger keys it can produce
+SECTION_KEYS: Dict[str, List[str]] = {
+    "block_mainnet": ["block_128atts_speedup", "block_128atts_mainnet_s"],
+    "sync_aggregate": ["sync_aggregate_512_speedup", "sync_aggregate_512_s"],
+    "generation": ["gen_operations_speedup", "gen_operations_device_s"],
+}
+HEADLINE_KEYS = ("block_128atts_speedup", "sync_aggregate_512_speedup",
+                 "gen_operations_speedup")
+
+
+def probe_backend(timeout_s: float = 90.0) -> Optional[str]:
+    """jax's default backend name, resolved in a disposable child (the
+    parent never opens the device), or None when the tunnel is wedged /
+    jax is unimportable."""
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         "import jax; print(jax.default_backend())"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, start_new_session=True,
+    )
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        proc.wait()
+        return None
+    if proc.returncode != 0:
+        return None
+    backend = (out or "").strip().splitlines()
+    return backend[-1] if backend else None
+
+
+def run_section(name: str, cap_s: float) -> Dict[str, Any]:
+    """One killable ``bench.py --section`` child; returns its merged
+    last-line JSON (empty dict on timeout/failure)."""
+    proc = subprocess.Popen(
+        [sys.executable, str(REPO / "bench.py"), "--section", name],
+        stdout=subprocess.PIPE, text=True, start_new_session=True,
+    )
+    try:
+        out, _ = proc.communicate(timeout=cap_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGTERM)
+        except OSError:
+            pass
+        try:
+            out, _ = proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except OSError:
+                pass
+            out, _ = proc.communicate()
+    for line in reversed((out or "").strip().splitlines()):
+        try:
+            return json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+    return {}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--ledger", default=None)
+    parser.add_argument("--cap", type=float, default=900.0,
+                        help="per-section child cap (seconds)")
+    parser.add_argument("--timeout", type=float, default=90.0,
+                        help="device-aliveness probe timeout (seconds)")
+    parser.add_argument("--sections", default=",".join(SECTION_KEYS),
+                        help="comma-separated bench sections to run")
+    parser.add_argument("--allow-cpu", action="store_true",
+                        help="treat a CPU-only jax as a device (testing)")
+    parser.add_argument("--json", dest="json_path", type=pathlib.Path, default=None)
+    ns = parser.parse_args(argv)
+
+    backend = probe_backend(ns.timeout)
+    summary: Dict[str, Any] = {"backend": backend}
+    if backend is None or (backend == "cpu" and not ns.allow_cpu):
+        reason = ("tunnel unreachable / jax unimportable" if backend is None
+                  else "cpu-only jax (no device; --allow-cpu overrides)")
+        record_event("device_probe_gap", domain="bench",
+                     capability="device_probe", kind="environmental",
+                     detail=reason)
+        summary["gap"] = reason
+        print(f"device-probe: environment gap — {reason}; nothing banked")
+        _maybe_json(ns.json_path, summary)
+        return 0
+
+    print(f"device-probe: backend {backend} healthy — running sections")
+    banked: Dict[str, float] = {}
+    failures: Dict[str, str] = {}
+    for name in [s.strip() for s in ns.sections.split(",") if s.strip()]:
+        keys = SECTION_KEYS.get(name, [])
+        merged = run_section(name, ns.cap)
+        found = {k: merged[k] for k in keys
+                 if isinstance(merged.get(k), (int, float))}
+        if found:
+            banked.update(found)
+            print(f"device-probe: {name} -> "
+                  + " ".join(f"{k}={v}" for k, v in sorted(found.items())))
+        else:
+            err = (merged.get("section_errors") or {}).get(name, "no value")
+            failures[name] = str(err)
+            print(f"device-probe: {name} produced nothing ({err})")
+    summary["banked"] = banked
+    summary["failures"] = failures
+
+    if banked and ns.ledger != "off":
+        path = ns.ledger or ledger_mod.default_path()
+        if path:
+            run_id = ledger_mod.Ledger(path).record_run(
+                banked, source="device_probe", backend=backend,
+                extra={"probe": {"sections": sorted(SECTION_KEYS),
+                                 "failures": failures or None}})
+            summary["ledger"] = {"path": path, "run_id": run_id}
+            print(f"device-probe: banked {len(banked)} point(s) as "
+                  f"backend:{backend!r} -> {path} ({run_id})")
+    _maybe_json(ns.json_path, summary)
+    if not banked:
+        print("device-probe: device healthy but every section failed")
+        return 1
+    missing = [k for k in HEADLINE_KEYS if k not in banked]
+    if missing:
+        print(f"device-probe: headline keys still missing: {missing}")
+    return 0
+
+
+def _maybe_json(path: Optional[pathlib.Path], summary: Dict[str, Any]) -> None:
+    if path is not None:
+        with open(path, "w") as f:
+            json.dump(summary, f, indent=2, sort_keys=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

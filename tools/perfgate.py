@@ -30,7 +30,15 @@ jax import, no device, no tunnel):
                               in-process serve daemon under 4
                               concurrent clients — the serving
                               machinery's latency floor, gated from
-                              round 7 on (docs/SERVE.md).
+                              round 7 on (docs/SERVE.md);
+- ``perfgate_chain_sim_ms``   wall time of a short seeded chain
+                              simulation (forks/reorgs/equivocations
+                              through fork choice + full transitions)
+                              on the VECTORIZED engine path, with every
+                              epoch checkpoint asserted bit-identical
+                              to an interpreted-oracle pass of the same
+                              scenario — the sim hot loop the sentinel
+                              watches from round 8 on (docs/SIM.md).
 
 Each run appends one ledger run (git sha + environment fingerprint) and
 is classified by :mod:`consensus_specs_tpu.obs.sentinel` against the
@@ -303,12 +311,35 @@ def measure_serve_rtt_ms() -> float:
     return p50 * _chaos_factor("perfgate_serve_rtt_ms")
 
 
+def measure_chain_sim_ms() -> float:
+    """The chain simulator end-to-end on host (docs/SIM.md): one short
+    seeded scenario — fork windows, reorg swings, an equivocation
+    slashing, empty and late slots — run through the fork-choice Store
+    and full state transitions on the VECTORIZED engine path (SoA epoch
+    stages + batched attestation sweep). The interpreted oracle runs the
+    same scenario first and every epoch checkpoint must match
+    bit-for-bit, so the gated number can never come from a diverging
+    engine. The metric is the vectorized pass's wall time."""
+    from consensus_specs_tpu.sim import Scenario, ScenarioConfig
+    from consensus_specs_tpu.sim.driver import compare_checkpoints, run_sim
+
+    cfg = ScenarioConfig(seed=11, slots=40, equivocations=1)
+    scenario = Scenario(cfg)
+    oracle = run_sim(cfg, "interpreted", scenario=scenario)
+    vectorized = run_sim(cfg, "vectorized", scenario=scenario)
+    mismatches = compare_checkpoints(oracle, vectorized)
+    assert not mismatches, f"chain sim diverged: {mismatches[:3]}"
+    assert oracle.checkpoints, "chain sim produced no epoch checkpoints"
+    return vectorized.seconds * 1e3 * _chaos_factor("perfgate_chain_sim_ms")
+
+
 MEASUREMENTS: Tuple[Tuple[str, Callable[[], float]], ...] = (
     ("perfgate_hash_mibs", measure_hash_mibs),
     ("perfgate_reroot_ms", measure_reroot_ms),
     ("perfgate_epoch_kernel_ms", measure_epoch_kernel_ms),
     ("perfgate_gen_pipeline_ms", measure_gen_pipeline_ms),
     ("perfgate_serve_rtt_ms", measure_serve_rtt_ms),
+    ("perfgate_chain_sim_ms", measure_chain_sim_ms),
 )
 
 

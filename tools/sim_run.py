@@ -1,0 +1,180 @@
+"""Run the chain simulator (docs/SIM.md): a seeded long-horizon
+"mainnet day" — forks, reorgs, equivocation slashings, empty and late
+slots — through the fork-choice Store and the full state-transition
+path, differentially checked (vectorized engine vs interpreted oracle,
+bit-identical at every epoch checkpoint) and banked in the perf ledger.
+
+Usage:
+    python tools/sim_run.py [--slots N] [--seed N] [--fork F] [--preset P]
+                            [--validators N] [--engine MODE] [--chaos-drill]
+                            [--sign] [--ledger PATH|off] [--json OUT]
+
+Engine modes:
+    differential (default)  oracle pass + vectorized pass, checkpoint
+                            streams compared field by field; exit 1 on
+                            any mismatch
+    vectorized | interpreted  a single pass on that path
+
+``--chaos-drill`` adds a third pass: the SAME scenario on the
+vectorized path with a deterministic fault injected at the ``sim.step``
+site mid-run — the quarantine breaker must open, the remaining steps
+must degrade to the oracle path, and the checkpoint stream must STILL
+be bit-identical (the resilience layer's contract under load).
+
+Seed resolution: --seed wins, else CONSENSUS_SPECS_TPU_SIM_SEED, else 0
+— so CI reruns are byte-reproducible by pinning the env knob.
+
+Exit status: 0 = identical (and drill passed); 1 = divergence or drill
+failure.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+from typing import Any, Dict, Optional
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from consensus_specs_tpu import resilience  # noqa: E402
+from consensus_specs_tpu.obs import ledger as ledger_mod  # noqa: E402
+from consensus_specs_tpu.resilience import injection  # noqa: E402
+from consensus_specs_tpu.sim import (  # noqa: E402
+    Scenario,
+    ScenarioConfig,
+    seed_from_env,
+)
+from consensus_specs_tpu.sim.driver import (  # noqa: E402
+    compare_checkpoints,
+    run_differential,
+    run_sim,
+)
+
+
+def chaos_drill(config: ScenarioConfig, scenario: Scenario,
+                baseline_checkpoints) -> Dict[str, Any]:
+    """The proven-degradation pass: a deterministic fault fires at
+    ``sim.step`` a third of the way in, the breaker opens, every later
+    step runs on the oracle path — and the chain must not move a bit."""
+    resilience.clear("sim.step")
+    resilience.clear("sim.epoch")
+    after = max(2, config.slots // 3)
+    try:
+        with injection.inject("sim.step", "deterministic", count=1, after=after):
+            result = run_sim(config, "vectorized", scenario=scenario)
+    finally:
+        resilience.clear("sim.step")
+        resilience.clear("sim.epoch")
+    identical = result.checkpoints == baseline_checkpoints
+    return {
+        "identical": identical,
+        "degraded_steps": result.stats["degraded_steps"],
+        "fault_after_slot": after,
+        "slots_per_s": round(result.slots_per_s, 2),
+    }
+
+
+def main(argv: Optional[list] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--slots", type=int, default=2048)
+    parser.add_argument("--seed", type=int, default=None,
+                        help="scenario seed (default: "
+                             "$CONSENSUS_SPECS_TPU_SIM_SEED, else 0)")
+    parser.add_argument("--fork", default="altair")
+    parser.add_argument("--preset", default="minimal")
+    parser.add_argument("--validators", type=int, default=64)
+    parser.add_argument("--engine", default="differential",
+                        choices=("differential", "vectorized", "interpreted"))
+    parser.add_argument("--chaos-drill", action="store_true",
+                        help="also prove quarantine degradation keeps the "
+                             "chain bit-identical")
+    parser.add_argument("--sign", action="store_true",
+                        help="real BLS signatures (slow; short horizons only)")
+    parser.add_argument("--ledger", default=None,
+                        help="perf ledger path; 'off' disables banking")
+    parser.add_argument("--json", dest="json_path", type=pathlib.Path, default=None)
+    ns = parser.parse_args(argv)
+
+    seed = ns.seed if ns.seed is not None else seed_from_env(0)
+    config = ScenarioConfig(seed=seed, slots=ns.slots, fork=ns.fork,
+                            preset=ns.preset, validators=ns.validators,
+                            sign=ns.sign)
+    scenario = Scenario(config)
+    print(f"sim: {ns.slots} slots of {ns.fork}/{ns.preset}, seed {seed}, "
+          f"{ns.validators} validators — scenario {scenario.summary()}")
+
+    summary: Dict[str, Any] = {
+        "config": {"seed": seed, "slots": ns.slots, "fork": ns.fork,
+                   "preset": ns.preset, "validators": ns.validators},
+        "scenario": scenario.summary(),
+    }
+    ok = True
+    metrics: Dict[str, float] = {}
+
+    if ns.engine == "differential":
+        diff = run_differential(config)
+        oracle, vectorized = diff["oracle"], diff["vectorized"]
+        summary["oracle"] = oracle.to_dict()
+        summary["vectorized"] = vectorized.to_dict()
+        summary["identical"] = diff["identical"]
+        summary["mismatches"] = diff["mismatches"]
+        ok = diff["identical"]
+        print(f"sim: oracle {oracle.seconds:.1f}s "
+              f"({oracle.slots_per_s:.1f} slots/s), vectorized "
+              f"{vectorized.seconds:.1f}s ({vectorized.slots_per_s:.1f} "
+              f"slots/s), speedup {diff['speedup']}x")
+        print(f"sim: {diff['checkpoints']} epoch checkpoints "
+              f"{'BIT-IDENTICAL' if ok else 'DIVERGED'}"
+              + ("" if ok else f" — {diff['mismatches'][:3]}"))
+        stats = oracle.stats
+        print(f"sim: {stats['blocks_delivered']} blocks "
+              f"({stats['late_delivered']} late, {stats['fork_blocks']} on "
+              f"fork branches), {stats['reorgs']} reorgs, "
+              f"{stats['equivocations']} equivocations, "
+              f"{stats['slashings_included']} slashings included, "
+              f"{stats['pruned_blocks']} blocks pruned at finality")
+        metrics = {
+            "chain_sim_slots_per_s": round(vectorized.slots_per_s, 2),
+            "chain_sim_oracle_slots_per_s": round(oracle.slots_per_s, 2),
+        }
+        if diff["speedup"] is not None:
+            metrics["chain_sim_speedup"] = diff["speedup"]
+        if ok and ns.chaos_drill:
+            drill = chaos_drill(config, scenario, oracle.checkpoints)
+            summary["chaos_drill"] = drill
+            ok = ok and drill["identical"] and drill["degraded_steps"] > 0
+            print(f"sim: chaos drill — fault after slot "
+                  f"{drill['fault_after_slot']}, {drill['degraded_steps']} "
+                  f"degraded step(s), checkpoints "
+                  f"{'BIT-IDENTICAL' if drill['identical'] else 'DIVERGED'}")
+    else:
+        result = run_sim(config, ns.engine, scenario=scenario)
+        summary[ns.engine] = result.to_dict()
+        print(f"sim: {ns.engine} {result.seconds:.1f}s "
+              f"({result.slots_per_s:.1f} slots/s), "
+              f"{len(result.checkpoints)} checkpoints")
+        if ns.engine == "vectorized":
+            metrics["chain_sim_slots_per_s"] = round(result.slots_per_s, 2)
+
+    if metrics and ns.ledger != "off":
+        path = ns.ledger or ledger_mod.default_path()
+        if path:
+            run_id = ledger_mod.Ledger(path).record_run(
+                metrics, source="chain_sim", backend="host",
+                extra={"sim": {"slots": ns.slots, "seed": seed,
+                               "fork": ns.fork, "identical": ok}})
+            summary["ledger"] = {"path": path, "run_id": run_id}
+            print(f"sim: banked {sorted(metrics)} -> {path} ({run_id})")
+
+    if ns.json_path is not None:
+        with open(ns.json_path, "w") as f:
+            json.dump(summary, f, indent=2, sort_keys=True)
+        print(f"json summary written to {ns.json_path}")
+    print(f"sim: {'OK' if ok else 'FAILED'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -194,6 +194,32 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             "shared_client_traces_max": max(flush_client_counts, default=0),
         }
 
+    # --- sim section: the chain simulator's per-slot/per-epoch latency
+    # percentiles plus its event tallies (reorgs, fork windows,
+    # equivocations, chaos-degraded steps split by site) — docs/SIM.md
+    slot_durs = [float(s.get("dur") or 0) / 1e3 for s in spans
+                 if s.get("name") == "sim.slot"]
+    epoch_durs = [float(s.get("dur") or 0) / 1e3 for s in spans
+                  if s.get("name") == "sim.epoch"]
+    sim_events: Dict[str, int] = {}
+    sim_degraded: Dict[str, int] = {}
+    for i in instants:
+        name = str(i.get("name") or "")
+        if name == "sim.degraded":
+            site = str((i.get("attrs") or {}).get("site", "?"))
+            sim_degraded[site] = sim_degraded.get(site, 0) + 1
+        elif name.startswith("sim."):
+            sim_events[name[len("sim."):]] = sim_events.get(name[len("sim."):], 0) + 1
+    sim: Dict[str, Any] = {}
+    if slot_durs:
+        sim["slot_latency"] = _pcts(slot_durs)
+    if epoch_durs:
+        sim["epoch_rollover_latency"] = _pcts(epoch_durs)
+    if sim_events:
+        sim["events"] = dict(sorted(sim_events.items()))
+    if sim_degraded:
+        sim["degraded_steps_by_site"] = dict(sorted(sim_degraded.items()))
+
     # --- persistent compile cache traffic (sched.compile_cache instants:
     # every request that found a cached executable skipped its compile)
     cache_requests = sum(1 for i in instants
@@ -220,6 +246,7 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "gen_case_latency_by_fork": gen_pcts,
         "sched_flush_buckets": sched_buckets,
         "serve": serve,
+        "sim": sim,
         "compile_cache": {
             "requests": cache_requests,
             "hits": cache_hits,
@@ -287,6 +314,22 @@ def print_summary(summary: Dict[str, Any]) -> None:
               f"request(s)/bucket over {fanin['requests']} request(s) "
               f"(max {fanin['shared_client_traces_max']} distinct client "
               f"trace(s) in one flush)")
+    sim = summary.get("sim") or {}
+    if sim:
+        print("\nchain sim:")
+        for label, key in (("slot", "slot_latency"),
+                           ("epoch rollover", "epoch_rollover_latency")):
+            e = sim.get(key)
+            if e:
+                print(f"  {label}: {e['count']} span(s)  p50 {e['p50_ms']}ms  "
+                      f"p90 {e['p90_ms']}ms  p99 {e['p99_ms']}ms")
+        if sim.get("events"):
+            tally_txt = "  ".join(f"{k}={n}" for k, n in sim["events"].items())
+            print(f"  events: {tally_txt}")
+        if sim.get("degraded_steps_by_site"):
+            deg = "  ".join(f"{k}={n}"
+                            for k, n in sim["degraded_steps_by_site"].items())
+            print(f"  chaos-degraded: {deg}")
     cache = summary.get("compile_cache") or {}
     if cache.get("requests"):
         print(f"\ncompile cache: {cache['hits']} hit(s) / "

@@ -24,7 +24,8 @@ DEVICE_TESTS = tests/test_bls_device.py tests/test_curve_device.py \
 
 .PHONY: test citest test-fast test-device test-mainnet lint docs generate_tests gen_% replay bench \
         dryrun detect_generator_incomplete clean-vectors chaos trace perfgate perf-report gen-bench \
-        warm-cache serve serve-smoke serve-bench serve-canary slo-report sim sim-smoke device-probe help
+        gen-shard-smoke warm-cache serve serve-smoke serve-bench serve-canary slo-report sim \
+        sim-smoke device-probe help
 
 # the fault-injection suite: supervisor/taxonomy units, chaos replay
 # (tampered vectors), induced backend failures, generator crash/resume
@@ -47,6 +48,8 @@ help:
 	@echo "perfgate              host-only micro-bench slice -> $(LEDGER); FAILS on a sentinel-confirmed regression"
 	@echo "perf-report           render the perf ledger trajectory -> perf-report.html (+ stdout summary)"
 	@echo "gen-bench             generation-pipeline bench: operations suite in 3 modes, byte-identity proven, speedup -> $(LEDGER)"
+	@echo "                      GEN_WORKERS=N switches to the shard sweep: pipelined mode at 1/2/4/../N workers, gen_pipeline_w<N>_s + gen_shard_scaling -> $(LEDGER)"
+	@echo "gen-shard-smoke       sharded-generation smoke: --workers 2 tree+journal byte-identical to --workers 1, clean AND under sched.worker chaos"
 	@echo "warm-cache            prebuild the spec matrix + prime the persistent XLA compile cache (standalone warm start)"
 	@echo "serve                 run the resident verification daemon (docs/SERVE.md; Ctrl-C drains)"
 	@echo "serve-smoke           boot the daemon, drive 4 concurrent clients, scrape /metrics, assert clean SIGTERM drain"
@@ -74,6 +77,7 @@ citest:
 	$(if $(fork),,$(error citest requires fork=<name>, e.g. make citest fork=phase0))
 	$(PYTHON) -m pytest tests/spec -q --fork $(fork) $(if $(engine),--engine $(engine))
 	$(MAKE) trace
+	$(MAKE) gen-shard-smoke
 	$(MAKE) sim-smoke
 	$(MAKE) serve-smoke
 	$(MAKE) serve-canary
@@ -96,9 +100,19 @@ perf-report:
 
 # the generation-pipeline bench (docs/GENPIPE.md): the minimal-preset
 # operations suite in strict / per-case-flush / pipelined modes, digest
-# journals compared byte-for-byte, the speedup banked in the ledger
+# journals compared byte-for-byte, the speedup banked in the ledger.
+# GEN_WORKERS=N runs the data-parallel shard sweep instead (pipelined
+# mode at 1/2/4/../N forked workers, byte-identity across counts,
+# gen_pipeline_w<N>_s + gen_shard_scaling banked)
+GEN_WORKERS ?=
 gen-bench:
-	$(PYTHON) tools/gen_bench.py --ledger $(LEDGER)
+	$(PYTHON) tools/gen_bench.py --ledger $(LEDGER) $(if $(GEN_WORKERS),--workers $(GEN_WORKERS))
+
+# the sharded-generation smoke (citest slice): --workers 2 must land a
+# tree + merged journal byte-identical to --workers 1, clean and with a
+# sched.worker deterministic fault degrading one slice in-process
+gen-shard-smoke:
+	$(PYTHON) tools/gen_shard_smoke.py
 
 # standalone warm start (ROADMAP #2's first half): the spec matrix +
 # persistent XLA compile cache the resident daemon primes at startup,
@@ -130,9 +144,12 @@ slo-report:
 # "mainnet day" through fork choice + full state transitions, the
 # vectorized engine differentially checked against the interpreted
 # oracle at every epoch checkpoint, with a proven chaos-degradation
-# drill; slots/s + the vectorized-vs-oracle speedup bank in the ledger
+# drill; slots/s + the vectorized-vs-oracle speedup bank in the ledger.
+# SIM_VALIDATORS=512 (etc) scales the registry — non-default sizes bank
+# their own chain_sim_<N>v_* series (engine wins grow with validators)
+SIM_VALIDATORS ?= 64
 sim:
-	$(PYTHON) tools/sim_run.py --slots 2048 --chaos-drill --ledger $(LEDGER)
+	$(PYTHON) tools/sim_run.py --slots 2048 --validators $(SIM_VALIDATORS) --chaos-drill --ledger $(LEDGER)
 
 sim-smoke:
 	$(PYTHON) tools/sim_run.py --slots 96 --chaos-drill --ledger $(LEDGER)

@@ -970,6 +970,34 @@ def bench_chain_sim() -> None:
     _note(f"chain_sim: {len(oracle.checkpoints)} checkpoints bit-identical, "
           f"total {_time.perf_counter() - t0:.1f}s")
 
+    # ROADMAP #5 headroom: engine wins GROW with registry size, so the
+    # 64-validator number understates the mainnet story. A second,
+    # mainnet-leaning differential pass at >=512 validators (short
+    # horizon — the oracle is the expensive half) banks its own series;
+    # BENCH_SIM_VALIDATORS=0 opts out.
+    validators = int(os.environ.get("BENCH_SIM_VALIDATORS", "512"))
+    if validators:
+        v_slots = int(os.environ.get("BENCH_SIM_VALIDATOR_SLOTS", "32"))
+        cfg_v = ScenarioConfig(seed=seed_from_env(7), slots=v_slots,
+                               validators=validators)
+        scenario_v = Scenario(cfg_v)
+        oracle_v = run_sim(cfg_v, "interpreted", scenario=scenario_v)
+        vectorized_v = run_sim(cfg_v, "vectorized", scenario=scenario_v)
+        mismatches = compare_checkpoints(oracle_v, vectorized_v)
+        if mismatches:
+            raise AssertionError(
+                f"chain_sim: {validators}-validator vectorized pass diverged "
+                f"at {len(mismatches)} checkpoint field(s): {mismatches[:3]}")
+        RESULTS[f"chain_sim_{validators}v_slots_per_s"] = round(
+            vectorized_v.slots_per_s, 2)
+        RESULTS[f"chain_sim_{validators}v_speedup"] = (
+            round(oracle_v.seconds / vectorized_v.seconds, 2)
+            if vectorized_v.seconds else None)
+        _note(f"chain_sim: {validators} validators x {v_slots} slots — "
+              f"oracle {oracle_v.slots_per_s:.1f} slots/s, vectorized "
+              f"{vectorized_v.slots_per_s:.1f} slots/s "
+              f"({RESULTS[f'chain_sim_{validators}v_speedup']}x)")
+
 
 def _device_alive(timeout_s: int = 90) -> bool:
     """Open the device in a DISPOSABLE CHILD first: a wedged tunnel (hung
@@ -1147,7 +1175,7 @@ def main() -> None:
         run("host_fallback", 150, 320, keep_s=45)
         run("sync_aggregate_host", 45, 120)  # config #4 host datapoint
         run("epoch_vectorized", 120, 300)
-        run("chain_sim", 60, 180)
+        run("chain_sim", 90, 230)
         run("incremental_reroot", 30, 90)
     else:
         host_keep = 220.0  # host_fallback (incl. config #3 host) + reroot stay fundable
@@ -1200,7 +1228,7 @@ def main() -> None:
             run("host_fallback", 150, 320, keep_s=45)
             run("sync_aggregate_host", 45, 120)
         run("epoch_vectorized", 120, 300)
-        run("chain_sim", 60, 180)
+        run("chain_sim", 90, 230)
         run("incremental_reroot", 30, 90)
         if os.environ.get("BENCH_PALLAS") == "1":
             run("pallas_probe", 75, 85)

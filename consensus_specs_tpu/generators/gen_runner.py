@@ -26,6 +26,17 @@ supervised writer thread (``--serial-writes`` opts out) so yaml/part
 IO + the journal append overlap the next case's compute and the next
 bucket's device dispatch. Output bytes are mode-independent — pinned
 by tests/test_gen_defer.py and tests/test_gen_sched.py.
+
+Data-parallel sharding (sched/shard.py, docs/GENPIPE.md "Sharded
+generation"): ``--workers N`` partitions the case stream across N
+forked supervised worker processes — each rank's slice is a pure
+function of (suite, N, rank), each rank runs the full pipelined path
+above with its own crash-safe per-rank digest journal, and a
+deterministic merge step produces a suite tree + combined journal
+byte-identical to the ``--workers 1`` run regardless of completion
+order, worker deaths (transients respawn and resume from the rank
+journal), or chaos at the ``sched.worker`` site (deterministic faults
+degrade that slice to the in-process serial path).
 """
 from __future__ import annotations
 
@@ -35,13 +46,14 @@ import shutil
 import time
 import traceback
 from pathlib import Path
-from typing import Iterable, List, Tuple
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 import yaml
 
 from consensus_specs_tpu import obs
 from consensus_specs_tpu.exceptions import SkippedTest
 from consensus_specs_tpu.resilience import CaseJournal, RetryPolicy, chaos, supervised
+from consensus_specs_tpu.resilience.journal import JOURNAL_NAME
 from consensus_specs_tpu.utils import profiling
 from consensus_specs_tpu.ssz.types import SSZType
 from consensus_specs_tpu.utils import snappy
@@ -132,10 +144,7 @@ class _CaseOutcome:
         self.start = start
 
 
-def run_generator(generator_name: str, test_providers: Iterable[TestProvider], args=None) -> None:
-    """Write all providers' cases under ``<output>/<case dir>`` with the
-    INCOMPLETE sentinel marking in-progress cases and skip-if-exists resume
-    (ref gen_runner.py:41-218)."""
+def build_parser(generator_name: str) -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog=f"gen-{generator_name}",
         description=f"Generate YAML/SSZ test-vector suites for {generator_name}",
@@ -172,17 +181,70 @@ def run_generator(generator_name: str, test_providers: Iterable[TestProvider], a
                              "instead of the bounded overlap writer queue "
                              "(default: overlapped unless "
                              "CONSENSUS_SPECS_TPU_GEN_OVERLAP=0)")
+    parser.add_argument("--workers", type=int, default=_workers_default(),
+                        help="shard cases across N forked supervised worker "
+                             "processes with per-rank journals and a "
+                             "deterministic merge (docs/GENPIPE.md; 0 = "
+                             "classic in-process run; default: "
+                             "CONSENSUS_SPECS_TPU_GEN_WORKERS env or 0)")
+    return parser
 
-    ns = parser.parse_args(args=args)
 
+def run_generator(generator_name: str, test_providers: Iterable[TestProvider], args=None) -> None:
+    """Write all providers' cases under ``<output>/<case dir>`` with the
+    INCOMPLETE sentinel marking in-progress cases and skip-if-exists resume
+    (ref gen_runner.py:41-218). ``--workers N`` scales the run out across
+    N supervised worker processes (sched/shard.py)."""
+    ns = build_parser(generator_name).parse_args(args=args)
+
+    if ns.workers > 0 and not ns.collect_only:
+        from consensus_specs_tpu.sched import shard
+
+        counts = shard.run_sharded(generator_name, test_providers, ns)
+    else:
+        counts = run_slice(generator_name, test_providers, ns)
+    if ns.collect_only:
+        return
+    summary = (
+        f"completed generation of {generator_name}: "
+        f"{counts['generated']} generated, {counts['skipped']} skipped, "
+        f"{counts['failed']} failed"
+    )
+    print(summary)
+    if ns.profile and ns.workers <= 0:
+        profiling.print_report(header="per-handler wall clock:")
+    if counts["failed"]:
+        raise SystemExit(1)
+
+
+def run_slice(generator_name: str, test_providers: Iterable[TestProvider],
+              ns: argparse.Namespace, *,
+              journal_name: str = JOURNAL_NAME,
+              absorb_journal: Optional[Path] = None,
+              case_filter: Optional[Callable[[TestCase, int], bool]] = None,
+              label: str = "") -> Dict[str, int]:
+    """One in-process generation pass over the providers' case stream —
+    the whole suite by default, or the sub-slice ``case_filter`` selects
+    (sharded workers pass the rank predicate plus their per-rank
+    ``journal_name``; ``absorb_journal`` pre-loads a prior merged
+    journal for resume admits). Returns the generated/skipped/failed
+    counts; case failures are counted and error-logged, never raised."""
     output_dir: Path = ns.output_dir
     log_file = output_dir / "testgen_error_log.txt"
     flush_every = max(1, int(ns.flush_every))
 
-    journal = CaseJournal(output_dir) if ns.journal and not ns.collect_only else None
+    journal = None
+    if ns.journal and not ns.collect_only:
+        journal = CaseJournal(output_dir, name=journal_name)
+        if absorb_journal is not None:
+            journal.absorb(absorb_journal)
 
     counts = {"generated": 0, "skipped": 0, "failed": 0}
     collected = 0
+    # per-(runner, fork) stream positions: the shard function's case
+    # index — identical in every worker because provider enumeration is
+    # deterministic (the TestCase re-runnability contract)
+    stream_pos: Dict[Tuple[str, str], int] = {}
 
     def record_failure(case_dir: Path, err: str) -> None:
         counts["failed"] += 1
@@ -265,7 +327,7 @@ def run_generator(generator_name: str, test_providers: Iterable[TestProvider], a
 
     def finalize_case(case_dir, encoded, meta, error, start) -> None:
         if isinstance(error, SkippedTest):
-            print(f"skipped: {error}")
+            print(f"{label}skipped: {error}")
             counts["skipped"] += 1
         elif error is not None:
             record_failure(case_dir, error)
@@ -315,6 +377,15 @@ def run_generator(generator_name: str, test_providers: Iterable[TestProvider], a
         for test_case in provider.make_cases():
             if ns.preset_list is not None and test_case.preset_name not in ns.preset_list:
                 continue
+            if case_filter is not None:
+                # the per-(runner, fork) stream index advances for EVERY
+                # enumerated case so rank assignment is a pure function
+                # of the stream, not of what other ranks generated
+                key = (test_case.runner_name, test_case.fork_name)
+                idx = stream_pos.get(key, 0)
+                stream_pos[key] = idx + 1
+                if not case_filter(test_case, idx):
+                    continue
             collected += 1
             if ns.collect_only:
                 print(test_case.dir_path())
@@ -329,6 +400,12 @@ def run_generator(generator_name: str, test_providers: Iterable[TestProvider], a
                             str(case_dir.relative_to(output_dir)), case_dir):
                         counts["skipped"] += 1
                         if journal is not None:
+                            # a case admitted on the structural pre-journal
+                            # path (its journal append was lost to a kill)
+                            # is backfilled so resumes verify digests and
+                            # the sharded merge sees every case
+                            journal.ensure_recorded(
+                                str(case_dir.relative_to(output_dir)), case_dir)
                             # resume marked in the trace: digest-verified
                             # cases skipped on re-run are visible, not silent
                             obs.instant("gen.journal_admitted",
@@ -336,12 +413,12 @@ def run_generator(generator_name: str, test_providers: Iterable[TestProvider], a
                         continue
                     # journal verification failed (truncated/tampered/
                     # unverifiable output): regenerate instead of shipping
-                    print(f"regenerating (failed resume verification): {case_dir}")
+                    print(f"{label}regenerating (failed resume verification): {case_dir}")
                     obs.instant("gen.journal_regenerate",
                                 case=test_case.dir_path())
                 shutil.rmtree(case_dir)
 
-            print(f"generating: {case_dir}")
+            print(f"{label}generating: {case_dir}")
             start = time.time()
             profile_ctx = (
                 profiling.section(f"{test_case.runner_name}/{test_case.handler_name}")
@@ -374,28 +451,28 @@ def run_generator(generator_name: str, test_providers: Iterable[TestProvider], a
           # drain inside the gen.run span so the trace shows the writer
           # tail; terminal write failures surface as failed cases, never
           # silently dropped output
-          for label, err in writer.close():
-              record_failure(Path(label), f"writer failed terminally: {err}")
+          for failed_label, err in writer.close():
+              record_failure(Path(failed_label), f"writer failed terminally: {err}")
 
     if ns.collect_only:
         print(f"collected {collected} test cases")
-    else:
-        summary = (
-            f"completed generation of {generator_name}: "
-            f"{counts['generated']} generated, {counts['skipped']} skipped, "
-            f"{counts['failed']} failed"
-        )
-        print(summary)
-        if ns.profile:
-            profiling.print_report(header="per-handler wall clock:")
-        if counts["failed"]:
-            raise SystemExit(1)
+    return counts
 
 
 def _defer_default() -> bool:
     import os
 
     return os.environ.get("CONSENSUS_SPECS_TPU_BLS_DEFER", "") not in ("", "0", "false")
+
+
+def _workers_default() -> int:
+    import os
+
+    raw = os.environ.get("CONSENSUS_SPECS_TPU_GEN_WORKERS", "")
+    try:
+        return max(0, int(raw)) if raw else 0
+    except ValueError:
+        return 0
 
 
 def _flush_every_default() -> int:

@@ -4,7 +4,8 @@
 Usage:
   python -m consensus_specs_tpu.generators.main -o out/          # all runners
   python -m consensus_specs_tpu.generators.main -o out/ --runners bls shuffling
-  ... plus any gen_runner flags (-f force, -l preset filter, -c collect)
+  ... plus any gen_runner flags (-f force, -l preset filter, -c collect,
+  --workers N for data-parallel sharded generation — docs/GENPIPE.md)
 """
 from __future__ import annotations
 

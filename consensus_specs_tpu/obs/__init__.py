@@ -48,6 +48,7 @@ from .core import (  # noqa: F401
     enabled,
     event,
     events,
+    fork_child_reinit,
     instant,
     is_root_process,
     kernel_span,

@@ -114,7 +114,7 @@ def infer_unit(metric: str) -> Optional[str]:
         return "/s"
     if metric.endswith("_s") or metric.endswith("_seconds"):
         return "s"
-    if "speedup" in metric or metric == "vs_baseline":
+    if "speedup" in metric or "scaling" in metric or metric == "vs_baseline":
         return "x"
     return None
 

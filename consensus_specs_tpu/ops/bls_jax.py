@@ -386,6 +386,49 @@ def fast_aggregate_verify_batch_sharded(pubkey_lists, messages, signatures, mesh
     )
 
 
+def flush_buckets_sharded(bucket_rows, mesh, axis_name: str = "dp"):
+    """A generation flush's planned bucket list dispatched across the
+    multi-chip mesh (ISSUE 9 / ROADMAP #3's device half): each bucket —
+    a list of ``(pubkey_list, message, signature)`` rows that
+    ``sched.bucketing.plan_flush`` grouped into one canonical shape — is
+    packed like :func:`run_checks_sharded` packs it, its rows placed
+    ``PartitionSpec(axis_name)`` over the mesh so every device runs its
+    shard's Miller loops, and the per-bucket accept count reduced with
+    an explicit :func:`shard_map` ``psum`` over the axis (an ICI
+    collective on real hardware).
+
+    Guarded by the resilience selfcheck: when the GSPMD quarantine for
+    ``jax.sharded_tree_reduce`` is open (the known jaxlib CPU
+    miscompile once reduce rows drop below the shard count — exactly
+    the small-tail shapes flush buckets produce), every bucket degrades
+    to the unsharded single-device dispatch with a recorded event, so a
+    sharded flush can never return an untrusted mask.
+
+    Returns ``(masks, counts)``: one per-row boolean accept mask and one
+    cross-shard-reduced accept count per bucket, in bucket order.
+    """
+    from ..resilience import record_event, selfcheck
+
+    probe = selfcheck.sharded_reduce_status()
+    if probe.quarantined:
+        record_event("fallback", domain="ops.bls", capability=probe.capability,
+                     detail="sharded flush degraded to unsharded dispatch: "
+                            + probe.detail[:200])
+    masks: List[np.ndarray] = []
+    counts: List[int] = []
+    for rows in bucket_rows:
+        checks = [_fast_aggregate_verify_check(pks, m, s) for pks, m, s in rows]
+        if probe.quarantined:
+            mask = _run_checks(checks)
+            masks.append(mask)
+            counts.append(int(mask.sum()))
+        else:
+            mask, count = run_checks_sharded(checks, mesh, axis_name)
+            masks.append(mask)
+            counts.append(int(count))
+    return masks, counts
+
+
 def aggregate_verify_batch(pubkey_lists, message_lists, signatures) -> np.ndarray:
     return _run_checks(
         [

@@ -22,6 +22,12 @@ Sites are plain strings; the convention is plane.point:
   hash.dispatch  gen.case  bench.section  dryrun.child  replay.case
   sched.flush (per bucket dispatch of the cross-case deferred flush)
   sched.writer (per case written by the overlap writer thread)
+  sched.worker (per worker slice of the sharded generator, fired in the
+                PARENT's supervised wait: transient=respawn the slice
+                — the per-rank journal resumes it; deterministic=the
+                slice degrades to the in-process serial path; either
+                way the merged tree + combined journal stay
+                byte-identical — docs/GENPIPE.md "Sharded generation")
   serve.request (per request executed by the resident daemon)
   serve.flush (per cross-client micro-batch dispatched by the daemon's
                flusher thread; a fault here degrades that batch to the

@@ -29,6 +29,43 @@ from .supervisor import record_event
 
 JOURNAL_NAME = ".gen_journal.jsonl"
 
+# per-rank journals for the sharded generator (sched/shard.py): each
+# supervised worker appends to its own file so crash safety never needs
+# cross-process append coordination; the parent merges them into
+# JOURNAL_NAME deterministically after every rank completes
+RANK_JOURNAL_FMT = ".gen_journal.rank{rank:04d}.jsonl"
+
+
+def rank_journal_name(rank: int) -> str:
+    return RANK_JOURNAL_FMT.format(rank=rank)
+
+
+def load_ops(path: Path) -> list:
+    """The raw op stream of one journal file: ``{"case", "parts"}``
+    records and ``{"case", "status": "invalidated"}`` tombstones, in
+    append order, torn trailing line tolerated. The sharded merge
+    (sched/shard.py) replays these on top of a prior merged journal so a
+    rank's invalidations are not resurrected by stale merged entries."""
+    ops = []
+    if not path.exists():
+        return ops
+    with open(path, "rb") as f:
+        for line in f:
+            try:
+                entry = json.loads(line)
+                if "case" in entry and ("parts" in entry or "status" in entry):
+                    ops.append(entry)
+            except (ValueError, KeyError, TypeError):
+                continue
+    return ops
+
+
+def encode_entry(case: str, parts: Dict[str, str]) -> str:
+    """The canonical one-line encoding of a journal entry — shared by
+    ``CaseJournal._append`` and the sharded merge so a merged journal is
+    byte-identical to one the serial writer would have produced."""
+    return json.dumps({"case": case, "parts": parts}, sort_keys=True) + "\n"
+
 COMPLETE = "complete"
 ABSENT = "absent"
 CORRUPT = "corrupt"
@@ -68,10 +105,23 @@ def verify_outputs(case_dir: Path) -> Optional[str]:
 class CaseJournal:
     """Append-only digest journal at ``<output_dir>/.gen_journal.jsonl``."""
 
-    def __init__(self, output_dir: Path):
-        self.path = Path(output_dir) / JOURNAL_NAME
+    def __init__(self, output_dir: Path, name: str = JOURNAL_NAME):
+        self.path = Path(output_dir) / name
         self._entries: Dict[str, Dict[str, str]] = {}
         self._load()
+
+    def absorb(self, path: Path) -> int:
+        """Pre-load entries from another journal file (the merged
+        journal of a PRIOR sharded run) for admit decisions only — no
+        lines are appended to this journal. Entries already present
+        (this journal's own appends) win. Returns the count absorbed."""
+        absorbed = 0
+        for op in load_ops(Path(path)):
+            if op.get("status") == "invalidated" or op["case"] in self._entries:
+                continue
+            self._entries[op["case"]] = op["parts"]
+            absorbed += 1
+        return absorbed
 
     def _load(self) -> None:
         if not self.path.exists():
@@ -112,6 +162,17 @@ class CaseJournal:
         }
         self._append({"case": rel, "parts": parts})
         self._entries[rel] = parts
+
+    def ensure_recorded(self, rel: str, case_dir: Path) -> None:
+        """Backfill a digest entry for a case admitted on the structural
+        (pre-journal) path. A kill in the window between a case's last
+        part write and its journal fsync leaves a fully-written case dir
+        with no entry; without backfill the case would be admitted on
+        resume yet stay invisible to digest verification and to the
+        sharded merge's combined journal (which must hold EVERY case for
+        worker-count-independent byte-identity — sched/shard.py)."""
+        if rel not in self._entries:
+            self.record(rel, case_dir)
 
     def invalidate(self, rel: str) -> None:
         """Drop a case from the journal (it failed or was removed)."""

@@ -32,6 +32,13 @@ compile-cache + host/device overlap shape any serving stack needs:
   thread prepares the next bucket's host inputs and device flush.
   Backpressure through the bounded queue; crash-safe ordering through
   the existing fsync'd digest journal (submit order == journal order).
+- :mod:`shard` — data-parallel scale-out (``gen_runner --workers N``):
+  the case stream partitioned across N forked supervised workers by a
+  deterministic (runner x fork x case-index) shard function, each rank
+  running the full pipelined path with its own crash-safe per-rank
+  journal, merged deterministically into a combined journal + tree
+  byte-identical to the ``--workers 1`` run whatever the completion
+  order, worker deaths, or ``sched.worker`` chaos.
 
 Consumers: ``crypto/bls`` (DeferredVerifier.flush plans through
 :func:`bucketing.plan_flush`), ``generators/gen_runner`` (cross-case
@@ -40,17 +47,19 @@ and ``__graft_entry__``'s dryrun child (compile cache), and
 ``tools/perfgate.py`` (the host-only ``gen_pipeline`` micro-bench the
 sentinel gates from this round on).
 
-Chaos sites: ``sched.flush`` (per bucket dispatch) and ``sched.writer``
-(per written case). Counters: ``sched.flush.*`` / ``sched.writer.*`` /
+Chaos sites: ``sched.flush`` (per bucket dispatch), ``sched.writer``
+(per written case), ``sched.worker`` (per sharded worker slice).
+Counters: ``sched.flush.*`` / ``sched.writer.*`` / ``sched.shard.*`` /
 ``sched.compile_cache.*``. See docs/GENPIPE.md.
 """
 from __future__ import annotations
 
-from . import bucketing, compile_cache, writer  # noqa: F401
+from . import bucketing, compile_cache, shard, writer  # noqa: F401
 from .bucketing import BucketDispatch, FlushPlan, plan_flush, pow2_bucket  # noqa: F401
 from .compile_cache import (  # noqa: F401
     COMPILE_CACHE_ENV,
     configure_compile_cache,
     compile_cache_stats,
 )
+from .shard import merge_journals, run_sharded, shard_rank  # noqa: F401
 from .writer import CaseWriter  # noqa: F401

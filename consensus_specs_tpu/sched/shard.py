@@ -1,0 +1,349 @@
+"""Data-parallel suite generation: shard cases across supervised worker
+processes, merge deterministically (docs/GENPIPE.md "Sharded generation").
+
+The generation workload is embarrassingly parallel — cases are
+independent pure functions (the TestCase re-runnability contract) — but
+until this layer it only ever ran in one process. ``gen_runner
+--workers N`` partitions the case stream across N forked workers:
+
+- **deterministic sharding** — a case's rank is a pure function of
+  (runner, fork, per-stream case index, N): :func:`shard_rank`
+  round-robins each (runner, fork) stream across ranks with a stable
+  crc32 stream offset, so every worker derives its own slice from the
+  same enumeration with zero coordination, and any slice can be
+  recomputed by anyone (the parent's degraded fallback does exactly
+  that);
+- **fork, not exec** — providers are live Python objects (closures over
+  imported test modules), so workers are forked from the parent after
+  argument parsing and inherit them copy-on-write; each child re-inits
+  the obs tracing context (``obs.fork_child_reinit``) so its spans file
+  parents under the spawning ``sched.shard`` span via the existing
+  ``CONSENSUS_SPECS_TPU_TRACE`` child-env machinery;
+- **per-rank crash safety** — each worker runs the full pipelined path
+  (cross-case bucketed BLS flush, overlap writer) with its OWN fsync'd
+  digest journal (``.gen_journal.rank<R>.jsonl``), so worker deaths
+  never contend on one append stream and a respawned rank resumes from
+  exactly its verified-complete cases;
+- **supervision** — each rank's wait runs under
+  ``resilience.supervised`` with chaos site ``sched.worker``: transient
+  faults (SIGKILLed child, EX_TEMPFAIL self-report, injected chaos)
+  respawn the slice, which journal-resumes; deterministic faults
+  degrade that slice to the in-process serial path (the parent runs it
+  itself) — either way the suite completes with identical bytes;
+- **deterministic merge** — after every slice lands, the per-rank
+  journals (plus any prior merged journal, minus per-rank
+  invalidations) merge into the canonical ``.gen_journal.jsonl`` in
+  sorted-case order, independent of worker completion order, so the
+  merged tree + combined journal are byte-identical to the
+  ``--workers 1`` run (tests/test_gen_shard.py drills clean, SIGKILLed,
+  and chaos-degraded runs to the same bytes).
+
+Spans: ``sched.shard`` (parent), ``sched.worker`` (one per rank per
+attempt — child-side, rank attr; the per-rank utilization source for
+``tools/trace_report.py``), ``sched.merge``. Counters:
+``sched.shard.respawns`` / ``sched.shard.degraded``.
+
+Pure stdlib + os.fork; no jax anywhere in this module (workers that
+need a device backend open it themselves after the fork).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+import zlib
+from pathlib import Path
+from typing import Any, Dict, Iterable, List
+
+from .. import obs
+from ..resilience import (
+    DETERMINISTIC,
+    ENVIRONMENTAL,
+    RetryPolicy,
+    TRANSIENT,
+    chaos,
+    record_event,
+    supervised,
+)
+from ..resilience import taxonomy
+from ..resilience.journal import (
+    JOURNAL_NAME,
+    encode_entry,
+    load_ops,
+    rank_journal_name,
+)
+
+# one respawn per rank: a SIGKILLed/transient worker resumes from its
+# rank journal; a second death in a row degrades to the in-process path
+WORKER_RETRY_POLICY = RetryPolicy(max_attempts=2, base_delay_s=0.1, max_delay_s=1.0)
+
+RANK_RESULT_FMT = ".gen_rank{rank:04d}.result.json"
+
+_FAULT_BY_KIND = {
+    TRANSIENT: taxonomy.TransientFault,
+    DETERMINISTIC: taxonomy.DeterministicFault,
+    ENVIRONMENTAL: taxonomy.EnvironmentalFault,
+}
+
+
+def shard_rank(runner: str, fork: str, index: int, workers: int) -> int:
+    """The rank owning case ``index`` of the (runner, fork) stream — a
+    pure function of its arguments (no process state, no hash
+    randomization), so any worker's slice is recomputable anywhere.
+    Streams start at a stable crc32-derived offset so the heads of many
+    short streams don't all pile onto rank 0."""
+    if workers <= 1:
+        return 0
+    offset = zlib.crc32(f"{runner}/{fork}".encode()) % workers
+    return (index + offset) % workers
+
+
+def _rank_filter(rank: int, workers: int):
+    def accept(test_case: Any, index: int) -> bool:
+        return shard_rank(test_case.runner_name, test_case.fork_name,
+                          index, workers) == rank
+
+    return accept
+
+
+def _result_path(output_dir: Path, rank: int) -> Path:
+    return output_dir / RANK_RESULT_FMT.format(rank=rank)
+
+
+class _Worker:
+    __slots__ = ("rank", "pid")
+
+    def __init__(self, rank: int, pid: int):
+        self.rank = rank
+        self.pid = pid
+
+    def wait(self) -> int:
+        """Child return code, signal deaths as negative (the subprocess
+        convention classify_exit expects)."""
+        _, status = os.waitpid(self.pid, 0)
+        if os.WIFSIGNALED(status):
+            return -os.WTERMSIG(status)
+        return os.WEXITSTATUS(status)
+
+    def kill(self) -> None:
+        try:
+            os.kill(self.pid, signal.SIGKILL)
+        except OSError:
+            return
+        try:
+            os.waitpid(self.pid, 0)
+        except OSError:
+            pass
+
+
+def _spawn_worker(generator_name: str, providers: Iterable[Any],
+                  ns: argparse.Namespace, rank: int, workers: int) -> _Worker:
+    """Fork one supervised worker for ``rank``'s slice. The child runs
+    the full pipelined slice with its per-rank journal and exits 0 even
+    when individual cases failed (failures are data, counted in the rank
+    result file); a nonzero exit is an infrastructure fault, classified
+    via the sysexits convention."""
+    from ..generators import gen_runner
+
+    output_dir: Path = ns.output_dir
+    trace_env = obs.child_env().get(obs.TRACE_ENV)
+    sys.stdout.flush()
+    sys.stderr.flush()
+    pid = os.fork()
+    if pid:
+        return _Worker(rank, pid)
+
+    # ---- child ----
+    code = taxonomy.EX_SOFTWARE
+    try:
+        obs.fork_child_reinit(trace_env)
+        with obs.span("sched.worker", rank=rank, workers=workers,
+                      generator=generator_name):
+            counts = gen_runner.run_slice(
+                generator_name, providers, ns,
+                journal_name=rank_journal_name(rank),
+                absorb_journal=output_dir / JOURNAL_NAME,
+                case_filter=_rank_filter(rank, workers),
+                label=f"[w{rank}] ")
+        payload = json.dumps({"rank": rank, "counts": counts}, sort_keys=True)
+        result = _result_path(output_dir, rank)
+        result.parent.mkdir(parents=True, exist_ok=True)
+        with open(result, "w") as f:
+            f.write(payload + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        code = 0
+    except BaseException as e:
+        import traceback
+
+        kind = taxonomy.classify(e)
+        try:
+            sys.stderr.write(f"[w{rank}] worker failed ({kind}): "
+                             f"{traceback.format_exc()}\n")
+        except Exception:
+            pass
+        code = taxonomy.exit_code_for(kind)
+    finally:
+        try:
+            sys.stdout.flush()
+            sys.stderr.flush()
+        except Exception:
+            pass
+        os._exit(code)  # never run the parent's exit machinery twice
+    raise AssertionError("unreachable")  # pragma: no cover
+
+
+def _run_degraded(generator_name: str, providers: Iterable[Any],
+                  ns: argparse.Namespace, rank: int, workers: int) -> Dict[str, int]:
+    """The quarantine response for one slice: re-run it IN-PROCESS on
+    the serial path (same rank journal, so whatever the dead worker
+    committed is admitted, not regenerated). Correct by construction —
+    the slice is a pure function of (suite, N, rank)."""
+    obs.count("sched.shard.degraded")
+    record_event("fallback", domain="sched.shard", capability="sched.worker",
+                 detail=f"rank {rank}: slice degraded to the in-process "
+                        "serial path")
+    from ..generators import gen_runner
+
+    with obs.span("sched.worker", rank=rank, workers=workers,
+                  generator=generator_name, degraded=True):
+        return gen_runner.run_slice(
+            generator_name, providers, ns,
+            journal_name=rank_journal_name(rank),
+            absorb_journal=ns.output_dir / JOURNAL_NAME,
+            case_filter=_rank_filter(rank, workers),
+            label=f"[w{rank}*] ")
+
+
+def merge_journals(output_dir: Path, workers: int) -> Dict[str, Dict[str, str]]:
+    """Fold the per-rank journals into the canonical combined journal.
+
+    Completion-order independent by construction: a prior merged journal
+    seeds the table (cases admitted-by-skip this run appear in no rank
+    journal), each rank's op stream replays on top of it (slices are
+    disjoint, so cross-rank replay order cannot matter; invalidations
+    tombstone their case), and the result is written in sorted-case
+    order via the journal's canonical line encoding — so the merged
+    bytes are a pure function of the suite content, identical for every
+    worker count including ``--workers 1``. Crash-safe: written to a
+    temp file, fsync'd, atomically renamed; the rank journals are
+    removed only after the rename (a crash in between leaves stale rank
+    journals whose entries are digest-verified on any later resume)."""
+    merged_path = output_dir / JOURNAL_NAME
+    table: Dict[str, Dict[str, str]] = {}
+    for op in load_ops(merged_path):
+        if op.get("status") == "invalidated":
+            table.pop(op["case"], None)
+        else:
+            table[op["case"]] = op["parts"]
+    rank_paths: List[Path] = []
+    for rank in range(workers):
+        path = output_dir / rank_journal_name(rank)
+        rank_paths.append(path)
+        for op in load_ops(path):
+            if op.get("status") == "invalidated":
+                table.pop(op["case"], None)
+            else:
+                table[op["case"]] = op["parts"]
+
+    tmp = output_dir / f"{JOURNAL_NAME}.merge.{os.getpid()}"
+    with open(tmp, "w") as f:
+        for case in sorted(table):
+            f.write(encode_entry(case, table[case]))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, merged_path)
+    for path in rank_paths:
+        try:
+            path.unlink()
+        except OSError:
+            pass
+    return table
+
+
+def run_sharded(generator_name: str, providers: Iterable[Any],
+                ns: argparse.Namespace) -> Dict[str, int]:
+    """Drive one ``--workers N`` generation run: fork N supervised
+    workers over disjoint deterministic slices, respawn/degrade per the
+    fault taxonomy, then merge. Returns the aggregated counts (the
+    caller prints the summary and owns the exit status)."""
+    workers = max(1, int(ns.workers))
+    # materialize: a degraded in-process slice iterates providers in THIS
+    # process; a lazily-built iterable consumed here must not starve a
+    # later respawned child (make_cases callables re-iterate freshly)
+    providers = list(providers)
+    output_dir: Path = ns.output_dir
+    output_dir.mkdir(parents=True, exist_ok=True)
+    t0 = time.time()
+    totals = {"generated": 0, "skipped": 0, "failed": 0}
+
+    with obs.span("sched.shard", workers=workers, generator=generator_name):
+        # phase 1 — spawn every rank up front so slices run concurrently
+        # (the sched.worker chaos site fires in phase 2's supervised
+        # attempt, where retry/degrade semantics are enforced)
+        procs: Dict[int, _Worker] = {}
+        for rank in range(workers):
+            procs[rank] = _spawn_worker(generator_name, providers, ns,
+                                        rank, workers)
+
+        # phase 2 — per-rank supervised wait: transient deaths respawn
+        # (the rank journal resumes), deterministic faults degrade the
+        # slice to the in-process serial path
+        for rank in range(workers):
+
+            def attempt(rank: int = rank) -> Dict[str, int]:
+                chaos("sched.worker")
+                proc = procs.pop(rank, None)
+                if proc is None:
+                    obs.count("sched.shard.respawns")
+                    record_event("retry", domain="sched.shard",
+                                 capability="sched.worker", kind=TRANSIENT,
+                                 detail=f"rank {rank}: respawning slice")
+                    proc = _spawn_worker(generator_name, providers, ns,
+                                         rank, workers)
+                rc = proc.wait()
+                kind = taxonomy.classify_exit(rc)
+                if kind is not None:
+                    raise _FAULT_BY_KIND[kind](
+                        f"worker rank {rank} exited rc={rc}",
+                        domain="sched.shard")
+                result = _result_path(output_dir, rank)
+                with open(result) as f:
+                    return json.load(f)["counts"]
+
+            def degraded(rank: int = rank) -> Dict[str, int]:
+                # a still-running child must die before its slice is
+                # re-run in-process (the chaos fault may have fired
+                # before the wait consumed the proc)
+                live = procs.pop(rank, None)
+                if live is not None:
+                    live.kill()
+                return _run_degraded(generator_name, providers, ns,
+                                     rank, workers)
+
+            counts = supervised(attempt, domain="sched.shard",
+                                policy=WORKER_RETRY_POLICY,
+                                fallback=degraded)
+            for key in totals:
+                totals[key] += int(counts.get(key, 0))
+
+        merged: Dict[str, Dict[str, str]] = {}
+        if ns.journal:
+            with obs.span("sched.merge", workers=workers):
+                merged = merge_journals(output_dir, workers)
+        for rank in range(workers):
+            try:
+                _result_path(output_dir, rank).unlink()
+            except OSError:
+                pass
+
+    obs.instant("sched.shard_done", workers=workers,
+                generated=totals["generated"], skipped=totals["skipped"],
+                failed=totals["failed"], journaled=len(merged),
+                seconds=round(time.time() - t0, 3))
+    print(f"sharded generation: {workers} worker(s), {len(merged)} journaled "
+          f"case(s), {time.time() - t0:.2f}s wall incl. merge")
+    return totals

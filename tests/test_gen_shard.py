@@ -1,0 +1,179 @@
+"""Data-parallel suite generation drills (docs/GENPIPE.md "Sharded
+generation"): the ``--workers N`` shard/merge machinery must land a
+suite tree AND combined journal byte-identical to the ``--workers 1``
+run — clean, after a SIGKILL'd worker (respawn resumes from the
+per-rank journals), and under ``sched.worker`` chaos of both kinds
+(transient = retry/respawn; deterministic = that slice degrades to the
+in-process serial path). Plus the shard function's determinism
+contract: any worker's slice is a pure function of (suite, N, rank)."""
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from consensus_specs_tpu import resilience as r
+from consensus_specs_tpu.resilience import journal as journal_mod
+from consensus_specs_tpu.resilience.journal import CaseJournal
+from consensus_specs_tpu.sched import shard
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+DRIVER = REPO / "tests" / "_gen_journal_driver.py"
+
+
+def _run_driver(out_dir: pathlib.Path, mode, chaos: str = "",
+                chaos_state: str = "") -> subprocess.CompletedProcess:
+    env = dict(os.environ)
+    env.pop("CONSENSUS_SPECS_TPU_GEN_OVERLAP", None)
+    env.pop("CONSENSUS_SPECS_TPU_GEN_WORKERS", None)
+    if chaos:
+        env[r.ENV_KNOB] = chaos
+    else:
+        env.pop(r.ENV_KNOB, None)
+    if chaos_state:
+        env["CONSENSUS_SPECS_TPU_CHAOS_STATE"] = chaos_state
+    else:
+        env.pop("CONSENSUS_SPECS_TPU_CHAOS_STATE", None)
+    return subprocess.run(
+        [sys.executable, str(DRIVER), str(out_dir)] + list(mode),
+        env=env, cwd=str(REPO), capture_output=True, text=True, timeout=300,
+    )
+
+
+def _tree(root: pathlib.Path, with_journal: bool = True) -> dict:
+    skip = {"testgen_error_log.txt"}
+    if not with_journal:
+        skip.add(journal_mod.JOURNAL_NAME)
+    return {
+        str(p.relative_to(root)): p.read_bytes()
+        for p in sorted(root.rglob("*"))
+        if p.is_file() and p.name not in skip
+    }
+
+
+@pytest.fixture(scope="module")
+def w1_run(tmp_path_factory):
+    """The reference: ``--workers 1`` through the same shard/merge
+    machinery (the acceptance baseline the merged bytes must equal)."""
+    out = tmp_path_factory.mktemp("gen_shard_w1")
+    proc = _run_driver(out, ["--workers", "1"])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    tree = _tree(out)
+    assert len(tree) >= 9
+    assert journal_mod.JOURNAL_NAME in {p.split("/")[-1] for p in tree}
+    return out, tree
+
+
+def test_shard_rank_is_pure_and_complete():
+    """Every case index lands on exactly one rank, the assignment is a
+    pure function (two calls agree), and no rank starves on a stream
+    longer than the worker count."""
+    for workers in (1, 2, 3, 5, 8):
+        seen = {rank: 0 for rank in range(workers)}
+        for idx in range(4 * workers):
+            rank = shard.shard_rank("operations", "phase0", idx, workers)
+            assert rank == shard.shard_rank("operations", "phase0", idx, workers)
+            assert 0 <= rank < workers
+            seen[rank] += 1
+        assert all(n == 4 for n in seen.values()), seen
+    # different streams rotate their heads (the crc32 offset): not every
+    # stream's case 0 may land on rank 0
+    heads = {shard.shard_rank(runner, fork, 0, 4)
+             for runner in ("operations", "sanity", "rewards")
+             for fork in ("phase0", "altair")}
+    assert len(heads) > 1
+
+
+def test_workers_2_byte_identical_to_workers_1(w1_run, tmp_path):
+    _, want = w1_run
+    out = tmp_path / "vectors"
+    proc = _run_driver(out, ["--workers", "2"])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    # tree AND merged journal bytes match; no per-rank leftovers remain
+    assert _tree(out) == want
+    assert not list(out.glob(".gen_journal.rank*"))
+    assert not list(out.glob(".gen_rank*"))
+
+
+def test_sigkilled_worker_respawns_and_resumes(w1_run, tmp_path):
+    """SIGKILL one worker mid-suite (cross-process-counted gen.case kill
+    chaos): the parent classifies the signal death transient, respawns
+    the slice, the respawn resumes from the per-rank journal, and the
+    merged tree + combined journal STILL equal the --workers 1 bytes —
+    all within ONE run."""
+    _, want = w1_run
+    out = tmp_path / "vectors"
+    state = tmp_path / "chaos.state"
+    proc = _run_driver(out, ["--workers", "2"],
+                       chaos="gen.case=kill:1:2", chaos_state=str(state))
+    assert proc.returncode == 0, (proc.returncode, proc.stdout[-800:],
+                                  proc.stderr[-800:])
+    # the kill really fired (the shared state file counted its hit)...
+    assert json.loads(state.read_text())["gen.case"] >= 3
+    # ...and the respawned slice completed to identical bytes
+    assert _tree(out) == want
+
+
+def test_sched_worker_transient_chaos_retries(w1_run, tmp_path):
+    _, want = w1_run
+    out = tmp_path / "vectors"
+    proc = _run_driver(out, ["--workers", "2"],
+                       chaos="sched.worker=transient:1")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert _tree(out) == want
+
+
+def test_sched_worker_deterministic_chaos_degrades_in_process(w1_run, tmp_path):
+    """A deterministic sched.worker fault must NOT retry: the slice is
+    degraded to the in-process serial path (visible as the [w<R>*]
+    label) and the run still completes byte-identical."""
+    _, want = w1_run
+    out = tmp_path / "vectors"
+    proc = _run_driver(out, ["--workers", "2"],
+                       chaos="sched.worker=deterministic:1")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "*]" in proc.stdout  # the degraded in-process slice ran
+    assert _tree(out) == want
+
+
+def test_rerun_admits_from_merged_journal(w1_run, tmp_path):
+    """A second --workers run over a completed tree regenerates nothing:
+    every case is admitted from the merged journal the per-rank journals
+    folded into."""
+    _, want = w1_run
+    out = tmp_path / "vectors"
+    proc = _run_driver(out, ["--workers", "3"])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    proc = _run_driver(out, ["--workers", "3"])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "generating: " not in proc.stdout
+    assert "6 skipped" in proc.stdout
+    assert _tree(out) == want
+
+
+def test_merge_is_completion_order_independent(tmp_path):
+    """merge_journals writes sorted-case canonical bytes whatever order
+    the rank journals were produced in (and tombstones invalidations)."""
+    out = tmp_path
+    j1 = CaseJournal(out, name=journal_mod.rank_journal_name(0))
+    j2 = CaseJournal(out, name=journal_mod.rank_journal_name(1))
+    case_dir = out / "z_case"
+    case_dir.mkdir()
+    (case_dir / "pre.yaml").write_text("a: 1\n")
+    j2.record("z_case", case_dir)        # rank 1 finishes first
+    (case_dir / "pre.yaml").write_text("b: 2\n")
+    j1.record("a_case", case_dir)
+    j1.record("dead_case", case_dir)
+    j1.invalidate("dead_case")
+    merged = shard.merge_journals(out, workers=2)
+    assert sorted(merged) == ["a_case", "z_case"]
+    lines = (out / journal_mod.JOURNAL_NAME).read_text().splitlines()
+    assert [json.loads(ln)["case"] for ln in lines] == ["a_case", "z_case"]
+    # idempotent: re-merging over the merged journal changes nothing
+    before = (out / journal_mod.JOURNAL_NAME).read_bytes()
+    shard.merge_journals(out, workers=2)
+    assert (out / journal_mod.JOURNAL_NAME).read_bytes() == before

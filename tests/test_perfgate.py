@@ -86,6 +86,34 @@ def test_slowed_gen_pipeline_fails_gate(tmp_path):
     assert "gate FAILED" in proc.stdout
 
 
+def test_slowed_gen_shard_fails_gate(tmp_path):
+    """The ISSUE-9 drill: the data-parallel shard/merge path is
+    sentinel-gated — a chaos-slowed shard run (3x) against an
+    established baseline flags ``regressed`` and fails `make perfgate`.
+    The measurement itself asserts the merged journal holds every case,
+    so the gated number can never come from a shard run that dropped a
+    slice."""
+    ledger_path = str(tmp_path / "ledger.jsonl")
+    summary_path = tmp_path / "summary.json"
+    proc = _run(["--ledger", ledger_path, "--json", str(summary_path)])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    measured = json.loads(summary_path.read_text())["metrics"]
+    assert "perfgate_gen_shard_ms" in measured
+
+    led = ledger_mod.Ledger(ledger_path)
+    base = measured["perfgate_gen_shard_ms"]
+    for i in range(sentinel.DEFAULT_POLICY.min_history):
+        led.record_run({"perfgate_gen_shard_ms": base * (1 + 0.01 * i)},
+                       source="perfgate", backend="host")
+
+    proc = _run(["--ledger", ledger_path],
+                env_extra={"CONSENSUS_SPECS_TPU_PERF_CHAOS": "gen_shard=3"})
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "perfgate_gen_shard_ms" in proc.stdout
+    assert "regressed" in proc.stdout
+    assert "gate FAILED" in proc.stdout
+
+
 def test_slowed_serve_daemon_fails_gate(tmp_path):
     """The ISSUE-6 drill: the serving round-trip metric is sentinel-gated
     — a chaos-slowed daemon (3x) against an established baseline flags

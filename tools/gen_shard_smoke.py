@@ -1,0 +1,108 @@
+"""Sharded-generation smoke (docs/GENPIPE.md "Sharded generation"):
+prove, end-to-end on the real sanity/slots minimal suite, that
+
+1. a ``--workers 2`` run produces a suite tree AND combined journal
+   byte-identical to the ``--workers 1`` run (the deterministic
+   shard/merge contract — merge order independent of completion order);
+2. a ``sched.worker`` deterministic chaos fault degrades one slice to
+   the in-process serial path and STILL lands identical bytes;
+3. a rerun over the completed tree admits every case from the merged
+   journal (nothing regenerates).
+
+Wired into ``make citest`` as ``make gen-shard-smoke``. Exit 0 iff all
+three hold; any divergence prints the differing paths and exits 1.
+
+Runs each pass in a fresh subprocess (like the crash-drill tests) so
+chaos arming and fork state never leak between passes.
+"""
+from __future__ import annotations
+
+import argparse
+import hashlib
+import os
+import pathlib
+import subprocess
+import sys
+import tempfile
+from typing import Dict, List, Optional
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+DRIVER = REPO / "tests" / "_gen_journal_driver.py"
+
+ERROR_LOG = "testgen_error_log.txt"
+
+
+def _run(out_dir: pathlib.Path, mode: List[str], chaos: str = "") -> None:
+    env = dict(os.environ)
+    env.pop("CONSENSUS_SPECS_TPU_CHAOS_STATE", None)
+    if chaos:
+        env["CONSENSUS_SPECS_TPU_CHAOS"] = chaos
+    else:
+        env.pop("CONSENSUS_SPECS_TPU_CHAOS", None)
+    proc = subprocess.run(
+        [sys.executable, str(DRIVER), str(out_dir)] + mode,
+        env=env, cwd=str(REPO), capture_output=True, text=True, timeout=600)
+    if proc.returncode != 0:
+        print(proc.stdout[-2000:])
+        print(proc.stderr[-2000:])
+        raise SystemExit(f"gen-shard-smoke: driver failed rc={proc.returncode} "
+                         f"({mode}, chaos={chaos!r})")
+
+
+def _tree(root: pathlib.Path) -> Dict[str, str]:
+    return {
+        str(p.relative_to(root)): hashlib.sha256(p.read_bytes()).hexdigest()
+        for p in sorted(root.rglob("*"))
+        if p.is_file() and p.name != ERROR_LOG
+    }
+
+
+def _compare(label: str, got: Dict[str, str], want: Dict[str, str]) -> bool:
+    if got == want:
+        print(f"gen-shard-smoke: {label}: byte-identical "
+              f"({len(want)} files incl. merged journal)")
+        return True
+    diff = sorted(set(got) ^ set(want)
+                  | {p for p in got if p in want and got[p] != want[p]})
+    print(f"gen-shard-smoke: {label}: DIVERGED at {len(diff)} path(s): "
+          f"{diff[:10]}")
+    return False
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argparse.ArgumentParser(description=__doc__.splitlines()[0]).parse_args(argv)
+
+    with tempfile.TemporaryDirectory(prefix="gen_shard_smoke_") as tmp:
+        base = pathlib.Path(tmp)
+        print("gen-shard-smoke: generating the reference --workers 1 tree")
+        _run(base / "w1", ["--workers", "1"])
+        want = _tree(base / "w1")
+        if not want:
+            print("gen-shard-smoke: reference run produced no files")
+            return 1
+
+        ok = True
+        print("gen-shard-smoke: --workers 2 (clean)")
+        _run(base / "w2", ["--workers", "2"])
+        ok &= _compare("workers=2 vs workers=1", _tree(base / "w2"), want)
+
+        print("gen-shard-smoke: --workers 2 under sched.worker "
+              "deterministic chaos (slice degrades to in-process serial)")
+        _run(base / "chaos", ["--workers", "2"],
+             chaos="sched.worker=deterministic:1")
+        ok &= _compare("chaos-degraded vs workers=1",
+                       _tree(base / "chaos"), want)
+
+        print("gen-shard-smoke: rerun over the completed tree (merged-"
+              "journal resume)")
+        _run(base / "w2", ["--workers", "2"])
+        ok &= _compare("resumed vs workers=1", _tree(base / "w2"), want)
+
+    print(f"gen-shard-smoke: {'OK' if ok else 'FAILED'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,7 +21,10 @@ device-unreachable round lands as a first-class host-only datapoint
   regression; the ``serve_*`` series (bench p50/p99/verifies_per_s,
   canary probes, SLO availability/latency-budget points) render in
   their own "Serving plane" section with absolute SLO badges next to
-  the relative sentinel verdicts;
+  the relative sentinel verdicts; a ``gen_pipeline_w<N>_s`` worker
+  sweep (tools/gen_bench.py --workers) renders as a "Generation
+  scaling" curve — measured seconds vs the ideal linear line — next to
+  the gen_* series;
 - ``--prom OUT``: Prometheus text exposition of the latest datapoint
   per metric (plus run counters), for scraping into a dashboard.
 
@@ -33,6 +36,7 @@ from __future__ import annotations
 import argparse
 import html as html_mod
 import pathlib
+import re
 import sys
 import time
 from typing import Any, Dict, List, Optional
@@ -157,6 +161,42 @@ def _svg_series(points: List[Dict[str, Any]], width: int = 360,
             f'stroke-width="1.5"/>' + "".join(dots) + "</svg>")
 
 
+_GEN_WORKER_RE = re.compile(r"^gen_pipeline_w(\d+)_s$")
+
+
+def _gen_scaling_svg(by_workers: Dict[int, float], width: int = 360,
+                     height: int = 80) -> str:
+    """The worker-sweep scaling curve: measured seconds per worker count
+    (filled blue) against the ideal t1/N linear-scaling line (dashed)."""
+    counts = sorted(by_workers)
+    values = [by_workers[w] for w in counts]
+    ideal = [values[0] / w for w in counts]
+    lo, hi = 0.0, max(values + ideal) or 1.0
+    pad = 8
+    n = len(counts)
+
+    def xy(i: int, v: float) -> tuple:
+        x = pad + (width - 2 * pad) * (i / max(1, n - 1))
+        y = height - pad - (height - 2 * pad) * ((v - lo) / (hi - lo))
+        return round(x, 1), round(y, 1)
+
+    measured = " ".join(f"{x},{y}" for x, y in
+                        (xy(i, v) for i, v in enumerate(values)))
+    ideal_line = " ".join(f"{x},{y}" for x, y in
+                          (xy(i, v) for i, v in enumerate(ideal)))
+    dots = "".join(
+        f'<circle cx="{x}" cy="{y}" r="3" fill="#1d4ed8">'
+        f'<title>{w} worker(s): {v:g}s</title></circle>'
+        for (x, y), w, v in ((xy(i, v), counts[i], v)
+                             for i, v in enumerate(values)))
+    return (f'<svg width="{width}" height="{height}" '
+            f'viewBox="0 0 {width} {height}">'
+            f'<polyline points="{ideal_line}" fill="none" stroke="#94a3b8" '
+            f'stroke-width="1" stroke-dasharray="4 3"/>'
+            f'<polyline points="{measured}" fill="none" stroke="#93c5fd" '
+            f'stroke-width="1.5"/>' + dots + "</svg>")
+
+
 def html_report(led: ledger_mod.Ledger) -> str:
     runs = led.runs()
     series = _series_by_metric(led)
@@ -205,6 +245,35 @@ def html_report(led: ledger_mod.Ledger) -> str:
     serve_rows = [_metric_row(m, slo_col=True) for m in serve_metric_names]
     rows = [_metric_row(m) for m in sorted(series)
             if m not in serve_metric_names]
+
+    # the worker-sweep scaling curve (docs/GENPIPE.md "Sharded
+    # generation"): latest gen_pipeline_w<N>_s point per worker count,
+    # rendered next to the gen_* trajectories so the scaling story and
+    # the single-process pipeline story read together
+    sweep_latest: Dict[int, float] = {}
+    for m in series:
+        match = _GEN_WORKER_RE.match(m)
+        if match:
+            sweep_latest[int(match.group(1))] = float(series[m][-1]["value"])
+    gen_scaling_html = ""
+    if len(sweep_latest) >= 2:
+        counts = sorted(sweep_latest)
+        t1, tmax = sweep_latest[counts[0]], sweep_latest[counts[-1]]
+        speedup = round(t1 / tmax, 2) if tmax else None
+        sweep_cells = "".join(
+            f"<tr><td>{w}</td><td style='text-align:right'>"
+            f"{sweep_latest[w]:g}s</td><td style='text-align:right'>"
+            f"{(round(t1 / sweep_latest[w], 2) if sweep_latest[w] else '—')}×"
+            f"</td></tr>" for w in counts)
+        gen_scaling_html = f"""<h2>Generation scaling (worker sweep)</h2>
+<p class="legend">Latest <code>gen_pipeline_w&lt;N&gt;_s</code> per worker
+count; dashed line = ideal linear scaling. Max-worker speedup:
+<b>{speedup}×</b> at {counts[-1]} workers
+(<code>gen_shard_scaling</code>).</p>
+{_gen_scaling_svg(sweep_latest)}
+<table><tr><th>workers</th><th>seconds</th><th>speedup vs 1</th></tr>
+{sweep_cells}
+</table>"""
     run_rows = []
     for run in runs:
         env = run.get("environment") or {}
@@ -241,6 +310,7 @@ datapoints.</p>
 <th>points</th><th>sentinel</th><th>SLO</th></tr>
 {''.join(serve_rows)}
 </table>''' if serve_rows else '')}
+{gen_scaling_html}
 <h2>Metric trajectories</h2>
 <table><tr><th>metric</th><th>trajectory</th><th>latest</th><th>backend</th>
 <th>points</th><th>sentinel</th></tr>

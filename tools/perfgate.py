@@ -25,6 +25,13 @@ jax import, no device, no tunnel):
                               population — the suite-generation
                               throughput the sentinel watches from
                               round 6 on (docs/GENPIPE.md);
+- ``perfgate_gen_shard_ms``   the same synthetic suite pushed through
+                              the REAL data-parallel shard/merge
+                              machinery at 2 forked supervised workers
+                              (per-rank journals, deterministic merge;
+                              docs/GENPIPE.md "Sharded generation") —
+                              a slowed shard/merge path regresses this
+                              number, gated from round 9 on;
 - ``perfgate_serve_rtt_ms``   median round-trip of a mixed verify +
                               hash_tree_root workload against a real
                               in-process serve daemon under 4
@@ -196,10 +203,37 @@ def measure_gen_pipeline_ms() -> float:
     import tempfile
 
     from consensus_specs_tpu.generators.gen_runner import run_generator
-    from consensus_specs_tpu.generators.gen_typing import TestCase, TestProvider
     from consensus_specs_tpu.sched import plan_flush
 
-    n_cases = 96
+    times = []
+    for _ in range(2):
+        out = tempfile.mkdtemp(prefix="perfgate_genpipe_")
+        try:
+            provider = _synthetic_suite_provider(96)
+            t0 = time.perf_counter()
+            with contextlib.redirect_stdout(io.StringIO()):
+                run_generator("gen_pipeline", [provider], args=["-o", out])
+            times.append(time.perf_counter() - t0)
+        finally:
+            shutil.rmtree(out, ignore_errors=True)
+
+    # the planner slice: block-shaped widths (attestation aggregates,
+    # single-key ops, 512-key sync committees), 50 plans
+    widths = ([1] * 512 + [64] * 128 + [512] * 8) * 2
+    t0 = time.perf_counter()
+    for _ in range(50):
+        plan_flush(widths, min_rows=8, max_rows=128, min_keys=2)
+    plan_ms = (time.perf_counter() - t0) * 1e3 / 50
+
+    return (min(times) * 1e3 + plan_ms) * _chaos_factor("perfgate_gen_pipeline_ms")
+
+
+def _synthetic_suite_provider(n_cases: int = 96):
+    """The deterministic jax-free suite both generation slices time:
+    fixed payload bytes through the real encode/sentinel/writer/journal
+    commit machinery."""
+    from consensus_specs_tpu.generators.gen_typing import TestCase, TestProvider
+
     rng = np.random.default_rng(13)
     payloads = [rng.bytes(4096) for _ in range(n_cases)]
 
@@ -218,27 +252,46 @@ def measure_gen_pipeline_ms() -> float:
                 suite_name="pyspec_tests", case_name=f"case_{i}",
                 case_fn=case_fn)
 
+    return TestProvider(prepare=lambda: None, make_cases=make_cases)
+
+
+def measure_gen_shard_ms() -> float:
+    """Data-parallel suite generation end-to-end on host, jax-free: the
+    96-case synthetic suite through the REAL shard machinery — two
+    forked supervised workers over deterministic disjoint slices,
+    per-rank fsync'd digest journals, the deterministic sorted-case
+    merge — wall time of the whole ``--workers 2`` run. Watches the
+    scale-out overhead the sharded generator adds (fork + supervision +
+    per-rank journals + merge); a slowed shard/merge path regresses
+    this number (chaos: ``gen_shard=3``). The measurement also asserts
+    the merged journal holds every case — a shard run that silently
+    dropped a slice must fail here, not ship a fast number."""
+    import contextlib
+    import io
+    import shutil
+    import tempfile
+
+    from consensus_specs_tpu.generators.gen_runner import run_generator
+    from consensus_specs_tpu.resilience.journal import CaseJournal
+
+    n_cases = 96
     times = []
     for _ in range(2):
-        out = tempfile.mkdtemp(prefix="perfgate_genpipe_")
+        out = tempfile.mkdtemp(prefix="perfgate_genshard_")
         try:
-            provider = TestProvider(prepare=lambda: None, make_cases=make_cases)
+            provider = _synthetic_suite_provider(n_cases)
             t0 = time.perf_counter()
             with contextlib.redirect_stdout(io.StringIO()):
-                run_generator("gen_pipeline", [provider], args=["-o", out])
+                run_generator("gen_pipeline", [provider],
+                              args=["-o", out, "--workers", "2"])
             times.append(time.perf_counter() - t0)
+            merged = CaseJournal(pathlib.Path(out)).entries()
+            assert len(merged) == n_cases, (
+                f"merged journal holds {len(merged)}/{n_cases} cases")
         finally:
             shutil.rmtree(out, ignore_errors=True)
 
-    # the planner slice: block-shaped widths (attestation aggregates,
-    # single-key ops, 512-key sync committees), 50 plans
-    widths = ([1] * 512 + [64] * 128 + [512] * 8) * 2
-    t0 = time.perf_counter()
-    for _ in range(50):
-        plan_flush(widths, min_rows=8, max_rows=128, min_keys=2)
-    plan_ms = (time.perf_counter() - t0) * 1e3 / 50
-
-    return (min(times) * 1e3 + plan_ms) * _chaos_factor("perfgate_gen_pipeline_ms")
+    return min(times) * 1e3 * _chaos_factor("perfgate_gen_shard_ms")
 
 
 def measure_serve_rtt_ms() -> float:
@@ -338,6 +391,7 @@ MEASUREMENTS: Tuple[Tuple[str, Callable[[], float]], ...] = (
     ("perfgate_reroot_ms", measure_reroot_ms),
     ("perfgate_epoch_kernel_ms", measure_epoch_kernel_ms),
     ("perfgate_gen_pipeline_ms", measure_gen_pipeline_ms),
+    ("perfgate_gen_shard_ms", measure_gen_shard_ms),
     ("perfgate_serve_rtt_ms", measure_serve_rtt_ms),
     ("perfgate_chain_sim_ms", measure_chain_sim_ms),
 )

@@ -24,6 +24,11 @@ be bit-identical (the resilience layer's contract under load).
 Seed resolution: --seed wins, else CONSENSUS_SPECS_TPU_SIM_SEED, else 0
 — so CI reruns are byte-reproducible by pinning the env knob.
 
+Registry scaling (ROADMAP #5 headroom): ``--validators N`` sizes the
+simulated registry; non-default sizes bank their own ledger series
+(``chain_sim_<N>v_slots_per_s`` etc.) so mainnet-leaning datapoints
+accumulate without polluting the default-size sentinel baseline.
+
 Exit status: 0 = identical (and drill passed); 1 = divergence or drill
 failure.
 """
@@ -112,6 +117,14 @@ def main(argv: Optional[list] = None) -> int:
     }
     ok = True
     metrics: Dict[str, float] = {}
+    # registry-scaled runs bank their own series (ROADMAP #5 headroom:
+    # engine wins grow with validators, so a 512-validator point must
+    # not pollute the default-size sentinel baseline); the `_per_s`
+    # suffix stays terminal so the ledger's unit inference holds
+    vtag = "" if ns.validators == 64 else f"_{ns.validators}v"
+
+    def _metric(stem: str, suffix: str) -> str:
+        return f"chain_sim{vtag}_{stem}{suffix}"
 
     if ns.engine == "differential":
         diff = run_differential(config)
@@ -136,11 +149,11 @@ def main(argv: Optional[list] = None) -> int:
               f"{stats['slashings_included']} slashings included, "
               f"{stats['pruned_blocks']} blocks pruned at finality")
         metrics = {
-            "chain_sim_slots_per_s": round(vectorized.slots_per_s, 2),
-            "chain_sim_oracle_slots_per_s": round(oracle.slots_per_s, 2),
+            _metric("slots", "_per_s"): round(vectorized.slots_per_s, 2),
+            _metric("oracle_slots", "_per_s"): round(oracle.slots_per_s, 2),
         }
         if diff["speedup"] is not None:
-            metrics["chain_sim_speedup"] = diff["speedup"]
+            metrics[_metric("speedup", "")] = diff["speedup"]
         if ok and ns.chaos_drill:
             drill = chaos_drill(config, scenario, oracle.checkpoints)
             summary["chaos_drill"] = drill
@@ -156,7 +169,7 @@ def main(argv: Optional[list] = None) -> int:
               f"({result.slots_per_s:.1f} slots/s), "
               f"{len(result.checkpoints)} checkpoints")
         if ns.engine == "vectorized":
-            metrics["chain_sim_slots_per_s"] = round(result.slots_per_s, 2)
+            metrics[_metric("slots", "_per_s")] = round(result.slots_per_s, 2)
 
     if metrics and ns.ledger != "off":
         path = ns.ledger or ledger_mod.default_path()
@@ -164,7 +177,8 @@ def main(argv: Optional[list] = None) -> int:
             run_id = ledger_mod.Ledger(path).record_run(
                 metrics, source="chain_sim", backend="host",
                 extra={"sim": {"slots": ns.slots, "seed": seed,
-                               "fork": ns.fork, "identical": ok}})
+                               "fork": ns.fork, "identical": ok,
+                               "validators": ns.validators}})
             summary["ledger"] = {"path": path, "run_id": run_id}
             print(f"sim: banked {sorted(metrics)} -> {path} ({run_id})")
 

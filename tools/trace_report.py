@@ -1,9 +1,11 @@
 """Summarize an obs trace: top spans by self-time, jit compile-vs-
 execute split, resilience retry/quarantine tally, per-fork generator
 case latency percentiles, the sched flush's per-bucket pad/compile
-table, the serve section (per-endpoint latency percentiles, queue-wait
-vs flush split, bucket-sharing fan-in per request), and the persistent
-compile cache's hit traffic.
+table, the sharded generator's per-rank utilization (sched.worker /
+sched.merge spans: wall vs busy per rank, respawn/degrade tallies,
+merge cost), the serve section (per-endpoint latency percentiles,
+queue-wait vs flush split, bucket-sharing fan-in per request), and the
+persistent compile cache's hit traffic.
 
 Usage:
     python tools/trace_report.py <trace-dir | trace.json> [--json <path>]
@@ -220,6 +222,53 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     if sim_degraded:
         sim["degraded_steps_by_site"] = dict(sorted(sim_degraded.items()))
 
+    # --- gen shard section: the sharded generator's per-rank story
+    # (docs/GENPIPE.md "Sharded generation") — one row per rank with its
+    # worker wall time, case count/busy time (gen.case spans matched by
+    # the worker's pid), and utilization relative to the slowest rank;
+    # plus the merge cost and respawn/degrade tallies
+    worker_spans = [s for s in spans if s.get("name") == "sched.worker"]
+    merge_durs = [float(s.get("dur") or 0) / 1e3 for s in spans
+                  if s.get("name") == "sched.merge"]
+    gen_shard: Dict[str, Any] = {}
+    if worker_spans:
+        case_by_pid: Dict[Any, List[float]] = {}
+        for s in spans:
+            if s.get("name") == "gen.case":
+                case_by_pid.setdefault(s.get("pid"), []).append(
+                    float(s.get("dur") or 0) / 1e3)
+        ranks: Dict[int, Dict[str, Any]] = {}
+        for s in worker_spans:
+            a = s.get("attrs") or {}
+            rank = int(a.get("rank") or 0)
+            acc3 = ranks.setdefault(rank, {
+                "rank": rank, "attempts": 0, "degraded": 0,
+                "wall_ms": 0.0, "cases": 0, "busy_ms": 0.0})
+            acc3["attempts"] += 1
+            acc3["degraded"] += 1 if a.get("degraded") else 0
+            acc3["wall_ms"] += float(s.get("dur") or 0) / 1e3
+            cases = case_by_pid.get(s.get("pid"), [])
+            acc3["cases"] += len(cases)
+            acc3["busy_ms"] += sum(cases)
+        max_wall = max((r["wall_ms"] for r in ranks.values()), default=0.0)
+        rank_rows = []
+        for rank in sorted(ranks):
+            r = ranks[rank]
+            rank_rows.append({
+                "rank": r["rank"], "attempts": r["attempts"],
+                "degraded": r["degraded"],
+                "wall_ms": round(r["wall_ms"], 3),
+                "cases": r["cases"], "busy_ms": round(r["busy_ms"], 3),
+                "utilization_pct": (round(100.0 * r["wall_ms"] / max_wall, 1)
+                                    if max_wall else None),
+            })
+        gen_shard = {
+            "workers": len(ranks),
+            "ranks": rank_rows,
+            "merge_ms": round(sum(merge_durs), 3) if merge_durs else None,
+            "respawns": sum(max(0, r["attempts"] - 1) for r in rank_rows),
+        }
+
     # --- persistent compile cache traffic (sched.compile_cache instants:
     # every request that found a cached executable skipped its compile)
     cache_requests = sum(1 for i in instants
@@ -245,6 +294,7 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "chaos_hits": chaos_hits,
         "gen_case_latency_by_fork": gen_pcts,
         "sched_flush_buckets": sched_buckets,
+        "gen_shard": gen_shard,
         "serve": serve,
         "sim": sim,
         "compile_cache": {
@@ -295,6 +345,21 @@ def print_summary(summary: Dict[str, Any]) -> None:
                   f"{b['dispatches']} dispatch(es)  {b['rows']} rows "
                   f"(+{b['pad_rows']} pad, {b['slot_waste_pct']}% slot waste)"
                   f"{split}")
+    shard = summary.get("gen_shard") or {}
+    if shard:
+        print(f"\ngen shard ({shard['workers']} worker(s), "
+              f"{shard['respawns']} respawn(s)"
+              + (f", merge {shard['merge_ms']}ms" if shard.get("merge_ms")
+                 is not None else "") + "):")
+        for r in shard["ranks"]:
+            flags = ""
+            if r["attempts"] > 1:
+                flags += f"  attempts={r['attempts']}"
+            if r["degraded"]:
+                flags += "  DEGRADED->in-process"
+            print(f"  rank {r['rank']}: {r['cases']} case(s)  "
+                  f"busy {r['busy_ms']:.1f}ms  wall {r['wall_ms']:.1f}ms  "
+                  f"util {r['utilization_pct']}%{flags}")
     serve = summary.get("serve") or {}
     if serve.get("requests_by_method"):
         print("\nserve requests (per endpoint):")

@@ -25,7 +25,7 @@ DEVICE_TESTS = tests/test_bls_device.py tests/test_curve_device.py \
 .PHONY: test citest test-fast test-device test-mainnet lint docs generate_tests gen_% replay bench \
         dryrun detect_generator_incomplete clean-vectors chaos trace perfgate perf-report gen-bench \
         gen-shard-smoke warm-cache serve serve-smoke serve-bench serve-canary slo-report sim \
-        sim-smoke device-probe help
+        sim-smoke device-probe overload-drill overload-smoke help
 
 # the fault-injection suite: supervisor/taxonomy units, chaos replay
 # (tampered vectors), induced backend failures, generator crash/resume
@@ -55,6 +55,8 @@ help:
 	@echo "serve-smoke           boot the daemon, drive 4 concurrent clients, scrape /metrics, assert clean SIGTERM drain"
 	@echo "serve-bench           concurrent-client serving bench: p50/p99 latency + verifies/s -> $(LEDGER)"
 	@echo "serve-canary          black-box daemon prober (incl. invalid-signature correctness probe): availability/latency -> $(LEDGER)"
+	@echo "overload-drill        open-loop overload drill at ~3x measured capacity: goodput/shed-ratio/recovery + differential corpus -> $(LEDGER)"
+	@echo "overload-smoke        scaled-down deterministic overload drill (in-process, jax-free; the citest slice)"
 	@echo "slo-report            serve SLO report: objectives, latest observations, 1h/6h/24h burn rates over $(LEDGER)"
 	@echo "sim                   2048-slot seeded chain simulation (forks/reorgs/equivocations), vectorized-vs-oracle differential + chaos drill -> $(LEDGER)"
 	@echo "sim-smoke             short chain-sim differential + chaos drill (the citest slice; docs/SIM.md)"
@@ -81,6 +83,7 @@ citest:
 	$(MAKE) sim-smoke
 	$(MAKE) serve-smoke
 	$(MAKE) serve-canary
+	$(MAKE) overload-smoke
 	$(MAKE) perfgate
 	$(MAKE) slo-report
 
@@ -139,6 +142,18 @@ serve-canary:
 
 slo-report:
 	$(PYTHON) tools/slo_report.py --ledger $(LEDGER)
+
+# the metastable-failure drill (docs/SERVE.md "Overload control"):
+# measure saturation goodput closed-loop, offer ~3x that open-loop with
+# deadlines + a priority mix, assert goodput holds within 20% (shed the
+# excess, serve the rest), recovery, and served-vs-direct bit-identity
+# clean AND overloaded; goodput/shed-ratio bank in the ledger. The
+# smoke is the scaled-down jax-free in-process twin wired into citest.
+overload-drill:
+	$(PYTHON) tools/overload_drill.py --ledger $(LEDGER)
+
+overload-smoke:
+	$(PYTHON) tools/overload_drill.py --smoke
 
 # the chain simulator (docs/SIM.md, ROADMAP #5): a seeded long-horizon
 # "mainnet day" through fork choice + full state transitions, the

@@ -114,9 +114,16 @@ class FlightRecorder:
         return entries[: n if n is not None else self.capacity]
 
     def slowest(self, n: int = 10) -> List[Dict[str, Any]]:
-        """The slowest recorded requests by total ms, slowest first."""
+        """The slowest recorded requests by total ms, slowest first.
+
+        Shed requests (``shed_deadline`` / ``shed_priority`` — an
+        expired-deadline entry may have sat in the queue for its whole
+        budget by design) are excluded: the ranking answers "which
+        *served* requests were slow", not "which were load-managed".
+        They remain visible in :meth:`requests` and the dump."""
         with self._lock:
-            entries = list(self._ring)
+            entries = [e for e in self._ring
+                       if not str(e.get("status", "")).startswith("shed")]
         entries.sort(key=lambda e: e.get("total_ms") or 0.0, reverse=True)
         return entries[:max(0, n)]
 

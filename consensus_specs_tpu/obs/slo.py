@@ -13,9 +13,12 @@ Objectives (env-overridable, docs/OBSERVABILITY.md):
 - ``serve_availability`` — fraction of served wire requests answered
   without a 5xx. Denominator = ``serve.responses`` +
   ``serve.errors.internal``: client-side 400/404/429 rejections are
-  *correct* behavior and never burn the budget, and introspection GETs
-  (``/metrics`` etc.) never reach the counters at all
-  (``serve/protocol.is_introspection``).
+  *correct* behavior and never burn the budget, overload sheds
+  (``deadline_exceeded`` 504 / ``shed`` 429 — load management, not
+  faults; tracked via ``serve.shed.*`` and the flight recorder, see
+  docs/RESILIENCE.md "Sheds vs faults") never enter it either, and
+  introspection GETs (``/metrics`` etc.) never reach the counters at
+  all (``serve/protocol.is_introspection``).
 - ``serve_latency_p99`` — p99 of the always-on ``serve.request_ms``
   histogram (host objective; the histogram exists without tracing
   armed, so the SLO needs no env knob).

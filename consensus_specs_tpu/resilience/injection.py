@@ -32,6 +32,12 @@ Sites are plain strings; the convention is plane.point:
   serve.flush (per cross-client micro-batch dispatched by the daemon's
                flusher thread; a fault here degrades that batch to the
                host oracle — docs/SERVE.md)
+  serve.admission (every adaptive-admission controller tick, INSIDE the
+               supervised control loop: transient=retried tick;
+               deterministic=quarantine + admission degrades to the
+               fixed bound; hang=the accept path's staleness watchdog
+               trips the same quarantine WITHOUT ever blocking a
+               request — docs/SERVE.md "Overload control")
   sim.step (top of every chain-simulator slot step, BEFORE any state
             mutation: transients retry the clean step, deterministic
             faults quarantine the site and every later step degrades to

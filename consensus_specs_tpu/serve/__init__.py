@@ -23,8 +23,18 @@ shape inference stacks use to amortize compilation and dispatch.
   request; ``python -m consensus_specs_tpu.serve`` CLI.
 - :mod:`lifecycle` — warm start (compile cache + spec matrix + opt-in
   jit probes), shared with ``make warm-cache``.
+- :mod:`admission` — overload control (ISSUE 10): the AIMD adaptive
+  queue limit driven by observed queue-wait p99 vs a latency target,
+  the live wait estimator behind deadline admission, brownout, and the
+  supervised controller loop (chaos site ``serve.admission``).
 - :mod:`client` — stdlib client used by tests and the bench/smoke
-  tools (``tools/serve_bench.py``, ``tools/serve_smoke.py``).
+  tools (``tools/serve_bench.py``, ``tools/serve_smoke.py``); carries
+  the client-side overload discipline (token-bucket retry budget,
+  jittered backoff, deadline propagation).
+- :mod:`drill` — open-loop / closed-loop load drivers + the overload
+  drill harness shared by ``tools/overload_drill.py``,
+  ``tools/serve_bench.py --open-loop`` and perfgate's
+  ``perfgate_overload_goodput_ratio`` slice.
 
 Request observability (ISSUE 7): every wire body MAY carry an optional
 W3C-shaped ``trace`` field — ``ServeClient`` injects it from the active
@@ -37,15 +47,26 @@ client → daemon request → synthesized queue-wait → the shared flush
 ``make perfgate`` and probed by ``tools/serve_canary.py``.
 
 Perf evidence: ``make serve-bench`` banks ``serve_p50_ms`` /
-``serve_p99_ms`` / ``serve_verifies_per_s`` in the ledger;
-``make perfgate`` gates ``perfgate_serve_rtt_ms`` on the sentinel and
-the serve SLOs (``serve_slo_availability`` / ``serve_slo_p99_budget``)
-on their absolute objectives.
+``serve_p99_ms`` / ``serve_verifies_per_s`` in the ledger (and, with
+``--open-loop RATE``, the ``serve_ol_*`` open-loop series);
+``make overload-drill`` banks ``serve_goodput_per_s`` /
+``serve_shed_ratio`` under 3x open-loop overload; ``make perfgate``
+gates ``perfgate_serve_rtt_ms`` on the sentinel, the serve SLOs
+(``serve_slo_availability`` / ``serve_slo_p99_budget``) on their
+absolute objectives, and ``perfgate_overload_goodput_ratio`` on the
+absolute no-collapse floor.
 """
 from __future__ import annotations
 
-from .batcher import Draining, QueueFull, VerifyBatcher  # noqa: F401
-from .client import ServeClient, ServeError  # noqa: F401
+from .admission import AdmissionController, AimdLimit, WaitEstimator  # noqa: F401
+from .batcher import (  # noqa: F401
+    DeadlineExceeded,
+    Draining,
+    QueueFull,
+    Shed,
+    VerifyBatcher,
+)
+from .client import RetryBudget, ServeClient, ServeError  # noqa: F401
 from .daemon import ServeDaemon  # noqa: F401
 from .lifecycle import warm_start  # noqa: F401
 from .protocol import WIRE_VERSION, RequestError  # noqa: F401

@@ -1,0 +1,347 @@
+"""Adaptive admission control for the serving plane (docs/SERVE.md
+"Overload control").
+
+The PR-6 batcher admitted work against one fixed bound (1024 queue
+slots, all-or-nothing 429s). That shape collapses under sustained
+overload: the queue fills with requests whose callers have already
+given up, every flush burns real pairing work on them, and goodput
+(answers that still matter) falls toward zero while the daemon looks
+"busy" — the metastable-failure mode. This module replaces the fixed
+bound with three cooperating pieces:
+
+- :class:`WaitEstimator` — a live model of how long a newly admitted
+  row will wait: recent ``serve.queue_wait_ms`` samples (the same
+  values the always-on histogram receives) plus an EWMA of the flush
+  pipeline's observed drain rate, so the estimate is
+  ``depth / drain_rate`` with the recent-wait percentile as a floor.
+  Admission uses it to reject a request whose estimated wait already
+  exceeds its remaining ``deadline_ms`` budget — the cheapest possible
+  shed, before the queue ever holds the row.
+
+- :class:`AimdLimit` — the adaptive queue bound: additive increase
+  while the observed queue-wait p99 sits under the latency target,
+  multiplicative decrease when it overshoots (the TCP-congestion /
+  gradient concurrency-limit shape). The limit floats in
+  ``[min_limit, hard_limit]``; the old fixed bound is the hard
+  ceiling and the fallback.
+
+- :class:`AdmissionController` — a resident controller thread that
+  re-evaluates the limit every ``tick_s`` under
+  ``resilience.supervised`` (chaos site ``serve.admission``). The
+  accept path never computes anything: it reads the last *published*
+  limit, so a hung controller cannot wedge admission — staleness past
+  ``stale_s`` trips the supervisor instead (quarantine
+  ``serve.admission``, recorded event, degrade to the fixed bound).
+  Sustained pressure (p99 over target for ``brownout_ticks``
+  consecutive ticks) enters **brownout**: the batcher's linger window
+  collapses to zero so batches stop waiting for company they no longer
+  need, restoring latency headroom; calm ticks exit it.
+
+Everything here is pure stdlib and jax-free; the knobs are
+env-overridable (docs/SERVE.md "Knobs").
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Deque, Dict, Optional
+
+from .. import obs
+from ..resilience import chaos, quarantine, supervised
+
+ENV_MODE = "CONSENSUS_SPECS_TPU_SERVE_ADMISSION"          # adaptive|fixed
+ENV_TARGET_P99 = "CONSENSUS_SPECS_TPU_SERVE_TARGET_P99_MS"
+ENV_MIN_LIMIT = "CONSENSUS_SPECS_TPU_SERVE_MIN_LIMIT"
+ENV_TICK_S = "CONSENSUS_SPECS_TPU_SERVE_ADMISSION_TICK_S"
+ENV_STALE_S = "CONSENSUS_SPECS_TPU_SERVE_ADMISSION_STALE_S"
+ENV_BROWNOUT_TICKS = "CONSENSUS_SPECS_TPU_SERVE_BROWNOUT_TICKS"
+
+MODE_ADAPTIVE = "adaptive"
+MODE_FIXED = "fixed"
+
+DEFAULT_TARGET_P99_MS = 50.0
+DEFAULT_MIN_LIMIT = 16
+DEFAULT_TICK_S = 0.05
+DEFAULT_STALE_S = 2.0
+DEFAULT_BROWNOUT_TICKS = 3
+
+# AIMD shape: gentle additive probe upward, decisive multiplicative
+# back-off — the asymmetry is what keeps the loop stable
+INCREASE_STEP = 8
+DECREASE_FACTOR = 0.65
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_str(name: str, default: str) -> str:
+    return (os.environ.get(name, "") or default).strip().lower()
+
+
+class WaitEstimator:
+    """Live queue-wait model: recent waits + EWMA drain rate.
+
+    Fed by the flusher (one ``observe_wait`` per flushed row, one
+    ``note_flush`` per dispatch); read by admission. Thread-safe; with
+    no evidence yet it estimates 0 (optimistic — admission never
+    rejects on a cold start)."""
+
+    def __init__(self, window: int = 512, alpha: float = 0.3) -> None:
+        self._waits: Deque[float] = deque(maxlen=max(8, int(window)))
+        self._alpha = alpha
+        self._rate_rows_s: Optional[float] = None  # EWMA service rate
+        self._service_ms: Optional[float] = None   # EWMA per-flush time
+        self._lock = threading.Lock()
+
+    def observe_wait(self, wait_ms: float) -> None:
+        with self._lock:
+            self._waits.append(float(wait_ms))
+
+    def note_flush(self, rows: int, service_s: float) -> None:
+        """One dispatch: ``rows`` answered in ``service_s`` of flusher
+        time. Under overload the flusher is always busy, so the service
+        rate IS the drain rate — exactly the regime where the estimate
+        matters."""
+        if rows <= 0 or service_s <= 0:
+            return
+        sample = rows / service_s
+        with self._lock:
+            if self._rate_rows_s is None:
+                self._rate_rows_s = sample
+                self._service_ms = service_s * 1e3
+            else:
+                self._rate_rows_s += self._alpha * (sample - self._rate_rows_s)
+                self._service_ms += self._alpha * (  # type: ignore[operator]
+                    service_s * 1e3 - self._service_ms)
+
+    def wait_percentile(self, q: float) -> Optional[float]:
+        from ..obs.metrics import percentile
+
+        with self._lock:
+            samples = list(self._waits)
+        return percentile(samples, q)
+
+    def drain_rate(self) -> Optional[float]:
+        with self._lock:
+            return self._rate_rows_s
+
+    def service_estimate_ms(self) -> float:
+        """EWMA of one flush's service time — the part of a request's
+        latency its ``deadline_ms`` budget must cover AFTER the queue
+        wait. 0 until evidence exists (optimistic cold start)."""
+        with self._lock:
+            return self._service_ms or 0.0
+
+    def estimate_ms(self, depth: int) -> float:
+        """Estimated queue wait for a row admitted behind ``depth``
+        already-queued rows: the forward-looking ``depth / drain_rate``
+        with the recent p90 wait as a floor (a burst grows depth before
+        new wait samples land; a draining lull does the opposite)."""
+        rate = self.drain_rate()
+        forward = (depth / rate) * 1e3 if (rate and depth > 0) else None
+        recent = self.wait_percentile(90) if depth > 0 else None
+        candidates = [v for v in (forward, recent) if v is not None]
+        return max(candidates) if candidates else 0.0
+
+    def completion_estimate_ms(self, depth: int) -> float:
+        """What a budget must actually cover: the queue wait, the row's
+        OWN flush, and up to one more service period for the flush that
+        may already be in flight when the row lands (the drain-rate
+        model cannot see intra-flush phase, and quantized 1-2s flushes
+        make that error material). A request admitted with
+        ``deadline_ms`` under this number would clear the queue only to
+        finish late — burning a flush on an answer nobody is waiting
+        for — so admission sheds it up front."""
+        return self.estimate_ms(depth) + 2.0 * self.service_estimate_ms()
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            n = len(self._waits)
+        return {
+            "wait_samples": n,
+            "wait_p50_ms": self.wait_percentile(50),
+            "wait_p90_ms": self.wait_percentile(90),
+            "wait_p99_ms": self.wait_percentile(99),
+            "drain_rate_rows_s": self.drain_rate(),
+            "service_ms": self.service_estimate_ms(),
+        }
+
+
+class AimdLimit:
+    """The adaptive queue bound: +``INCREASE_STEP`` per calm tick,
+    ×``DECREASE_FACTOR`` per overshooting tick, clamped to
+    ``[min_limit, hard_limit]``. Starts at the hard limit (optimistic:
+    only observed pressure shrinks it)."""
+
+    def __init__(self, hard_limit: int, min_limit: int,
+                 target_p99_ms: float) -> None:
+        self.hard_limit = max(1, int(hard_limit))
+        self.min_limit = max(1, min(int(min_limit), self.hard_limit))
+        self.target_p99_ms = float(target_p99_ms)
+        self._limit = float(self.hard_limit)
+
+    @property
+    def limit(self) -> int:
+        return int(self._limit)
+
+    def update(self, wait_p99_ms: Optional[float]) -> int:
+        """One control step against the observed queue-wait p99. No
+        evidence (None) reads as no pressure."""
+        if wait_p99_ms is not None and wait_p99_ms > self.target_p99_ms:
+            self._limit = max(float(self.min_limit),
+                              self._limit * DECREASE_FACTOR)
+        else:
+            self._limit = min(float(self.hard_limit),
+                              self._limit + INCREASE_STEP)
+        return self.limit
+
+
+class AdmissionController:
+    """The resident control loop + the published admission state.
+
+    The accept path calls :meth:`limit` / :meth:`brownout` only — both
+    are lock-free reads of published values plus one staleness check,
+    so nothing on the accept path can hang even when the controller
+    thread does (chaos kind ``hang`` at site ``serve.admission``): the
+    staleness watchdog quarantines the capability and degrades to the
+    fixed bound instead."""
+
+    CAPABILITY = "serve.admission"
+
+    def __init__(
+        self,
+        hard_limit: int,
+        *,
+        mode: Optional[str] = None,
+        min_limit: Optional[int] = None,
+        target_p99_ms: Optional[float] = None,
+        tick_s: Optional[float] = None,
+        stale_s: Optional[float] = None,
+        brownout_ticks: Optional[int] = None,
+    ) -> None:
+        self.mode = (mode or _env_str(ENV_MODE, MODE_ADAPTIVE))
+        if self.mode not in (MODE_ADAPTIVE, MODE_FIXED):
+            raise ValueError(f"unknown admission mode {self.mode!r} "
+                             f"(have {MODE_ADAPTIVE!r}/{MODE_FIXED!r})")
+        self.hard_limit = max(1, int(hard_limit))
+        self.target_p99_ms = (target_p99_ms if target_p99_ms is not None
+                              else _env_float(ENV_TARGET_P99,
+                                              DEFAULT_TARGET_P99_MS))
+        self.tick_s = max(0.005, tick_s if tick_s is not None
+                          else _env_float(ENV_TICK_S, DEFAULT_TICK_S))
+        self.stale_s = max(0.05, stale_s if stale_s is not None
+                           else _env_float(ENV_STALE_S, DEFAULT_STALE_S))
+        self.brownout_ticks = max(1, int(
+            brownout_ticks if brownout_ticks is not None
+            else _env_float(ENV_BROWNOUT_TICKS, DEFAULT_BROWNOUT_TICKS)))
+        self.estimator = WaitEstimator()
+        self._aimd = AimdLimit(
+            self.hard_limit,
+            int(min_limit if min_limit is not None
+                else _env_float(ENV_MIN_LIMIT, DEFAULT_MIN_LIMIT)),
+            self.target_p99_ms)
+        self._published_limit = self.hard_limit
+        self._brownout = False
+        self._over_ticks = 0
+        self._calm_ticks = 0
+        self._ticks = 0
+        self._degraded: Optional[str] = None
+        self._last_tick = time.monotonic()
+        self._closing = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "AdmissionController":
+        if self.mode == MODE_ADAPTIVE and self._thread is None:
+            self._last_tick = time.monotonic()
+            self._thread = threading.Thread(
+                target=self._run, name="serve-admission", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self, timeout_s: float = 2.0) -> None:
+        self._closing.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout_s)  # a hung tick is abandoned (daemon thread)
+
+    # -- the accept-path reads (never compute, never block) ------------
+
+    @property
+    def adaptive(self) -> bool:
+        return (self.mode == MODE_ADAPTIVE and self._degraded is None
+                and self._thread is not None)
+
+    def limit(self) -> int:
+        """The queue bound admission enforces right now. Fixed mode, a
+        degraded controller, or a controller that has not started all
+        publish the hard (fixed) bound."""
+        if not self.adaptive:
+            return self.hard_limit
+        if time.monotonic() - self._last_tick > self.stale_s:
+            self._degrade(f"controller stale: no tick for >{self.stale_s}s "
+                          "(hung admission check)")
+            return self.hard_limit
+        return self._published_limit
+
+    def brownout(self) -> bool:
+        return self._brownout if self.adaptive else False
+
+    # -- the control loop ----------------------------------------------
+
+    def _run(self) -> None:
+        while not self._closing.wait(self.tick_s):
+            try:
+                supervised(self._tick, domain="serve.admission",
+                           capability=self.CAPABILITY)
+            except BaseException as e:
+                # deterministic/exhausted fault: supervised() already
+                # quarantined the capability; publish the degradation
+                # and leave the fixed bound in charge
+                self._degrade(f"{type(e).__name__}: {e}", quarantined=True)
+                return
+
+    def _tick(self) -> None:
+        chaos("serve.admission")
+        p99 = self.estimator.wait_percentile(99)
+        self._published_limit = self._aimd.update(p99)
+        over = p99 is not None and p99 > self.target_p99_ms
+        self._over_ticks = self._over_ticks + 1 if over else 0
+        self._calm_ticks = 0 if over else self._calm_ticks + 1
+        if not self._brownout and self._over_ticks >= self.brownout_ticks:
+            self._brownout = True
+            obs.count("serve.brownout.entered")
+        elif self._brownout and self._calm_ticks >= self.brownout_ticks:
+            self._brownout = False
+        self._ticks += 1
+        self._last_tick = time.monotonic()
+
+    def _degrade(self, reason: str, quarantined: bool = False) -> None:
+        if self._degraded is not None:
+            return
+        self._degraded = reason
+        if not quarantined:
+            quarantine(self.CAPABILITY, reason, domain="serve.admission")
+        obs.count("serve.admission.degraded")
+
+    # -- introspection (/debug/overload, /healthz) ---------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "mode": self.mode,
+            "adaptive": self.adaptive,
+            "limit": self.limit(),
+            "hard_limit": self.hard_limit,
+            "target_p99_ms": self.target_p99_ms,
+            "brownout": self.brownout(),
+            "ticks": self._ticks,
+            "degraded": self._degraded,
+            "estimator": self.estimator.snapshot(),
+        }

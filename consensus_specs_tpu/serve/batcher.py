@@ -11,10 +11,28 @@ sync aggregates compiles O(#buckets) programs and pads nothing to the
 widest row (docs/GENPIPE.md). Per-request futures resolve when their
 flush lands.
 
-Admission control: the queue is bounded (``max_queue``); a submit
-against a full queue raises :class:`QueueFull` immediately (the daemon
-maps it to a 429) instead of queueing unbounded work — counted under
-``serve.rejected`` so backpressure is visible in /metrics.
+Admission control (docs/SERVE.md "Overload control"): the queue is
+hard-bounded (``max_queue``) and, by default, *adaptively* bounded
+below that by an :class:`~.admission.AdmissionController` — an AIMD
+limit driven by the observed queue-wait p99 against a latency target,
+so queue depth tracks what the flush pipeline can actually absorb. A
+submit against the hard bound raises :class:`QueueFull` (429,
+``serve.rejected``); over the adaptive limit the queue sheds by
+criticality class: an incoming ``sheddable`` request is refused with
+:class:`Shed`, queued ``sheddable`` entries are evicted (answered with
+:class:`Shed`) to make room for ``default`` traffic, and ``critical``
+bypasses the adaptive limit entirely (never the hard bound). A request
+carrying a ``deadline_ms`` budget is rejected with
+:class:`DeadlineExceeded` at admission when the estimated completion
+time (queue wait from live ``serve.queue_wait_ms`` evidence + drain
+rate, plus the EWMA flush service time) already exceeds it, and entries
+whose deadline expires while queued are shed — answered
+``deadline_exceeded``, never dropped — *before* any flush work is spent
+on them. Under sustained pressure the controller enters brownout and
+the linger window collapses to zero. All sheds are counted per class
+(``serve.shed.*``) and land in the flight recorder; after a drain,
+``accepted == flushed_rows + shed_rows`` — exactly-once, with sheds
+accounted separately.
 
 Result cache: a verify check is a pure function of its key (the same
 rationale that lets the flush dedup rows), so resolved answers populate
@@ -37,6 +55,7 @@ dropped or dispatched twice (each entry is popped exactly once).
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import OrderedDict, deque
@@ -45,11 +64,18 @@ from typing import Deque, Dict, List, Optional, Tuple
 from .. import obs
 from ..obs import flightrec
 from ..resilience import chaos, record_event, supervised
+from . import protocol
+from .admission import AdmissionController
 
 DEFAULT_MAX_QUEUE = 1024
 DEFAULT_MAX_BATCH = 256
 DEFAULT_LINGER_MS = 5.0
 DEFAULT_CACHE_SIZE = 4096
+
+# drill knob (docs/SERVE.md "Overload control"): a deterministic
+# simulated service time per flush, so overload drills / the perfgate
+# slice can create real queueing pressure jax-free and crypto-free
+ENV_FLUSH_DELAY = "CONSENSUS_SPECS_TPU_SERVE_FLUSH_DELAY_MS"
 
 
 class QueueFull(Exception):
@@ -58,6 +84,18 @@ class QueueFull(Exception):
 
 class Draining(Exception):
     """Intake is closed: the daemon is shutting down."""
+
+
+class DeadlineExceeded(Exception):
+    """Overload control: the request's ``deadline_ms`` budget expired
+    while queued, or the estimated queue wait already exceeds it at
+    admission — answered structured (wire code ``deadline_exceeded``),
+    never silently dropped."""
+
+
+class Shed(Exception):
+    """Overload control: a ``sheddable``-priority request was refused
+    (or evicted from the queue) to protect higher-priority work."""
 
 
 class _Pending:
@@ -71,17 +109,27 @@ class _Pending:
     thread for the flight recorder."""
 
     __slots__ = ("key", "done", "result", "error", "t_submit",
-                 "origin", "stats")
+                 "origin", "stats", "priority", "deadline_at")
 
     def __init__(self, key: Tuple,
-                 origin: Optional[Tuple[Optional[str], str, int]] = None) -> None:
+                 origin: Optional[Tuple[Optional[str], str, int]] = None,
+                 priority: str = protocol.PRIORITY_DEFAULT,
+                 deadline_ms: Optional[float] = None) -> None:
         self.key = key
         self.done = threading.Event()
         self.result: Optional[bool] = None
         self.error: Optional[BaseException] = None
         self.t_submit = time.monotonic()
         self.origin = origin
+        self.priority = priority
+        # absolute monotonic deadline: admission timestamps arrival, the
+        # wire budget is relative (client and daemon clocks may disagree)
+        self.deadline_at = (self.t_submit + deadline_ms / 1e3
+                            if deadline_ms is not None else None)
         self.stats: Optional[Dict[str, object]] = None
+
+    def expired(self, now: float) -> bool:
+        return self.deadline_at is not None and now >= self.deadline_at
 
     def resolve(self, result: bool) -> None:
         self.result = result
@@ -102,11 +150,20 @@ class VerifyBatcher:
         max_batch: int = DEFAULT_MAX_BATCH,
         linger_ms: float = DEFAULT_LINGER_MS,
         cache_size: int = DEFAULT_CACHE_SIZE,
+        admission: Optional[AdmissionController] = None,
+        flush_delay_ms: Optional[float] = None,
     ) -> None:
         self.max_queue = max(1, int(max_queue))
         self.max_batch = max(1, int(max_batch))
         self.linger_s = max(0.0, float(linger_ms)) / 1e3
         self.cache_size = max(0, int(cache_size))
+        self.admission = admission or AdmissionController(self.max_queue)
+        if flush_delay_ms is None:
+            try:
+                flush_delay_ms = float(os.environ.get(ENV_FLUSH_DELAY, "") or 0)
+            except ValueError:
+                flush_delay_ms = 0.0
+        self.flush_delay_s = max(0.0, flush_delay_ms) / 1e3
         self._q: Deque[_Pending] = deque()
         self._cond = threading.Condition()
         self._cache: "OrderedDict[Tuple, bool]" = OrderedDict()
@@ -118,11 +175,17 @@ class VerifyBatcher:
         self.cache_hits = 0
         self.flushes = 0
         self.flushed_rows = 0
+        # sheds are accepted-then-answered-structured, never dropped:
+        # after a drain, accepted == flushed_rows + shed_rows
+        self.shed_rows = 0
+        self.shed_by_class: Dict[str, int] = {"deadline": 0, "priority": 0,
+                                              "admission_deadline": 0}
 
     # -- lifecycle -----------------------------------------------------
 
     def start(self) -> "VerifyBatcher":
         if self._thread is None:
+            self.admission.start()
             self._thread = threading.Thread(
                 target=self._run, name="serve-flusher", daemon=True)
             self._thread.start()
@@ -137,6 +200,7 @@ class VerifyBatcher:
         t = self._thread
         if t is not None:
             t.join(timeout_s)
+        self.admission.stop()
         with self._cond:
             return not self._q and (t is None or not t.is_alive())
 
@@ -153,13 +217,32 @@ class VerifyBatcher:
             return {"size": len(self._cache), "hits": self.cache_hits,
                     "capacity": self.cache_size}
 
+    def overload_snapshot(self) -> Dict[str, object]:
+        """The /debug/overload surface: admission state + shed tallies."""
+        with self.stats_lock:
+            sheds = dict(self.shed_by_class)
+            shed_rows = self.shed_rows
+        snap = self.admission.snapshot()
+        snap.update({
+            "depth": self.depth(),
+            "linger_ms_effective": round(self._effective_linger_s() * 1e3, 3),
+            "linger_ms_configured": round(self.linger_s * 1e3, 3),
+            "shed": sheds,
+            "shed_rows": shed_rows,
+            "flush_delay_ms": round(self.flush_delay_s * 1e3, 3),
+        })
+        return snap
+
     # -- intake --------------------------------------------------------
 
-    def submit(self, key: Tuple, timeout_s: Optional[float] = None) -> bool:
+    def submit(self, key: Tuple, timeout_s: Optional[float] = None,
+               priority: str = protocol.PRIORITY_DEFAULT,
+               deadline_ms: Optional[float] = None) -> bool:
         """Submit one check key (the DeferredVerifier key shape) and
         block until its flush resolves. Raises :class:`QueueFull` /
-        :class:`Draining` at admission time, TimeoutError if the result
-        does not land within ``timeout_s``."""
+        :class:`Shed` / :class:`DeadlineExceeded` / :class:`Draining`
+        at admission time, TimeoutError if the result does not land
+        within ``timeout_s``."""
         if self.cache_size:
             with self.stats_lock:
                 cached = self._cache.get(key)
@@ -170,16 +253,19 @@ class VerifyBatcher:
                 obs.count("serve.cache_hits")
                 flightrec.note(cache_hit=True)
                 return cached
-        pending = self._enqueue([key])[0]
+        pending = self._enqueue([key], priority, deadline_ms)[0]
         result = self._await(pending, timeout_s)
         if pending.stats is not None:
             flightrec.note(**pending.stats)
         return result
 
     def submit_many(self, keys: List[Tuple],
-                    timeout_s: Optional[float] = None) -> List[bool]:
+                    timeout_s: Optional[float] = None,
+                    priority: str = protocol.PRIORITY_DEFAULT,
+                    deadline_ms: Optional[float] = None) -> List[bool]:
         """Batched submit: all-or-nothing admission (a 429 must never
-        leave half a client batch queued), one future per key."""
+        leave half a client batch queued), one future per key. The
+        priority/deadline apply to the whole wire request."""
         results: Dict[int, bool] = {}
         misses: List[Tuple[int, Tuple]] = []
         if self.cache_size:
@@ -198,36 +284,107 @@ class VerifyBatcher:
             obs.count("serve.cache_hits", len(results))
             flightrec.note(cache_hits=len(results))
         if misses:
-            pendings = self._enqueue([k for _, k in misses])
+            pendings = self._enqueue([k for _, k in misses],
+                                     priority, deadline_ms)
             for (i, _), pending in zip(misses, pendings):
                 results[i] = self._await(pending, timeout_s)
             if pendings[0].stats is not None:
                 flightrec.note(**pendings[0].stats)
         return [results[i] for i in range(len(keys))]
 
-    def _enqueue(self, keys: List[Tuple]) -> List[_Pending]:
+    def _enqueue(self, keys: List[Tuple],
+                 priority: str = protocol.PRIORITY_DEFAULT,
+                 deadline_ms: Optional[float] = None) -> List[_Pending]:
         origin: Optional[Tuple[Optional[str], str, int]] = None
         if obs.enabled():
             sp = obs.current_span()
             if sp is not None:
                 origin = (sp.remote_trace, sp.span_id,
                           threading.get_ident() & 0xFFFFFFFF)
+        k = len(keys)
         with self._cond:
             if self._closing:
                 raise Draining("serve batcher is draining")
-            if len(self._q) + len(keys) > self.max_queue:
+            # 1) the hard bound (the fixed PR-6 knob) always applies
+            if len(self._q) + k > self.max_queue:
                 with self.stats_lock:
-                    self.rejected += len(keys)
-                obs.count("serve.rejected", len(keys))
+                    self.rejected += k
+                obs.count("serve.rejected", k)
                 raise QueueFull(
                     f"verify queue full ({len(self._q)}/{self.max_queue})")
-            pendings = [_Pending(k, origin) for k in keys]
+            # 2) deadline admission: reject a request whose estimated
+            #    COMPLETION time (queue wait + flush service, from live
+            #    evidence) already exceeds its remaining budget — the
+            #    cheapest shed, before the queue ever holds the row
+            if deadline_ms is not None:
+                est = self.admission.estimator.completion_estimate_ms(
+                    len(self._q))
+                if est >= deadline_ms:
+                    self._count_shed("admission_deadline", k, queued=False)
+                    raise DeadlineExceeded(
+                        f"estimated completion {est:.0f}ms exceeds the "
+                        f"{deadline_ms:.0f}ms deadline budget")
+            # 3) the adaptive limit, with priority shedding: sheddable
+            #    is refused, queued sheddable is evicted for default
+            #    traffic, critical bypasses (never past the hard bound)
+            limit = self.admission.limit()
+            if (len(self._q) + k > limit
+                    and priority != protocol.PRIORITY_CRITICAL):
+                if priority == protocol.PRIORITY_SHEDDABLE:
+                    self._count_shed("priority", k, queued=False)
+                    raise Shed(
+                        f"queue over adaptive limit ({len(self._q)}/{limit}): "
+                        "sheddable request refused")
+                self._evict_sheddable(len(self._q) + k - limit)
+                if len(self._q) + k > limit:
+                    with self.stats_lock:
+                        self.rejected += k
+                    obs.count("serve.rejected", k)
+                    raise QueueFull(
+                        f"verify queue over adaptive limit "
+                        f"({len(self._q)}/{limit}, hard {self.max_queue})")
+            pendings = [_Pending(key, origin, priority, deadline_ms)
+                        for key in keys]
             self._q.extend(pendings)
             with self.stats_lock:
-                self.accepted += len(keys)
-            obs.count("serve.accepted", len(keys))
+                self.accepted += k
+            obs.count("serve.accepted", k)
             self._cond.notify_all()
         return pendings
+
+    def _evict_sheddable(self, need: int) -> None:
+        """Shed up to ``need`` queued ``sheddable`` entries (oldest
+        first — they are nearest their deadlines anyway), answering each
+        with :class:`Shed`. Caller holds ``_cond``."""
+        if need <= 0:
+            return
+        kept: List[_Pending] = []
+        evicted: List[_Pending] = []
+        for p in self._q:
+            if len(evicted) < need and (
+                    p.priority == protocol.PRIORITY_SHEDDABLE):
+                evicted.append(p)
+            else:
+                kept.append(p)
+        if not evicted:
+            return
+        self._q.clear()
+        self._q.extend(kept)
+        self._count_shed("priority", len(evicted), queued=True)
+        for p in evicted:
+            p.fail(Shed("evicted from the queue under overload "
+                        "(sheddable priority)"))
+
+    def _count_shed(self, klass: str, n: int, *, queued: bool) -> None:
+        """Tally one shed decision: per-class counters always; the
+        exactly-once ``shed_rows`` only for entries that were accepted
+        (admission-time refusals were never queued)."""
+        with self.stats_lock:
+            self.shed_by_class[klass] = self.shed_by_class.get(klass, 0) + n
+            if queued:
+                self.shed_rows += n
+        obs.count(f"serve.shed.{klass}", n)
+        obs.count("serve.shed.total", n)
 
     @staticmethod
     def _await(pending: _Pending, timeout_s: Optional[float]) -> bool:
@@ -247,15 +404,24 @@ class VerifyBatcher:
                 return  # closing and empty: done
             self._flush(batch)
 
+    def _effective_linger_s(self) -> float:
+        """Brownout shrinks the linger window to zero: under sustained
+        pressure a batch never waits for company — the queue already
+        guarantees full batches, and every linger ms is pure added
+        latency against the deadlines."""
+        return 0.0 if self.admission.brownout() else self.linger_s
+
     def _collect(self) -> List[_Pending]:
-        """Block for the first entry, then linger up to ``linger_s`` for
-        the batch to fill (skipped when closing: drain flushes at full
-        speed). Pops at most ``max_batch`` entries — each exactly once."""
+        """Block for the first entry, then linger up to the effective
+        linger window for the batch to fill (skipped when closing: drain
+        flushes at full speed). Pops at most ``max_batch`` entries —
+        each exactly once."""
         with self._cond:
             while not self._q and not self._closing:
                 self._cond.wait()
-            if self._q and not self._closing and self.linger_s > 0:
-                deadline = time.monotonic() + self.linger_s
+            linger_s = self._effective_linger_s()
+            if self._q and not self._closing and linger_s > 0:
+                deadline = time.monotonic() + linger_s
                 while len(self._q) < self.max_batch and not self._closing:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
@@ -265,10 +431,41 @@ class VerifyBatcher:
                      for _ in range(min(len(self._q), self.max_batch))]
         return batch
 
+    def _shed_expired(self, batch: List[_Pending]) -> List[_Pending]:
+        """Answer every expired — or doomed — entry with
+        ``deadline_exceeded`` BEFORE any flush work is spent on it (the
+        anti-congestion-collapse move: never burn pairings for callers
+        that gave up). Doomed = the remaining budget cannot even cover
+        the flush's own estimated service time, so dispatching it could
+        only produce a late answer. Returns the still-live remainder."""
+        now = time.monotonic()
+        horizon = now + self.admission.estimator.service_estimate_ms() / 1e3
+        live: List[_Pending] = []
+        shed: List[_Pending] = []
+        for p in batch:
+            (shed if p.expired(horizon) else live).append(p)
+        if shed:
+            self._count_shed("deadline", len(shed), queued=True)
+            for p in shed:
+                waited_ms = (now - p.t_submit) * 1e3
+                p.stats = {"queue_wait_ms": round(waited_ms, 3),
+                           "shed": "deadline"}
+                verb = ("expired" if p.expired(now)
+                        else "cannot complete within its budget")
+                p.fail(DeadlineExceeded(
+                    f"deadline {verb} after {waited_ms:.0f}ms in queue "
+                    "(shed before flush)"))
+        return live
+
     def _flush(self, batch: List[_Pending]) -> None:
+        batch = self._shed_expired(batch)
+        if not batch:
+            return
         t0 = time.monotonic()
         for p in batch:
-            obs.observe("serve.queue_wait_ms", (t0 - p.t_submit) * 1e3)
+            wait_ms = (t0 - p.t_submit) * 1e3
+            obs.observe("serve.queue_wait_ms", wait_ms)
+            self.admission.estimator.observe_wait(wait_ms)
 
         # request-scoped attribution (tracing armed): a synthesized
         # serve.queue_wait child under each member's request span, and
@@ -294,6 +491,8 @@ class VerifyBatcher:
 
         def dispatch() -> Dict[Tuple, bool]:
             chaos("serve.flush")
+            if self.flush_delay_s:
+                time.sleep(self.flush_delay_s)  # drill-knob service time
             from ..crypto import bls
 
             verifier = bls.DeferredVerifier()
@@ -324,6 +523,7 @@ class VerifyBatcher:
         obs.count("serve.flush_rows", len(batch))
         flush_ms = (time.monotonic() - t0) * 1e3
         obs.observe("serve.flush_ms", flush_ms)
+        self.admission.estimator.note_flush(len(batch), flush_ms / 1e3)
         if self.cache_size:
             with self.stats_lock:
                 for key, result in table.items():

@@ -7,15 +7,35 @@ One :class:`ServeClient` holds ONE keep-alive connection and is NOT
 thread-safe — each concurrent client thread owns its own instance,
 which is exactly the N-clients shape the daemon's micro-batcher
 amortizes across.
+
+Retry discipline (docs/SERVE.md "Overload control"): retryable
+refusals (``queue_full`` 429, ``draining`` 503) and torn connections
+retry with **jittered exponential backoff**, but only while the
+client-wide **token-bucket retry budget** holds tokens — each original
+request deposits ``retry_ratio`` tokens (default 0.1 = at most ~10%
+retry amplification in steady state), each retry spends one. An empty
+bucket means the fleet is already overloaded and retrying would
+multiply the offered load — the classic retry-storm / metastable-
+failure amplifier — so the original error surfaces instead (counted
+``serve.client.retry_budget_exhausted`` and committed to the flight
+recorder). ``shed`` and ``deadline_exceeded`` responses are NEVER
+retried: the daemon is explicitly telling the caller to back off / the
+budget is spent. A client-level ``deadline_ms`` propagates on the wire
+(minus elapsed time, re-computed per attempt) so the daemon can shed
+work the caller has already given up on.
 """
 from __future__ import annotations
 
 import http.client
 import json
+import random
 import socket
+import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence
 
 from .. import obs
+from ..obs import flightrec
 from . import protocol
 
 
@@ -29,12 +49,63 @@ class ServeError(Exception):
         self.message = message
 
 
+# refusals worth retrying (transient queue states); sheds and deadline
+# expiries are the daemon telling the caller NOT to add load
+RETRYABLE_CODES = (protocol.QUEUE_FULL, protocol.DRAINING)
+
+
+class RetryBudget:
+    """SRE-style token-bucket retry budget: ``capacity`` tokens to
+    start, ``ratio`` deposited per original request, one spent per
+    retry. Thread-safe (one budget may be shared by a fleet of
+    per-thread clients to bound GLOBAL retry amplification)."""
+
+    def __init__(self, capacity: float = 10.0, ratio: float = 0.1) -> None:
+        self.capacity = max(0.0, float(capacity))
+        self.ratio = max(0.0, float(ratio))
+        self._tokens = self.capacity
+        self._lock = threading.Lock()
+
+    def deposit(self) -> None:
+        with self._lock:
+            self._tokens = min(self.capacity, self._tokens + self.ratio)
+
+    def try_spend(self) -> bool:
+        with self._lock:
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+    @property
+    def tokens(self) -> float:
+        with self._lock:
+            return self._tokens
+
+
 class ServeClient:
     def __init__(self, port: int, host: str = "127.0.0.1",
-                 timeout_s: float = 120.0) -> None:
+                 timeout_s: float = 120.0,
+                 *,
+                 max_retries: int = 2,
+                 retry_budget: Optional[RetryBudget] = None,
+                 backoff_base_ms: float = 25.0,
+                 backoff_cap_ms: float = 1000.0,
+                 deadline_ms: Optional[float] = None,
+                 priority: Optional[str] = None,
+                 rng: Optional[random.Random] = None) -> None:
         self.host = host
         self.port = port
         self.timeout_s = timeout_s
+        self.max_retries = max(0, int(max_retries))
+        self.retry_budget = retry_budget if retry_budget is not None \
+            else RetryBudget()
+        self.backoff_base_ms = backoff_base_ms
+        self.backoff_cap_ms = backoff_cap_ms
+        self.deadline_ms = deadline_ms      # client-wide default budget
+        self.priority = priority            # client-wide default class
+        self._rng = rng or random.Random()
+        self.retries = 0                    # spent on this client
         self._conn: Optional[http.client.HTTPConnection] = None
 
     # -- plumbing ------------------------------------------------------
@@ -89,12 +160,80 @@ class ServeClient:
                              err.get("message", ""))
         return obj
 
-    def call(self, method: str, params: Dict[str, Any]) -> Dict[str, Any]:
-        """One wire method round trip. With tracing armed, the call runs
-        under a ``serve.client`` span and injects its trace context as
-        the optional ``trace`` wire field, so the daemon-side request
-        span files under THIS span in the merged trace (docs/SERVE.md).
-        Disabled cost: one env check."""
+    def call(self, method: str, params: Dict[str, Any],
+             deadline_ms: Optional[float] = None,
+             priority: Optional[str] = None) -> Dict[str, Any]:
+        """One wire method call with retry discipline. With tracing
+        armed, each attempt runs under a ``serve.client`` span and
+        injects its trace context as the optional ``trace`` wire field,
+        so the daemon-side request span files under THIS span in the
+        merged trace (docs/SERVE.md). Disabled cost: one env check.
+
+        ``deadline_ms`` (or the client-wide default) is the TOTAL
+        budget across attempts: each attempt propagates the remaining
+        budget on the wire, and an expired budget surfaces as a
+        client-side ``deadline_exceeded`` ServeError without another
+        round trip."""
+        deadline_ms = deadline_ms if deadline_ms is not None else self.deadline_ms
+        priority = priority if priority is not None else self.priority
+        t_start = time.monotonic()
+        self.retry_budget.deposit()
+        attempt = 0
+        while True:
+            send = params
+            remaining: Optional[float] = None
+            if deadline_ms is not None:
+                remaining = deadline_ms - (time.monotonic() - t_start) * 1e3
+                if remaining <= 0:
+                    obs.count("serve.client.deadline_expired")
+                    raise ServeError(
+                        protocol.HTTP_STATUS[protocol.DEADLINE_EXCEEDED],
+                        protocol.DEADLINE_EXCEEDED,
+                        f"client budget ({deadline_ms:.0f}ms) expired "
+                        f"before attempt {attempt + 1}")
+            if remaining is not None or priority is not None:
+                send = dict(params)
+                if remaining is not None:
+                    send.setdefault(protocol.DEADLINE_FIELD, round(remaining, 3))
+                if priority is not None:
+                    send.setdefault(protocol.PRIORITY_FIELD, priority)
+            try:
+                return self._call_once(method, send)
+            except (ServeError, OSError) as e:
+                if not self._retryable(e) or attempt >= self.max_retries:
+                    raise
+                if not self.retry_budget.try_spend():
+                    # retrying now would amplify offered load with no
+                    # budget to pay for it — the retry-storm guard
+                    obs.count("serve.client.retry_budget_exhausted")
+                    flightrec.begin(method)
+                    flightrec.commit(status="retry_budget_exhausted",
+                                     error=str(e))
+                    raise
+                delay_s = self._backoff_s(attempt, remaining)
+                obs.count("serve.client.retries")
+                self.retries += 1
+                if delay_s > 0:
+                    time.sleep(delay_s)
+                attempt += 1
+
+    @staticmethod
+    def _retryable(e: BaseException) -> bool:
+        if isinstance(e, ServeError):
+            return e.code in RETRYABLE_CODES
+        return isinstance(e, OSError)  # torn/refused connection
+
+    def _backoff_s(self, attempt: int, remaining_ms: Optional[float]) -> float:
+        """Full-jitter exponential backoff, capped, and never sleeping
+        past the remaining deadline budget."""
+        cap_ms = min(self.backoff_cap_ms,
+                     self.backoff_base_ms * (2 ** attempt))
+        delay_ms = self._rng.uniform(0, cap_ms)
+        if remaining_ms is not None:
+            delay_ms = min(delay_ms, max(0.0, remaining_ms))
+        return delay_ms / 1e3
+
+    def _call_once(self, method: str, params: Dict[str, Any]) -> Dict[str, Any]:
         if not obs.enabled():
             return self._roundtrip("POST", protocol.route_for(method), params)
         with obs.span("serve.client", method=method,
@@ -111,7 +250,9 @@ class ServeClient:
                pubkey: Optional[bytes] = None,
                message: Optional[bytes] = None,
                messages: Optional[Sequence[bytes]] = None,
-               signature: bytes) -> bool:
+               signature: bytes,
+               deadline_ms: Optional[float] = None,
+               priority: Optional[str] = None) -> bool:
         params: Dict[str, Any] = {"signature": protocol.to_hex(signature)}
         if pubkey is not None:
             params["pubkey"] = protocol.to_hex(pubkey)
@@ -121,10 +262,15 @@ class ServeClient:
             params["message"] = protocol.to_hex(message)
         if messages is not None:
             params["messages"] = [protocol.to_hex(m) for m in messages]
-        return bool(self.call("verify", params)["valid"])
+        return bool(self.call("verify", params, deadline_ms=deadline_ms,
+                              priority=priority)["valid"])
 
-    def verify_batch(self, checks: List[Dict[str, Any]]) -> List[bool]:
-        return list(self.call("verify_batch", {"checks": checks})["results"])
+    def verify_batch(self, checks: List[Dict[str, Any]],
+                     deadline_ms: Optional[float] = None,
+                     priority: Optional[str] = None) -> List[bool]:
+        return list(self.call("verify_batch", {"checks": checks},
+                              deadline_ms=deadline_ms,
+                              priority=priority)["results"])
 
     def hash_tree_root(self, fork: str, preset: str, type_name: str,
                        ssz_bytes: bytes) -> bytes:

@@ -16,6 +16,10 @@ Endpoints (wire contract v1 — docs/SERVE.md):
   the flight recorder (obs/flightrec.py): the last N completed wire
   requests with queue-wait/flush/total ms, cache hits, degradation and
   bucket shape; also dumped to stderr on SIGUSR2 and at drain.
+  ``/debug/slowest`` excludes shed requests from the ranking.
+- ``GET /debug/overload`` — the overload-control surface (docs/SERVE.md
+  "Overload control"): admission mode, published adaptive limit vs the
+  hard bound, brownout, the wait estimator, per-class shed tallies.
 
 Introspection routes (``/metrics`` ``/healthz`` ``/readyz``
 ``/debug/*``) are excluded from ``serve.request_ms`` and the SLO
@@ -49,7 +53,8 @@ from typing import Any, Dict, List, Optional
 from .. import obs
 from ..obs import flightrec
 from . import protocol
-from .batcher import Draining, QueueFull, VerifyBatcher
+from .admission import AdmissionController
+from .batcher import DeadlineExceeded, Draining, QueueFull, Shed, VerifyBatcher
 from .service import DEFAULT_FORKS, DEFAULT_PRESETS, SpecService
 
 MAX_BODY_BYTES = 64 << 20  # a mainnet BeaconState is ~tens of MiB
@@ -136,6 +141,10 @@ class _Handler(BaseHTTPRequestHandler):
                 "requests": flightrec.slowest(params.get("n") or 10),
                 "recorded": flightrec.RECORDER.recorded,
             })
+        elif path == "/debug/overload":
+            # the overload-control surface: adaptive limit, brownout,
+            # wait estimator, per-class shed tallies (docs/SERVE.md)
+            self._send_json(200, daemon.service.batcher.overload_snapshot())
         else:
             self._send_json(404, protocol.error_response(
                 protocol.NOT_FOUND, f"no route {path!r}"))
@@ -192,6 +201,17 @@ class _Handler(BaseHTTPRequestHandler):
                 flightrec.commit(status=protocol.QUEUE_FULL, error=str(e))
                 self._send_json(429, protocol.error_response(
                     protocol.QUEUE_FULL, str(e)))
+            except DeadlineExceeded as e:
+                # a shed, not a fault: answered structured (504), never
+                # counted against availability, excluded from /debug/slowest
+                flightrec.commit(status="shed_deadline", error=str(e))
+                self._send_json(
+                    protocol.HTTP_STATUS[protocol.DEADLINE_EXCEEDED],
+                    protocol.error_response(protocol.DEADLINE_EXCEEDED, str(e)))
+            except Shed as e:
+                flightrec.commit(status="shed_priority", error=str(e))
+                self._send_json(protocol.HTTP_STATUS[protocol.SHED],
+                                protocol.error_response(protocol.SHED, str(e)))
             except Draining as e:
                 flightrec.commit(status=protocol.DRAINING, error=str(e))
                 self._send_json(503, protocol.error_response(
@@ -319,9 +339,13 @@ class ServeDaemon:
             "queue_drained": queue_drained,
             "drain_s": round(time.monotonic() - t0, 3),
             "accepted": self.service.batcher.accepted,
-            # == accepted iff every accepted check was dispatched exactly
-            # once (the no-drop / no-double-dispatch drill reads this)
+            # flushed_rows + shed_rows == accepted iff every accepted
+            # check was answered exactly once — flushed OR shed with a
+            # structured deadline_exceeded/shed response, never dropped
+            # (the drain drill reads this; sheds counted separately)
             "flushed_rows": self.service.batcher.flushed_rows,
+            "shed_rows": self.service.batcher.shed_rows,
+            "shed": dict(self.service.batcher.shed_by_class),
             "rejected": self.service.batcher.rejected,
             "flightrec_recorded": flightrec.RECORDER.recorded,
         }
@@ -369,6 +393,21 @@ def main(argv: Optional[List[str]] = None) -> int:
                         default=_env_float(ENV_LINGER_MS, 5.0))
     parser.add_argument("--result-cache", type=int,
                         default=int(_env_float(ENV_CACHE, 4096)))
+    parser.add_argument("--admission", default=None,
+                        choices=("adaptive", "fixed"),
+                        help="queue admission mode (default: adaptive, or "
+                             "CONSENSUS_SPECS_TPU_SERVE_ADMISSION); fixed = "
+                             "the PR-6 hard bound only")
+    parser.add_argument("--target-p99-ms", type=float, default=None,
+                        help="adaptive admission latency target (queue-wait "
+                             "p99; default 50 or "
+                             "CONSENSUS_SPECS_TPU_SERVE_TARGET_P99_MS)")
+    parser.add_argument("--min-limit", type=int, default=None,
+                        help="adaptive admission floor (default 16)")
+    parser.add_argument("--flush-delay-ms", type=float, default=None,
+                        help="drill knob: simulated service time per flush "
+                             "(overload drills; default 0 or "
+                             "CONSENSUS_SPECS_TPU_SERVE_FLUSH_DELAY_MS)")
     parser.add_argument("--no-warm", action="store_true",
                         help="skip the compile-cache/jit warm start")
     parser.add_argument("--jit-probe", action="store_true",
@@ -381,8 +420,13 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     from ..crypto import bls
 
+    admission = AdmissionController(
+        ns.max_queue, mode=ns.admission, min_limit=ns.min_limit,
+        target_p99_ms=ns.target_p99_ms)
     batcher = VerifyBatcher(max_queue=ns.max_queue, max_batch=ns.max_batch,
-                            linger_ms=ns.linger_ms, cache_size=ns.result_cache)
+                            linger_ms=ns.linger_ms, cache_size=ns.result_cache,
+                            admission=admission,
+                            flush_delay_ms=ns.flush_delay_ms)
     service = SpecService(
         forks=tuple(f for f in ns.forks.split(",") if f),
         presets=tuple(p for p in ns.presets.split(",") if p),

@@ -1,0 +1,397 @@
+"""Overload drill harness: open-loop / closed-loop load drivers and
+the goodput-under-overload measurement (docs/SERVE.md "Overload
+control").
+
+The existing bench harness (tools/serve_bench.py) is *closed-loop*: N
+threads each wait for an answer before sending the next request, so
+offered load can never exceed capacity — the harness itself backs off,
+and congestion collapse is unobservable by construction. This module
+adds the missing half:
+
+- :func:`closed_loop` — the saturation measurement: N clients at full
+  tilt over distinct (dedup-proof, cache-proof) checks. Its answered/s
+  IS the serving capacity on this box.
+- :func:`open_loop` — fixed *arrival rate*, independent of completions
+  (arrivals that find every sender busy are sent late and counted
+  ``lagged``, never dropped): offered load CAN exceed capacity, which
+  is the only regime where overload control does anything.
+- :func:`run_overload_drill` — the full phase sequence against an
+  already-running daemon: saturation -> 3x open-loop overload with
+  deadlines + a priority mix -> recovery probe. Returns one report
+  dict with **goodput** (answered within deadline / s), per-outcome
+  tallies, the shed ratio, and the recovery latency — the numbers
+  ``make overload-drill`` banks as ``serve_goodput_per_s`` /
+  ``serve_shed_ratio``.
+- :func:`mini_drill` — a scaled-down, jax-free, crypto-free instance
+  (in-process daemon, simulated flush service time via the
+  ``flush_delay_ms`` drill knob, invalid-pubkey checks the oracle
+  answers instantly) used by ``make overload-smoke`` and perfgate's
+  ``perfgate_overload_goodput_ratio`` slice.
+
+Check populations: "cheap" checks are well-formed-but-invalid (the
+oracle rejects the pubkey without a pairing) — they exercise every
+queue/batch/shed mechanism at zero crypto cost. "Expensive" checks
+reuse ONE valid signature against distinct messages, so every check is
+a distinct key (no dedup, no cache hit) that costs a full pairing —
+the honest capacity workload for the real drill.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..obs.metrics import percentile
+from . import protocol
+from .client import ServeClient, ServeError
+
+OUTCOMES = ("ok_in_deadline", "ok_late", "shed_deadline", "shed_priority",
+            "queue_full", "draining", "error")
+
+
+# ---------------------------------------------------------------------------
+# check populations
+# ---------------------------------------------------------------------------
+
+def cheap_check(i: int, tag: str = "drill") -> Dict[str, Any]:
+    """A distinct well-formed-but-invalid check: the oracle rejects the
+    pubkey without pairing work, answers False — free of crypto cost,
+    distinct key (no dedup/cache short-circuit)."""
+    seed = (i * 2654435761) & 0xFFFFFFFF
+    return {
+        "pubkeys": [protocol.to_hex(bytes([seed % 251 + 1]) * 48)],
+        "message": protocol.to_hex(
+            tag.encode()[:8].ljust(8, b".") + seed.to_bytes(4, "little")
+            + b"\x00" * 20),
+        "signature": protocol.to_hex(b"\x02" * 96),
+    }
+
+
+def expensive_check_factory() -> Callable[[int], Dict[str, Any]]:
+    """Checks that each cost a FULL pairing: one valid (pk, sig) pair is
+    built once (one SkToPk + one Sign), then reused against distinct
+    messages — every check is a distinct key, deserializes cleanly, and
+    the pairing evaluates before answering False."""
+    from ..crypto.bls import ciphersuite as oracle
+
+    pk = protocol.to_hex(oracle.SkToPk(7))
+    sig = protocol.to_hex(oracle.Sign(7, b"overload-drill-anchor" + b"\x00" * 11))
+
+    def make(i: int) -> Dict[str, Any]:
+        return {"pubkey": pk,
+                "message": protocol.to_hex(
+                    b"overload." + i.to_bytes(4, "little") + b"\x00" * 19),
+                "signature": sig}
+
+    return make
+
+
+def default_priority_mix(i: int) -> str:
+    """The drill's deterministic criticality mix: 10% critical, 20%
+    sheddable, 70% default."""
+    if i % 10 == 0:
+        return protocol.PRIORITY_CRITICAL
+    if i % 5 == 1:
+        return protocol.PRIORITY_SHEDDABLE
+    return protocol.PRIORITY_DEFAULT
+
+
+# ---------------------------------------------------------------------------
+# load drivers
+# ---------------------------------------------------------------------------
+
+def closed_loop(port: int, *, clients: int, requests_per_client: int,
+                make_check: Callable[[int], Dict[str, Any]],
+                timeout_s: float = 120.0,
+                priority: Optional[str] = None) -> Dict[str, Any]:
+    """Saturation measurement: every thread always has exactly one
+    request outstanding. Distinct checks per request, no retries (the
+    harness must never amplify its own load). The drill runs this at
+    ``critical`` priority so the capacity number can never be clipped
+    by the adaptive limiter it is calibrating."""
+    lat: List[List[float]] = [[] for _ in range(clients)]
+    answered = [0] * clients
+    errors = [0] * clients
+    barrier = threading.Barrier(clients + 1)
+
+    def worker(idx: int) -> None:
+        with ServeClient(port, timeout_s=timeout_s, max_retries=0) as c:
+            barrier.wait()
+            for r in range(requests_per_client):
+                i = idx * requests_per_client + r
+                t0 = time.perf_counter()
+                try:
+                    c.call("verify", make_check(i), priority=priority)
+                    answered[idx] += 1
+                except Exception:
+                    errors[idx] += 1
+                lat[idx].append((time.perf_counter() - t0) * 1e3)
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(clients)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    flat = sorted(x for ls in lat for x in ls)
+    total = sum(answered)
+    return {
+        "clients": clients,
+        "requests": clients * requests_per_client,
+        "answered": total,
+        "errors": sum(errors),
+        "wall_s": round(wall, 3),
+        "rate_per_s": round(total / wall, 3) if wall > 0 else None,
+        "p50_ms": percentile(flat, 50),
+        "p99_ms": percentile(flat, 99),
+    }
+
+
+def open_loop(port: int, *, rate_per_s: float, duration_s: float,
+              make_check: Callable[[int], Dict[str, Any]],
+              deadline_ms: Optional[float] = None,
+              priority_for: Optional[Callable[[int], str]] = None,
+              max_threads: int = 64,
+              timeout_s: Optional[float] = None) -> Dict[str, Any]:
+    """Fixed-arrival-rate driver. Arrival i is due at ``t0 + i/rate``;
+    a free sender sleeps until then and fires. When every sender is
+    busy the arrival goes out late (counted ``lagged``) — arrivals are
+    never dropped, so offered load is honest even past capacity.
+
+    Senders use ``max_retries=0``: the drill measures the DAEMON's
+    overload behavior; client retry discipline is drilled separately.
+    """
+    n_arrivals = max(1, int(rate_per_s * duration_s))
+    if timeout_s is None:
+        timeout_s = max(10.0, (deadline_ms or 0) / 1e3 * 4 + 10.0)
+    # enough senders to keep arrivals on schedule at the expected
+    # latency, bounded so the driver cannot melt the box
+    threads_n = min(max(8, int(rate_per_s * (timeout_s if deadline_ms is None
+                                             else deadline_ms / 1e3) * 1.5)),
+                    max_threads)
+    counter = {"next": 0}
+    counter_lock = threading.Lock()
+    outcomes = {k: 0 for k in OUTCOMES}
+    ok_lat: List[float] = []
+    stats_lock = threading.Lock()
+    lagged = [0]
+    t_start = [0.0]
+    barrier = threading.Barrier(threads_n + 1)
+
+    def classify(code: str) -> str:
+        return {protocol.DEADLINE_EXCEEDED: "shed_deadline",
+                protocol.SHED: "shed_priority",
+                protocol.QUEUE_FULL: "queue_full",
+                protocol.DRAINING: "draining"}.get(code, "error")
+
+    def worker() -> None:
+        with ServeClient(port, timeout_s=timeout_s, max_retries=0) as c:
+            barrier.wait()
+            while True:
+                with counter_lock:
+                    i = counter["next"]
+                    if i >= n_arrivals:
+                        return
+                    counter["next"] = i + 1
+                due = t_start[0] + i / rate_per_s
+                now = time.perf_counter()
+                if now < due:
+                    time.sleep(due - now)
+                elif now - due > 0.05:
+                    with stats_lock:
+                        lagged[0] += 1
+                check = make_check(i)
+                prio = priority_for(i) if priority_for else None
+                t0 = time.perf_counter()
+                try:
+                    c.call("verify", check, deadline_ms=deadline_ms,
+                           priority=prio)
+                    ms = (time.perf_counter() - t0) * 1e3
+                    key = ("ok_late" if deadline_ms is not None
+                           and ms > deadline_ms else "ok_in_deadline")
+                    with stats_lock:
+                        outcomes[key] += 1
+                        if key == "ok_in_deadline":
+                            ok_lat.append(ms)
+                except ServeError as e:
+                    with stats_lock:
+                        outcomes[classify(e.code)] += 1
+                except Exception:
+                    with stats_lock:
+                        outcomes["error"] += 1
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(threads_n)]
+    for t in threads:
+        t.start()
+    t_start[0] = time.perf_counter()
+    barrier.wait()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start[0]
+    sheds = outcomes["shed_deadline"] + outcomes["shed_priority"]
+    return {
+        "offered": n_arrivals,
+        "offered_rate_per_s": round(rate_per_s, 3),
+        "achieved_rate_per_s": round(n_arrivals / wall, 3) if wall else None,
+        "duration_s": round(wall, 3),
+        "senders": threads_n,
+        "lagged": lagged[0],
+        "outcomes": dict(outcomes),
+        "goodput_per_s": (round(outcomes["ok_in_deadline"] / wall, 3)
+                          if wall else None),
+        "shed_ratio": round(sheds / n_arrivals, 4),
+        "rejected_ratio": round(
+            (sheds + outcomes["queue_full"]) / n_arrivals, 4),
+        "answered": sum(outcomes.values()),
+        "ok_p50_ms": percentile(sorted(ok_lat), 50),
+        "ok_p99_ms": percentile(sorted(ok_lat), 99),
+    }
+
+
+def recovery_probe(port: int, *, make_check: Callable[[int], Dict[str, Any]],
+                   probes: int = 20, settle_timeout_s: float = 30.0,
+                   ) -> Dict[str, Any]:
+    """After the overload stops: wait for the queue to drain, then
+    measure a clean probe window — the daemon must return to baseline
+    latency, not stay wedged behind a backlog of dead work."""
+    t0 = time.perf_counter()
+    with ServeClient(port, timeout_s=60, max_retries=0) as c:
+        depth = None
+        while time.perf_counter() - t0 < settle_timeout_s:
+            depth = c.health()["queue"]["depth"]
+            if depth == 0:
+                break
+            time.sleep(0.05)
+        settle_s = time.perf_counter() - t0
+        lat: List[float] = []
+        errors = 0
+        for i in range(probes):
+            t1 = time.perf_counter()
+            try:
+                c.call("verify", make_check(10_000_000 + i))
+            except Exception:
+                errors += 1
+            lat.append((time.perf_counter() - t1) * 1e3)
+    return {
+        "settle_s": round(settle_s, 3),
+        "settled": depth == 0,
+        "probes": probes,
+        "errors": errors,
+        "p50_ms": percentile(sorted(lat), 50),
+        "p99_ms": percentile(sorted(lat), 99),
+    }
+
+
+# ---------------------------------------------------------------------------
+# the drill sequence
+# ---------------------------------------------------------------------------
+
+def run_overload_drill(
+    port: int,
+    *,
+    make_check: Callable[[int], Dict[str, Any]],
+    sat_clients: int = 4,
+    sat_requests_per_client: int = 12,
+    overload_multiplier: float = 3.0,
+    overload_duration_s: float = 10.0,
+    deadline_ms: float = 2000.0,
+    priority_for: Optional[Callable[[int], str]] = default_priority_mix,
+    recovery_probes: int = 20,
+    max_threads: int = 64,
+) -> Dict[str, Any]:
+    """Saturation -> overload -> recovery against a running daemon.
+
+    Goodput contract (the no-collapse criterion the drill asserts):
+    open-loop offered load at ``overload_multiplier``x the measured
+    saturation rate must keep goodput (answered within deadline / s)
+    within 20% of the saturation rate — shed the excess, serve the
+    rest — and the post-load probe must sit back at baseline latency.
+    """
+    saturation = closed_loop(port, clients=sat_clients,
+                             requests_per_client=sat_requests_per_client,
+                             make_check=make_check,
+                             priority=protocol.PRIORITY_CRITICAL)
+    sat_rate = saturation["rate_per_s"] or 1.0
+    offered_rate = max(1.0, sat_rate * overload_multiplier)
+    overload = open_loop(
+        port, rate_per_s=offered_rate, duration_s=overload_duration_s,
+        make_check=lambda i: make_check(1_000_000 + i),
+        deadline_ms=deadline_ms, priority_for=priority_for,
+        max_threads=max_threads)
+    recovery = recovery_probe(port, make_check=make_check,
+                              probes=recovery_probes)
+    goodput = overload["goodput_per_s"] or 0.0
+    return {
+        "saturation": saturation,
+        "overload": overload,
+        "recovery": recovery,
+        "deadline_ms": deadline_ms,
+        "overload_multiplier": overload_multiplier,
+        "goodput_per_s": goodput,
+        "goodput_ratio": round(goodput / sat_rate, 4) if sat_rate else None,
+        "shed_ratio": overload["shed_ratio"],
+    }
+
+
+# ---------------------------------------------------------------------------
+# the scaled-down in-process instance (overload-smoke + perfgate)
+# ---------------------------------------------------------------------------
+
+def mini_drill(
+    *,
+    flush_delay_ms: float = 80.0,
+    max_batch: int = 2,
+    sat_clients: int = 4,
+    sat_requests_per_client: int = 10,
+    overload_multiplier: float = 3.0,
+    overload_duration_s: float = 2.5,
+    deadline_ms: float = 500.0,
+    target_p99_ms: float = 250.0,
+    min_limit: int = 2,
+    recovery_probes: int = 20,
+    probe: Optional[Callable[[int], Any]] = None,
+) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """The deterministic, jax-free, crypto-free drill: an in-process
+    daemon whose flush pipeline has a SIMULATED service time
+    (``flush_delay_ms`` per dispatch, ``max_batch`` rows each), driven
+    with invalid-pubkey checks the oracle answers instantly. Capacity
+    is therefore ``max_batch / flush_delay`` rows/s by construction —
+    small enough that a Python thread pool can offer 3x it — and every
+    shed/admission mechanism runs for real.
+
+    Returns ``(report, drain_report)``; the daemon is always drained.
+    """
+    from .admission import AdmissionController
+    from .batcher import VerifyBatcher
+    from .daemon import ServeDaemon
+    from .service import SpecService
+
+    admission = AdmissionController(
+        1024, mode="adaptive", min_limit=min_limit,
+        target_p99_ms=target_p99_ms, tick_s=0.02, brownout_ticks=2)
+    batcher = VerifyBatcher(
+        max_queue=1024, max_batch=max_batch, linger_ms=2.0, cache_size=0,
+        admission=admission, flush_delay_ms=flush_delay_ms)
+    service = SpecService(forks=("phase0",), presets=("minimal",),
+                          batcher=batcher, request_timeout_s=30.0)
+    daemon = ServeDaemon(service).start(warm=False)
+    try:
+        report = run_overload_drill(
+            daemon.port, make_check=cheap_check,
+            sat_clients=sat_clients,
+            sat_requests_per_client=sat_requests_per_client,
+            overload_multiplier=overload_multiplier,
+            overload_duration_s=overload_duration_s,
+            deadline_ms=deadline_ms,
+            recovery_probes=recovery_probes,
+            max_threads=48)
+        report["overload_state"] = batcher.overload_snapshot()
+        if probe is not None:
+            report["probe"] = probe(daemon.port)
+    finally:
+        drain_report = daemon.drain(15)
+    return report, drain_report

@@ -11,11 +11,33 @@ Error codes map onto HTTP statuses (and, for faults, onto the
 resilience taxonomy so a client can tell a bad request from a degraded
 backend):
 
-    bad_request   400  malformed params / undecodable SSZ / unknown type
-    not_found     404  unknown route or method
-    queue_full    429  admission control: the bounded verify queue is full
-    draining      503  daemon is shutting down; request was NOT accepted
-    internal      500  a fault the service could not degrade around
+    bad_request        400  malformed params / undecodable SSZ / unknown type
+    not_found          404  unknown route or method
+    queue_full         429  admission control: the bounded verify queue is full
+    shed               429  overload control: a `sheddable`-priority request
+                            was shed to protect higher-priority work (do NOT
+                            blind-retry; the daemon is telling you it is
+                            overloaded)
+    deadline_exceeded  504  the request's `deadline_ms` budget expired (in
+                            queue, or predicted to at admission) before any
+                            flush work was spent on it
+    draining           503  daemon is shutting down; request was NOT accepted
+    internal           500  a fault the service could not degrade around
+
+Overload-control wire fields (docs/SERVE.md "Overload control"; both
+optional — v1 clients that omit them are unaffected):
+
+    deadline_ms   number  the caller's REMAINING latency budget, relative
+                          to request arrival (relative, because client and
+                          daemon clocks need not agree). Admission
+                          timestamps arrival; a request whose estimated
+                          queue wait already exceeds the budget — or whose
+                          budget expires while queued — is answered
+                          `deadline_exceeded` instead of burning flush work.
+    priority      string  `critical` | `default` | `sheddable`. Under
+                          overload the queue sheds `sheddable` first;
+                          `critical` bypasses the adaptive limit (never the
+                          hard bound).
 
 This module is pure stdlib and imported by both sides of the socket
 (daemon and client) plus the bench/smoke tools — the contract lives in
@@ -49,9 +71,21 @@ DEBUG_PREFIX = "/debug/"
 # under the client's request span (obs.traceparent / obs.remote_span)
 TRACE_FIELD = "trace"
 
+# overload-control fields (optional on every POST body; see module
+# docstring): a relative latency budget and a criticality class
+DEADLINE_FIELD = "deadline_ms"
+PRIORITY_FIELD = "priority"
+
+PRIORITY_CRITICAL = "critical"
+PRIORITY_DEFAULT = "default"
+PRIORITY_SHEDDABLE = "sheddable"
+PRIORITIES = (PRIORITY_CRITICAL, PRIORITY_DEFAULT, PRIORITY_SHEDDABLE)
+
 BAD_REQUEST = "bad_request"
 NOT_FOUND = "not_found"
 QUEUE_FULL = "queue_full"
+SHED = "shed"
+DEADLINE_EXCEEDED = "deadline_exceeded"
 DRAINING = "draining"
 INTERNAL = "internal"
 
@@ -59,6 +93,8 @@ HTTP_STATUS = {
     BAD_REQUEST: 400,
     NOT_FOUND: 404,
     QUEUE_FULL: 429,
+    SHED: 429,
+    DEADLINE_EXCEEDED: 504,
     DRAINING: 503,
     INTERNAL: 500,
 }
@@ -196,6 +232,32 @@ def trace_context(params: Dict[str, Any]) -> Optional[str]:
         return None
     if not isinstance(value, str):
         raise bad_request(f"{TRACE_FIELD}: expected a traceparent string")
+    return value
+
+
+def request_deadline_ms(params: Dict[str, Any]) -> Optional[float]:
+    """The optional ``deadline_ms`` budget: a positive-or-zero number.
+    Absent -> None (no deadline). A non-number, bool, NaN, or negative
+    value is a typed contract violation (bad request)."""
+    value = params.get(DEADLINE_FIELD)
+    if value is None:
+        return None
+    if isinstance(value, bool) or not isinstance(value, (int, float)):
+        raise bad_request(f"{DEADLINE_FIELD}: expected a number of ms")
+    ms = float(value)
+    if ms != ms or ms < 0:  # NaN or negative
+        raise bad_request(f"{DEADLINE_FIELD}: must be a finite ms budget >= 0")
+    return ms
+
+
+def request_priority(params: Dict[str, Any]) -> str:
+    """The optional ``priority`` class; absent -> ``default``."""
+    value = params.get(PRIORITY_FIELD)
+    if value is None:
+        return PRIORITY_DEFAULT
+    if not isinstance(value, str) or value not in PRIORITIES:
+        raise bad_request(
+            f"{PRIORITY_FIELD}: expected one of {'/'.join(PRIORITIES)}")
     return value
 
 

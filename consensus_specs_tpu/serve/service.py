@@ -35,7 +35,7 @@ from .. import obs
 from ..obs import flightrec
 from ..resilience import chaos
 from . import protocol
-from .batcher import VerifyBatcher
+from .batcher import DeadlineExceeded, VerifyBatcher
 
 DEFAULT_FORKS = ("phase0", "altair")
 DEFAULT_PRESETS = ("minimal",)
@@ -121,6 +121,18 @@ class SpecService:
                 flightrec.note(span=sp.span_id)
                 chaos("serve.request")
                 obs.count(f"serve.requests.{method}")
+                # overload-control fields validate for EVERY method; a
+                # request that arrives with its budget already spent is
+                # shed before any work (docs/SERVE.md "Overload control")
+                deadline_ms = protocol.request_deadline_ms(params)
+                priority = protocol.request_priority(params)
+                if priority != protocol.PRIORITY_DEFAULT:
+                    flightrec.note(priority=priority)
+                if deadline_ms is not None and deadline_ms <= 0:
+                    self.batcher._count_shed("admission_deadline", 1,
+                                             queued=False)
+                    raise DeadlineExceeded(
+                        "deadline_ms budget already expired at arrival")
                 return fn(params)
         finally:
             # span histograms only feed when tracing is armed; /metrics
@@ -131,7 +143,8 @@ class SpecService:
 
     # -- methods -------------------------------------------------------
 
-    def _resolve_check(self, key: Tuple) -> bool:
+    def _resolve_check(self, key: Tuple, priority: str,
+                       deadline_ms: Optional[float]) -> bool:
         if key[0] == "av":
             # never appears in spec-level state-transition code; resolve
             # scalar through the facade, same as DeferredVerifier.flush
@@ -142,24 +155,30 @@ class SpecService:
                                                 key[3]))
             except Exception:
                 return False
-        return self.batcher.submit(key, timeout_s=self.request_timeout_s)
+        return self.batcher.submit(key, timeout_s=self.request_timeout_s,
+                                   priority=priority, deadline_ms=deadline_ms)
 
     def _do_verify(self, params: Dict[str, Any]) -> Dict[str, Any]:
         key = protocol.parse_check(params)
-        return {"valid": self._resolve_check(key)}
+        return {"valid": self._resolve_check(
+            key, protocol.request_priority(params),
+            protocol.request_deadline_ms(params))}
 
     def _do_verify_batch(self, params: Dict[str, Any]) -> Dict[str, Any]:
         checks = params.get("checks")
         if not isinstance(checks, list) or not checks:
             raise protocol.bad_request("checks: expected a non-empty list")
+        priority = protocol.request_priority(params)
+        deadline_ms = protocol.request_deadline_ms(params)
         keys = [protocol.parse_check(c, f"checks[{i}]")
                 for i, c in enumerate(checks)]
-        scalar = {i: self._resolve_check(k)
+        scalar = {i: self._resolve_check(k, priority, deadline_ms)
                   for i, k in enumerate(keys) if k[0] == "av"}
         batched = [(i, k) for i, k in enumerate(keys) if k[0] != "av"]
         if batched:
             answers = self.batcher.submit_many(
-                [k for _, k in batched], timeout_s=self.request_timeout_s)
+                [k for _, k in batched], timeout_s=self.request_timeout_s,
+                priority=priority, deadline_ms=deadline_ms)
             scalar.update({i: a for (i, _), a in zip(batched, answers)})
         return {"results": [scalar[i] for i in range(len(keys))]}
 
@@ -245,7 +264,9 @@ class SpecService:
                       "capacity": self.batcher.max_queue,
                       "accepted": self.batcher.accepted,
                       "rejected": self.batcher.rejected,
+                      "shed_rows": self.batcher.shed_rows,
                       "flushes": self.batcher.flushes},
+            "overload": self.batcher.overload_snapshot(),
             "result_cache": self.batcher.cache_stats(),
             "compile_cache": compile_cache_stats(),
             "counters": counters,

@@ -9,6 +9,8 @@ import pathlib
 import subprocess
 import sys
 
+import pytest
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from consensus_specs_tpu.obs import ledger as ledger_mod, sentinel
@@ -185,6 +187,47 @@ def test_budget_burning_daemon_fails_slo_gate(tmp_path):
     assert summary["metrics"]["serve_slo_availability"] == 0.5
     led = ledger_mod.Ledger(ledger_path)
     assert len(led.series("serve_slo_availability")) == 1  # evidence banked
+
+
+def test_collapsing_overload_config_fails_gate(tmp_path):
+    """The ISSUE-10 drill: perfgate_overload_goodput_ratio is gated
+    ABSOLUTELY against the no-collapse floor (like the SLO gate, so a
+    cold ledger cannot ship a collapsing configuration). The chaos
+    knob halves the measured ratio — simulating a daemon whose goodput
+    collapses under 3x overload — and the gate must FAIL with the
+    ``collapsed`` verdict while the evidence still banks."""
+    ledger_path = str(tmp_path / "ledger.jsonl")
+    summary_path = tmp_path / "summary.json"
+    proc = _run(["--ledger", ledger_path, "--json", str(summary_path)],
+                env_extra={"CONSENSUS_SPECS_TPU_PERF_CHAOS":
+                           "perfgate_overload=0.5"}, timeout=360)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "collapsed" in proc.stdout
+    assert "gate FAILED" in proc.stdout
+    summary = json.loads(summary_path.read_text())
+    assert summary["overload"]["ok"] is False
+    assert summary["overload"]["observed"] < summary["overload"]["floor"]
+    led = ledger_mod.Ledger(ledger_path)
+    assert len(led.series("perfgate_overload_goodput_ratio")) == 1  # banked
+
+
+@pytest.mark.slow
+def test_clean_overload_ratio_passes_floor(tmp_path):
+    """The clean half of the ISSUE-10 acceptance at the gate level: the
+    in-process mini drill's goodput ratio clears the absolute floor
+    with margin, the drill's own exactly-once accounting held (the
+    measurement asserts it), and the summary carries the ok verdict.
+    Marked slow (a full extra perfgate run): `make citest`'s perfgate
+    invocation exercises the clean path on every CI run anyway."""
+    ledger_path = str(tmp_path / "ledger.jsonl")
+    summary_path = tmp_path / "summary.json"
+    proc = _run(["--ledger", ledger_path, "--json", str(summary_path)],
+                timeout=360)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    summary = json.loads(summary_path.read_text())
+    assert summary["overload"]["ok"] is True
+    assert summary["overload"]["verdict"] == "ok"
+    assert summary["metrics"]["perfgate_overload_goodput_ratio"] >= 0.6
 
 
 def test_environmental_gap_does_not_fail_gate(tmp_path):

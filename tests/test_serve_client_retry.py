@@ -1,0 +1,216 @@
+"""Client-side overload discipline (ISSUE 10, docs/SERVE.md "Overload
+control"): the token-bucket retry budget, jittered exponential backoff,
+which refusals are retryable (queue_full/draining/torn sockets — never
+shed or deadline_exceeded), and end-to-end deadline propagation on the
+wire. The core property under drill: retries can never multiply offered
+load unboundedly — an empty budget surfaces the original error."""
+import os
+import random
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from consensus_specs_tpu import obs
+from consensus_specs_tpu.obs import flightrec
+from consensus_specs_tpu.serve import (
+    RetryBudget,
+    ServeClient,
+    ServeDaemon,
+    ServeError,
+    SpecService,
+    VerifyBatcher,
+)
+from consensus_specs_tpu.serve import protocol
+
+
+def _wire_check(i: int):
+    from consensus_specs_tpu.serve.protocol import to_hex
+
+    return {"pubkeys": [to_hex(bytes([i % 251 + 1]) * 48)],
+            "message": to_hex(bytes([i % 256]) * 32),
+            "signature": to_hex(b"\x02" * 96)}
+
+
+def test_retry_budget_token_bucket():
+    budget = RetryBudget(capacity=2.0, ratio=0.5)
+    assert budget.try_spend() and budget.try_spend()
+    assert not budget.try_spend()  # empty
+    budget.deposit()  # +0.5
+    assert not budget.try_spend()  # still < 1 token
+    budget.deposit()
+    assert budget.try_spend()
+    for _ in range(100):
+        budget.deposit()
+    assert budget.tokens == pytest.approx(2.0)  # capped at capacity
+
+
+def test_retryable_classification():
+    retryable = ServeClient._retryable
+    assert retryable(ServeError(429, protocol.QUEUE_FULL, ""))
+    assert retryable(ServeError(503, protocol.DRAINING, ""))
+    assert retryable(ConnectionResetError())
+    # the daemon said "stop adding load" / "budget spent": NOT retryable
+    assert not retryable(ServeError(429, protocol.SHED, ""))
+    assert not retryable(ServeError(504, protocol.DEADLINE_EXCEEDED, ""))
+    assert not retryable(ServeError(400, protocol.BAD_REQUEST, ""))
+    assert not retryable(ServeError(500, protocol.INTERNAL, ""))
+
+
+def test_backoff_is_jittered_exponential_and_deadline_capped():
+    c = ServeClient(1, rng=random.Random(7), backoff_base_ms=100,
+                    backoff_cap_ms=300)
+    samples0 = [c._backoff_s(0, None) for _ in range(200)]
+    samples2 = [c._backoff_s(2, None) for _ in range(200)]
+    assert all(0 <= s <= 0.1 for s in samples0)
+    assert all(0 <= s <= 0.3 for s in samples2)  # capped below 400ms
+    assert max(samples2) > max(samples0)  # the envelope grew
+    assert len({round(s, 6) for s in samples0}) > 50  # full jitter
+    assert c._backoff_s(5, remaining_ms=10.0) <= 0.010  # never past deadline
+
+
+@pytest.fixture(scope="module")
+def stuck_daemon():
+    """A daemon whose 1-slot queue never flushes (long linger): every
+    submit past the first is a deterministic queue_full 429."""
+    service = SpecService(forks=("phase0",), presets=("minimal",),
+                          batcher=VerifyBatcher(max_queue=1,
+                                                linger_ms=60_000,
+                                                cache_size=0),
+                          request_timeout_s=60)
+    d = ServeDaemon(service).start(warm=False)
+    blocker = threading.Thread(
+        target=lambda: ServeClient(d.port, timeout_s=60, max_retries=0).call(
+            "verify", _wire_check(0)),
+        daemon=True)
+    blocker.start()
+    deadline = time.monotonic() + 30
+    while d.service.batcher.depth() < 1:
+        assert time.monotonic() < deadline
+        time.sleep(0.01)
+    yield d
+    d.drain(10)
+
+
+def test_retries_happen_with_backoff_then_surface(stuck_daemon):
+    snap0 = obs.snapshot()["counters"].get("serve.client.retries", 0)
+    c = ServeClient(stuck_daemon.port, max_retries=2,
+                    retry_budget=RetryBudget(capacity=10, ratio=0.1),
+                    backoff_base_ms=1, rng=random.Random(3))
+    with pytest.raises(ServeError) as e:
+        c.call("verify", _wire_check(1))
+    assert e.value.code == protocol.QUEUE_FULL  # surfaced after retries
+    assert c.retries == 2
+    assert obs.snapshot()["counters"]["serve.client.retries"] == snap0 + 2
+    c.close()
+
+
+def test_exhausted_budget_blocks_retries_and_is_recorded(stuck_daemon):
+    flightrec.RECORDER.clear()
+    c = ServeClient(stuck_daemon.port, max_retries=5,
+                    retry_budget=RetryBudget(capacity=1, ratio=0.0),
+                    backoff_base_ms=1, rng=random.Random(5))
+    with pytest.raises(ServeError):
+        c.call("verify", _wire_check(2))  # spends the single token
+    assert c.retries == 1
+    with pytest.raises(ServeError) as e:
+        c.call("verify", _wire_check(3))  # budget empty: NO retry
+    assert e.value.code == protocol.QUEUE_FULL
+    assert c.retries == 1  # unchanged — the retry never happened
+    assert obs.snapshot()["counters"]["serve.client.retry_budget_exhausted"] >= 1
+    recorded = [r for r in flightrec.requests()
+                if r["status"] == "retry_budget_exhausted"]
+    assert recorded, "budget exhaustion must land in the flight recorder"
+    c.close()
+
+
+def test_shared_budget_bounds_fleet_amplification(stuck_daemon):
+    """One budget across N client threads: total retries across the
+    fleet are bounded by the bucket, not N * max_retries."""
+    shared = RetryBudget(capacity=3, ratio=0.0)
+    clients = [ServeClient(stuck_daemon.port, max_retries=4,
+                           retry_budget=shared, backoff_base_ms=1,
+                           rng=random.Random(i)) for i in range(6)]
+    errors = []
+
+    def worker(c, i):
+        try:
+            c.call("verify", _wire_check(10 + i))
+        except ServeError as e:
+            errors.append(e)
+        finally:
+            c.close()
+
+    threads = [threading.Thread(target=worker, args=(c, i))
+               for i, c in enumerate(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert len(errors) == 6  # every caller surfaced the refusal
+    assert sum(c.retries for c in clients) == 3  # exactly the bucket
+
+
+def test_client_deadline_expires_locally_without_a_round_trip():
+    c = ServeClient(1, deadline_ms=0.0)  # port never dialed
+    with pytest.raises(ServeError) as e:
+        c.call("verify", _wire_check(4))
+    assert e.value.code == protocol.DEADLINE_EXCEEDED
+    assert e.value.status == 504
+
+
+def test_deadline_propagates_on_the_wire():
+    """A client-level budget rides the wire as deadline_ms: a daemon
+    whose estimator has real slow-drain evidence rejects the tight
+    budget at admission with 504 deadline_exceeded — which the client
+    must surface, not retry. The daemon can only have done that if the
+    client actually injected the field."""
+    service = SpecService(forks=("phase0",), presets=("minimal",),
+                          batcher=VerifyBatcher(max_batch=1, linger_ms=1,
+                                                cache_size=0,
+                                                flush_delay_ms=250.0),
+                          request_timeout_s=60)
+    d = ServeDaemon(service).start(warm=False)
+    try:
+        with ServeClient(d.port, max_retries=0, timeout_s=60) as warm:
+            for i in range(2):  # teach the estimator the ~4 rows/s drain
+                warm.call("verify", _wire_check(20 + i))
+        holders = [threading.Thread(
+            target=lambda i=i: ServeClient(d.port, timeout_s=60,
+                                           max_retries=0).call(
+                "verify", _wire_check(30 + i)), daemon=True)
+            for i in range(2)]
+        for t in holders:
+            t.start()
+        deadline = time.monotonic() + 30
+        while service.batcher.depth() < 1:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        c = ServeClient(d.port, max_retries=3, backoff_base_ms=1,
+                        deadline_ms=100.0)
+        t0 = time.monotonic()
+        with pytest.raises(ServeError) as e:
+            c.call("verify", _wire_check(5))
+        assert e.value.code == protocol.DEADLINE_EXCEEDED
+        assert e.value.status == 504
+        assert c.retries == 0  # deadline_exceeded is never retried
+        assert time.monotonic() - t0 < 10
+        c.close()
+        for t in holders:
+            t.join(30)
+    finally:
+        d.drain(15)
+
+
+def test_priority_defaults_ride_every_call(stuck_daemon):
+    """A client-wide priority=sheddable is injected into the params —
+    proven by the 400 a bogus class draws vs the clean validation a
+    real one passes (the daemon parses what the client sent)."""
+    c = ServeClient(stuck_daemon.port, max_retries=0, priority="bogus")
+    with pytest.raises(ServeError) as e:
+        c.call("verify", _wire_check(6))
+    assert e.value.status == 400 and "priority" in e.value.message
+    c.close()

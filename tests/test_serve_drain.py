@@ -103,6 +103,80 @@ def test_sigterm_with_full_queue_answers_every_accepted_request(tmp_path):
     assert report["flushed_rows"] == N_CHECKS
 
 
+def test_sigterm_with_expired_deadlines_answers_all_sheds_separately(tmp_path):
+    """The ISSUE-10 drain-while-shedding invariant: SIGTERM lands on a
+    full queue that ALSO holds expired-deadline entries. Every accepted
+    request is still answered exactly once — the expired ones with a
+    structured 504 deadline_exceeded, the rest with their verdicts,
+    none dropped — and the SERVE DRAINED report counts sheds separately
+    from flushed rows (accepted == flushed_rows + shed_rows)."""
+    from consensus_specs_tpu.serve.protocol import DEADLINE_EXCEEDED
+
+    n_live, n_dead = 10, 6
+    proc, port = _start_daemon(
+        tmp_path, ("--linger-ms", "60000", "--max-batch", "512",
+                   "--result-cache", "0"))
+    try:
+        answers = {}
+        sheds = {}
+        failures = {}
+
+        def worker(i, deadline_ms):
+            check = {"pubkeys": [to_hex(bytes([i + 1]) * 48)],
+                     "message": to_hex(bytes([i]) * 32),
+                     "signature": to_hex(b"\x03" * 96)}
+            if deadline_ms is not None:
+                check["deadline_ms"] = deadline_ms
+            try:
+                with ServeClient(port, timeout_s=90, max_retries=0) as c:
+                    answers[i] = c.call("verify", check)["valid"]
+            except ServeError as e:
+                if e.code == DEADLINE_EXCEEDED:
+                    sheds[i] = e.status
+                else:
+                    failures[i] = repr(e)
+            except Exception as e:
+                failures[i] = repr(e)
+
+        threads = [threading.Thread(target=worker, args=(i, None))
+                   for i in range(n_live)]
+        # the doomed cohort: budgets that will be long expired at drain
+        threads += [threading.Thread(target=worker, args=(n_live + j, 150.0))
+                    for j in range(n_dead)]
+        for t in threads:
+            t.start()
+
+        with ServeClient(port) as monitor:
+            deadline = time.monotonic() + 60
+            while monitor.health()["queue"]["depth"] < n_live + n_dead:
+                assert time.monotonic() < deadline, "queue never filled"
+                time.sleep(0.02)
+        time.sleep(0.4)  # the 150ms budgets expire IN the queue
+        proc.send_signal(signal.SIGTERM)
+        for t in threads:
+            t.join(90)
+        out, _ = proc.communicate(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+
+    assert not failures, f"accepted requests dropped: {failures}"
+    assert answers == {i: False for i in range(n_live)}
+    assert sheds == {n_live + j: 504 for j in range(n_dead)}
+
+    assert proc.returncode == 0, out[-1500:]
+    report = json.loads(out.split("SERVE DRAINED", 1)[1].strip().splitlines()[0])
+    assert report["queue_drained"] is True
+    assert report["inflight_answered"] is True
+    # exactly-once with sheds accounted separately from flushed rows
+    assert report["accepted"] == n_live + n_dead
+    assert report["flushed_rows"] == n_live
+    assert report["shed_rows"] == n_dead
+    assert report["shed"]["deadline"] == n_dead
+    assert report["accepted"] == report["flushed_rows"] + report["shed_rows"]
+
+
 def test_requests_after_drain_get_structured_503(tmp_path):
     proc, port = _start_daemon(tmp_path, ("--linger-ms", "60000",))
     try:

@@ -1,0 +1,432 @@
+"""Overload control for the serving plane (ISSUE 10, docs/SERVE.md
+"Overload control"): deadline admission + in-queue expiry shedding,
+the AIMD adaptive queue limit, priority classes (sheddable shed first,
+critical bypasses), brownout, the supervised admission controller with
+its ``serve.admission`` chaos site (a HUNG admission check must trip
+supervision, never wedge the accept loop), and the wire surface
+(``deadline_ms`` / ``priority`` fields, ``deadline_exceeded`` /
+``shed`` error codes, ``/debug/overload``, ``serve.shed.*`` counters in
+``prometheus_text``)."""
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from consensus_specs_tpu import obs, resilience
+from consensus_specs_tpu.obs import flightrec
+from consensus_specs_tpu.serve import protocol
+from consensus_specs_tpu.serve.admission import (
+    AdmissionController,
+    AimdLimit,
+    WaitEstimator,
+)
+from consensus_specs_tpu.serve.batcher import (
+    DeadlineExceeded,
+    QueueFull,
+    Shed,
+    VerifyBatcher,
+)
+
+
+def garbage_check(i: int):
+    """Well-formed but invalid key: the oracle answers False without
+    pairing cost (same shape as test_serve_batcher)."""
+    return ("fav", (bytes([i % 251 + 1]) * 48,), i.to_bytes(4, "big") * 8,
+            b"\x02" * 96)
+
+
+class _StubAdmission:
+    """A deterministic controller stand-in for batcher admission-logic
+    units: fixed published limit/brownout, a real estimator."""
+
+    def __init__(self, limit: int, brownout: bool = False) -> None:
+        self._limit = limit
+        self._brownout = brownout
+        self.estimator = WaitEstimator()
+
+    def start(self):
+        return self
+
+    def stop(self, timeout_s: float = 2.0) -> None:
+        pass
+
+    def limit(self) -> int:
+        return self._limit
+
+    def brownout(self) -> bool:
+        return self._brownout
+
+    def snapshot(self):
+        return {"mode": "stub", "limit": self._limit,
+                "brownout": self._brownout,
+                "estimator": self.estimator.snapshot()}
+
+
+# ---------------------------------------------------------------------------
+# estimator + AIMD units
+# ---------------------------------------------------------------------------
+
+def test_estimator_cold_start_is_optimistic():
+    est = WaitEstimator()
+    assert est.estimate_ms(0) == 0.0
+    assert est.estimate_ms(100) == 0.0  # no evidence -> never rejects
+
+
+def test_estimator_forward_model_scales_with_depth():
+    est = WaitEstimator()
+    est.note_flush(rows=4, service_s=0.1)  # 40 rows/s drain rate
+    assert est.drain_rate() == pytest.approx(40.0)
+    assert est.estimate_ms(40) == pytest.approx(1000.0)
+    assert est.estimate_ms(4) == pytest.approx(100.0)
+    # recent waits act as a floor when they exceed the forward model
+    for _ in range(20):
+        est.observe_wait(500.0)
+    assert est.estimate_ms(4) == pytest.approx(500.0)
+    # empty queue estimates zero wait regardless of history
+    assert est.estimate_ms(0) == 0.0
+
+
+def test_aimd_limit_decreases_multiplicatively_and_recovers():
+    aimd = AimdLimit(hard_limit=1024, min_limit=16, target_p99_ms=50.0)
+    assert aimd.limit == 1024
+    aimd.update(200.0)  # over target -> x0.65
+    assert aimd.limit == int(1024 * 0.65)
+    for _ in range(100):
+        aimd.update(1e9)
+    assert aimd.limit == 16  # clamped at the floor
+    aimd.update(None)  # no evidence reads as calm -> additive increase
+    assert aimd.limit == 24
+    for _ in range(1000):
+        aimd.update(1.0)
+    assert aimd.limit == 1024  # clamped at the hard bound
+
+
+# ---------------------------------------------------------------------------
+# batcher admission: deadlines
+# ---------------------------------------------------------------------------
+
+def test_expired_deadline_is_shed_before_flush_work():
+    """An entry whose deadline passes while queued is answered
+    deadline_exceeded when its batch pops — before any dispatch — and
+    the exactly-once accounting books it as a shed, not a flush."""
+    b = VerifyBatcher(linger_ms=60_000, cache_size=0).start()
+    results = {}
+
+    def worker(name, deadline_ms):
+        try:
+            results[name] = b.submit(garbage_check(ord(name[0])),
+                                     timeout_s=30, deadline_ms=deadline_ms)
+        except BaseException as e:
+            results[name] = e
+
+    threads = [threading.Thread(target=worker, args=("dead", 50.0)),
+               threading.Thread(target=worker, args=("live", None))]
+    for t in threads:
+        t.start()
+    while b.depth() < 2:
+        time.sleep(0.005)
+    time.sleep(0.12)  # the 50ms budget expires in-queue
+    assert b.drain(15) is True
+    for t in threads:
+        t.join(15)
+    assert isinstance(results["dead"], DeadlineExceeded)
+    assert results["live"] is False  # garbage check, answered normally
+    assert b.accepted == 2
+    assert b.flushed_rows == 1 and b.shed_rows == 1
+    assert b.shed_by_class["deadline"] == 1
+    assert b.accepted == b.flushed_rows + b.shed_rows
+
+
+def test_admission_rejects_predicted_late_request():
+    """Evidence of a slow drain + deep queue must reject a tight
+    deadline at admission (never queued, not counted accepted)."""
+    b = VerifyBatcher(cache_size=0, admission=_StubAdmission(limit=1024))
+    # 10 rows/s drain; 5 queued rows ahead -> ~500ms estimated wait
+    b.admission.estimator.note_flush(rows=1, service_s=0.1)
+    b._enqueue([garbage_check(i) for i in range(5)])
+    with pytest.raises(DeadlineExceeded):
+        b._enqueue([garbage_check(99)], deadline_ms=100.0)
+    assert b.accepted == 5  # the reject was never admitted
+    assert b.shed_by_class["admission_deadline"] == 1
+    assert b.shed_rows == 0  # admission-time refusals are not queued sheds
+    # a generous budget still gets in
+    b._enqueue([garbage_check(100)], deadline_ms=10_000.0)
+    assert b.accepted == 6
+
+
+# ---------------------------------------------------------------------------
+# batcher admission: priority classes
+# ---------------------------------------------------------------------------
+
+def test_sheddable_is_refused_over_the_adaptive_limit():
+    b = VerifyBatcher(cache_size=0, admission=_StubAdmission(limit=4))
+    b._enqueue([garbage_check(i) for i in range(4)])
+    with pytest.raises(Shed):
+        b._enqueue([garbage_check(9)],
+                   priority=protocol.PRIORITY_SHEDDABLE)
+    assert b.shed_by_class["priority"] == 1
+    assert b.depth() == 4
+
+
+def test_default_traffic_evicts_queued_sheddable():
+    """Over the adaptive limit, queued sheddable entries are evicted
+    (answered Shed) to make room for default traffic — shed the low
+    class first, exactly-once accounting intact."""
+    b = VerifyBatcher(cache_size=0, admission=_StubAdmission(limit=4))
+    shed_results = {}
+
+    def shed_worker(i):
+        try:
+            shed_results[i] = b.submit(garbage_check(i), timeout_s=10,
+                                       priority=protocol.PRIORITY_SHEDDABLE)
+        except BaseException as e:
+            shed_results[i] = e
+
+    threads = [threading.Thread(target=shed_worker, args=(i,))
+               for i in range(2)]
+    for t in threads:
+        t.start()
+    while b.depth() < 2:
+        time.sleep(0.005)
+    b._enqueue([garbage_check(10), garbage_check(11)])  # fills to limit 4
+    pendings = b._enqueue([garbage_check(12)])  # over limit -> evicts 1
+    for t in threads:
+        t.join(10)
+    evicted = [r for r in shed_results.values() if isinstance(r, Shed)]
+    assert len(evicted) == 1, f"exactly one eviction expected: {shed_results}"
+    assert b.depth() == 4  # still at the limit
+    assert pendings[0] in b._q
+    assert b.shed_rows == 1  # the evicted entry WAS accepted -> a queued shed
+
+
+def test_critical_bypasses_adaptive_limit_but_not_hard_bound():
+    b = VerifyBatcher(max_queue=6, cache_size=0,
+                      admission=_StubAdmission(limit=2))
+    b._enqueue([garbage_check(i) for i in range(2)])
+    with pytest.raises(QueueFull):
+        b._enqueue([garbage_check(8)])  # default: no sheddables to evict
+    b._enqueue([garbage_check(9)], priority=protocol.PRIORITY_CRITICAL)
+    b._enqueue([garbage_check(10), garbage_check(11), garbage_check(12)],
+               priority=protocol.PRIORITY_CRITICAL)
+    assert b.depth() == 6  # critical rode past the adaptive limit...
+    with pytest.raises(QueueFull):
+        b._enqueue([garbage_check(13)],
+                   priority=protocol.PRIORITY_CRITICAL)  # ...never the hard one
+
+
+def test_brownout_collapses_linger_window():
+    calm = VerifyBatcher(linger_ms=25, admission=_StubAdmission(limit=8))
+    assert calm._effective_linger_s() == pytest.approx(0.025)
+    browned = VerifyBatcher(linger_ms=25,
+                            admission=_StubAdmission(limit=8, brownout=True))
+    assert browned._effective_linger_s() == 0.0
+
+
+# ---------------------------------------------------------------------------
+# the admission controller under chaos (site serve.admission)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(autouse=True)
+def _clean_admission_breaker():
+    yield
+    resilience.clear(AdmissionController.CAPABILITY)
+    resilience.disarm()
+
+
+def test_controller_ticks_and_publishes():
+    c = AdmissionController(256, mode="adaptive", tick_s=0.01,
+                            stale_s=5.0).start()
+    try:
+        deadline = time.monotonic() + 5
+        while c._ticks < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert c._ticks >= 3
+        assert c.adaptive and c.limit() == 256  # calm -> stays at the cap
+        snap = c.snapshot()
+        assert snap["mode"] == "adaptive" and snap["degraded"] is None
+    finally:
+        c.stop()
+
+
+def test_transient_admission_fault_is_retried_not_degraded():
+    c = AdmissionController(256, mode="adaptive", tick_s=0.01, stale_s=5.0)
+    with resilience.inject("serve.admission", "transient", count=1):
+        c.start()
+        deadline = time.monotonic() + 5
+        while c._ticks < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+    try:
+        assert c._ticks >= 3 and c.adaptive
+        assert not resilience.is_quarantined(c.CAPABILITY)
+    finally:
+        c.stop()
+
+
+def test_deterministic_admission_fault_quarantines_and_degrades():
+    c = AdmissionController(256, mode="adaptive", tick_s=0.01, stale_s=5.0)
+    with resilience.inject("serve.admission", "deterministic", count=1):
+        c.start()
+        deadline = time.monotonic() + 5
+        while c._degraded is None and time.monotonic() < deadline:
+            time.sleep(0.01)
+    try:
+        assert c._degraded is not None
+        assert resilience.is_quarantined(c.CAPABILITY)
+        assert c.limit() == 256  # the fixed bound takes over
+        assert not c.brownout()
+    finally:
+        c.stop()
+
+
+def test_hung_admission_check_trips_supervision_not_the_accept_loop():
+    """The satellite drill: chaos kind ``hang`` wedges the controller
+    tick. The accept path must keep admitting at the fixed bound — a
+    submit never blocks on the controller — and the staleness watchdog
+    must quarantine serve.admission with a recorded event."""
+    os.environ["CONSENSUS_SPECS_TPU_CHAOS_HANG_S"] = "30"
+    try:
+        c = AdmissionController(64, mode="adaptive", tick_s=0.01,
+                                stale_s=0.15)
+        b = VerifyBatcher(max_queue=64, linger_ms=1, cache_size=0,
+                          admission=c)
+        with resilience.inject("serve.admission", "hang", count=1):
+            b.start()
+            time.sleep(0.4)  # hang fires on an early tick; staleness > 0.15s
+            t0 = time.monotonic()
+            assert b.submit(garbage_check(1), timeout_s=10) is False
+            assert time.monotonic() - t0 < 5  # the accept loop never wedged
+        assert c._degraded is not None, "staleness watchdog did not trip"
+        assert resilience.is_quarantined(c.CAPABILITY)
+        events = [e for e in resilience.events()
+                  if e["event"] == "quarantine"
+                  and e["capability"] == c.CAPABILITY]
+        assert events, "the hung admission check must be a recorded event"
+        assert c.limit() == 64  # degraded to the fixed bound
+        assert b.drain(10) is True
+    finally:
+        os.environ.pop("CONSENSUS_SPECS_TPU_CHAOS_HANG_S", None)
+
+
+# ---------------------------------------------------------------------------
+# the wire surface (in-process daemon)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def wire_daemon():
+    from consensus_specs_tpu.serve import ServeDaemon, SpecService
+
+    flightrec.RECORDER.clear()
+    service = SpecService(forks=("phase0",), presets=("minimal",),
+                          batcher=VerifyBatcher(linger_ms=1, cache_size=0),
+                          request_timeout_s=30)
+    d = ServeDaemon(service).start(warm=False)
+    yield d
+    d.drain(10)
+
+
+@pytest.fixture()
+def wire_client(wire_daemon):
+    from consensus_specs_tpu.serve import ServeClient
+
+    with ServeClient(wire_daemon.port, max_retries=0) as c:
+        yield c
+
+
+def _wire_check(i: int):
+    from consensus_specs_tpu.serve.protocol import to_hex
+
+    return {"pubkeys": [to_hex(bytes([i % 251 + 1]) * 48)],
+            "message": to_hex(bytes([i % 256]) * 32),
+            "signature": to_hex(b"\x02" * 96)}
+
+
+def test_wire_deadline_already_expired_is_504(wire_daemon, wire_client):
+    from consensus_specs_tpu.serve import ServeError
+
+    with pytest.raises(ServeError) as e:
+        wire_client.call("verify", dict(_wire_check(1), deadline_ms=0))
+    assert e.value.status == 504
+    assert e.value.code == protocol.DEADLINE_EXCEEDED
+    rec = flightrec.requests(n=1)[0]
+    assert rec["status"] == "shed_deadline"
+
+
+def test_wire_deadline_applies_to_every_method(wire_client):
+    from consensus_specs_tpu.serve import ServeError
+    from consensus_specs_tpu.serve.protocol import to_hex
+
+    with pytest.raises(ServeError) as e:
+        wire_client.call("hash_tree_root",
+                         {"fork": "phase0", "preset": "minimal",
+                          "type": "Checkpoint", "ssz": to_hex(b"\x00" * 40),
+                          "deadline_ms": 0})
+    assert e.value.code == protocol.DEADLINE_EXCEEDED
+
+
+def test_wire_field_validation(wire_client):
+    from consensus_specs_tpu.serve import ServeError
+
+    with pytest.raises(ServeError) as e:
+        wire_client.call("verify", dict(_wire_check(2), priority="urgent"))
+    assert e.value.status == 400
+    with pytest.raises(ServeError) as e:
+        wire_client.call("verify", dict(_wire_check(2), deadline_ms="soon"))
+    assert e.value.status == 400
+    with pytest.raises(ServeError) as e:
+        wire_client.call("verify", dict(_wire_check(2), deadline_ms=-5))
+    assert e.value.status == 400
+    # a valid budget + class pass validation and answer normally
+    assert wire_client.call("verify", dict(
+        _wire_check(2), deadline_ms=30_000,
+        priority="critical"))["valid"] is False
+
+
+def test_debug_overload_and_prometheus_shed_counters(wire_daemon, wire_client):
+    """/debug/overload exposes the admission state; serve.shed.*
+    counters land in prometheus_text() (the satellite's always-on
+    visibility of shedding)."""
+    from consensus_specs_tpu.serve import ServeError
+
+    with pytest.raises(ServeError):
+        wire_client.call("verify", dict(_wire_check(3), deadline_ms=0))
+    snap = wire_client._roundtrip("GET", "/debug/overload")
+    assert snap["mode"] in ("adaptive", "fixed")
+    assert snap["hard_limit"] == wire_daemon.service.batcher.max_queue
+    assert snap["shed"]["admission_deadline"] >= 1
+    assert "estimator" in snap and "brownout" in snap
+    text = wire_client.metrics()
+    assert "serve_shed_admission_deadline" in text
+    assert "serve_shed_total" in text
+
+
+def test_slowest_excludes_shed_requests(wire_daemon, wire_client):
+    from consensus_specs_tpu.serve import ServeError
+
+    flightrec.RECORDER.clear()
+    assert wire_client.call("verify", _wire_check(7))["valid"] is False
+    with pytest.raises(ServeError):
+        wire_client.call("verify", dict(_wire_check(8), deadline_ms=0))
+    statuses = {r["status"] for r in flightrec.requests()}
+    assert "shed_deadline" in statuses and "ok" in statuses
+    slowest = wire_client._roundtrip("GET", "/debug/slowest")["requests"]
+    assert slowest, "served requests must still rank"
+    assert all(not r["status"].startswith("shed") for r in slowest)
+
+
+def test_shed_is_excluded_from_slo_availability(wire_daemon, wire_client):
+    """Sheds answer 429/504 — load management, not availability burn:
+    the SLO denominator (serve.responses + serve.errors.internal) must
+    not move when a request is shed."""
+    from consensus_specs_tpu.obs import slo
+    from consensus_specs_tpu.serve import ServeError
+
+    before = slo.observed_from_snapshot()
+    with pytest.raises(ServeError):
+        wire_client.call("verify", dict(_wire_check(9), deadline_ms=0))
+    after = slo.observed_from_snapshot()
+    assert after["requests"] == before["requests"]
+    assert after["errors_5xx"] == before["errors_5xx"]

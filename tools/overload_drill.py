@@ -1,0 +1,427 @@
+"""`make overload-drill` / `make overload-smoke`: the metastable-failure
+drill for the serving plane (docs/SERVE.md "Overload control").
+
+Full mode (``make overload-drill``, host-measured evidence):
+
+    python tools/overload_drill.py [--multiplier 3] [--duration S]
+                                   [--deadline-ms D] [--ledger P]
+                                   [--json OUT]
+
+1. boots a real daemon subprocess (reference BLS, result cache OFF so
+   every admitted check costs a full pairing — the honest per-request
+   work on a host box);
+2. measures **saturation goodput** closed-loop (4 critical-priority
+   clients at full tilt over distinct checks);
+3. offers **open-loop load at ~3x that rate** with ``deadline_ms``
+   budgets and a 10/70/20 critical/default/sheddable priority mix —
+   arrivals never wait for completions, so the overload is real;
+4. runs the **differential corpus** (verify valid + tampered /
+   hash_tree_root / process_block, locally recomputed) BOTH clean and
+   concurrently with the overload at critical priority: every answered
+   request must be bit-identical to the direct path;
+5. probes **recovery**: queue back to empty and probe latency back to
+   baseline within seconds of load removal.
+
+No-collapse criteria (exit 1 when violated):
+- offered rate >= 3x measured capacity (by construction, reported);
+- goodput (answered within deadline / s) under overload within 20% of
+  saturation goodput — shed the excess, serve the rest;
+- recovery: queue settles and the post-load probe p99 is sane;
+- zero differential mismatches, zero transport errors.
+
+Banked (source ``overload_drill``): ``serve_goodput_per_s`` (goodput
+under 3x overload) and ``serve_shed_ratio`` (sheds / offered), with
+saturation rate, per-outcome tallies and recovery stats in ``extra``.
+
+Smoke mode (``--smoke``, wired into `make citest`): the scaled-down
+jax-free deterministic instance — an in-process daemon whose flush
+pipeline has a simulated service time (the ``flush_delay_ms`` drill
+knob) driven by invalid-pubkey checks the oracle answers instantly, so
+the whole overload -> shed -> recover cycle runs in a few seconds with
+zero crypto cost; assertions are structural (sheds engage per class,
+every arrival is answered, no collapse, clean drain accounting,
+differential corpus identical) with generous margins.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from consensus_specs_tpu import obs  # noqa: E402
+from consensus_specs_tpu.serve import drill  # noqa: E402
+from consensus_specs_tpu.serve.client import ServeClient, ServeError  # noqa: E402
+from consensus_specs_tpu.serve.protocol import to_hex  # noqa: E402
+
+
+def fail(msg: str) -> int:
+    print(f"overload_drill: FAIL — {msg}")
+    return 1
+
+
+# ---------------------------------------------------------------------------
+# the differential corpus (served vs direct, clean AND overloaded)
+# ---------------------------------------------------------------------------
+
+def build_differential_corpus() -> List[Dict[str, Any]]:
+    """(method, params, expected) probes whose answers are recomputed
+    locally through the direct spec path — the bit-identity half of the
+    drill's acceptance."""
+    from consensus_specs_tpu.crypto import bls
+    from consensus_specs_tpu.crypto.bls import ciphersuite as oracle
+    from consensus_specs_tpu.crypto.bls.fields import R
+    from consensus_specs_tpu.specs.build import build_spec
+    from consensus_specs_tpu.test_framework.block import (
+        apply_randao_reveal,
+        build_empty_block_for_next_slot,
+    )
+    from consensus_specs_tpu.test_framework.context import (
+        _prepare_state,
+        default_activation_threshold,
+        default_balances,
+    )
+    from consensus_specs_tpu.test_framework.state import next_slot, transition_to
+
+    sks = [41, 42]
+    pks = [oracle.SkToPk(sk) for sk in sks]
+    msg = b"overload-differential" + b"\x00" * 11
+    sig = oracle.Sign(sum(sks) % R, msg)
+    tampered = b"overload-differentiaL" + b"\x00" * 11
+
+    spec = build_spec("phase0", "minimal")
+    checkpoint = spec.Checkpoint(epoch=31, root=b"\x1f" * 32)
+
+    bls.bls_active = False
+    state = _prepare_state(default_balances,
+                           default_activation_threshold, spec).copy()
+    next_slot(spec, state)
+    block = build_empty_block_for_next_slot(spec, state)
+    transition_to(spec, state, block.slot)
+    bls.bls_active = True
+    apply_randao_reveal(spec, state, block)
+    post = state.copy()
+    spec.process_block(post, block)
+
+    return [
+        {"name": "verify_valid", "method": "verify",
+         "params": {"pubkeys": [to_hex(p) for p in pks],
+                    "message": to_hex(msg), "signature": to_hex(sig)},
+         "expect": {"valid": bool(bls.FastAggregateVerify(pks, msg, sig))}},
+        {"name": "verify_tampered", "method": "verify",
+         "params": {"pubkeys": [to_hex(p) for p in pks],
+                    "message": to_hex(tampered), "signature": to_hex(sig)},
+         "expect": {"valid": bool(bls.FastAggregateVerify(pks, tampered, sig))}},
+        {"name": "hash_tree_root", "method": "hash_tree_root",
+         "params": {"fork": "phase0", "preset": "minimal",
+                    "type": "Checkpoint",
+                    "ssz": to_hex(checkpoint.encode_bytes())},
+         "expect": {"root": to_hex(checkpoint.hash_tree_root())}},
+        {"name": "process_block", "method": "process_block",
+         "params": {"fork": "phase0", "preset": "minimal",
+                    "pre": to_hex(state.encode_bytes()),
+                    "block": to_hex(block.encode_bytes())},
+         "expect": {"post": to_hex(post.encode_bytes()),
+                    "root": to_hex(post.hash_tree_root())}},
+    ]
+
+
+def differential_pass(port: int, corpus: List[Dict[str, Any]],
+                      label: str, deadline_ms: Optional[float] = None,
+                      ) -> Dict[str, Any]:
+    """One served pass over the corpus: every probe that is ANSWERED
+    must match the locally recomputed expectation exactly; a shed/429
+    under overload is allowed (load management, not a correctness
+    escape) and tallied."""
+    answered = shed = 0
+    mismatches: List[str] = []
+    with ServeClient(port, timeout_s=90, max_retries=0) as c:
+        for probe in corpus:
+            try:
+                got = c.call(probe["method"], dict(probe["params"]),
+                             deadline_ms=deadline_ms, priority="critical")
+            except ServeError as e:
+                if e.code in ("deadline_exceeded", "shed", "queue_full"):
+                    shed += 1
+                    continue
+                mismatches.append(f"{label}/{probe['name']}: "
+                                  f"unexpected error [{e.status}] {e.code}")
+                continue
+            answered += 1
+            for key, expect in probe["expect"].items():
+                if got.get(key) != expect:
+                    mismatches.append(
+                        f"{label}/{probe['name']}: {key} diverged "
+                        f"(got {str(got.get(key))[:64]!r})")
+    return {"label": label, "answered": answered, "shed": shed,
+            "mismatches": mismatches}
+
+
+# ---------------------------------------------------------------------------
+# full mode: subprocess daemon, real pairing workload
+# ---------------------------------------------------------------------------
+
+def start_daemon(tmp: pathlib.Path, extra: Tuple[str, ...] = ()) -> Tuple[subprocess.Popen, int]:
+    ready_file = tmp / "ready.json"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "consensus_specs_tpu.serve",
+         "--port", "0", "--forks", "phase0", "--presets", "minimal",
+         "--linger-ms", "5", "--max-batch", "4", "--result-cache", "0",
+         "--ready-file", str(ready_file), *extra],
+        cwd=str(REPO), env=obs.child_env(), stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if ready_file.exists():
+            return proc, json.loads(ready_file.read_text())["port"]
+        if proc.poll() is not None:
+            out, _ = proc.communicate()
+            raise RuntimeError(f"daemon died at startup rc={proc.returncode}: "
+                               f"{(out or '')[-400:]}")
+        time.sleep(0.05)
+    proc.kill()
+    raise RuntimeError("daemon not ready within 120s")
+
+
+def run_full(ns: argparse.Namespace) -> int:
+    t_all = time.perf_counter()
+    print("overload_drill: building the expensive check population "
+          "(one Sign) + differential corpus ...")
+    make_check = drill.expensive_check_factory()
+    corpus = build_differential_corpus()
+
+    tmp = pathlib.Path(tempfile.mkdtemp(prefix="overload_drill_"))
+    proc, port = start_daemon(
+        tmp, ("--target-p99-ms", str(ns.target_p99_ms),
+              "--min-limit", str(ns.min_limit)))
+    rc = 0
+    report: Dict[str, Any] = {}
+    try:
+        diff_clean = differential_pass(port, corpus, "clean")
+        if diff_clean["mismatches"]:
+            return fail(f"clean differential diverged: "
+                        f"{diff_clean['mismatches'][:3]}")
+        print(f"overload_drill: clean differential OK "
+              f"({diff_clean['answered']} probes)")
+
+        # the overload phase carries a concurrent differential stream:
+        # critical priority + generous budget, answers must still be
+        # bit-identical while the daemon sheds all around them
+        diff_overload: Dict[str, Any] = {}
+
+        def diff_worker() -> None:
+            diff_overload.update(differential_pass(
+                port, corpus, "overloaded", deadline_ms=60_000.0))
+
+        diff_thread = threading.Thread(target=diff_worker, daemon=True)
+
+        def priority_mix(i: int) -> str:
+            return drill.default_priority_mix(i)
+
+        print(f"overload_drill: measuring saturation "
+              f"({ns.sat_clients} clients x {ns.sat_requests} requests, "
+              "full pairing each) ...")
+        saturation = drill.closed_loop(
+            port, clients=ns.sat_clients,
+            requests_per_client=ns.sat_requests,
+            make_check=make_check, priority="critical")
+        sat_rate = saturation["rate_per_s"] or 0.0
+        if not sat_rate or saturation["errors"]:
+            return fail(f"saturation phase broken: {saturation}")
+        offered = sat_rate * ns.multiplier
+        print(f"overload_drill: capacity {sat_rate:.2f}/s "
+              f"(p50 {saturation['p50_ms']:.0f}ms) -> offering "
+              f"{offered:.2f}/s open-loop for {ns.duration}s, "
+              f"deadline {ns.deadline_ms:.0f}ms")
+
+        diff_thread.start()
+        overload = drill.open_loop(
+            port, rate_per_s=offered, duration_s=ns.duration,
+            make_check=lambda i: make_check(1_000_000 + i),
+            deadline_ms=ns.deadline_ms, priority_for=priority_mix,
+            max_threads=ns.max_threads)
+        diff_thread.join(120)
+        recovery = drill.recovery_probe(
+            port, make_check=lambda i: drill.cheap_check(i, "recover"))
+
+        goodput = overload["goodput_per_s"] or 0.0
+        ratio = goodput / sat_rate
+        report = {
+            "saturation": saturation, "overload": overload,
+            "recovery": recovery, "goodput_per_s": goodput,
+            "goodput_ratio": round(ratio, 4),
+            "shed_ratio": overload["shed_ratio"],
+            "differential": {"clean": diff_clean,
+                             "overloaded": diff_overload},
+            "multiplier": ns.multiplier,
+            "deadline_ms": ns.deadline_ms,
+            "wall_s": round(time.perf_counter() - t_all, 1),
+        }
+        out = overload["outcomes"]
+        print(f"overload_drill: goodput {goodput:.2f}/s "
+              f"({ratio:.0%} of saturation), outcomes {out}")
+        print(f"overload_drill: recovery settle {recovery['settle_s']:.2f}s, "
+              f"probe p99 {recovery['p99_ms']:.1f}ms")
+        print(f"overload_drill: overloaded differential "
+              f"{diff_overload.get('answered', 0)} answered / "
+              f"{diff_overload.get('shed', 0)} shed")
+
+        if ratio < 1.0 - ns.goodput_margin:
+            rc = fail(f"goodput collapsed: {ratio:.0%} of saturation "
+                      f"(floor {1.0 - ns.goodput_margin:.0%})")
+        if out["error"]:
+            rc = fail(f"{out['error']} transport errors under overload")
+        if not recovery["settled"]:
+            rc = fail("queue did not settle after load removal")
+        if recovery["p99_ms"] is not None and recovery["p99_ms"] > ns.recovery_p99_ms:
+            rc = fail(f"recovery p99 {recovery['p99_ms']:.1f}ms "
+                      f"> {ns.recovery_p99_ms}ms")
+        if diff_overload.get("mismatches"):
+            rc = fail(f"overloaded differential diverged: "
+                      f"{diff_overload['mismatches'][:3]}")
+        if not diff_overload.get("answered"):
+            rc = fail("overloaded differential: no probe answered "
+                      "(critical priority must survive the overload)")
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            out_text, _ = proc.communicate(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out_text, _ = proc.communicate()
+        if proc.returncode != 0:
+            rc = fail(f"daemon drain rc={proc.returncode} "
+                      f"(tail: {(out_text or '')[-300:]})")
+        elif "SERVE DRAINED" in (out_text or ""):
+            drained = json.loads(out_text.split("SERVE DRAINED", 1)[1]
+                                 .strip().splitlines()[0])
+            report["drain"] = drained
+            if drained["accepted"] != (drained["flushed_rows"]
+                                       + drained["shed_rows"]):
+                rc = fail(f"drain accounting broken: {drained}")
+
+    if rc == 0 and (ns.ledger or "").strip().lower() not in ("off", "none", "0"):
+        from consensus_specs_tpu.obs import ledger as ledger_mod
+
+        path = ns.ledger or ledger_mod.default_path()
+        if path:
+            run_id = ledger_mod.Ledger(path).record_run(
+                {"serve_goodput_per_s": round(report["goodput_per_s"], 3),
+                 "serve_shed_ratio": report["shed_ratio"]},
+                source="overload_drill", backend="host",
+                extra={"saturation_rate_per_s": report["saturation"]["rate_per_s"],
+                       "offered_rate_per_s": report["overload"]["offered_rate_per_s"],
+                       "goodput_ratio": report["goodput_ratio"],
+                       "multiplier": ns.multiplier,
+                       "deadline_ms": ns.deadline_ms,
+                       "outcomes": report["overload"]["outcomes"],
+                       "recovery_settle_s": report["recovery"]["settle_s"],
+                       "recovery_p99_ms": report["recovery"]["p99_ms"]})
+            report["ledger"] = {"path": path, "run_id": run_id}
+            print(f"overload_drill: banked as {run_id} -> {path}")
+
+    if ns.json_path is not None:
+        ns.json_path.write_text(json.dumps(report, indent=2, sort_keys=True,
+                                           default=repr))
+    print(f"overload_drill: {'PASSED' if rc == 0 else 'FAILED'} "
+          f"in {time.perf_counter() - t_all:.1f}s")
+    return rc
+
+
+# ---------------------------------------------------------------------------
+# smoke mode: in-process, jax-free, crypto-free, deterministic
+# ---------------------------------------------------------------------------
+
+def run_smoke(ns: argparse.Namespace) -> int:
+    t0 = time.perf_counter()
+    corpus = build_differential_corpus()
+
+    def probe(port: int) -> Dict[str, Any]:
+        return differential_pass(port, corpus, "post-overload")
+
+    report, drain = drill.mini_drill(
+        overload_duration_s=ns.duration if ns.duration != 20.0 else 2.5,
+        probe=probe)
+    out = report["overload"]["outcomes"]
+    state = report["overload_state"]
+    diff = report["probe"]
+    print(f"overload_smoke: sat {report['saturation']['rate_per_s']}/s, "
+          f"goodput {report['goodput_per_s']}/s "
+          f"(ratio {report['goodput_ratio']}), outcomes {out}")
+    print(f"overload_smoke: admission {state['mode']} limit {state['limit']} "
+          f"brownout {state['brownout']} shed {state['shed']}")
+    print(f"overload_smoke: drain {drain['accepted']} accepted = "
+          f"{drain['flushed_rows']} flushed + {drain['shed_rows']} shed")
+
+    checks = [
+        (report["goodput_ratio"] is not None
+         and report["goodput_ratio"] >= 0.55,
+         f"goodput collapsed (ratio {report['goodput_ratio']})"),
+        (out["shed_deadline"] + out["shed_priority"] > 0,
+         "overload produced no sheds — the drill never stressed the daemon"),
+        (out["shed_priority"] > 0,
+         "no priority sheds: sheddable traffic was not shed first"),
+        (out["error"] == 0, f"{out['error']} transport errors"),
+        (sum(out.values()) == report["overload"]["offered"],
+         "arrivals went unanswered (sum(outcomes) != offered)"),
+        (report["recovery"]["settled"], "queue did not settle after load"),
+        (report["recovery"]["p99_ms"] is not None
+         and report["recovery"]["p99_ms"] < 500.0,
+         f"recovery p99 {report['recovery']['p99_ms']}ms"),
+        (not diff["mismatches"],
+         f"differential diverged: {diff['mismatches'][:3]}"),
+        (diff["answered"] == len(corpus),
+         "post-overload differential probes were shed"),
+        (drain["accepted"] == drain["flushed_rows"] + drain["shed_rows"],
+         f"drain accounting broken: {drain}"),
+        (drain["queue_drained"], "drain left queued work"),
+    ]
+    for ok, msg in checks:
+        if not ok:
+            return fail(msg)
+    print(f"overload_smoke: OK in {time.perf_counter() - t0:.1f}s")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--smoke", action="store_true",
+                        help="scaled-down in-process deterministic drill "
+                             "(the citest slice)")
+    parser.add_argument("--multiplier", type=float, default=3.0,
+                        help="offered load as a multiple of measured capacity")
+    parser.add_argument("--duration", type=float, default=20.0,
+                        help="overload window seconds")
+    parser.add_argument("--deadline-ms", type=float, default=4000.0)
+    parser.add_argument("--target-p99-ms", type=float, default=2000.0,
+                        help="daemon adaptive-admission queue-wait target")
+    parser.add_argument("--min-limit", type=int, default=4,
+                        help="daemon adaptive-admission floor (the default "
+                             "16 is sized for ms-scale checks; the pairing "
+                             "workload here drains ~3 rows/s)")
+    parser.add_argument("--sat-clients", type=int, default=4)
+    parser.add_argument("--sat-requests", type=int, default=8,
+                        help="saturation requests per client (each a pairing)")
+    parser.add_argument("--max-threads", type=int, default=64)
+    parser.add_argument("--goodput-margin", type=float, default=0.2,
+                        help="allowed goodput drop vs saturation (0.2 = 20%%)")
+    parser.add_argument("--recovery-p99-ms", type=float, default=500.0)
+    parser.add_argument("--ledger", default=None,
+                        help="perf-ledger path ('off' skips banking)")
+    parser.add_argument("--json", dest="json_path", type=pathlib.Path,
+                        default=None)
+    ns = parser.parse_args(argv)
+    return run_smoke(ns) if ns.smoke else run_full(ns)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

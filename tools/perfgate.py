@@ -45,7 +45,22 @@ jax import, no device, no tunnel):
                               epoch checkpoint asserted bit-identical
                               to an interpreted-oracle pass of the same
                               scenario — the sim hot loop the sentinel
-                              watches from round 8 on (docs/SIM.md).
+                              watches from round 8 on (docs/SIM.md);
+- ``perfgate_overload_goodput_ratio`` goodput under 3x open-loop
+                              overload as a fraction of measured
+                              saturation goodput, from the scaled-down
+                              in-process overload drill
+                              (serve/drill.py mini_drill: simulated
+                              flush service time, crypto-free checks,
+                              real admission/shed/deadline machinery).
+                              Gated TWO ways: relatively by the
+                              sentinel like every metric, and
+                              ABSOLUTELY against the no-collapse floor
+                              (:data:`OVERLOAD_FLOOR`) — a collapsing
+                              configuration fails the gate even on a
+                              cold ledger (chaos:
+                              ``perfgate_overload=0.5``), from round
+                              10 on (docs/SERVE.md "Overload control").
 
 Each run appends one ledger run (git sha + environment fingerprint) and
 is classified by :mod:`consensus_specs_tpu.obs.sentinel` against the
@@ -386,6 +401,38 @@ def measure_chain_sim_ms() -> float:
     return vectorized.seconds * 1e3 * _chaos_factor("perfgate_chain_sim_ms")
 
 
+def measure_overload_goodput_ratio() -> float:
+    """The overload-control drill, scaled down (docs/SERVE.md "Overload
+    control"): an in-process daemon whose flush pipeline has a
+    deterministic simulated service time is saturated closed-loop, then
+    offered 3x that rate open-loop with deadline budgets and a priority
+    mix. The metric is goodput (answered within deadline / s) as a
+    fraction of the saturation rate: ~1.0 means the daemon sheds the
+    excess and keeps serving; collapse drives it toward 0. The
+    measurement also asserts the drain's exactly-once accounting
+    (accepted == flushed + shed) — a fast number from a daemon that
+    drops work must fail here, not ship."""
+    from consensus_specs_tpu.serve.drill import mini_drill
+
+    report, drain = mini_drill(flush_delay_ms=50, sat_requests_per_client=8,
+                               overload_duration_s=1.2, deadline_ms=300,
+                               target_p99_ms=150, recovery_probes=10)
+    assert drain["accepted"] == drain["flushed_rows"] + drain["shed_rows"], (
+        f"drain accounting broken: {drain}")
+    assert drain["queue_drained"], "overload drill daemon failed to drain"
+    outcomes = report["overload"]["outcomes"]
+    assert outcomes["error"] == 0, f"transport errors under overload: {outcomes}"
+    assert report["recovery"]["settled"], "queue did not settle after load"
+    ratio = report["goodput_ratio"] or 0.0
+    return ratio * _chaos_factor("perfgate_overload_goodput_ratio")
+
+
+# the absolute no-collapse floor for the overload slice: goodput under
+# 3x overload must stay within this fraction of saturation goodput.
+# Absolute (like the SLO gate), because a cold ledger must still refuse
+# to ship a collapsing configuration.
+OVERLOAD_FLOOR = 0.6
+
 MEASUREMENTS: Tuple[Tuple[str, Callable[[], float]], ...] = (
     ("perfgate_hash_mibs", measure_hash_mibs),
     ("perfgate_reroot_ms", measure_reroot_ms),
@@ -394,6 +441,7 @@ MEASUREMENTS: Tuple[Tuple[str, Callable[[], float]], ...] = (
     ("perfgate_gen_shard_ms", measure_gen_shard_ms),
     ("perfgate_serve_rtt_ms", measure_serve_rtt_ms),
     ("perfgate_chain_sim_ms", measure_chain_sim_ms),
+    ("perfgate_overload_goodput_ratio", measure_overload_goodput_ratio),
 )
 
 
@@ -408,6 +456,7 @@ def run_gate(
 
     metrics: Dict[str, float] = {}
     skipped: Dict[str, str] = {}
+    slo_snap: Optional[Dict[str, Any]] = None
     for name, fn in MEASUREMENTS:
         try:
             metrics[name] = round(fn(), 4)
@@ -419,6 +468,14 @@ def run_gate(
                 skipped[name] = f"environmental: {e!r}"
                 continue
             return 2, {"error": f"{name} failed deterministically: {e!r}"}
+        if name == "perfgate_serve_rtt_ms":
+            # freeze the SLO evidence HERE: the overload slice below
+            # deliberately drives the daemon past capacity, and those
+            # drill latencies must not read as an SLO burn (sheds and
+            # overload-regime tails are load management, not outages)
+            from consensus_specs_tpu.obs import metrics as obs_metrics
+
+            slo_snap = obs_metrics.snapshot()
 
     env = ledger_mod.environment_fingerprint(
         perf_chaos=os.environ.get(PERF_CHAOS_ENV) or None)
@@ -438,15 +495,31 @@ def run_gate(
     # confirmed perf regression; an environmentally-skipped serving
     # slice is an environment gap and never does.
     slo_result = slo.gate(
+        slo_snap,
         skipped_environmental="perfgate_serve_rtt_ms" in skipped,
         chaos_factor=_chaos_factor)
     metrics.update(slo_result["points"])  # banked alongside the slice
+
+    # the overload no-collapse gate: ABSOLUTE, like the SLO gate — a
+    # goodput ratio under the floor is congestion collapse and fails
+    # even on a cold ledger; an environmentally-skipped slice never does
+    overload_ratio = metrics.get("perfgate_overload_goodput_ratio")
+    overload_result = {
+        "ok": overload_ratio is None or overload_ratio >= OVERLOAD_FLOOR,
+        "floor": OVERLOAD_FLOOR,
+        "observed": overload_ratio,
+        "verdict": ("environmental" if overload_ratio is None
+                    else "ok" if overload_ratio >= OVERLOAD_FLOOR
+                    else "collapsed"),
+    }
 
     run_id = led.record_run(
         metrics, source="perfgate", backend="host", environment=env,
         extra={"skipped": skipped or None, "sentinel": verdict_counts,
                "slo": {"ok": slo_result["ok"],
-                       "verdict": slo_result["verdict"]}})
+                       "verdict": slo_result["verdict"]},
+               "overload": {"ok": overload_result["ok"],
+                            "verdict": overload_result["verdict"]}})
 
     summary = {
         "run_id": run_id,
@@ -455,8 +528,10 @@ def run_gate(
         "skipped": skipped,
         "report": report.to_dict(),
         "slo": slo_result,
+        "overload": overload_result,
     }
-    code = 1 if (gate and not (report.ok and slo_result["ok"])) else 0
+    code = 1 if (gate and not (report.ok and slo_result["ok"]
+                               and overload_result["ok"])) else 0
     return code, summary
 
 
@@ -500,7 +575,16 @@ def print_summary(summary: Dict[str, Any]) -> None:
     if slo_sum:
         print(f"slo: {slo_sum.get('verdict', '?')}"
               + (f" — {slo_sum['detail']}" if slo_sum.get("detail") else ""))
-    print(f"perfgate: gate {'PASSED' if (sentinel_ok and slo_ok) else 'FAILED'}")
+    over = summary.get("overload") or {}
+    over_ok = over.get("ok", True)
+    if over:
+        observed = over.get("observed")
+        obs_txt = f"{observed:g}" if observed is not None else "skipped"
+        print(f"overload: goodput ratio {obs_txt} "
+              f"(floor {over.get('floor', OVERLOAD_FLOOR):g})  "
+              f"[{over.get('verdict', '?')}]")
+    print(f"perfgate: gate "
+          f"{'PASSED' if (sentinel_ok and slo_ok and over_ok) else 'FAILED'}")
 
 
 def main(argv: Optional[List[str]] = None) -> int:

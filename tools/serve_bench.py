@@ -6,6 +6,8 @@ ledger (docs/SERVE.md).
 Usage:
     python tools/serve_bench.py [--clients N] [--requests R]
                                 [--distinct D] [--batch-every K]
+                                [--open-loop RATE] [--duration S]
+                                [--deadline-ms D]
                                 [--ledger P] [--json OUT] [--quick]
 
 Shape: a daemon subprocess (reference BLS on a host-only box — the
@@ -28,6 +30,17 @@ Ledger keys (source="serve_bench"):
 a trajectory point is interpretable. The sentinel gates the
 ``perfgate_serve_rtt_ms`` twin in `make perfgate`; this harness banks
 the heavier concurrent evidence.
+
+``--open-loop RATE`` switches the timed window to a fixed ARRIVAL rate
+(serve/drill.py's open-loop driver): requests fire on a schedule
+independent of completions, so offered load can exceed capacity — the
+closed-loop harness above can never observe that regime because its
+threads back off with the daemon. Open-loop runs bank their own series
+alongside the closed-loop ones (source="serve_bench_ol"):
+    serve_ol_p50_ms / serve_ol_p99_ms   round trip of in-deadline answers
+    serve_ol_goodput_per_s              answered-within-deadline / s
+with the offered rate, shed ratio and per-outcome tallies in ``extra``
+(docs/SERVE.md "Overload control").
 """
 from __future__ import annotations
 
@@ -47,6 +60,11 @@ sys.path.insert(0, str(REPO))
 from consensus_specs_tpu import obs  # noqa: E402
 from consensus_specs_tpu.serve.client import ServeClient  # noqa: E402
 from consensus_specs_tpu.serve.protocol import to_hex  # noqa: E402
+
+
+class _OpenLoopDone(Exception):
+    """Control flow: the open-loop window finished; fall through to the
+    shared daemon-drain epilogue with its exit code."""
 
 
 def build_population(distinct: int) -> List[Dict[str, Any]]:
@@ -159,6 +177,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--batch-every", type=int, default=10,
                         help="every K-th request is a 32-check "
                              "verify_batch (0 = singles only)")
+    parser.add_argument("--open-loop", type=float, default=None,
+                        metavar="RATE",
+                        help="fixed arrival rate (req/s) instead of the "
+                             "closed-loop thread drive — offered load may "
+                             "exceed capacity (docs/SERVE.md)")
+    parser.add_argument("--duration", type=float, default=10.0,
+                        help="open-loop window seconds")
+    parser.add_argument("--deadline-ms", type=float, default=1000.0,
+                        help="open-loop per-request deadline budget")
     parser.add_argument("--ledger", default=None,
                         help="perf-ledger path ('off' skips banking)")
     parser.add_argument("--json", dest="json_path", type=pathlib.Path,
@@ -173,6 +200,66 @@ def main(argv: Optional[List[str]] = None) -> int:
     import tempfile
 
     return run_bench(ns, pathlib.Path(tempfile.mkdtemp(prefix="serve_bench_")))
+
+
+def run_open_loop(ns: argparse.Namespace, port: int,
+                  client: ServeClient, population: List[Dict[str, Any]]) -> int:
+    """The fixed-arrival-rate window (serve/drill.py): offered load is
+    ``--open-loop`` req/s regardless of completions; goodput, shed
+    outcomes and in-deadline latency bank alongside the closed-loop
+    series. Runs inside run_bench's daemon lifecycle (the caller's
+    finally drains it)."""
+    from consensus_specs_tpu.serve import drill
+
+    stats = drill.open_loop(
+        port, rate_per_s=ns.open_loop, duration_s=ns.duration,
+        make_check=lambda i: population[i % len(population)],
+        deadline_ms=ns.deadline_ms, max_threads=64)
+    health = client.health()
+    client.close()
+    out = stats["outcomes"]
+    print(f"serve_bench[open-loop]: offered {stats['offered']} @ "
+          f"{stats['offered_rate_per_s']}/s for {stats['duration_s']}s "
+          f"-> goodput {stats['goodput_per_s']}/s "
+          f"(shed ratio {stats['shed_ratio']}), outcomes {out}")
+    print(f"serve_bench[open-loop]: p50={stats['ok_p50_ms']} "
+          f"p99={stats['ok_p99_ms']} (in-deadline answers) "
+          f"overload={health.get('overload', {}).get('limit')}")
+    exit_code = 0 if out["error"] == 0 else 1
+
+    metrics = {
+        "serve_ol_p50_ms": (round(stats["ok_p50_ms"], 3)
+                            if stats["ok_p50_ms"] is not None else None),
+        "serve_ol_p99_ms": (round(stats["ok_p99_ms"], 3)
+                            if stats["ok_p99_ms"] is not None else None),
+        "serve_ol_goodput_per_s": stats["goodput_per_s"],
+    }
+    summary: Dict[str, Any] = {
+        "mode": "open_loop", "metrics": metrics,
+        "offered_rate_per_s": stats["offered_rate_per_s"],
+        "deadline_ms": ns.deadline_ms,
+        "outcomes": out, "shed_ratio": stats["shed_ratio"],
+        "lagged": stats["lagged"],
+    }
+    if (ns.ledger or "").strip().lower() not in ("off", "none", "0") \
+            and exit_code == 0:
+        from consensus_specs_tpu.obs import ledger as ledger_mod
+
+        path = ns.ledger or ledger_mod.default_path()
+        if path:
+            run_id = ledger_mod.Ledger(path).record_run(
+                {k: v for k, v in metrics.items() if v is not None},
+                source="serve_bench_ol", backend="host",
+                extra={"offered_rate_per_s": stats["offered_rate_per_s"],
+                       "deadline_ms": ns.deadline_ms,
+                       "shed_ratio": stats["shed_ratio"],
+                       "outcomes": out})
+            summary["ledger"] = {"path": path, "run_id": run_id}
+            print(f"serve_bench[open-loop]: banked as {run_id} -> {path}")
+    if ns.json_path is not None:
+        with open(ns.json_path, "w") as f:
+            json.dump(summary, f, indent=2, sort_keys=True)
+    return exit_code
 
 
 def run_bench(ns: argparse.Namespace, tmp: pathlib.Path) -> int:
@@ -192,6 +279,12 @@ def run_bench(ns: argparse.Namespace, tmp: pathlib.Path) -> int:
         assert all(warm), "population must verify True"
         print(f"serve_bench: population resolved (one-time crypto) in "
               f"{time.perf_counter() - t0:.1f}s")
+
+        if ns.open_loop:
+            # the drain-check in the finally below still applies: a
+            # daemon that fails to drain flips the exit code
+            exit_code = run_open_loop(ns, port, client, population)
+            raise _OpenLoopDone
 
         stats = drive(port, ns.clients, ns.requests, ns.batch_every,
                       population)
@@ -246,6 +339,8 @@ def run_bench(ns: argparse.Namespace, tmp: pathlib.Path) -> int:
         if ns.json_path is not None:
             with open(ns.json_path, "w") as f:
                 json.dump(summary, f, indent=2, sort_keys=True)
+    except _OpenLoopDone:
+        pass
     finally:
         proc.send_signal(signal.SIGTERM)
         try:

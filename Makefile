@@ -25,7 +25,7 @@ DEVICE_TESTS = tests/test_bls_device.py tests/test_curve_device.py \
 .PHONY: test citest test-fast test-device test-mainnet lint docs generate_tests gen_% replay bench \
         dryrun detect_generator_incomplete clean-vectors chaos trace perfgate perf-report gen-bench \
         gen-shard-smoke warm-cache serve serve-smoke serve-bench serve-canary slo-report sim \
-        sim-smoke device-probe overload-drill overload-smoke help
+        sim-smoke device-probe overload-drill overload-smoke fleet-drill fleet-smoke help
 
 # the fault-injection suite: supervisor/taxonomy units, chaos replay
 # (tampered vectors), induced backend failures, generator crash/resume
@@ -57,6 +57,8 @@ help:
 	@echo "serve-canary          black-box daemon prober (incl. invalid-signature correctness probe): availability/latency -> $(LEDGER)"
 	@echo "overload-drill        open-loop overload drill at ~3x measured capacity: goodput/shed-ratio/recovery + differential corpus -> $(LEDGER)"
 	@echo "overload-smoke        scaled-down deterministic overload drill (in-process, jax-free; the citest slice)"
+	@echo "fleet-drill           serve-fleet drill: 1..N replica goodput scaling, 3x-overload hold, kill-one-replica zero-dropped + bit-identity -> $(LEDGER)"
+	@echo "fleet-smoke           scaled-down jax-free fleet drill (2 forked replicas, kill-one mid-workload, zero-dropped assert; the citest slice)"
 	@echo "slo-report            serve SLO report: objectives, latest observations, 1h/6h/24h burn rates over $(LEDGER)"
 	@echo "sim                   2048-slot seeded chain simulation (forks/reorgs/equivocations), vectorized-vs-oracle differential + chaos drill -> $(LEDGER)"
 	@echo "sim-smoke             short chain-sim differential + chaos drill (the citest slice; docs/SIM.md)"
@@ -84,6 +86,7 @@ citest:
 	$(MAKE) serve-smoke
 	$(MAKE) serve-canary
 	$(MAKE) overload-smoke
+	$(MAKE) fleet-smoke
 	$(MAKE) perfgate
 	$(MAKE) slo-report
 
@@ -154,6 +157,21 @@ overload-drill:
 
 overload-smoke:
 	$(PYTHON) tools/overload_drill.py --smoke
+
+# the serve fleet drill (docs/SERVE.md "Fleet", ROADMAP #1): a real
+# forked replica fleet behind FleetClient routers — 1..N goodput
+# scaling curve (near-linear needs a multi-core box; 1-CPU results are
+# recorded environment-limited like the gen-shard sweep), goodput held
+# >=80% at 3x fleet saturation, and a kill-one-replica run with zero
+# dropped (not shed) requests and answers bit-identical to the direct
+# path; fleet_goodput_per_s + the replicas-vs-goodput curve bank in the
+# ledger. The smoke is the scaled-down jax-free twin wired into citest.
+FLEET_REPLICAS ?= 4
+fleet-drill:
+	$(PYTHON) tools/fleet_drill.py --replicas $(FLEET_REPLICAS) --ledger $(LEDGER)
+
+fleet-smoke:
+	$(PYTHON) tools/fleet_drill.py --smoke
 
 # the chain simulator (docs/SIM.md, ROADMAP #5): a seeded long-horizon
 # "mainnet day" through fork choice + full state transitions, the

@@ -189,6 +189,43 @@ def prometheus_text(snap: Optional[Dict[str, Any]] = None) -> str:
     return "\n".join(lines) + ("\n" if lines else "")
 
 
+def parse_prometheus(text: str) -> Dict[str, float]:
+    """Prometheus text exposition -> ``{series-line-key: value}``.
+    The key is the full series identity (name incl. any ``{labels}``),
+    so two expositions aggregate line-for-line. Comment/TYPE lines and
+    unparseable values are skipped."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        key, _, value = line.rpartition(" ")
+        if not key:
+            continue
+        try:
+            out[key] = float(value)
+        except ValueError:
+            continue
+    return out
+
+
+def aggregate_prometheus(texts: List[str]) -> Dict[str, float]:
+    """Fleet-level /metrics rollup (docs/SERVE.md "Fleet"): counters,
+    histogram ``_bucket``/``_sum``/``_count`` series, and gauges SUM
+    across replicas; percentile/quantile summary gauges (``_p50`` etc.)
+    take the MAX instead — a fleet's pessimistic tail, since summing
+    per-replica percentiles is meaningless."""
+    out: Dict[str, float] = {}
+    quantile = re.compile(r"_p\d+(\{|$)|quantile=")
+    for text in texts:
+        for key, value in parse_prometheus(text).items():
+            if quantile.search(key):
+                out[key] = max(out.get(key, value), value)
+            else:
+                out[key] = out.get(key, 0.0) + value
+    return out
+
+
 def reset() -> None:
     """Test hook: drop all aggregates."""
     with _lock:

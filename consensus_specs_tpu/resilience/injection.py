@@ -38,6 +38,19 @@ Sites are plain strings; the convention is plane.point:
                fixed bound; hang=the accept path's staleness watchdog
                trips the same quarantine WITHOUT ever blocking a
                request — docs/SERVE.md "Overload control")
+  serve.replica (every fleet replica's supervise-loop tick, INSIDE the
+               forked replica process — docs/SERVE.md "Fleet": kill=the
+               replica SIGKILLs itself and the FleetSupervisor respawns
+               the slot, which rejoins via /readyz; transient=the
+               replica exits EX_TEMPFAIL, same respawn path;
+               deterministic=the replica exits EX_CONFIG and the slot
+               is quarantined — the ring shrinks and only its ~K/N keys
+               move; hang=the loop stops beating the daemon heartbeat,
+               /readyz flips 503 "stale", and routers steer around it
+               via health staleness. Arm with
+               CONSENSUS_SPECS_TPU_CHAOS_STATE pointed at a scratch
+               file so "kill:1" means ONE replica across the fleet,
+               not one per process — tests/test_serve_fleet.py)
   sim.step (top of every chain-simulator slot step, BEFORE any state
             mutation: transients retry the clean step, deterministic
             faults quarantine the site and every later step degrades to

@@ -35,6 +35,18 @@ shape inference stacks use to amortize compilation and dispatch.
   drill harness shared by ``tools/overload_drill.py``,
   ``tools/serve_bench.py --open-loop`` and perfgate's
   ``perfgate_overload_goodput_ratio`` slice.
+- :mod:`ring` — the consistent-hash ring (key→replica affinity, ≤K/N
+  remap on membership change, the coordination-free failover chain).
+- :mod:`fleet` — the replica fleet (ISSUE 11, ROADMAP #1):
+  ``FleetSupervisor`` forks N daemon replicas (COW spec matrix, shared
+  compile cache, per-replica ports + ready/drain journals), supervises
+  them with the resilience taxonomy (transient death → respawn-and-
+  rejoin via ``/readyz``, deterministic → quarantine + ring shrink,
+  hang → heartbeat-stale ``/readyz`` routed around), aggregates fleet
+  ``/metrics``+``/healthz``+SLO burn, and hands off drains; chaos site
+  ``serve.replica``. ``FleetClient`` (in :mod:`client`) is the
+  shard-aware router: affinity routing, health/drain-aware dispatch,
+  idempotency-keyed failover (exactly-once), fleet-shared RetryBudget.
 
 Request observability (ISSUE 7): every wire body MAY carry an optional
 W3C-shaped ``trace`` field — ``ServeClient`` injects it from the active
@@ -66,8 +78,10 @@ from .batcher import (  # noqa: F401
     Shed,
     VerifyBatcher,
 )
-from .client import RetryBudget, ServeClient, ServeError  # noqa: F401
-from .daemon import ServeDaemon  # noqa: F401
+from .client import FleetClient, RetryBudget, ServeClient, ServeError  # noqa: F401
+from .daemon import IdemCache, ServeDaemon  # noqa: F401
+from .fleet import FleetConfig, FleetSupervisor  # noqa: F401
 from .lifecycle import warm_start  # noqa: F401
 from .protocol import WIRE_VERSION, RequestError  # noqa: F401
+from .ring import HashRing  # noqa: F401
 from .service import SpecService  # noqa: F401

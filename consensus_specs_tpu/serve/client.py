@@ -32,11 +32,12 @@ import random
 import socket
 import threading
 import time
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from .. import obs
 from ..obs import flightrec
 from . import protocol
+from .ring import HashRing
 
 
 class ServeError(Exception):
@@ -83,7 +84,59 @@ class RetryBudget:
             return self._tokens
 
 
-class ServeClient:
+class _WireCalls:
+    """The typed wire-method surface, defined over ``self.call`` so the
+    single-daemon client and the fleet router share one implementation."""
+
+    def call(self, method: str, params: Dict[str, Any],
+             deadline_ms: Optional[float] = None,
+             priority: Optional[str] = None) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def verify(self, *, pubkeys: Optional[Sequence[bytes]] = None,
+               pubkey: Optional[bytes] = None,
+               message: Optional[bytes] = None,
+               messages: Optional[Sequence[bytes]] = None,
+               signature: bytes,
+               deadline_ms: Optional[float] = None,
+               priority: Optional[str] = None) -> bool:
+        params: Dict[str, Any] = {"signature": protocol.to_hex(signature)}
+        if pubkey is not None:
+            params["pubkey"] = protocol.to_hex(pubkey)
+        if pubkeys is not None:
+            params["pubkeys"] = [protocol.to_hex(p) for p in pubkeys]
+        if message is not None:
+            params["message"] = protocol.to_hex(message)
+        if messages is not None:
+            params["messages"] = [protocol.to_hex(m) for m in messages]
+        return bool(self.call("verify", params, deadline_ms=deadline_ms,
+                              priority=priority)["valid"])
+
+    def verify_batch(self, checks: List[Dict[str, Any]],
+                     deadline_ms: Optional[float] = None,
+                     priority: Optional[str] = None) -> List[bool]:
+        return list(self.call("verify_batch", {"checks": checks},
+                              deadline_ms=deadline_ms,
+                              priority=priority)["results"])
+
+    def hash_tree_root(self, fork: str, preset: str, type_name: str,
+                       ssz_bytes: bytes) -> bytes:
+        out = self.call("hash_tree_root", {
+            "fork": fork, "preset": preset, "type": type_name,
+            "ssz": protocol.to_hex(ssz_bytes)})
+        return protocol.from_hex(out["root"], "root")
+
+    def process_block(self, fork: str, preset: str, pre_ssz: bytes,
+                      block_ssz: bytes) -> Dict[str, bytes]:
+        out = self.call("process_block", {
+            "fork": fork, "preset": preset,
+            "pre": protocol.to_hex(pre_ssz),
+            "block": protocol.to_hex(block_ssz)})
+        return {"post": protocol.from_hex(out["post"], "post"),
+                "root": protocol.from_hex(out["root"], "root")}
+
+
+class ServeClient(_WireCalls):
     def __init__(self, port: int, host: str = "127.0.0.1",
                  timeout_s: float = 120.0,
                  *,
@@ -244,50 +297,6 @@ class ServeClient:
                 params[protocol.TRACE_FIELD] = tp
             return self._roundtrip("POST", protocol.route_for(method), params)
 
-    # -- the wire methods ----------------------------------------------
-
-    def verify(self, *, pubkeys: Optional[Sequence[bytes]] = None,
-               pubkey: Optional[bytes] = None,
-               message: Optional[bytes] = None,
-               messages: Optional[Sequence[bytes]] = None,
-               signature: bytes,
-               deadline_ms: Optional[float] = None,
-               priority: Optional[str] = None) -> bool:
-        params: Dict[str, Any] = {"signature": protocol.to_hex(signature)}
-        if pubkey is not None:
-            params["pubkey"] = protocol.to_hex(pubkey)
-        if pubkeys is not None:
-            params["pubkeys"] = [protocol.to_hex(p) for p in pubkeys]
-        if message is not None:
-            params["message"] = protocol.to_hex(message)
-        if messages is not None:
-            params["messages"] = [protocol.to_hex(m) for m in messages]
-        return bool(self.call("verify", params, deadline_ms=deadline_ms,
-                              priority=priority)["valid"])
-
-    def verify_batch(self, checks: List[Dict[str, Any]],
-                     deadline_ms: Optional[float] = None,
-                     priority: Optional[str] = None) -> List[bool]:
-        return list(self.call("verify_batch", {"checks": checks},
-                              deadline_ms=deadline_ms,
-                              priority=priority)["results"])
-
-    def hash_tree_root(self, fork: str, preset: str, type_name: str,
-                       ssz_bytes: bytes) -> bytes:
-        out = self.call("hash_tree_root", {
-            "fork": fork, "preset": preset, "type": type_name,
-            "ssz": protocol.to_hex(ssz_bytes)})
-        return protocol.from_hex(out["root"], "root")
-
-    def process_block(self, fork: str, preset: str, pre_ssz: bytes,
-                      block_ssz: bytes) -> Dict[str, bytes]:
-        out = self.call("process_block", {
-            "fork": fork, "preset": preset,
-            "pre": protocol.to_hex(pre_ssz),
-            "block": protocol.to_hex(block_ssz)})
-        return {"post": protocol.from_hex(out["post"], "post"),
-                "root": protocol.from_hex(out["root"], "root")}
-
     # -- observability -------------------------------------------------
 
     def metrics(self) -> str:
@@ -299,5 +308,223 @@ class ServeClient:
     def ready(self) -> bool:
         try:
             return bool(self._roundtrip("GET", "/readyz").get("ready"))
-        except (ServeError, OSError):
+        except (ServeError, OSError, http.client.HTTPException):
             return False
+
+
+# ---------------------------------------------------------------------------
+# the fleet router (docs/SERVE.md "Fleet")
+# ---------------------------------------------------------------------------
+
+# errors that justify re-sending the SAME request to the NEXT ring
+# replica: the replica is gone/going (torn socket, refused connect,
+# timeout, draining) or full (queue_full spills to a sibling with
+# capacity). Sheds/deadlines/bad requests NEVER fail over — the fleet
+# is saying "stop", or the request itself is wrong on every replica.
+FAILOVER_CODES = (protocol.DRAINING, protocol.QUEUE_FULL)
+
+
+class _ReplicaState:
+    """Router-side view of one replica: its keep-alive client, the
+    down-mark backoff, and the TTL-cached /readyz verdict."""
+
+    __slots__ = ("name", "port", "client", "down_until",
+                 "ready_checked", "ready")
+
+    def __init__(self, name: str, port: int, client: ServeClient) -> None:
+        self.name = name
+        self.port = port
+        self.client = client
+        self.down_until = 0.0
+        self.ready_checked = float("-inf")  # first use always probes
+        self.ready = True
+
+
+class FleetClient(_WireCalls):
+    """Shard-aware failover router over a fleet of daemon replicas.
+
+    Routing: each request's *identity* (``protocol.affinity_key`` — the
+    params minus volatile fields) hashes onto a consistent-hash ring of
+    replica names, so repeat traffic for one key lands on one replica
+    (its LRU result cache and warm BLS bucket shapes stay hot) and a
+    membership change moves only ~K/N keys. Health/drain awareness:
+    replicas are dispatched optimistically, but each replica's
+    ``/readyz`` is re-probed at most every ``health_ttl_s`` — a draining
+    or heartbeat-stale replica answers 503 there and is routed around —
+    and a replica that fails a request transport-wise is marked down for
+    ``down_backoff_s`` before being re-probed.
+
+    Failover exactly-once: every logical request carries ONE idempotency
+    key across all its sends. An unanswered request (torn socket,
+    timeout, refused connect, ``draining``/``queue_full`` refusal)
+    re-sends to the next replica in the key's ring chain under the same
+    key; a replica that already answered it replays its stored response
+    from the idempotency cache instead of executing twice, and replicas
+    that never saw it compute the same answer by purity — the caller
+    receives exactly one answer, never a dropped request, never double
+    work on one replica. Re-sends spend the **fleet-shared**
+    :class:`RetryBudget` (pass one budget to every router in a client
+    fleet): when the bucket is empty the error surfaces instead of
+    joining a retry storm — the metastable-failure guard, fleet-wide.
+
+    Tracing: every logical request runs under ONE ``serve.route`` span
+    (attrs: chosen replica, failover count); each send is a
+    ``serve.client`` child injecting the SAME trace context, so failover
+    re-sends stay linked to the original trace id across processes.
+
+    Like ServeClient, one FleetClient is NOT thread-safe — one per
+    thread, sharing a membership callable and a RetryBudget.
+    """
+
+    def __init__(self, members: Any, *,
+                 timeout_s: float = 30.0,
+                 retry_budget: Optional[RetryBudget] = None,
+                 health_ttl_s: float = 0.5,
+                 down_backoff_s: float = 1.0,
+                 deadline_ms: Optional[float] = None,
+                 priority: Optional[str] = None,
+                 host: str = "127.0.0.1",
+                 rng: Optional[random.Random] = None) -> None:
+        # members: a callable returning [(name, port), ...] (live view —
+        # e.g. FleetSupervisor.members) or a static sequence of pairs
+        self._members_fn = members if callable(members) else (lambda: members)
+        self.timeout_s = timeout_s
+        self.retry_budget = retry_budget if retry_budget is not None \
+            else RetryBudget()
+        self.health_ttl_s = health_ttl_s
+        self.down_backoff_s = down_backoff_s
+        self.deadline_ms = deadline_ms
+        self.priority = priority
+        self.host = host
+        self._rng = rng or random.Random()
+        self._ring = HashRing()
+        self._replicas: Dict[str, _ReplicaState] = {}
+        self._membership: Tuple = ()
+        self.failovers = 0
+
+    # -- membership ----------------------------------------------------
+
+    def _refresh(self) -> None:
+        snapshot = tuple(sorted((str(n), int(p))
+                                for n, p in self._members_fn()))
+        if snapshot == self._membership:
+            return
+        self._membership = snapshot
+        live = {name: port for name, port in snapshot}
+        for name in list(self._replicas):
+            state = self._replicas[name]
+            if name not in live:
+                state.client.close()
+                del self._replicas[name]
+                self._ring.remove(name)
+            elif state.port != live[name]:
+                # respawned on a new port: same ring slot, fresh socket
+                state.client.close()
+                del self._replicas[name]
+                self._ring.remove(name)
+        for name, port in snapshot:
+            if name not in self._replicas:
+                self._replicas[name] = _ReplicaState(
+                    name, port, ServeClient(port, host=self.host,
+                                            timeout_s=self.timeout_s,
+                                            max_retries=0))
+                self._ring.add(name)
+
+    def close(self) -> None:
+        for state in self._replicas.values():
+            state.client.close()
+
+    def __enter__(self) -> "FleetClient":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+    # -- health gating -------------------------------------------------
+
+    def _usable(self, state: _ReplicaState, now: float) -> bool:
+        if now < state.down_until:
+            return False
+        if now - state.ready_checked > self.health_ttl_s:
+            state.ready = state.client.ready()
+            state.ready_checked = now
+            if not state.ready:
+                # draining / heartbeat-stale / dead: routed around until
+                # the next TTL probe says otherwise
+                state.down_until = now + self.down_backoff_s
+        return state.ready
+
+    def _mark_down(self, state: _ReplicaState) -> None:
+        state.ready = False
+        state.ready_checked = time.monotonic()
+        state.down_until = state.ready_checked + self.down_backoff_s
+
+    # -- routing -------------------------------------------------------
+
+    @staticmethod
+    def _failover_worthy(e: BaseException) -> bool:
+        if isinstance(e, ServeError):
+            return e.code in FAILOVER_CODES
+        return isinstance(e, (OSError, http.client.HTTPException,
+                              TimeoutError))
+
+    def call(self, method: str, params: Dict[str, Any],
+             deadline_ms: Optional[float] = None,
+             priority: Optional[str] = None) -> Dict[str, Any]:
+        """Route one wire method call: affinity replica first, then the
+        ring chain with the same idempotency key, spending the shared
+        retry budget per re-send."""
+        deadline_ms = deadline_ms if deadline_ms is not None else self.deadline_ms
+        priority = priority if priority is not None else self.priority
+        self._refresh()
+        send = dict(params)
+        send.setdefault(protocol.IDEM_FIELD,
+                        f"{self._rng.getrandbits(64):016x}")
+        key = protocol.affinity_key(method, params)
+        chain = self._ring.chain(key)
+        if not chain:
+            raise ServeError(503, protocol.DRAINING,
+                             "fleet has no routable members")
+        obs.count("serve.route.requests")
+        self.retry_budget.deposit()
+        with obs.span("serve.route", method=method,
+                      owner=chain[0]) as route_sp:
+            now = time.monotonic()
+            candidates = [self._replicas[n] for n in chain
+                          if self._usable(self._replicas[n], now)]
+            if not candidates:
+                # everything marked down: dispatch the raw chain anyway
+                # (a request must never be stranded by stale marks)
+                candidates = [self._replicas[n] for n in chain]
+            last_err: Optional[BaseException] = None
+            for attempt, state in enumerate(candidates):
+                if attempt > 0:
+                    if not self.retry_budget.try_spend():
+                        # re-sending without budget would turn one
+                        # replica failure into a fleet-wide retry storm
+                        obs.count("serve.route.budget_exhausted")
+                        assert last_err is not None
+                        raise last_err
+                    obs.count("serve.route.failover")
+                    self.failovers += 1
+                try:
+                    result = state.client.call(method, send,
+                                               deadline_ms=deadline_ms,
+                                               priority=priority)
+                except (ServeError, OSError, http.client.HTTPException,
+                        TimeoutError) as e:
+                    if not self._failover_worthy(e):
+                        raise
+                    if not (isinstance(e, ServeError)
+                            and e.code == protocol.QUEUE_FULL):
+                        self._mark_down(state)  # full != unhealthy
+                    last_err = e
+                    self._refresh()  # a respawn may already have landed
+                    continue
+                if route_sp.span_id is not None:
+                    route_sp.attrs["replica"] = state.name
+                    route_sp.attrs["port"] = state.port
+                    route_sp.attrs["failovers"] = attempt
+                return result
+            assert last_err is not None
+            raise last_err

@@ -47,8 +47,9 @@ import signal
 import sys
 import threading
 import time
+from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from .. import obs
 from ..obs import flightrec
@@ -70,6 +71,49 @@ def _env_float(name: str, default: float) -> float:
         return float(os.environ.get(name, "") or default)
     except ValueError:
         return default
+
+
+class IdemCache:
+    """Bounded LRU of completed (status, payload) responses keyed by the
+    wire ``idem`` field (docs/SERVE.md "Fleet"): a failover router
+    re-sends an unanswered request — to the next ring replica, or to the
+    SAME replica after a torn connection — under one idempotency key, and
+    a replica that already answered it replays the stored response
+    instead of executing twice. Only *settled* outcomes are stored
+    (200s and deterministic 400/404s); transient refusals
+    (queue_full/shed/deadline/draining/internal) are not, because a
+    re-send SHOULD re-attempt those. Thread-safe (handler threads)."""
+
+    def __init__(self, capacity: int = 2048) -> None:
+        self.capacity = max(0, int(capacity))
+        self._entries: "OrderedDict[str, Tuple[int, Dict[str, Any]]]" = \
+            OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.stored = 0
+
+    def get(self, key: str) -> Optional[Tuple[int, Dict[str, Any]]]:
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+            return hit
+
+    def put(self, key: str, status: int, payload: Dict[str, Any]) -> None:
+        if not self.capacity:
+            return
+        with self._lock:
+            self._entries[key] = (status, payload)
+            self._entries.move_to_end(key)
+            self.stored += 1
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"size": len(self._entries), "hits": self.hits,
+                    "capacity": self.capacity}
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -119,12 +163,16 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_text(200, obs.prometheus_text(),
                             "text/plain; version=0.0.4; charset=utf-8")
         elif path == "/healthz":
-            self._send_json(200, daemon.service.health(draining=daemon.draining))
+            health = daemon.service.health(draining=daemon.draining)
+            health["idem_cache"] = daemon.idem_cache.stats()
+            self._send_json(200, health)
         elif path == "/readyz":
-            ready = daemon.service.ready and not daemon.draining
+            stale = daemon.heartbeat_stale
+            ready = daemon.service.ready and not daemon.draining and not stale
             self._send_json(200 if ready else 503,
                             {"ready": ready,
                              "status": "draining" if daemon.draining
+                             else "stale" if stale
                              else "ready" if daemon.service.ready
                              else "starting"})
         elif path == "/debug/requests":
@@ -180,6 +228,8 @@ class _Handler(BaseHTTPRequestHandler):
             return
         with daemon.track_request():
             flightrec.begin(method)
+            idem: Optional[str] = None
+            settled = False  # settled outcomes enter the idem cache
             try:
                 length = int(self.headers.get("Content-Length") or 0)
                 if length > MAX_BODY_BYTES:
@@ -187,6 +237,17 @@ class _Handler(BaseHTTPRequestHandler):
                         f"body too large ({length} > {MAX_BODY_BYTES})")
                 params = protocol.loads(self.rfile.read(length))
                 protocol.check_version(params)
+                idem = protocol.request_idem(params)
+                if idem is not None:
+                    replay = daemon.idem_cache.get(idem)
+                    if replay is not None:
+                        # a failover router re-sent a request this
+                        # replica already answered: replay the stored
+                        # response — exactly-once execution per replica
+                        obs.count("serve.idem_hits")
+                        flightrec.commit(status="idem_replay")
+                        self._send_json(replay[0], replay[1])
+                        return
                 wire_trace = obs.parse_traceparent(
                     params.get(protocol.TRACE_FIELD))
                 if wire_trace is not None:
@@ -195,27 +256,28 @@ class _Handler(BaseHTTPRequestHandler):
             except protocol.RequestError as e:
                 obs.count("serve.errors.bad_request")
                 flightrec.commit(status=e.code, error=e.message)
-                self._send_json(e.http_status,
-                                protocol.error_response(e.code, e.message))
+                status, payload = e.http_status, protocol.error_response(
+                    e.code, e.message)
+                settled = True  # a malformed request stays malformed
             except QueueFull as e:
                 flightrec.commit(status=protocol.QUEUE_FULL, error=str(e))
-                self._send_json(429, protocol.error_response(
-                    protocol.QUEUE_FULL, str(e)))
+                status, payload = 429, protocol.error_response(
+                    protocol.QUEUE_FULL, str(e))
             except DeadlineExceeded as e:
                 # a shed, not a fault: answered structured (504), never
                 # counted against availability, excluded from /debug/slowest
                 flightrec.commit(status="shed_deadline", error=str(e))
-                self._send_json(
-                    protocol.HTTP_STATUS[protocol.DEADLINE_EXCEEDED],
-                    protocol.error_response(protocol.DEADLINE_EXCEEDED, str(e)))
+                status = protocol.HTTP_STATUS[protocol.DEADLINE_EXCEEDED]
+                payload = protocol.error_response(
+                    protocol.DEADLINE_EXCEEDED, str(e))
             except Shed as e:
                 flightrec.commit(status="shed_priority", error=str(e))
-                self._send_json(protocol.HTTP_STATUS[protocol.SHED],
-                                protocol.error_response(protocol.SHED, str(e)))
+                status = protocol.HTTP_STATUS[protocol.SHED]
+                payload = protocol.error_response(protocol.SHED, str(e))
             except Draining as e:
                 flightrec.commit(status=protocol.DRAINING, error=str(e))
-                self._send_json(503, protocol.error_response(
-                    protocol.DRAINING, str(e)))
+                status, payload = 503, protocol.error_response(
+                    protocol.DRAINING, str(e))
             except Exception as e:
                 from ..resilience import classify, record_event
 
@@ -225,13 +287,17 @@ class _Handler(BaseHTTPRequestHandler):
                 obs.count("serve.errors.internal")
                 flightrec.commit(status=protocol.INTERNAL,
                                  error=f"[{kind}] {type(e).__name__}: {e}")
-                self._send_json(500, protocol.error_response(
+                status, payload = 500, protocol.error_response(
                     protocol.INTERNAL,
-                    f"[{kind}] {type(e).__name__}: {e}"))
+                    f"[{kind}] {type(e).__name__}: {e}")
             else:
                 obs.count("serve.responses")
                 flightrec.commit(status="ok")
-                self._send_json(200, protocol.ok_response(result))
+                status, payload = 200, protocol.ok_response(result)
+                settled = True
+            if idem is not None and settled:
+                daemon.idem_cache.put(idem, status, payload)
+            self._send_json(status, payload)
 
 
 class _Server(ThreadingHTTPServer):
@@ -254,18 +320,38 @@ class ServeDaemon:
         host: str = "127.0.0.1",
         port: int = 0,
         verbose: bool = False,
+        idem_cache_size: int = 2048,
+        heartbeat_stale_s: Optional[float] = None,
     ) -> None:
         self.service = service or SpecService()
         self.host = host
         self.requested_port = port
         self.verbose = verbose
         self.draining = False
+        self.idem_cache = IdemCache(idem_cache_size)
+        # fleet replicas run a supervise loop that beats this; /readyz
+        # goes 503 "stale" when the loop stops beating (a hung replica
+        # must advertise itself un-routable — docs/SERVE.md "Fleet").
+        # None (the default, non-fleet daemon) disables the gate.
+        self.heartbeat_stale_s = heartbeat_stale_s
+        self._last_heartbeat = time.monotonic()
         self._server: Optional[_Server] = None
         self._serve_thread: Optional[threading.Thread] = None
         self._inflight = 0
         self._inflight_lock = threading.Lock()
         self._inflight_zero = threading.Event()
         self._inflight_zero.set()
+
+    # -- liveness heartbeat (fleet replicas) ---------------------------
+
+    def heartbeat(self) -> None:
+        self._last_heartbeat = time.monotonic()
+
+    @property
+    def heartbeat_stale(self) -> bool:
+        return (self.heartbeat_stale_s is not None
+                and time.monotonic() - self._last_heartbeat
+                > self.heartbeat_stale_s)
 
     # -- in-flight accounting ------------------------------------------
 

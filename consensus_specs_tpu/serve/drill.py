@@ -100,10 +100,25 @@ def default_priority_mix(i: int) -> str:
 # load drivers
 # ---------------------------------------------------------------------------
 
-def closed_loop(port: int, *, clients: int, requests_per_client: int,
+def _make_client(port: Optional[int], timeout_s: float,
+                 client_factory: Optional[Callable[[], Any]]) -> Any:
+    """One per-thread wire client: the default single-daemon
+    ``ServeClient``, or whatever ``client_factory`` builds (the fleet
+    drills pass a :class:`~.client.FleetClient` factory so the SAME
+    load drivers exercise the routed path)."""
+    if client_factory is not None:
+        return client_factory()
+    assert port is not None, "need a port or a client_factory"
+    return ServeClient(port, timeout_s=timeout_s, max_retries=0)
+
+
+def closed_loop(port: Optional[int], *, clients: int,
+                requests_per_client: int,
                 make_check: Callable[[int], Dict[str, Any]],
                 timeout_s: float = 120.0,
-                priority: Optional[str] = None) -> Dict[str, Any]:
+                priority: Optional[str] = None,
+                client_factory: Optional[Callable[[], Any]] = None,
+                ) -> Dict[str, Any]:
     """Saturation measurement: every thread always has exactly one
     request outstanding. Distinct checks per request, no retries (the
     harness must never amplify its own load). The drill runs this at
@@ -115,7 +130,7 @@ def closed_loop(port: int, *, clients: int, requests_per_client: int,
     barrier = threading.Barrier(clients + 1)
 
     def worker(idx: int) -> None:
-        with ServeClient(port, timeout_s=timeout_s, max_retries=0) as c:
+        with _make_client(port, timeout_s, client_factory) as c:
             barrier.wait()
             for r in range(requests_per_client):
                 i = idx * requests_per_client + r
@@ -150,12 +165,14 @@ def closed_loop(port: int, *, clients: int, requests_per_client: int,
     }
 
 
-def open_loop(port: int, *, rate_per_s: float, duration_s: float,
+def open_loop(port: Optional[int], *, rate_per_s: float, duration_s: float,
               make_check: Callable[[int], Dict[str, Any]],
               deadline_ms: Optional[float] = None,
               priority_for: Optional[Callable[[int], str]] = None,
               max_threads: int = 64,
-              timeout_s: Optional[float] = None) -> Dict[str, Any]:
+              timeout_s: Optional[float] = None,
+              client_factory: Optional[Callable[[], Any]] = None,
+              ) -> Dict[str, Any]:
     """Fixed-arrival-rate driver. Arrival i is due at ``t0 + i/rate``;
     a free sender sleeps until then and fires. When every sender is
     busy the arrival goes out late (counted ``lagged``) — arrivals are
@@ -188,7 +205,7 @@ def open_loop(port: int, *, rate_per_s: float, duration_s: float,
                 protocol.DRAINING: "draining"}.get(code, "error")
 
     def worker() -> None:
-        with ServeClient(port, timeout_s=timeout_s, max_retries=0) as c:
+        with _make_client(port, timeout_s, client_factory) as c:
             barrier.wait()
             while True:
                 with counter_lock:
@@ -260,7 +277,7 @@ def recovery_probe(port: int, *, make_check: Callable[[int], Dict[str, Any]],
     latency, not stay wedged behind a backlog of dead work."""
     t0 = time.perf_counter()
     with ServeClient(port, timeout_s=60, max_retries=0) as c:
-        depth = None
+        depth: Optional[int] = None
         while time.perf_counter() - t0 < settle_timeout_s:
             depth = c.health()["queue"]["depth"]
             if depth == 0:
@@ -395,3 +412,225 @@ def mini_drill(
     finally:
         drain_report = daemon.drain(15)
     return report, drain_report
+
+
+# ---------------------------------------------------------------------------
+# fleet drills (docs/SERVE.md "Fleet"): the same load drivers routed
+# through a FleetClient over a real forked replica fleet
+# ---------------------------------------------------------------------------
+
+def fleet_client_factory(supervisor: Any, *,
+                         retry_budget: Optional[Any] = None,
+                         timeout_s: float = 30.0,
+                         health_ttl_s: float = 0.25) -> Callable[[], Any]:
+    """A per-thread :class:`~.client.FleetClient` factory over a live
+    supervisor's membership, all sharing ONE fleet-wide retry budget —
+    the drill shape the load drivers accept as ``client_factory``."""
+    from .client import FleetClient, RetryBudget
+
+    budget = retry_budget if retry_budget is not None \
+        else RetryBudget(capacity=64.0, ratio=0.25)
+
+    def make() -> Any:
+        return FleetClient(supervisor.members, retry_budget=budget,
+                           timeout_s=timeout_s, health_ttl_s=health_ttl_s)
+
+    return make
+
+
+def victim_check(supervisor: Any, victim: str,
+                 make_check: Callable[[int], Dict[str, Any]],
+                 start: int = 0) -> Tuple[int, Dict[str, Any]]:
+    """The first check index >= ``start`` whose affinity key routes to
+    ``victim`` on the CURRENT membership ring — how the kill drills aim
+    traffic at the replica about to die."""
+    from .ring import HashRing
+
+    ring = HashRing([name for name, _ in supervisor.members()])
+    i = start
+    while True:
+        check = make_check(i)
+        if ring.lookup(protocol.affinity_key("verify", check)) == victim:
+            return i, check
+        i += 1
+
+
+def kill_one_drill(supervisor: Any, *,
+                   make_check: Callable[[int], Dict[str, Any]],
+                   client_factory: Callable[[], Any],
+                   clients: int = 3,
+                   requests_per_client: int = 30,
+                   kill_at_fraction: float = 0.35,
+                   victim: Optional[str] = None,
+                   rejoin_timeout_s: float = 60.0) -> Dict[str, Any]:
+    """The kill-one-replica chaos drill: a closed-loop fleet workload
+    with a killer thread SIGKILLing one replica once ``kill_at_fraction``
+    of the requests have completed. The acceptance the callers assert:
+    **zero dropped** — every request is answered (failover re-sends the
+    unanswered ones under their idempotency keys), zero transport errors
+    surface, and the slot respawns and rejoins before the drill ends."""
+    total = clients * requests_per_client
+    completed = [0]
+    lock = threading.Lock()
+    errors: List[str] = []
+    answers: Dict[int, Any] = {}
+    factories_failovers = [0]
+    victim_name = victim or supervisor.members()[0][0]
+    kill_info: Dict[str, Any] = {}
+    kill_trigger = threading.Event()
+    barrier = threading.Barrier(clients + 1)
+
+    def worker(idx: int) -> None:
+        c = client_factory()
+        try:
+            barrier.wait()
+            for r in range(requests_per_client):
+                i = idx * requests_per_client + r
+                try:
+                    out = c.call("verify", make_check(i))
+                    with lock:
+                        answers[i] = bool(out["valid"])
+                except Exception as e:
+                    with lock:
+                        errors.append(f"req {i}: {type(e).__name__}: {e}")
+                with lock:
+                    completed[0] += 1
+                    if completed[0] >= kill_at_fraction * total:
+                        kill_trigger.set()
+        finally:
+            with lock:
+                factories_failovers[0] += getattr(c, "failovers", 0)
+            c.close()
+
+    def killer() -> None:
+        kill_trigger.wait(120)
+        kill_info["t_kill"] = time.perf_counter()
+        kill_info["victim"] = victim_name
+        kill_info["pid"] = supervisor.kill_replica(victim_name)
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(clients)]
+    kill_thread = threading.Thread(target=killer, daemon=True)
+    for t in threads:
+        t.start()
+    kill_thread.start()
+    barrier.wait()
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join(300)
+    kill_thread.join(10)
+    wall = time.perf_counter() - t0
+
+    # the respawn-and-rejoin half: the slot must come back ready
+    rejoined = False
+    deadline = time.perf_counter() + rejoin_timeout_s
+    expect = {r["name"] for r in supervisor.replicas()
+              if r["status"] in ("ready", "starting")}
+    while time.perf_counter() < deadline:
+        names = {name for name, _ in supervisor.members()}
+        if victim_name in names:
+            rejoined = True
+            break
+        time.sleep(0.05)
+    return {
+        "requests": total,
+        "answered": len(answers),
+        "dropped": total - len(answers) - len(errors),
+        "errors": errors,
+        "failovers": factories_failovers[0],
+        "victim": victim_name,
+        "rejoined": rejoined,
+        "expected_members": sorted(expect),
+        "wall_s": round(wall, 3),
+        "answers": answers,
+    }
+
+
+def failover_probe(supervisor: Any, *,
+                   make_check: Callable[[int], Dict[str, Any]],
+                   timeout_s: float = 10.0) -> Dict[str, Any]:
+    """One measured failover: aim a request at a replica, SIGKILL it,
+    then time the FIRST victim-affine request through a router that
+    still believes the victim is alive — the membership snapshot is
+    FROZEN before the kill and ``health_ttl_s`` is huge, the realistic
+    stale-router view, so the latency always includes dead-replica
+    detection + the re-send to the next ring replica (the supervisor's
+    monitor may quarantine the victim concurrently; a live-membership
+    router would sometimes learn first and skip the failover, making
+    the measurement race-dependent). The perfgate slice medians this
+    over a couple of victims — ``perfgate_fleet_failover_ms``."""
+    from .client import FleetClient, RetryBudget
+
+    frozen = supervisor.members()  # the stale view the failover drills
+    victim = frozen[0][0]
+    idx, check = victim_check(supervisor, victim, make_check)
+    # a SECOND victim-affine key, computed before the kill: the answer
+    # must be computed by the failover target, not replayed from a cache
+    _, check2 = victim_check(supervisor, victim, make_check, start=idx + 1)
+    client = FleetClient(frozen, retry_budget=RetryBudget(capacity=16.0),
+                         timeout_s=timeout_s, health_ttl_s=3600.0)
+    try:
+        warm = client.call("verify", check)  # connection + route warm
+        assert "valid" in warm
+        supervisor.kill_replica(victim)
+        t0 = time.perf_counter()
+        out = client.call("verify", check2)
+        failover_ms = (time.perf_counter() - t0) * 1e3
+        assert "valid" in out
+        failovers = client.failovers
+    finally:
+        client.close()
+    return {"victim": victim, "failover_ms": round(failover_ms, 3),
+            "failovers": failovers}
+
+
+def mini_fleet_drill(
+    *,
+    replicas: int = 2,
+    flush_delay_ms: float = 10.0,
+    clients: int = 3,
+    requests_per_client: int = 20,
+    probe: Optional[Callable[[Callable[[], Any]], Any]] = None,
+) -> Tuple[Dict[str, Any], Dict[str, Dict[str, Any]]]:
+    """The deterministic, jax-free fleet drill (``make fleet-smoke`` +
+    the perfgate failover slice): a real forked 2-replica fleet driven
+    with invalid-pubkey checks (zero crypto cost), SIGKILL one replica
+    mid-workload, and assert the fleet contract — zero dropped requests,
+    correct answers throughout, the slot respawns and rejoins, and every
+    replica's drain report holds ``accepted == flushed + shed``.
+
+    Returns ``(report, drain_reports)``; the fleet is always stopped."""
+    from .fleet import FleetConfig, FleetSupervisor
+
+    cfg = FleetConfig(replicas=replicas, linger_ms=1.0, cache_size=0,
+                      flush_delay_ms=flush_delay_ms, max_batch=8,
+                      heartbeat_stale_s=1.0)
+    sup = FleetSupervisor(cfg).start()
+    try:
+        factory = fleet_client_factory(sup, timeout_s=15.0,
+                                       health_ttl_s=0.25)
+        baseline = closed_loop(None, clients=clients,
+                               requests_per_client=6,
+                               make_check=lambda i: cheap_check(i, "base"),
+                               client_factory=factory)
+        kill = kill_one_drill(sup, make_check=lambda i: cheap_check(i, "kill"),
+                              client_factory=factory, clients=clients,
+                              requests_per_client=requests_per_client)
+        # every cheap check is invalid-by-construction: the answers the
+        # fleet computed — including the failed-over ones — must all be
+        # False, bit-identical to the direct oracle path
+        wrong = [i for i, v in kill["answers"].items() if v is not False]
+        kill["wrong_answers"] = wrong
+        kill.pop("answers")
+        report = {
+            "replicas": replicas,
+            "baseline": baseline,
+            "kill": kill,
+            "fleet_health": sup.fleet_health(),
+            "fleet_slo": sup.fleet_metrics()["slo"],
+        }
+        if probe is not None:
+            report["probe"] = probe(factory)
+    finally:
+        drain_reports = sup.stop()
+    return report, drain_reports

@@ -1,0 +1,491 @@
+"""Serve fleet: N replicated verification daemons under one supervisor
+(docs/SERVE.md "Fleet", ROADMAP #1).
+
+One hardened daemon (PRs 6-10) survives faults *inside* itself —
+degraded flushes, quarantined controllers, shed overload. The fleet
+layer survives the loss of the daemon itself: N replicas forked like
+``sched/shard.py`` workers (COW — the parent prebuilds the spec matrix
+so every child inherits it instantly; the persistent XLA compile cache
+is shared by path), each with its own ephemeral port, scratch dir
+(ready file + drain report — the replica's journal), and flight
+recorder, supervised with the resilience taxonomy:
+
+- **transient death** (SIGKILL, ``EX_TEMPFAIL``, injected chaos) —
+  respawn the slot and rejoin once the fresh process answers
+  ``/readyz`` green (``serve.fleet.respawns`` /
+  ``serve.fleet.rejoined``); the ring slot keeps its NAME, so the keys
+  the dead replica owned come home to the respawn and its sibling's
+  cache churn is transient;
+- **deterministic fault** (``EX_CONFIG``/``EX_SOFTWARE`` exits, or a
+  respawn budget exhausted — a slot that never stops dying is an
+  environment problem) — quarantine the slot and shrink the ring
+  (``serve.fleet.quarantined``): the router's consistent hash moves
+  only that slot's keys to the survivors;
+- **hang** — the replica's supervise loop stops beating its daemon
+  heartbeat, ``/readyz`` flips to 503 ``stale``, and routers steer
+  around it via health staleness without the supervisor killing
+  anything (the process may recover).
+
+Drain handoff: :meth:`FleetSupervisor.drain_replica` removes the slot
+from the membership FIRST (routers steer new traffic to survivors on
+their next refresh), then SIGTERMs it — the replica answers everything
+it accepted (``accepted == flushed + shed``, the PR 6/10 exactly-once
+drain contract) and its report is collected from its journal dir.
+
+Membership is served programmatically (:meth:`members` — the callable
+a :class:`~.client.FleetClient` routes over) and the fleet's aggregate
+observability rolls up the per-replica surfaces:
+:meth:`fleet_health` (every ``/healthz`` + supervisor state) and
+:meth:`fleet_metrics` (every ``/metrics`` summed via
+``obs.metrics.aggregate_prometheus``, plus the fleet-wide SLO
+availability burn over the summed response counters).
+
+Chaos site ``serve.replica`` fires in each replica's supervise loop
+(cross-process hit state makes "kill one replica" mean exactly one
+across the fleet); all three kinds are drilled in
+``tests/test_serve_fleet.py`` and ``make fleet-smoke``.
+
+Pure stdlib + os.fork; jax-free unless a replica's config asks to warm
+device kernels.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .. import obs
+from ..obs import metrics as obs_metrics
+from ..resilience import chaos, record_event
+from ..resilience import taxonomy
+from .client import ServeClient
+
+READY_FMT = "ready.{epoch}.json"
+DRAIN_FMT = "drain.{epoch}.json"
+
+
+@dataclass
+class FleetConfig:
+    """One replica recipe, applied to every slot."""
+
+    replicas: int = 2
+    forks: Sequence[str] = ("phase0",)
+    presets: Sequence[str] = ("minimal",)
+    max_queue: int = 1024
+    max_batch: int = 64
+    linger_ms: float = 2.0
+    cache_size: int = 4096
+    flush_delay_ms: float = 0.0       # drill knob (docs/SERVE.md)
+    admission_mode: Optional[str] = None
+    target_p99_ms: Optional[float] = None
+    min_limit: Optional[int] = None
+    warm: bool = False                # jax-free by default
+    heartbeat_stale_s: float = 1.0    # /readyz goes stale past this
+    tick_s: float = 0.02              # replica supervise-loop cadence
+    drain_timeout_s: float = 15.0
+    ready_timeout_s: float = 120.0
+    max_respawns: int = 3             # per slot; beyond = quarantine
+    base_dir: Optional[str] = None    # scratch root (default: mkdtemp)
+
+
+class Replica:
+    """Parent-side handle for one fleet slot."""
+
+    __slots__ = ("name", "slot", "pid", "port", "epoch", "status",
+                 "respawns", "rc", "dir")
+
+    def __init__(self, name: str, slot: int, pid: int, epoch: int,
+                 rdir: Path) -> None:
+        self.name = name
+        self.slot = slot
+        self.pid = pid
+        self.port: Optional[int] = None
+        self.epoch = epoch
+        self.status = "starting"   # starting/ready/draining/drained/
+        #                            exited/quarantined
+        self.respawns = 0
+        self.rc: Optional[int] = None
+        self.dir = rdir
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"name": self.name, "pid": self.pid, "port": self.port,
+                "epoch": self.epoch, "status": self.status,
+                "respawns": self.respawns}
+
+
+def _fsync_write(path: Path, payload: Dict[str, Any]) -> None:
+    tmp = path.with_name(f"{path.name}.tmp.{os.getpid()}")
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _replica_child(cfg: FleetConfig, name: str, epoch: int, rdir: Path,
+                   trace_env: Optional[str]) -> None:
+    """The forked replica body: build one full daemon (admission,
+    batcher, service, HTTP front-end), report ready, then supervise-loop
+    (heartbeat + the ``serve.replica`` chaos site) until SIGTERM drains
+    it. Exits via the sysexits taxonomy so the parent can classify."""
+    code = taxonomy.EX_SOFTWARE
+    try:
+        obs.fork_child_reinit(trace_env)
+        stop = threading.Event()
+
+        def _on_term(signum: int, frame: Any) -> None:
+            stop.set()
+
+        try:
+            signal.signal(signal.SIGTERM, _on_term)
+            signal.signal(signal.SIGINT, _on_term)
+        except ValueError:  # pragma: no cover — non-main-thread fork
+            pass
+
+        from .admission import AdmissionController
+        from .batcher import VerifyBatcher
+        from .daemon import ServeDaemon
+        from .service import SpecService
+
+        admission = AdmissionController(
+            cfg.max_queue, mode=cfg.admission_mode,
+            min_limit=cfg.min_limit, target_p99_ms=cfg.target_p99_ms)
+        batcher = VerifyBatcher(
+            max_queue=cfg.max_queue, max_batch=cfg.max_batch,
+            linger_ms=cfg.linger_ms, cache_size=cfg.cache_size,
+            admission=admission, flush_delay_ms=cfg.flush_delay_ms)
+        service = SpecService(forks=tuple(cfg.forks),
+                              presets=tuple(cfg.presets), batcher=batcher)
+        daemon = ServeDaemon(service, port=0,
+                             heartbeat_stale_s=cfg.heartbeat_stale_s)
+        daemon.start(warm=cfg.warm)
+        _fsync_write(rdir / READY_FMT.format(epoch=epoch),
+                     {"port": daemon.port, "pid": os.getpid(),
+                      "replica": name, "epoch": epoch})
+        with obs.span("serve.replica", replica=name, epoch=epoch,
+                      port=daemon.port):
+            while not stop.is_set():
+                chaos("serve.replica")
+                daemon.heartbeat()
+                stop.wait(cfg.tick_s)
+            report = daemon.drain(cfg.drain_timeout_s)
+        _fsync_write(rdir / DRAIN_FMT.format(epoch=epoch), report)
+        code = 0 if (report.get("queue_drained")
+                     and report.get("inflight_answered")) \
+            else taxonomy.EX_SOFTWARE
+    except BaseException as e:
+        kind = taxonomy.classify(e)
+        try:
+            sys.stderr.write(f"[{name}] replica failed ({kind}): "
+                             f"{type(e).__name__}: {e}\n")
+        except Exception:
+            pass
+        code = taxonomy.exit_code_for(kind)
+    finally:
+        try:
+            sys.stdout.flush()
+            sys.stderr.flush()
+        except Exception:
+            pass
+        os._exit(code)
+
+
+class FleetSupervisor:
+    """Spawn, watch, respawn/quarantine, and drain a replica fleet."""
+
+    def __init__(self, config: Optional[FleetConfig] = None) -> None:
+        self.cfg = config or FleetConfig()
+        self.base_dir = Path(self.cfg.base_dir
+                             or tempfile.mkdtemp(prefix="serve_fleet_"))
+        self._replicas: Dict[str, Replica] = {}
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+        self.drain_reports: Dict[str, Dict[str, Any]] = {}
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "FleetSupervisor":
+        """Prebuild the spec matrix in the parent (children inherit it
+        COW — SpecService.start in every replica is then cache-hits
+        only), fork every slot, wait for the fleet to go ready, start
+        the monitor."""
+        from ..specs import build
+
+        with obs.span("serve.fleet.start", replicas=self.cfg.replicas):
+            build.prebuild(forks=list(self.cfg.forks),
+                           presets=tuple(self.cfg.presets))
+            for slot in range(self.cfg.replicas):
+                self._spawn(f"r{slot}", slot, epoch=0)
+            deadline = time.monotonic() + self.cfg.ready_timeout_s
+            while time.monotonic() < deadline:
+                self._poll_once()
+                states = {r.status for r in self._replicas.values()}
+                if states <= {"ready", "quarantined"} and "ready" in states:
+                    break
+                time.sleep(0.02)
+            else:
+                raise TimeoutError(
+                    f"fleet not ready within {self.cfg.ready_timeout_s}s: "
+                    f"{[r.snapshot() for r in self._replicas.values()]}")
+        self._monitor = threading.Thread(target=self._monitor_loop,
+                                         name="fleet-monitor", daemon=True)
+        self._monitor.start()
+        obs.count("serve.fleet.started")
+        return self
+
+    def _spawn(self, name: str, slot: int, epoch: int) -> Replica:
+        rdir = self.base_dir / name
+        rdir.mkdir(parents=True, exist_ok=True)
+        trace_env = obs.child_env().get(obs.TRACE_ENV)
+        sys.stdout.flush()
+        sys.stderr.flush()
+        pid = os.fork()
+        if pid == 0:
+            _replica_child(self.cfg, name, epoch, rdir, trace_env)
+            raise AssertionError("unreachable")  # pragma: no cover
+        rep = Replica(name, slot, pid, epoch, rdir)
+        with self._lock:
+            old = self._replicas.get(name)
+            if old is not None:
+                rep.respawns = old.respawns
+            self._replicas[name] = rep
+        return rep
+
+    # -- supervision ---------------------------------------------------
+
+    def _try_reap(self, rep: Replica) -> Optional[int]:
+        """Non-blocking reap; idempotent per incarnation."""
+        with self._lock:
+            if rep.rc is not None:
+                return rep.rc
+            try:
+                pid, status = os.waitpid(rep.pid, os.WNOHANG)
+            except ChildProcessError:
+                rep.rc = taxonomy.EX_SOFTWARE
+                return rep.rc
+            if pid == 0:
+                return None
+            rep.rc = (-os.WTERMSIG(status) if os.WIFSIGNALED(status)
+                      else os.WEXITSTATUS(status))
+            return rep.rc
+
+    def _poll_once(self) -> None:
+        for rep in list(self._replicas.values()):
+            if rep.status in ("drained", "exited", "quarantined"):
+                continue
+            rc = self._try_reap(rep)
+            if rc is not None:
+                self._handle_death(rep, rc)
+                continue
+            if rep.status == "starting":
+                self._progress_startup(rep)
+
+    def _progress_startup(self, rep: Replica) -> None:
+        ready_path = rep.dir / READY_FMT.format(epoch=rep.epoch)
+        if rep.port is None:
+            if not ready_path.exists():
+                return
+            try:
+                rep.port = int(json.loads(ready_path.read_text())["port"])
+            except (OSError, ValueError, KeyError):
+                return
+        # rejoin gate: membership only once the replica answers green
+        probe = ServeClient(rep.port, timeout_s=2.0, max_retries=0)
+        try:
+            if probe.ready():
+                with self._lock:
+                    if rep.status == "starting":
+                        rep.status = "ready"
+                if rep.epoch > 0:
+                    obs.count("serve.fleet.rejoined")
+                    record_event("probe", domain="serve.fleet",
+                                 capability=f"serve.replica.{rep.name}",
+                                 detail=f"respawn epoch {rep.epoch} rejoined "
+                                        f"on :{rep.port}")
+        finally:
+            probe.close()
+
+    def _handle_death(self, rep: Replica, rc: int) -> None:
+        if rep.status == "draining":
+            # an operator-initiated drain: collect the report, done
+            self._collect_drain(rep)
+            return
+        kind = taxonomy.classify_exit(rc)
+        with self._lock:
+            rep.status = "dead"
+        if kind is None:
+            # clean exit nobody asked for: treat as a voluntary leave
+            with self._lock:
+                rep.status = "exited"
+            obs.count("serve.fleet.exited")
+            return
+        detail = f"replica {rep.name} (epoch {rep.epoch}) died rc={rc}"
+        if kind == taxonomy.TRANSIENT and rep.respawns < self.cfg.max_respawns:
+            rep.respawns += 1
+            obs.count("serve.fleet.respawns")
+            record_event("retry", domain="serve.fleet",
+                         capability=f"serve.replica.{rep.name}",
+                         kind=kind, detail=f"{detail}: respawning "
+                                           f"(attempt {rep.respawns})")
+            self._spawn(rep.name, rep.slot, epoch=rep.epoch + 1)
+            return
+        if kind == taxonomy.TRANSIENT:
+            kind = taxonomy.ENVIRONMENTAL  # a slot that never stops dying
+            detail += f" with the respawn budget ({self.cfg.max_respawns}) spent"
+        with self._lock:
+            rep.status = "quarantined"
+        obs.count("serve.fleet.quarantined")
+        record_event("quarantine", domain="serve.fleet",
+                     capability=f"serve.replica.{rep.name}", kind=kind,
+                     detail=f"{detail}: slot quarantined, ring shrinks")
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._poll_once()
+            except Exception:  # supervision must never die silently
+                pass
+            self._stop.wait(0.05)
+
+    # -- membership (the router's view) --------------------------------
+
+    def members(self) -> List[Tuple[str, int]]:
+        """Live routable replicas as (name, port) — the callable handed
+        to :class:`~.client.FleetClient`."""
+        with self._lock:
+            return [(r.name, r.port) for r in self._replicas.values()
+                    if r.status == "ready" and r.port is not None]
+
+    def replicas(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [r.snapshot() for r in self._replicas.values()]
+
+    def replica(self, name: str) -> Replica:
+        with self._lock:
+            return self._replicas[name]
+
+    # -- chaos / drain handoff -----------------------------------------
+
+    def kill_replica(self, name: str) -> int:
+        """SIGKILL one replica (the kill-one drill); the monitor will
+        classify the signal death transient and respawn the slot."""
+        rep = self.replica(name)
+        os.kill(rep.pid, signal.SIGKILL)
+        obs.count("serve.fleet.killed")
+        return rep.pid
+
+    def drain_replica(self, name: str,
+                      timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        """Drain handoff: pull the slot out of the membership FIRST (new
+        traffic steers to survivors on the routers' next refresh), then
+        SIGTERM it and collect its exactly-once drain report."""
+        rep = self.replica(name)
+        with self._lock:
+            rep.status = "draining"
+        obs.count("serve.fleet.drained")
+        try:
+            os.kill(rep.pid, signal.SIGTERM)
+        except OSError:
+            pass
+        deadline = time.monotonic() + (timeout_s
+                                       or self.cfg.drain_timeout_s + 15)
+        while time.monotonic() < deadline:
+            if self._try_reap(rep) is not None:
+                break
+            time.sleep(0.02)
+        return self._collect_drain(rep)
+
+    def _collect_drain(self, rep: Replica) -> Dict[str, Any]:
+        report: Dict[str, Any] = {"rc": rep.rc}
+        drain_path = rep.dir / DRAIN_FMT.format(epoch=rep.epoch)
+        if drain_path.exists():
+            try:
+                report.update(json.loads(drain_path.read_text()))
+            except (OSError, ValueError):
+                pass
+        with self._lock:
+            rep.status = "drained"
+            self.drain_reports[f"{rep.name}.{rep.epoch}"] = report
+        return report
+
+    def stop(self) -> Dict[str, Dict[str, Any]]:
+        """Drain the whole fleet (monitor stopped first so this thread
+        owns every reap), returning per-replica drain reports."""
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(5)
+            self._monitor = None
+        for rep in list(self._replicas.values()):
+            if rep.status in ("ready", "starting"):
+                self.drain_replica(rep.name)
+        return dict(self.drain_reports)
+
+    # -- aggregate observability ---------------------------------------
+
+    def fleet_health(self) -> Dict[str, Any]:
+        """Every live replica's /healthz plus supervisor state — the
+        fleet-level health surface."""
+        per: Dict[str, Any] = {}
+        totals = {"accepted": 0, "flushes": 0, "rejected": 0,
+                  "shed_rows": 0, "depth": 0}
+        for name, port in self.members():
+            client = ServeClient(port, timeout_s=2.0, max_retries=0)
+            try:
+                h = client.health()
+            except Exception as e:
+                per[name] = {"error": f"{type(e).__name__}: {e}"}
+                continue
+            finally:
+                client.close()
+            per[name] = {"status": h.get("status"), "port": port,
+                         "queue": h.get("queue"),
+                         "backend": h.get("backend"),
+                         "idem_cache": h.get("idem_cache")}
+            q = h.get("queue") or {}
+            for key in ("accepted", "rejected", "shed_rows", "depth",
+                        "flushes"):
+                totals[key] += int(q.get(key) or 0)
+        return {
+            "replicas": self.replicas(),
+            "members": len(self.members()),
+            "per_replica": per,
+            "totals": totals,
+            "respawns": sum(r["respawns"] for r in self.replicas()),
+            "quarantined": [r["name"] for r in self.replicas()
+                            if r["status"] == "quarantined"],
+        }
+
+    def fleet_metrics(self) -> Dict[str, Any]:
+        """Aggregate /metrics across the fleet: counters summed,
+        quantile gauges taken pessimistically (max), plus the fleet-wide
+        SLO availability burn over the summed response counters."""
+        texts: Dict[str, str] = {}
+        for name, port in self.members():
+            client = ServeClient(port, timeout_s=2.0, max_retries=0)
+            try:
+                texts[name] = client.metrics()
+            except Exception:
+                continue
+            finally:
+                client.close()
+        aggregate = obs_metrics.aggregate_prometheus(list(texts.values()))
+        responses = aggregate.get("serve_responses", 0.0)
+        internal = aggregate.get("serve_errors_internal", 0.0)
+        denom = responses + internal
+        return {
+            "replicas_scraped": len(texts),
+            "aggregate": aggregate,
+            "slo": {
+                "availability": (responses / denom) if denom else None,
+                "responses": responses,
+                "errors_internal": internal,
+            },
+        }

@@ -76,6 +76,20 @@ TRACE_FIELD = "trace"
 DEADLINE_FIELD = "deadline_ms"
 PRIORITY_FIELD = "priority"
 
+# fleet-routing fields (optional; docs/SERVE.md "Fleet"): an
+# idempotency key a failover router attaches so a request re-sent to
+# another replica — or re-sent to the SAME replica after a torn
+# connection — is answered from the daemon's bounded idempotency cache
+# instead of executed twice. Volatile per logical request, stable
+# across its attempts.
+IDEM_FIELD = "idem"
+IDEM_MAX_LEN = 128
+
+# request fields that vary per attempt / per caller without changing
+# the request's *identity* — stripped before computing affinity keys
+VOLATILE_FIELDS = (TRACE_FIELD, DEADLINE_FIELD, PRIORITY_FIELD,
+                   IDEM_FIELD, "v")
+
 PRIORITY_CRITICAL = "critical"
 PRIORITY_DEFAULT = "default"
 PRIORITY_SHEDDABLE = "sheddable"
@@ -259,6 +273,31 @@ def request_priority(params: Dict[str, Any]) -> str:
         raise bad_request(
             f"{PRIORITY_FIELD}: expected one of {'/'.join(PRIORITIES)}")
     return value
+
+
+def request_idem(params: Dict[str, Any]) -> Optional[str]:
+    """The optional idempotency key; absent -> None. A non-string,
+    empty, or oversized key is a typed contract violation."""
+    value = params.get(IDEM_FIELD)
+    if value is None:
+        return None
+    if not isinstance(value, str) or not value or len(value) > IDEM_MAX_LEN:
+        raise bad_request(
+            f"{IDEM_FIELD}: expected a non-empty string of at most "
+            f"{IDEM_MAX_LEN} chars")
+    return value
+
+
+def affinity_key(method: str, params: Dict[str, Any]) -> bytes:
+    """The fleet router's key→replica affinity identity: a canonical
+    encoding of (method, params minus the volatile per-attempt fields),
+    so the SAME logical check routes to the SAME replica every time —
+    its per-replica LRU result cache entry and warm BLS bucket shapes
+    stay hot — while deadlines/priorities/trace contexts/idempotency
+    keys never scatter repeats across the ring (docs/SERVE.md "Fleet")."""
+    stable = {k: v for k, v in params.items() if k not in VOLATILE_FIELDS}
+    return f"{method}\x00".encode() + json.dumps(
+        stable, sort_keys=True, default=repr).encode()
 
 
 def route_for(method: str) -> str:

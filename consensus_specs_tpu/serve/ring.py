@@ -1,0 +1,124 @@
+"""Consistent-hash ring: key → replica affinity for the serve fleet
+(docs/SERVE.md "Fleet").
+
+Why consistent hashing instead of round-robin: each daemon replica owns
+a bounded LRU result cache and a set of warm BLS bucket shapes, both
+keyed by the check population it has seen. Routing a check by a stable
+hash of its *identity* keeps repeat traffic for one key landing on one
+replica — the caches stay hot — and a membership change (one replica
+dies, drains, or joins) moves only ~K/N of K keys instead of reshuffling
+everything (`tests/test_serve_fleet.py` pins the remap bound).
+
+Implementation: the classic virtual-node ring. Each node name is hashed
+onto ``vnodes`` points of a 64-bit circle (sha256, so placement is
+stable across processes and Python hash randomization); a key routes to
+the first node clockwise from its own hash. Removing a node removes
+only its points, so exactly the keys it owned remap — the ≤K/N
+guarantee is structural, not statistical. Nodes are *names* (replica
+slot labels like ``r0``), not (host, port) pairs: a replica that dies
+and is respawned on a new port rejoins under the same name, so its keys
+come home and its successor's cache churn is transient.
+
+``chain(key)`` returns every node in ring preference order (distinct,
+starting at the owner) — the failover walk: an unanswered request
+re-sends to the next replica in ITS OWN chain, so two routers always
+agree on the failover order without coordination.
+
+Pure stdlib; imported by the router (serve/client.py) and the fleet
+supervisor (serve/fleet.py).
+"""
+from __future__ import annotations
+
+import bisect
+import hashlib
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+DEFAULT_VNODES = 96
+
+
+def _point(label: str) -> int:
+    return int.from_bytes(
+        hashlib.sha256(label.encode()).digest()[:8], "big")
+
+
+def key_point(key: bytes) -> int:
+    """A key's position on the circle (stable across processes)."""
+    return int.from_bytes(hashlib.sha256(key).digest()[:8], "big")
+
+
+class HashRing:
+    """A consistent-hash ring over node names. Not thread-safe: the
+    owner (one router / one supervisor) rebuilds or mutates it from a
+    single thread and hands out lookups."""
+
+    def __init__(self, nodes: Iterable[str] = (),
+                 vnodes: int = DEFAULT_VNODES) -> None:
+        self.vnodes = max(1, int(vnodes))
+        self._points: List[int] = []       # sorted circle positions
+        self._owner: Dict[int, str] = {}   # position -> node name
+        self._nodes: List[str] = []
+        for node in nodes:
+            self.add(node)
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    def nodes(self) -> List[str]:
+        return list(self._nodes)
+
+    def add(self, node: str) -> None:
+        if node in self._nodes:
+            return
+        self._nodes.append(node)
+        for i in range(self.vnodes):
+            p = _point(f"{node}#{i}")
+            if p in self._owner:   # 64-bit collision: first owner keeps it
+                continue
+            bisect.insort(self._points, p)
+            self._owner[p] = node
+
+    def remove(self, node: str) -> None:
+        if node not in self._nodes:
+            return
+        self._nodes.remove(node)
+        dead = [p for p, n in self._owner.items() if n == node]
+        for p in dead:
+            del self._owner[p]
+            idx = bisect.bisect_left(self._points, p)
+            if idx < len(self._points) and self._points[idx] == p:
+                del self._points[idx]
+
+    def lookup(self, key: bytes) -> str:
+        """The owning node for ``key`` (raises LookupError on an empty
+        ring)."""
+        if not self._points:
+            raise LookupError("hash ring is empty")
+        idx = bisect.bisect_right(self._points, key_point(key))
+        if idx == len(self._points):
+            idx = 0
+        return self._owner[self._points[idx]]
+
+    def chain(self, key: bytes) -> List[str]:
+        """Every node in preference order for ``key``: the owner first,
+        then each DISTINCT node met walking clockwise — the failover
+        order every router derives identically with no coordination."""
+        if not self._points:
+            return []
+        out: List[str] = []
+        start = bisect.bisect_right(self._points, key_point(key))
+        n = len(self._points)
+        for step in range(n):
+            node = self._owner[self._points[(start + step) % n]]
+            if node not in out:
+                out.append(node)
+                if len(out) == len(self._nodes):
+                    break
+        return out
+
+
+def remap_fraction(before: HashRing, after: HashRing,
+                   keys: Sequence[bytes]) -> Tuple[int, float]:
+    """(moved, fraction) of ``keys`` whose owner differs between two
+    rings — the stability measurement the ring tests pin."""
+    moved = sum(1 for k in keys if before.lookup(k) != after.lookup(k))
+    return moved, moved / max(1, len(keys))

@@ -1,0 +1,449 @@
+"""Serve fleet (ISSUE 11, docs/SERVE.md "Fleet"): consistent-hash ring
+stability, idempotency-keyed failover exactly-once, the three
+``serve.replica`` chaos kinds (transient kill → respawn-and-rejoin,
+hang → routed around via health staleness, deterministic → quarantined
+ring shrink), kill-one-replica with zero dropped requests, drain
+handoff, the fleet-shared retry budget, and the
+client → router → replica trace linkage."""
+import json
+import os
+import socket
+import time
+
+import pytest
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from consensus_specs_tpu import obs
+from consensus_specs_tpu.obs import export as obs_export
+from consensus_specs_tpu.obs import metrics as obs_metrics
+from consensus_specs_tpu.serve import protocol
+from consensus_specs_tpu.serve.client import (
+    FleetClient,
+    RetryBudget,
+    ServeClient,
+)
+from consensus_specs_tpu.serve.daemon import IdemCache, ServeDaemon
+from consensus_specs_tpu.serve.drill import cheap_check, victim_check
+from consensus_specs_tpu.serve.fleet import FleetConfig, FleetSupervisor
+from consensus_specs_tpu.serve.ring import HashRing, remap_fraction
+from consensus_specs_tpu.serve.service import SpecService
+from consensus_specs_tpu.serve.batcher import VerifyBatcher
+
+
+# ---------------------------------------------------------------------------
+# the consistent-hash ring (pure; the ≤K/N stability contract)
+# ---------------------------------------------------------------------------
+
+KEYS_1K = [f"key-{i}".encode() for i in range(1000)]
+
+
+def test_ring_remove_remaps_only_victim_keys():
+    """Removing one of N replicas must move EXACTLY the keys the victim
+    owned (the structural consistent-hashing guarantee), which is ~K/N
+    of a 1k-key sample — never a reshuffle."""
+    before = HashRing(["r0", "r1", "r2", "r3"])
+    owned_by_victim = {k for k in KEYS_1K if before.lookup(k) == "r1"}
+    after = HashRing(["r0", "r1", "r2", "r3"])
+    after.remove("r1")
+    moved = {k for k in KEYS_1K if before.lookup(k) != after.lookup(k)}
+    # only the victim's keys move ...
+    assert moved == owned_by_victim
+    # ... and that is ~K/N (generous envelope for hash variance)
+    _, fraction = remap_fraction(before, after, KEYS_1K)
+    assert 0.10 <= fraction <= 0.45, fraction
+    # cache-affinity keys owned by survivors stay put
+    for k in KEYS_1K:
+        if k not in owned_by_victim:
+            assert after.lookup(k) == before.lookup(k)
+
+
+def test_ring_balance_and_chain():
+    ring = HashRing(["r0", "r1", "r2", "r3"])
+    counts = {n: 0 for n in ring.nodes()}
+    for k in KEYS_1K:
+        counts[ring.lookup(k)] += 1
+    for n, c in counts.items():
+        assert 50 <= c <= 600, (n, counts)  # no starved/hot node
+    chain = ring.chain(b"some-key")
+    assert chain[0] == ring.lookup(b"some-key")
+    assert sorted(chain) == ["r0", "r1", "r2", "r3"]  # all, deduped
+
+
+def test_ring_rejoin_restores_affinity():
+    """A respawned replica rejoins under the same slot name: the
+    mapping is identical to before it left — its keys come home."""
+    ring = HashRing(["r0", "r1", "r2"])
+    owners = {k: ring.lookup(k) for k in KEYS_1K}
+    ring.remove("r1")
+    ring.add("r1")
+    assert {k: ring.lookup(k) for k in KEYS_1K} == owners
+
+
+def test_affinity_key_strips_volatile_fields():
+    check = cheap_check(7)
+    base = protocol.affinity_key("verify", check)
+    noisy = dict(check, idem="abc", deadline_ms=50, priority="critical",
+                 trace="00-xyz-1-01", v=1)
+    assert protocol.affinity_key("verify", noisy) == base
+    other = protocol.affinity_key("verify", cheap_check(8))
+    assert other != base
+    assert protocol.affinity_key("verify_batch", check) != base
+
+
+# ---------------------------------------------------------------------------
+# idempotency (exactly-once per replica)
+# ---------------------------------------------------------------------------
+
+def test_idem_cache_unit():
+    cache = IdemCache(capacity=2)
+    assert cache.get("a") is None
+    cache.put("a", 200, {"ok": True})
+    cache.put("b", 400, {"ok": False})
+    assert cache.get("a") == (200, {"ok": True})
+    cache.put("c", 200, {"ok": True})  # evicts b (a was touched)
+    assert cache.get("b") is None
+    assert cache.get("a") is not None
+    assert cache.stats()["size"] == 2
+
+
+def test_idem_validation():
+    assert protocol.request_idem({}) is None
+    assert protocol.request_idem({"idem": "k1"}) == "k1"
+    for bad in (7, "", "x" * 200):
+        with pytest.raises(protocol.RequestError):
+            protocol.request_idem({"idem": bad})
+
+
+@pytest.fixture
+def daemon():
+    service = SpecService(forks=("phase0",), presets=("minimal",),
+                          batcher=VerifyBatcher(linger_ms=1, cache_size=0))
+    d = ServeDaemon(service).start(warm=False)
+    yield d
+    d.drain(10)
+
+
+def test_idem_replay_answers_without_reexecution(daemon):
+    """A re-sent request under the same idempotency key is replayed from
+    the daemon's cache: the SAME answer, no new queue admission — the
+    torn-connection half of the failover exactly-once contract."""
+    params = dict(cheap_check(42), idem="replay-one")
+    with ServeClient(daemon.port) as c:
+        first = c.call("verify", params)
+        accepted = daemon.service.batcher.accepted
+        again = c.call("verify", dict(params))
+        assert again["valid"] == first["valid"] is False
+        assert daemon.service.batcher.accepted == accepted  # no re-execution
+        assert daemon.idem_cache.hits == 1
+    # a deterministic 400 is settled and replays too
+    bad = {"signature": "zz-not-hex", "idem": "replay-bad"}
+    with ServeClient(daemon.port) as c:
+        for _ in range(2):
+            from consensus_specs_tpu.serve.client import ServeError
+
+            with pytest.raises(ServeError) as err:
+                c.call("verify", bad)
+            assert err.value.code == protocol.BAD_REQUEST
+    assert daemon.idem_cache.hits == 2
+
+
+def test_heartbeat_stale_flips_readyz(daemon):
+    daemon.heartbeat_stale_s = 0.2
+    daemon.heartbeat()
+    with ServeClient(daemon.port) as c:
+        assert c.ready() is True
+        time.sleep(0.35)
+        assert c.ready() is False  # stale: un-routable, not dead
+        assert c._roundtrip("GET", "/readyz") and True  # still answers
+        daemon.heartbeat()
+        assert c.ready() is True
+
+
+# ---------------------------------------------------------------------------
+# the forked fleet
+# ---------------------------------------------------------------------------
+
+def _mini_cfg(**overrides):
+    base = dict(replicas=2, linger_ms=1.0, cache_size=0, max_batch=8,
+                heartbeat_stale_s=0.5)
+    base.update(overrides)
+    return FleetConfig(**base)
+
+
+def _drains_exactly_once(reports, allow_killed=False):
+    """Every drained incarnation answered exactly what it accepted. A
+    SIGKILLed incarnation (rc=-9) has no report by design — its
+    unanswered work was re-sent by the routers — and is tolerated only
+    where the test killed one on purpose."""
+    for name, r in reports.items():
+        if allow_killed and r.get("rc") == -9 and "accepted" not in r:
+            continue
+        assert r.get("accepted") == (r.get("flushed_rows", 0)
+                                     + r.get("shed_rows", 0)), (name, r)
+
+
+def test_fleet_serves_and_drain_handoff():
+    """Basic fleet serving + drain handoff: SIGTERM one replica via the
+    supervisor — it leaves the membership first, the router steers new
+    traffic to the survivor, and its drain report proves accepted ==
+    flushed + shed (nothing dropped in the handoff)."""
+    sup = FleetSupervisor(_mini_cfg()).start()
+    try:
+        assert len(sup.members()) == 2
+        with FleetClient(sup.members, retry_budget=RetryBudget(),
+                         health_ttl_s=0.1, timeout_s=15) as c:
+            for i in range(8):
+                assert c.call("verify", cheap_check(i))["valid"] is False
+            victim = sup.members()[0][0]
+            report = sup.drain_replica(victim)
+            assert report["rc"] == 0
+            assert report["accepted"] == (report["flushed_rows"]
+                                          + report["shed_rows"])
+            assert [m[0] for m in sup.members()] == \
+                [m for m in ("r0", "r1") if m != victim]
+            for i in range(8, 16):  # survivors carry the traffic
+                assert c.call("verify", cheap_check(i))["valid"] is False
+    finally:
+        _drains_exactly_once(sup.stop())
+
+
+def test_kill_one_answered_exactly_once_fleet_wide():
+    """The idempotency acceptance: a request aimed at a replica that
+    dies is answered EXACTLY ONCE fleet-wide — the failover target
+    executes it (one new queue admission), and a re-send of the same
+    idempotency key is replayed, not re-executed."""
+    sup = FleetSupervisor(_mini_cfg()).start()
+    try:
+        frozen = sup.members()
+        victim = frozen[0][0]
+        survivor_port = dict(frozen)[[n for n, _ in frozen
+                                      if n != victim][0]]
+        idx, check = victim_check(sup, victim, cheap_check)
+        params = dict(check, idem="fleet-exactly-once")
+        client = FleetClient(frozen, retry_budget=RetryBudget(),
+                             health_ttl_s=3600.0, timeout_s=15)
+        with ServeClient(survivor_port) as scrape, client:
+            client.call("verify", cheap_check(999_999))  # warm connections
+            before = scrape.health()["queue"]["accepted"]
+            sup.kill_replica(victim)
+            out = client.call("verify", params)
+            assert out["valid"] is False
+            assert client.failovers >= 1
+            after = scrape.health()["queue"]["accepted"]
+            assert after == before + 1  # executed once, on the survivor
+            # re-send the SAME idem straight to the survivor: replayed
+            replay = scrape.call("verify", dict(params))
+            assert replay["valid"] is False
+            assert scrape.health()["queue"]["accepted"] == after
+            assert "serve_idem_hits 1" in scrape.metrics()
+        # let the monitor respawn the slot so the stop() drains a live
+        # fleet (the killed incarnation itself has no report by design)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and len(sup.members()) < 2:
+            time.sleep(0.05)
+    finally:
+        _drains_exactly_once(sup.stop(), allow_killed=True)
+
+
+def test_chaos_transient_kill_respawns_and_rejoins(monkeypatch, tmp_path):
+    """serve.replica kill: ONE replica (cross-process chaos state)
+    SIGKILLs itself mid-fleet; the supervisor classifies the signal
+    death transient, respawns the slot, and it rejoins via /readyz —
+    while the router keeps answering every request."""
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_CHAOS", "serve.replica=kill:1")
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_CHAOS_STATE",
+                       str(tmp_path / "chaos_state.json"))
+    sup = FleetSupervisor(_mini_cfg()).start()
+    try:
+        with FleetClient(sup.members, retry_budget=RetryBudget(),
+                         health_ttl_s=0.1, timeout_s=15) as c:
+            deadline = time.monotonic() + 30
+            respawned = False
+            while time.monotonic() < deadline:
+                assert c.call("verify",
+                              cheap_check(int(time.monotonic() * 1e3) % 10**6)
+                              )["valid"] is False
+                reps = {r["name"]: r for r in sup.replicas()}
+                if any(r["respawns"] >= 1 and r["status"] == "ready"
+                       for r in reps.values()):
+                    respawned = True
+                    break
+                time.sleep(0.05)
+            assert respawned, sup.replicas()
+            assert len(sup.members()) == 2  # rejoined: full strength
+    finally:
+        monkeypatch.delenv("CONSENSUS_SPECS_TPU_CHAOS")
+        _drains_exactly_once(sup.stop())
+
+
+def test_chaos_deterministic_quarantines_and_shrinks_ring(monkeypatch, tmp_path):
+    """serve.replica deterministic: the faulted replica exits with a
+    deterministic sysexit, the slot is QUARANTINED (never respawned),
+    the ring shrinks to the survivor, and requests keep flowing."""
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_CHAOS",
+                       "serve.replica=deterministic:1")
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_CHAOS_STATE",
+                       str(tmp_path / "chaos_state.json"))
+    sup = FleetSupervisor(_mini_cfg()).start()
+    try:
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            statuses = {r["name"]: r["status"] for r in sup.replicas()}
+            if "quarantined" in statuses.values():
+                break
+            time.sleep(0.05)
+        statuses = {r["name"]: r["status"] for r in sup.replicas()}
+        assert "quarantined" in statuses.values(), statuses
+        assert len(sup.members()) == 1  # the ring shrank
+        with FleetClient(sup.members, retry_budget=RetryBudget(),
+                         health_ttl_s=0.1, timeout_s=15) as c:
+            for i in range(6):
+                assert c.call("verify", cheap_check(i, "detq"))["valid"] is False
+        health = sup.fleet_health()
+        assert health["quarantined"], health
+    finally:
+        monkeypatch.delenv("CONSENSUS_SPECS_TPU_CHAOS")
+        _drains_exactly_once(sup.stop())
+
+
+def test_chaos_hang_routed_around_via_health_staleness(monkeypatch, tmp_path):
+    """serve.replica hang: the replica's supervise loop stops beating,
+    its /readyz flips 503 'stale' (the process is ALIVE and still
+    answering HTTP), and the router steers around it — no kills, no
+    errors, every request answered by the healthy sibling."""
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_CHAOS", "serve.replica=hang:1")
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_CHAOS_STATE",
+                       str(tmp_path / "chaos_state.json"))
+    monkeypatch.setenv("CONSENSUS_SPECS_TPU_CHAOS_HANG_S", "4")
+    sup = FleetSupervisor(_mini_cfg(heartbeat_stale_s=0.3)).start()
+    try:
+        members = sup.members()
+        assert len(members) == 2
+        # find the hung replica: its /readyz goes stale while its
+        # process stays alive and in the supervisor's membership
+        stale = None
+        deadline = time.monotonic() + 10
+        while stale is None and time.monotonic() < deadline:
+            for name, port in members:
+                with ServeClient(port, timeout_s=2) as probe:
+                    status = probe._roundtrip("GET", "/readyz").get("status")
+                if status == "stale":
+                    stale = name
+                    break
+            time.sleep(0.05)
+        assert stale is not None, "no replica went heartbeat-stale"
+        assert len(sup.members()) == 2  # supervisor did NOT kill it
+        with FleetClient(sup.members, retry_budget=RetryBudget(),
+                         health_ttl_s=0.05, timeout_s=15) as c:
+            for i in range(10):
+                assert c.call("verify", cheap_check(i, "hang"))["valid"] is False
+    finally:
+        monkeypatch.delenv("CONSENSUS_SPECS_TPU_CHAOS")
+        monkeypatch.delenv("CONSENSUS_SPECS_TPU_CHAOS_HANG_S")
+        _drains_exactly_once(sup.stop())
+
+
+def test_fleet_shared_retry_budget_gates_failover(daemon):
+    """The fleet-shared token bucket: with an empty budget a failover
+    re-send is refused and the transport error surfaces (the retry-storm
+    guard); with budget the SAME request fails over and succeeds."""
+    # a dead port: bind-then-close guarantees ECONNREFUSED
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    dead_port = sock.getsockname()[1]
+    sock.close()
+    members = [("dead", dead_port), ("live", daemon.port)]
+    ring = HashRing(["dead", "live"])
+    i = 0
+    while ring.lookup(protocol.affinity_key(
+            "verify", cheap_check(i, "budget"))) != "dead":
+        i += 1
+    check = cheap_check(i, "budget")
+
+    empty = RetryBudget(capacity=0.0, ratio=0.0)
+    with FleetClient(members, retry_budget=empty,
+                     health_ttl_s=3600.0, timeout_s=5) as c:
+        # defeat the first-use health probe: mark every replica fresh
+        c._refresh()
+        for state in c._replicas.values():
+            state.ready_checked = time.monotonic()
+        before = obs_metrics.snapshot()["counters"].get(
+            "serve.route.budget_exhausted", 0)
+        with pytest.raises(OSError):
+            c.call("verify", check)
+        after = obs_metrics.snapshot()["counters"].get(
+            "serve.route.budget_exhausted", 0)
+        assert after == before + 1
+
+    shared = RetryBudget()  # default capacity: failover allowed
+    with FleetClient(members, retry_budget=shared,
+                     health_ttl_s=3600.0, timeout_s=5) as c:
+        c._refresh()
+        for state in c._replicas.values():
+            state.ready_checked = time.monotonic()
+        assert c.call("verify", check)["valid"] is False
+        assert c.failovers == 1
+
+
+def test_fleet_trace_links_client_router_replica(monkeypatch, tmp_path):
+    """One trace id links the caller's serve.route span → its
+    serve.client child → the chosen replica's serve.request span in
+    ANOTHER process (remote flow arrow), per docs/OBSERVABILITY.md."""
+    trace_dir = tmp_path / "trace"
+    monkeypatch.setenv(obs.TRACE_ENV, str(trace_dir))
+    sup = FleetSupervisor(_mini_cfg()).start()
+    try:
+        with FleetClient(sup.members, retry_budget=RetryBudget(),
+                         health_ttl_s=0.1, timeout_s=15) as c:
+            assert c.call("verify", cheap_check(3, "trace"))["valid"] is False
+    finally:
+        reports = sup.stop()
+    _drains_exactly_once(reports)
+    monkeypatch.delenv(obs.TRACE_ENV)
+    records = obs_export.load_records(str(trace_dir))
+    spans = [r for r in records if r.get("type") == "span"]
+    routes = [s for s in spans if s["name"] == "serve.route"]
+    assert routes, "no serve.route span recorded"
+    route = routes[0]
+    assert route["attrs"].get("replica") in ("r0", "r1")
+    clients = [s for s in spans if s["name"] == "serve.client"
+               and s.get("parent") == route["span"]]
+    assert clients, "serve.client did not parent under serve.route"
+    requests = [s for s in spans if s["name"] == "serve.request"
+                and s.get("parent") in {c["span"] for c in clients}]
+    assert requests, "replica serve.request did not adopt the wire context"
+    req = requests[0]
+    assert req.get("remote") is True  # cross-process flow arrow
+    assert req["pid"] != route["pid"]  # answered in the replica process
+    assert req["trace"] == route["trace"]  # ONE trace id end to end
+
+    # tools/trace_report.py renders the per-replica fan-out from these
+    import importlib.util
+    import pathlib
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    spec = importlib.util.spec_from_file_location(
+        "trace_report_fleet", str(repo / "tools" / "trace_report.py"))
+    trace_report = importlib.util.module_from_spec(spec)
+    assert spec.loader is not None
+    spec.loader.exec_module(trace_report)
+    summary = trace_report.summarize(records)
+    fanout = summary["serve"]["route_fanout"]
+    assert fanout["requests"] >= 1
+    assert route["attrs"]["replica"] in fanout["by_replica"]
+
+
+def test_fleet_metrics_aggregation():
+    texts = [
+        "serve_accepted 3\nserve_responses 5\nserve_request_ms_p99 2.5\n",
+        "serve_accepted 4\nserve_responses 7\nserve_request_ms_p99 9.0\n"
+        "serve_errors_internal 1\n",
+    ]
+    agg = obs_metrics.aggregate_prometheus(texts)
+    assert agg["serve_accepted"] == 7
+    assert agg["serve_responses"] == 12
+    assert agg["serve_errors_internal"] == 1
+    assert agg["serve_request_ms_p99"] == 9.0  # pessimistic max, not sum

@@ -1,0 +1,344 @@
+"""`make fleet-drill` / `make fleet-smoke`: the serve-fleet scale-out +
+kill-one-replica drill (docs/SERVE.md "Fleet", ROADMAP #1).
+
+Full mode (``make fleet-drill``, host-measured evidence):
+
+    python tools/fleet_drill.py [--replicas N] [--ledger P] [--json OUT]
+
+1. **scaling sweep** — for each replica count in 1..N (powers of two),
+   boot a real forked fleet (reference BLS, result cache OFF, every
+   check a full pairing) and measure closed-loop fleet goodput through
+   :class:`FleetClient` routers; banks ``fleet_goodput_r<N>_per_s`` per
+   point plus the headline ``fleet_goodput_per_s`` at N. On a 1-CPU box
+   the curve is environment-limited (like the gen-shard sweep) and
+   recorded honestly with ``cpus`` alongside;
+2. **overload** — open-loop load at ~3x the N-replica fleet's measured
+   saturation, with deadlines (scaled to the box's measured service
+   p50) and the standard priority mix, THROUGH the routers: fleet
+   goodput must hold >= 80% of saturation (shed the excess, serve the
+   rest — the PR 10 contract, now fleet-wide). The floor is enforced
+   on boxes with >= N cores; with fewer cores the cross-replica CPU
+   contention inflates service variance past what per-replica deadline
+   estimation tracks, so the ratio is recorded environment-limited
+   (like the gen-shard sweep) instead of failed;
+3. **kill-one-replica** — SIGKILL one replica mid-workload: zero
+   dropped (not shed) requests — every request is answered via
+   idempotency-keyed failover — with answers bit-identical to the
+   direct path (the invalid-check population must answer False
+   everywhere, and the differential corpus re-verifies after the kill);
+   the slot must respawn and rejoin;
+4. **drain accounting** — every replica's drain report must hold
+   ``accepted == flushed_rows + shed_rows`` (exactly-once fleet-wide).
+
+Banked (source ``fleet_drill``): ``fleet_goodput_per_s``,
+``fleet_goodput_r<N>_per_s`` (the replicas-vs-goodput curve rendered by
+tools/perf_report.py), ``fleet_scaling`` (N-replica / 1-replica
+goodput), ``fleet_overload_goodput_ratio``.
+
+Smoke mode (``--smoke``, wired into ``make citest``): the scaled-down
+jax-free deterministic twin — a forked 2-replica fleet with a simulated
+flush service time driven by invalid-pubkey checks (zero crypto cost),
+kill-one mid-workload, zero-dropped + respawn-and-rejoin + exactly-once
+drain asserts, plus the differential corpus routed through the fleet.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(1, str(REPO / "tools"))
+
+from consensus_specs_tpu.serve import drill  # noqa: E402
+from consensus_specs_tpu.serve.fleet import FleetConfig, FleetSupervisor  # noqa: E402
+from overload_drill import build_differential_corpus, differential_pass  # noqa: E402
+
+
+def fail(msg: str) -> int:
+    print(f"fleet_drill: FAIL — {msg}")
+    return 1
+
+
+def _replica_counts(n: int) -> List[int]:
+    counts = [1]
+    while counts[-1] * 2 <= n:
+        counts.append(counts[-1] * 2)
+    if counts[-1] != n:
+        counts.append(n)
+    return counts
+
+
+def _boot(replicas: int, **overrides: Any) -> FleetSupervisor:
+    # pairing-workload admission sizing, same rationale as the
+    # single-daemon overload drill: the default 50ms queue-wait target
+    # and 256-row batches are sized for ms-scale checks, not ~400ms
+    # pairings (worse under N-replicas-per-core CPU contention)
+    cfg = FleetConfig(replicas=replicas, linger_ms=2.0, cache_size=0,
+                      max_batch=4, target_p99_ms=2000.0, min_limit=2,
+                      **overrides)
+    return FleetSupervisor(cfg).start()
+
+
+def _drain_ok(reports: Dict[str, Dict[str, Any]]) -> Optional[str]:
+    for name, r in reports.items():
+        if r.get("rc") != 0:
+            return f"replica {name} drain rc={r.get('rc')}"
+        if r.get("accepted") != (r.get("flushed_rows", 0)
+                                 + r.get("shed_rows", 0)):
+            return f"replica {name} accounting broken: {r}"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# full mode
+# ---------------------------------------------------------------------------
+
+def run_full(ns: argparse.Namespace) -> int:
+    t_all = time.perf_counter()
+    print("fleet_drill: building the pairing check population + "
+          "differential corpus ...")
+    make_check = drill.expensive_check_factory()
+    corpus = build_differential_corpus()
+    counts = _replica_counts(ns.replicas)
+    rc = 0
+    goodput_by_r: Dict[int, float] = {}
+    report: Dict[str, Any] = {"cpus": os.cpu_count(), "counts": counts}
+
+    # 1) the scaling sweep: same workload, 1..N replicas
+    for n in counts:
+        sup = _boot(n)
+        try:
+            factory = drill.fleet_client_factory(sup, timeout_s=120.0)
+            sat = drill.closed_loop(
+                None, clients=ns.sat_clients,
+                requests_per_client=ns.sat_requests,
+                make_check=lambda i: make_check(n * 100_000 + i),
+                client_factory=factory, priority="critical")
+            if sat["errors"]:
+                return fail(f"{n}-replica saturation errored: {sat}")
+            goodput_by_r[n] = sat["rate_per_s"] or 0.0
+            print(f"fleet_drill: {n} replica(s) -> "
+                  f"{goodput_by_r[n]:.2f} verifies/s "
+                  f"(p50 {sat['p50_ms']:.0f}ms)")
+        finally:
+            if n != counts[-1]:
+                err = _drain_ok(sup.stop())
+                if err:
+                    return fail(err)
+        if n == counts[-1]:
+            break  # keep the N-replica fleet for phases 2-4
+
+    scaling = (round(goodput_by_r[counts[-1]] / goodput_by_r[1], 3)
+               if goodput_by_r.get(1) else None)
+    report["goodput_by_replicas"] = goodput_by_r
+    report["fleet_scaling"] = scaling
+
+    try:
+        factory = drill.fleet_client_factory(sup, timeout_s=120.0)
+        diff_clean = differential_pass(None, corpus, "fleet-clean",
+                                       client_factory=factory)
+        if diff_clean["mismatches"]:
+            return fail(f"clean fleet differential diverged: "
+                        f"{diff_clean['mismatches'][:3]}")
+
+        # 2) overload at 3x fleet saturation, through the routers.
+        # The deadline budget scales with the box's MEASURED per-request
+        # service time (closed-loop p50): a fixed 4s budget is ~10
+        # services on a box where a pairing takes 370ms but only ~2.5
+        # where N replicas contend for one core — goodput-held-at-3x is
+        # a statement about shedding discipline, not about how many
+        # cores the host happens to have.
+        sat_rate = goodput_by_r[counts[-1]] or 1.0
+        sat_p50 = sat["p50_ms"] or 400.0
+        deadline_ms = max(ns.deadline_ms, 8.0 * sat_p50)
+        offered = sat_rate * ns.multiplier
+        print(f"fleet_drill: offering {offered:.2f}/s open-loop for "
+              f"{ns.duration}s (3x fleet saturation), deadline "
+              f"{deadline_ms:.0f}ms (8x measured p50 {sat_p50:.0f}ms)")
+        overload = drill.open_loop(
+            None, rate_per_s=offered, duration_s=ns.duration,
+            make_check=lambda i: make_check(9_000_000 + i),
+            deadline_ms=deadline_ms,
+            priority_for=drill.default_priority_mix,
+            client_factory=drill.fleet_client_factory(
+                sup, timeout_s=max(60.0, deadline_ms / 250)),
+            max_threads=ns.max_threads)
+        goodput = overload["goodput_per_s"] or 0.0
+        ratio = goodput / sat_rate
+        report["overload"] = overload
+        report["overload_deadline_ms"] = deadline_ms
+        report["fleet_overload_goodput_ratio"] = round(ratio, 4)
+        print(f"fleet_drill: overload goodput {goodput:.2f}/s "
+              f"({ratio:.0%} of saturation), outcomes "
+              f"{overload['outcomes']}")
+        env_limited = (os.cpu_count() or 1) < counts[-1]
+        report["environment_limited"] = env_limited
+        if ratio < ns.goodput_floor:
+            if env_limited:
+                # like the gen-shard sweep: N replicas contending for
+                # fewer cores inflates per-request service variance past
+                # what per-replica deadline estimation can track — the
+                # >=80%-at-3x criterion is a multi-core statement, so on
+                # this box the ratio is recorded honestly instead of
+                # failed (a multi-core run still enforces the floor)
+                print(f"fleet_drill: NOTE — goodput ratio {ratio:.0%} is "
+                      f"under the {ns.goodput_floor:.0%} floor with "
+                      f"{counts[-1]} replicas on a {os.cpu_count()}-CPU "
+                      "box; recorded environment-limited")
+            else:
+                rc = fail(f"fleet goodput collapsed under overload: "
+                          f"{ratio:.0%} < {ns.goodput_floor:.0%}")
+        if overload["outcomes"]["error"]:
+            rc = fail(f"{overload['outcomes']['error']} transport errors "
+                      "under fleet overload")
+
+        # 3) kill-one-replica mid-workload: zero dropped, bit-identical
+        kill = drill.kill_one_drill(
+            sup, make_check=lambda i: drill.cheap_check(i, "fleetkill"),
+            client_factory=drill.fleet_client_factory(sup, timeout_s=30.0),
+            clients=3, requests_per_client=ns.kill_requests)
+        answers = kill.pop("answers")
+        wrong = [i for i, v in answers.items() if v is not False]
+        kill["wrong_answers"] = wrong
+        report["kill"] = kill
+        print(f"fleet_drill: kill-one ({kill['victim']}): "
+              f"{kill['answered']}/{kill['requests']} answered, "
+              f"{kill['dropped']} dropped, {kill['failovers']} failover(s), "
+              f"rejoined={kill['rejoined']}")
+        if kill["dropped"] or kill["errors"]:
+            rc = fail(f"kill-one dropped/errored requests: "
+                      f"dropped={kill['dropped']} errors={kill['errors'][:3]}")
+        if wrong:
+            rc = fail(f"kill-one answers diverged from the direct path: "
+                      f"{wrong[:5]}")
+        if not kill["rejoined"]:
+            rc = fail("killed replica never rejoined the fleet")
+
+        diff_post = differential_pass(
+            None, corpus, "fleet-post-kill",
+            client_factory=drill.fleet_client_factory(sup, timeout_s=120.0))
+        report["differential"] = {"clean": diff_clean, "post_kill": diff_post}
+        if diff_post["mismatches"]:
+            rc = fail(f"post-kill differential diverged: "
+                      f"{diff_post['mismatches'][:3]}")
+        report["fleet_health"] = sup.fleet_health()
+        report["fleet_slo"] = sup.fleet_metrics()["slo"]
+    finally:
+        # 4) fleet drain: exactly-once accounting on every replica
+        err = _drain_ok(sup.stop())
+        if err:
+            rc = fail(err)
+
+    report["wall_s"] = round(time.perf_counter() - t_all, 1)
+
+    if rc == 0 and (ns.ledger or "").strip().lower() not in ("off", "none", "0"):
+        from consensus_specs_tpu.obs import ledger as ledger_mod
+
+        path = ns.ledger or ledger_mod.default_path()
+        if path:
+            metrics = {f"fleet_goodput_r{n}_per_s": round(v, 3)
+                       for n, v in goodput_by_r.items()}
+            metrics["fleet_goodput_per_s"] = round(
+                goodput_by_r[counts[-1]], 3)
+            if scaling is not None:
+                metrics["fleet_scaling"] = scaling
+            metrics["fleet_overload_goodput_ratio"] = \
+                report["fleet_overload_goodput_ratio"]
+            run_id = ledger_mod.Ledger(path).record_run(
+                metrics, source="fleet_drill", backend="host",
+                extra={"cpus": os.cpu_count(),
+                       "replica_counts": counts,
+                       "kill": {k: report["kill"][k]
+                                for k in ("victim", "answered", "dropped",
+                                          "failovers", "rejoined")},
+                       "overload_outcomes": report["overload"]["outcomes"],
+                       "environment_limited": (os.cpu_count() or 1) < max(counts)})
+            report["ledger"] = {"path": path, "run_id": run_id}
+            print(f"fleet_drill: banked as {run_id} -> {path}")
+
+    if ns.json_path is not None:
+        ns.json_path.write_text(json.dumps(report, indent=2, sort_keys=True,
+                                           default=repr))
+    print(f"fleet_drill: {'PASSED' if rc == 0 else 'FAILED'} "
+          f"in {time.perf_counter() - t_all:.1f}s")
+    return rc
+
+
+# ---------------------------------------------------------------------------
+# smoke mode (the citest slice): jax-free, crypto-free, deterministic
+# ---------------------------------------------------------------------------
+
+def run_smoke(ns: argparse.Namespace) -> int:
+    t0 = time.perf_counter()
+    corpus = build_differential_corpus()
+
+    def probe(factory: Any) -> Dict[str, Any]:
+        return differential_pass(None, corpus, "fleet-smoke",
+                                 client_factory=factory)
+
+    report, drains = drill.mini_fleet_drill(probe=probe)
+    kill = report["kill"]
+    diff = report["probe"]
+    print(f"fleet_smoke: baseline {report['baseline']['rate_per_s']}/s over "
+          f"{report['replicas']} replicas")
+    print(f"fleet_smoke: kill-one ({kill['victim']}): "
+          f"{kill['answered']}/{kill['requests']} answered, "
+          f"{kill['dropped']} dropped, {kill['failovers']} failover(s), "
+          f"rejoined={kill['rejoined']}")
+    print(f"fleet_smoke: fleet slo {report['fleet_slo']}, drains "
+          f"{[r.get('rc') for r in drains.values()]}")
+
+    checks = [
+        (kill["dropped"] == 0, f"{kill['dropped']} requests dropped"),
+        (not kill["errors"], f"transport errors: {kill['errors'][:3]}"),
+        (not kill["wrong_answers"],
+         f"answers diverged from the direct path: {kill['wrong_answers'][:5]}"),
+        (kill["rejoined"], "killed replica never rejoined"),
+        (not diff["mismatches"],
+         f"differential diverged: {diff['mismatches'][:3]}"),
+        (diff["answered"] == len(corpus),
+         "differential probes went unanswered"),
+        (_drain_ok(drains) is None, str(_drain_ok(drains))),
+    ]
+    for ok, msg in checks:
+        if not ok:
+            return fail(msg)
+    print(f"fleet_smoke: OK in {time.perf_counter() - t0:.1f}s")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--smoke", action="store_true",
+                        help="scaled-down jax-free kill-one drill "
+                             "(the citest slice)")
+    parser.add_argument("--replicas", type=int, default=4,
+                        help="fleet size for the scaling sweep (1..N)")
+    parser.add_argument("--sat-clients", type=int, default=4)
+    parser.add_argument("--sat-requests", type=int, default=4,
+                        help="saturation requests per client (pairings)")
+    parser.add_argument("--multiplier", type=float, default=3.0)
+    parser.add_argument("--duration", type=float, default=10.0)
+    parser.add_argument("--deadline-ms", type=float, default=4000.0)
+    parser.add_argument("--goodput-floor", type=float, default=0.8,
+                        help="min overload goodput as a fraction of "
+                             "fleet saturation")
+    parser.add_argument("--kill-requests", type=int, default=20,
+                        help="kill-drill requests per client (cheap checks)")
+    parser.add_argument("--max-threads", type=int, default=64)
+    parser.add_argument("--ledger", default=None,
+                        help="perf-ledger path ('off' skips banking)")
+    parser.add_argument("--json", dest="json_path", type=pathlib.Path,
+                        default=None)
+    ns = parser.parse_args(argv)
+    return run_smoke(ns) if ns.smoke else run_full(ns)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -135,16 +135,20 @@ def build_differential_corpus() -> List[Dict[str, Any]]:
     ]
 
 
-def differential_pass(port: int, corpus: List[Dict[str, Any]],
+def differential_pass(port: Optional[int], corpus: List[Dict[str, Any]],
                       label: str, deadline_ms: Optional[float] = None,
+                      client_factory: Optional[Any] = None,
                       ) -> Dict[str, Any]:
     """One served pass over the corpus: every probe that is ANSWERED
     must match the locally recomputed expectation exactly; a shed/429
     under overload is allowed (load management, not a correctness
-    escape) and tallied."""
+    escape) and tallied. ``client_factory`` routes the pass through a
+    fleet router instead of one daemon (tools/fleet_drill.py)."""
     answered = shed = 0
     mismatches: List[str] = []
-    with ServeClient(port, timeout_s=90, max_retries=0) as c:
+    client = (client_factory() if client_factory is not None
+              else ServeClient(port, timeout_s=90, max_retries=0))
+    with client as c:
         for probe in corpus:
             try:
                 got = c.call(probe["method"], dict(probe["params"]),

@@ -21,10 +21,12 @@ device-unreachable round lands as a first-class host-only datapoint
   regression; the ``serve_*`` series (bench p50/p99/verifies_per_s,
   canary probes, SLO availability/latency-budget points) render in
   their own "Serving plane" section with absolute SLO badges next to
-  the relative sentinel verdicts; a ``gen_pipeline_w<N>_s`` worker
-  sweep (tools/gen_bench.py --workers) renders as a "Generation
-  scaling" curve — measured seconds vs the ideal linear line — next to
-  the gen_* series;
+  the relative sentinel verdicts; a ``fleet_goodput_r<N>_per_s``
+  replica sweep (tools/fleet_drill.py) renders as a "Serve fleet
+  scaling" curve — measured goodput vs the ideal linear line — and a
+  ``gen_pipeline_w<N>_s`` worker sweep (tools/gen_bench.py --workers)
+  renders as a "Generation scaling" curve — measured seconds vs the
+  ideal linear line — next to the gen_* series;
 - ``--prom OUT``: Prometheus text exposition of the latest datapoint
   per metric (plus run counters), for scraping into a dashboard.
 
@@ -162,6 +164,42 @@ def _svg_series(points: List[Dict[str, Any]], width: int = 360,
 
 
 _GEN_WORKER_RE = re.compile(r"^gen_pipeline_w(\d+)_s$")
+_FLEET_RE = re.compile(r"^fleet_goodput_r(\d+)_per_s$")
+
+
+def _fleet_scaling_svg(by_replicas: Dict[int, float], width: int = 360,
+                       height: int = 80) -> str:
+    """The replicas-vs-goodput scaling curve (docs/SERVE.md "Fleet"):
+    measured verifies/s per replica count (filled blue) against the
+    ideal r1·N linear line (dashed) — a rate, so up is better (the
+    inverse of the worker-sweep seconds curve)."""
+    counts = sorted(by_replicas)
+    values = [by_replicas[r] for r in counts]
+    ideal = [values[0] * r for r in counts]
+    lo, hi = 0.0, max(values + ideal) or 1.0
+    pad = 8
+    n = len(counts)
+
+    def xy(i: int, v: float) -> tuple:
+        x = pad + (width - 2 * pad) * (i / max(1, n - 1))
+        y = height - pad - (height - 2 * pad) * ((v - lo) / (hi - lo))
+        return round(x, 1), round(y, 1)
+
+    measured = " ".join(f"{x},{y}" for x, y in
+                        (xy(i, v) for i, v in enumerate(values)))
+    ideal_line = " ".join(f"{x},{y}" for x, y in
+                          (xy(i, v) for i, v in enumerate(ideal)))
+    dots = "".join(
+        f'<circle cx="{x}" cy="{y}" r="3" fill="#1d4ed8">'
+        f'<title>{r} replica(s): {v:g}/s</title></circle>'
+        for (x, y), r, v in ((xy(i, v), counts[i], v)
+                             for i, v in enumerate(values)))
+    return (f'<svg width="{width}" height="{height}" '
+            f'viewBox="0 0 {width} {height}">'
+            f'<polyline points="{ideal_line}" fill="none" stroke="#94a3b8" '
+            f'stroke-width="1" stroke-dasharray="4 3"/>'
+            f'<polyline points="{measured}" fill="none" stroke="#93c5fd" '
+            f'stroke-width="1.5"/>' + dots + "</svg>")
 
 
 def _gen_scaling_svg(by_workers: Dict[int, float], width: int = 360,
@@ -274,6 +312,35 @@ count; dashed line = ideal linear scaling. Max-worker speedup:
 <table><tr><th>workers</th><th>seconds</th><th>speedup vs 1</th></tr>
 {sweep_cells}
 </table>"""
+
+    # the serve-fleet scaling curve (docs/SERVE.md "Fleet"): latest
+    # fleet_goodput_r<N>_per_s point per replica count, rendered next to
+    # the serving-plane series (the cpus note matters: on a 1-CPU box
+    # the measured curve is environment-limited, like the gen sweep)
+    fleet_latest: Dict[int, float] = {}
+    for m in series:
+        match = _FLEET_RE.match(m)
+        if match:
+            fleet_latest[int(match.group(1))] = float(series[m][-1]["value"])
+    fleet_scaling_html = ""
+    if len(fleet_latest) >= 2:
+        counts_f = sorted(fleet_latest)
+        g1, gmax = fleet_latest[counts_f[0]], fleet_latest[counts_f[-1]]
+        speedup_f = round(gmax / g1, 2) if g1 else None
+        fleet_cells = "".join(
+            f"<tr><td>{r}</td><td style='text-align:right'>"
+            f"{fleet_latest[r]:g}/s</td><td style='text-align:right'>"
+            f"{(round(fleet_latest[r] / g1, 2) if g1 else '—')}×"
+            f"</td></tr>" for r in counts_f)
+        fleet_scaling_html = f"""<h2>Serve fleet scaling (replicas vs goodput)</h2>
+<p class="legend">Latest <code>fleet_goodput_r&lt;N&gt;_per_s</code> per
+replica count; dashed line = ideal linear scaling. Max-replica speedup:
+<b>{speedup_f}×</b> at {counts_f[-1]} replicas
+(<code>fleet_scaling</code>).</p>
+{_fleet_scaling_svg(fleet_latest)}
+<table><tr><th>replicas</th><th>goodput</th><th>speedup vs 1</th></tr>
+{fleet_cells}
+</table>"""
     run_rows = []
     for run in runs:
         env = run.get("environment") or {}
@@ -310,6 +377,7 @@ datapoints.</p>
 <th>points</th><th>sentinel</th><th>SLO</th></tr>
 {''.join(serve_rows)}
 </table>''' if serve_rows else '')}
+{fleet_scaling_html}
 {gen_scaling_html}
 <h2>Metric trajectories</h2>
 <table><tr><th>metric</th><th>trajectory</th><th>latest</th><th>backend</th>

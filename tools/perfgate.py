@@ -60,7 +60,16 @@ jax import, no device, no tunnel):
                               configuration fails the gate even on a
                               cold ledger (chaos:
                               ``perfgate_overload=0.5``), from round
-                              10 on (docs/SERVE.md "Overload control").
+                              10 on (docs/SERVE.md "Overload control");
+- ``perfgate_fleet_failover_ms`` the serve fleet's kill-one failover
+                              latency: a forked 3-replica fleet, one
+                              replica SIGKILLed, the time to detect the
+                              dead replica and re-send the aimed
+                              request to the next ring replica under
+                              its idempotency key — the fleet's
+                              availability hot path, gated from round
+                              11 on (chaos: ``perfgate_fleet=3``;
+                              docs/SERVE.md "Fleet").
 
 Each run appends one ledger run (git sha + environment fingerprint) and
 is classified by :mod:`consensus_specs_tpu.obs.sentinel` against the
@@ -427,6 +436,49 @@ def measure_overload_goodput_ratio() -> float:
     return ratio * _chaos_factor("perfgate_overload_goodput_ratio")
 
 
+def measure_fleet_failover_ms() -> float:
+    """The serve fleet's failover latency, end-to-end on host, jax-free
+    (docs/SERVE.md "Fleet"): a real forked 3-replica fleet; a router
+    whose health cache still believes a replica is alive aims a request
+    at it right after it is SIGKILLed, so the measured time covers
+    dead-replica detection (torn socket / refused connect) + the
+    idempotency-keyed re-send to the next ring replica. Median over two
+    victims. The measurement asserts the failover actually happened
+    (>=1 failover re-send, an answer delivered) and that the fleet
+    drains exactly-once — a fast number from a fleet that drops
+    requests must fail here, not ship (chaos: ``perfgate_fleet=3``)."""
+    from consensus_specs_tpu.serve.drill import cheap_check, failover_probe
+    from consensus_specs_tpu.serve.fleet import FleetConfig, FleetSupervisor
+
+    sup = FleetSupervisor(FleetConfig(
+        replicas=3, linger_ms=1.0, cache_size=0, max_batch=8,
+        max_respawns=0)).start()
+    try:
+        samples: List[float] = []
+        for round_i in range(2):
+            probe = failover_probe(
+                sup, make_check=lambda i, r=round_i: cheap_check(i, f"pfg{r}"))
+            assert probe["failovers"] >= 1, (
+                f"no failover re-send happened: {probe}")
+            samples.append(probe["failover_ms"])
+            # wait for the monitor to quarantine the corpse before the
+            # next round freezes its membership (else the next probe
+            # could pick the SAME dead slot as its victim)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and any(
+                    name == probe["victim"] for name, _ in sup.members()):
+                time.sleep(0.02)
+    finally:
+        drains = sup.stop()
+        for name, r in drains.items():
+            assert r.get("accepted", 0) == (r.get("flushed_rows", 0)
+                                            + r.get("shed_rows", 0)), (
+                f"fleet drain accounting broken for {name}: {r}")
+    samples.sort()
+    return samples[len(samples) // 2] * _chaos_factor(
+        "perfgate_fleet_failover_ms")
+
+
 # the absolute no-collapse floor for the overload slice: goodput under
 # 3x overload must stay within this fraction of saturation goodput.
 # Absolute (like the SLO gate), because a cold ledger must still refuse
@@ -442,6 +494,7 @@ MEASUREMENTS: Tuple[Tuple[str, Callable[[], float]], ...] = (
     ("perfgate_serve_rtt_ms", measure_serve_rtt_ms),
     ("perfgate_chain_sim_ms", measure_chain_sim_ms),
     ("perfgate_overload_goodput_ratio", measure_overload_goodput_ratio),
+    ("perfgate_fleet_failover_ms", measure_fleet_failover_ms),
 )
 
 

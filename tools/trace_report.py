@@ -4,8 +4,9 @@ case latency percentiles, the sched flush's per-bucket pad/compile
 table, the sharded generator's per-rank utilization (sched.worker /
 sched.merge spans: wall vs busy per rank, respawn/degrade tallies,
 merge cost), the serve section (per-endpoint latency percentiles,
-queue-wait vs flush split, bucket-sharing fan-in per request), and the
-persistent compile cache's hit traffic.
+queue-wait vs flush split, bucket-sharing fan-in per request, and the
+fleet router's per-replica fan-out over ``serve.route`` spans incl.
+failover re-sends), and the persistent compile cache's hit traffic.
 
 Usage:
     python tools/trace_report.py <trace-dir | trace.json> [--json <path>]
@@ -151,12 +152,24 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     flush_durs: List[float] = []
     fanins: List[int] = []
     flush_client_counts: List[int] = []
+    route_by_replica: Dict[str, int] = {}
+    route_failovers = 0
+    route_requests = 0
     for s in spans:
         name = s.get("name")
         dur_ms = float(s.get("dur") or 0) / 1e3
         if name == "serve.request":
             method = str((s.get("attrs") or {}).get("method", "?"))
             serve_by_method.setdefault(method, []).append(dur_ms)
+        elif name == "serve.route":
+            # the fleet router's per-replica fan-out (docs/SERVE.md
+            # "Fleet"): which replica each routed request landed on,
+            # plus how many needed a failover re-send
+            a = s.get("attrs") or {}
+            replica = str(a.get("replica") or a.get("owner") or "?")
+            route_by_replica[replica] = route_by_replica.get(replica, 0) + 1
+            route_failovers += int(a.get("failovers") or 0)
+            route_requests += 1
         elif name == "serve.queue_wait":
             queue_waits.append(dur_ms)
         elif name == "serve.flush":
@@ -194,6 +207,12 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             "mean": round(sum(fanins) / len(fanins), 2),
             "max": max(fanins),
             "shared_client_traces_max": max(flush_client_counts, default=0),
+        }
+    if route_requests:
+        serve["route_fanout"] = {
+            "requests": route_requests,
+            "by_replica": dict(sorted(route_by_replica.items())),
+            "failovers": route_failovers,
         }
 
     # --- sim section: the chain simulator's per-slot/per-epoch latency
@@ -379,6 +398,13 @@ def print_summary(summary: Dict[str, Any]) -> None:
               f"request(s)/bucket over {fanin['requests']} request(s) "
               f"(max {fanin['shared_client_traces_max']} distinct client "
               f"trace(s) in one flush)")
+    route = serve.get("route_fanout")
+    if route:
+        per = "  ".join(f"{name}={n}"
+                        for name, n in route["by_replica"].items())
+        print(f"  serve route fan-out: {route['requests']} routed request(s) "
+              f"over {len(route['by_replica'])} replica(s) [{per}], "
+              f"{route['failovers']} failover re-send(s)")
     sim = summary.get("sim") or {}
     if sim:
         print("\nchain sim:")

@@ -25,7 +25,8 @@ DEVICE_TESTS = tests/test_bls_device.py tests/test_curve_device.py \
 .PHONY: test citest test-fast test-device test-mainnet lint docs generate_tests gen_% replay bench \
         dryrun detect_generator_incomplete clean-vectors chaos trace perfgate perf-report gen-bench \
         gen-shard-smoke warm-cache serve serve-smoke serve-bench serve-canary slo-report sim \
-        sim-smoke device-probe overload-drill overload-smoke fleet-drill fleet-smoke help
+        sim-smoke device-probe overload-drill overload-smoke fleet-drill fleet-smoke fuzz \
+        fuzz-smoke help
 
 # the fault-injection suite: supervisor/taxonomy units, chaos replay
 # (tampered vectors), induced backend failures, generator crash/resume
@@ -62,6 +63,8 @@ help:
 	@echo "slo-report            serve SLO report: objectives, latest observations, 1h/6h/24h burn rates over $(LEDGER)"
 	@echo "sim                   2048-slot seeded chain simulation (forks/reorgs/equivocations), vectorized-vs-oracle differential + chaos drill -> $(LEDGER)"
 	@echo "sim-smoke             short chain-sim differential + chaos drill (the citest slice; docs/SIM.md)"
+	@echo "fuzz                  sharded differential fuzzing long-haul: oracle vs engine vs served path, FUZZ_MINUTES=N budget, findings shrunk + journaled -> ./fuzz-farm (docs/FUZZ.md)"
+	@echo "fuzz-smoke            deterministic fuzz drill (citest slice): clean build finds ZERO divergences; a planted engine defect is found AND shrunk; fuzz_execs_per_s -> $(LEDGER)"
 	@echo "device-probe          opportunistic device probe: bank backend:jax ledger points for the headline keys when the tunnel is healthy"
 
 # parallelize like the reference (ref Makefile:100-106) when pytest-xdist
@@ -83,6 +86,7 @@ citest:
 	$(MAKE) trace
 	$(MAKE) gen-shard-smoke
 	$(MAKE) sim-smoke
+	$(MAKE) fuzz-smoke
 	$(MAKE) serve-smoke
 	$(MAKE) serve-canary
 	$(MAKE) overload-smoke
@@ -186,6 +190,22 @@ sim:
 
 sim-smoke:
 	$(PYTHON) tools/sim_run.py --slots 96 --chaos-drill --ledger $(LEDGER)
+
+# the conformance fuzzing farm (docs/FUZZ.md, ROADMAP #4): seeded
+# mutation corpus (SSZ byte corruption + spec-level wreckage) through
+# process_block on the interpreted oracle, the vectorized engine, and
+# the served wire path simultaneously — any divergence is a finding,
+# shrunk to a minimal reproducer and journaled crash-safe. The
+# long-haul fans out across forked supervised workers and exits 3 when
+# findings exist; the smoke is the deterministic citest twin (clean
+# build = zero findings, planted engine defect = found and shrunk).
+FUZZ_MINUTES ?= 5
+FUZZ_WORKERS ?= 2
+fuzz:
+	$(PYTHON) tools/fuzz_farm.py --minutes $(FUZZ_MINUTES) --workers $(FUZZ_WORKERS) --ledger $(LEDGER)
+
+fuzz-smoke:
+	$(PYTHON) tools/fuzz_farm.py --smoke --ledger $(LEDGER)
 
 # ROADMAP #2's second half: the moment the tunnel is healthy, bank
 # backend:"jax" datapoints for the round-4 headline keys by running just

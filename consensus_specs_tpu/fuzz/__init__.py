@@ -1,0 +1,49 @@
+"""Conformance fuzzing farm (docs/FUZZ.md, ROADMAP #4).
+
+The repo's differential planes each check ONE implementation pair on
+inputs somebody thought to write down; this package closes the loop
+with an input *generator*: seeded mutation fuzzing of ``process_block``
+across THREE implementations at once — the interpreted oracle, the
+vectorized engine, and the served wire path — where any disagreement in
+verdict, post-state ``hash_tree_root``, or rejection class is a
+finding, automatically shrunk to a minimal reproducer and journaled
+crash-safe.
+
+- :mod:`mutate` — the shared mutation taxonomy: SSZ-level byte
+  corruption (the replayer's taxonomy as an applier) + spec-level
+  wreckage of valid blocks.
+- :mod:`corpus` — the seeded corpus: valid (pre, block) bases from a
+  short simulated chain, derived cases a pure function of
+  (fork, preset, seed, index).
+- :mod:`executor` — the three-path differential executor and outcome
+  normalization; the planted-defect test hook.
+- :mod:`shrink` — greedy mutation-subset + field-level + byte-level
+  minimization, re-verified against all three paths per step.
+- :mod:`journal` — fsync'd per-rank findings journals, resume
+  watermarks, the deterministic sorted merge.
+- :mod:`farm` — forked supervised workers on the ``sched.shard``
+  contract (respawn-and-resume, degrade-in-process), chaos sites
+  ``fuzz.exec`` / ``fuzz.shrink``.
+
+Entry points: ``tools/fuzz_farm.py`` (``make fuzz`` /
+``make fuzz-smoke``), ``perfgate_fuzz_execs_per_s`` in
+``tools/perfgate.py``.
+"""
+from __future__ import annotations
+
+from .corpus import CorpusBuilder, FuzzCase  # noqa: F401
+from .executor import (  # noqa: F401
+    CaseResult,
+    DifferentialExecutor,
+    Outcome,
+    REJECTED,
+)
+from .farm import FarmConfig, FarmReport, run_farm, run_slice  # noqa: F401
+from .journal import (  # noqa: F401
+    FindingsJournal,
+    load_merged,
+    merge_findings,
+    merged_digest,
+)
+from .mutate import BYTE_OPS, WRECKAGE_OPS  # noqa: F401
+from .shrink import shrink_finding  # noqa: F401

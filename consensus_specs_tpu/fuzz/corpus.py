@@ -1,0 +1,164 @@
+"""Seeded fuzz corpus: a pure function of ``(fork, preset, seed)``.
+
+The corpus is built in two stages:
+
+1. **valid bases** — a short simulated chain (genesis registry, empty
+   and attestation-carrying blocks built with the test_framework
+   helpers, BLS stubbed) yields ``(pre_state, block)`` pairs the oracle
+   provably accepts; the pre is snapshotted AT the block's slot so the
+   executor is strictly ``process_block`` — no slot advance anywhere,
+   which keeps an overflowed-slot mutation a rejection, never a hang.
+2. **derived cases** — each corpus index deterministically names its
+   recipe: a valid base replayed as-is (the differential's control
+   group), a wreckage-mutated base (:mod:`mutate` spec-level ops, 1-3
+   per case), a byte-mutated base (SSZ-level corruption ops), or a
+   ``debug/random_value`` object in one of the 6 RandomizationModes
+   encoded as the block (adversarial garbage that exercises the decode
+   surface and the header rejection ladder).
+
+Every case id, mutation stream, and payload derives from
+``Random(f"fuzz:{fork}:{preset}:{seed}:{index}")`` substreams keyed on
+the case INDEX only — never on rank, worker count, or wall clock — so
+any shard of the corpus is recomputable anywhere (the same contract as
+``sched.shard``'s slices) and the merged findings of a sharded farm are
+byte-identical to a serial run.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from random import Random
+from typing import Any, Iterator, List, Optional, Tuple
+
+from .mutate import BYTE_OPS, WRECKAGE_OPS, apply_byte_op, apply_wreckage
+
+# corpus mix per 8 indices: 1 valid control, 4 wreckage, 2 byte, 1 random
+_KIND_WHEEL = ("valid", "wreck", "wreck", "byte", "wreck", "byte", "random",
+               "wreck")
+
+
+@dataclass(frozen=True)
+class FuzzCase:
+    """One executable differential case (all byte payloads, no live SSZ
+    objects — cases cross process boundaries and journals as hex)."""
+
+    case_id: str
+    fork: str
+    preset: str
+    pre: bytes
+    block: bytes
+    kind: str                         # valid | wreck | byte | random
+    base_index: int                   # which valid base it derived from
+    mutations: Tuple[str, ...] = field(default=())
+
+
+def case_seed(fork: str, preset: str, seed: int, index: int) -> str:
+    return f"fuzz:{fork}:{preset}:{seed}:{index}"
+
+
+class CorpusBuilder:
+    """Builds the valid bases once (cached), then materializes any case
+    index on demand — the per-worker entry point: a rank materializes
+    only the indices of its slice."""
+
+    def __init__(self, spec: Any, fork: str, preset: str, seed: int) -> None:
+        self.spec = spec
+        self.fork = fork
+        self.preset = preset
+        self.seed = seed
+        self._bases: Optional[List[Tuple[bytes, bytes]]] = None
+
+    # -- valid bases ----------------------------------------------------
+
+    def bases(self) -> List[Tuple[bytes, bytes]]:
+        if self._bases is None:
+            self._bases = _build_bases(self.spec, self.seed)
+        return self._bases
+
+    # -- case materialization -------------------------------------------
+
+    def case(self, index: int) -> FuzzCase:
+        """The case at ``index`` — a pure function of the corpus key."""
+        bases = self.bases()
+        rng = Random(case_seed(self.fork, self.preset, self.seed, index))
+        kind = _KIND_WHEEL[index % len(_KIND_WHEEL)]
+        base_index = rng.randrange(len(bases))
+        pre, block = bases[base_index]
+        mutations: Tuple[str, ...] = ()
+
+        if kind == "wreck":
+            ops = tuple(rng.sample(sorted(WRECKAGE_OPS), rng.randint(1, 3)))
+            mutated = apply_wreckage(
+                self.spec, block, ops,
+                case_seed(self.fork, self.preset, self.seed, index))
+            if mutated is None:       # no op applied: fall back to control
+                kind, mutated = "valid", block
+            else:
+                mutations = ops
+            block = mutated
+        elif kind == "byte":
+            ops = tuple(rng.sample(sorted(BYTE_OPS), rng.randint(1, 2)))
+            for op in ops:
+                block = apply_byte_op(
+                    op, block,
+                    case_seed(self.fork, self.preset, self.seed, index))
+            mutations = ops
+        elif kind == "random":
+            block, mode_name = self._random_block(rng)
+            mutations = (f"random:{mode_name}",)
+
+        case_id = f"f{self.seed:04d}-{index:06d}-{kind}"
+        return FuzzCase(case_id=case_id, fork=self.fork, preset=self.preset,
+                        pre=pre, block=block, kind=kind,
+                        base_index=base_index, mutations=mutations)
+
+    def cases(self, indices) -> Iterator[FuzzCase]:
+        for i in indices:
+            yield self.case(i)
+
+    def _random_block(self, rng: Random) -> Tuple[bytes, str]:
+        from ..debug.random_value import RandomizationMode, get_random_ssz_object
+
+        mode = RandomizationMode(rng.randrange(6))
+        obj = get_random_ssz_object(rng, self.spec.BeaconBlock,
+                                    max_bytes_length=256, max_list_length=4,
+                                    mode=mode, chaos=False)
+        return bytes(obj.encode_bytes()), mode.to_name()
+
+
+def _build_bases(spec: Any, seed: int, n_blocks: int = 6,
+                 validators: int = 32) -> List[Tuple[bytes, bytes]]:
+    """The short valid chain: ``n_blocks`` (pre@slot, block) pairs the
+    oracle accepts, blocks 2+ carrying one real attestation. BLS is
+    stubbed for the duration (signatures zeroed, verification passes)
+    so base building is fast and deterministic."""
+    from ..crypto import bls
+    from ..test_framework.attestations import get_valid_attestation
+    from ..test_framework.block import build_empty_block_for_next_slot
+    from ..test_framework.genesis import create_genesis_state
+
+    was_active = bls.bls_active
+    bls.bls_active = False
+    try:
+        state = create_genesis_state(
+            spec, [spec.MAX_EFFECTIVE_BALANCE] * validators,
+            spec.MAX_EFFECTIVE_BALANCE)
+        bases: List[Tuple[bytes, bytes]] = []
+        for i in range(n_blocks):
+            block = build_empty_block_for_next_slot(spec, state)
+            if i >= 1:
+                # attest the previous slot; includable at delay 1
+                try:
+                    att = get_valid_attestation(spec, state, signed=False)
+                    block.body.attestations.append(att)
+                except Exception:
+                    pass
+            pre = state.copy()
+            spec.process_slots(pre, block.slot)
+            block.state_root = b"\x00" * 32  # process_block never reads it
+            bases.append((bytes(pre.encode_bytes()),
+                          bytes(block.encode_bytes())))
+            state = pre.copy()
+            spec.process_block(state, block)
+        return bases
+    finally:
+        bls.bls_active = was_active

@@ -1,0 +1,218 @@
+"""Three-path differential execution: ONE fuzz case through the
+interpreted oracle, the vectorized engine, and the served wire path —
+any disagreement is a finding (docs/FUZZ.md).
+
+The contract mirrors the repo's other differential planes (engine
+crosscheck, chain-sim checkpoints) but at single-case granularity and
+across THREE implementations at once:
+
+- **oracle** — ``spec.process_block`` with every engine hook
+  uninstalled: the always-correct interpreted path.
+- **engine** — the same call with the vectorized engine installed
+  (``use_batched_attestations`` owns the block path; the epoch hooks
+  ride along so an installed farm matches the sim's configuration).
+- **serve** — the case round-trips the v1 wire contract (hex encode,
+  ``protocol`` param parsing, the daemon's decode/reject ladder) —
+  either through an in-process :class:`SpecService` (deterministic,
+  fork-cheap: the smoke/perfgate shape) or a real localhost daemon via
+  :class:`ServeClient` (the long-haul farm shape).
+
+Outcomes normalize to ``(verdict, detail)``:
+
+    ("accept", <post-state hash_tree_root hex>)
+    ("reject", <error class from the spec's rejection ladder>)
+    ("undecodable", "pre" | "block")
+
+Anything outside the spec's rejection tuple is normalized to
+``("reject", "uncaught")`` on every path (the serve path maps its 500
+there), so a *different* uncaught class on two paths still compares
+equal — class granularity is only meaningful inside the ladder the
+paths share.
+
+The planted-defect hook (``CONSENSUS_SPECS_TPU_FUZZ_DEFECT=engine``)
+perturbs the ENGINE path's accepted post-root whenever the block
+carries at least one attestation — a test-only knob, exactly like the
+perfgate chaos drills, that the smoke uses to prove the farm finds and
+shrinks a real divergence (and that a clean build reports none).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import re
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+from .corpus import FuzzCase
+
+# the spec's invalid-block surface: rejection control flow, not faults
+# (sim/driver.py's _REJECTED plus OverflowError for uint wrap-arounds
+# surfaced by mutated counters). MUST equal
+# serve.service.PROCESS_BLOCK_REJECTED — the served path classifies the
+# same ladder, or error surface alone would read as divergence
+# (tests/test_fuzz.py pins the two tuples together).
+REJECTED = (AssertionError, IndexError, ValueError, KeyError, OverflowError)
+
+DEFECT_ENV = "CONSENSUS_SPECS_TPU_FUZZ_DEFECT"
+
+_SERVE_CLASS_RE = re.compile(r"process_block: ([A-Za-z_][A-Za-z0-9_]*)\(")
+
+PATHS = ("oracle", "engine", "serve")
+
+
+@dataclass(frozen=True)
+class Outcome:
+    verdict: str   # accept | reject | undecodable
+    detail: str
+
+    def as_tuple(self) -> Tuple[str, str]:
+        return (self.verdict, self.detail)
+
+
+@dataclass
+class CaseResult:
+    case: FuzzCase
+    outcomes: Dict[str, Outcome]
+
+    @property
+    def divergence(self) -> Optional[Dict[str, Any]]:
+        """None when all three paths agree; else the finding skeleton:
+        the divergence kind plus every path's outcome."""
+        outs = self.outcomes
+        tuples = {p: outs[p].as_tuple() for p in PATHS}
+        if len(set(tuples.values())) == 1:
+            return None
+        verdicts = {p: outs[p].verdict for p in PATHS}
+        if len(set(verdicts.values())) > 1:
+            kind = "verdict"
+        elif outs["oracle"].verdict == "accept":
+            kind = "post_root"
+        else:
+            kind = "error_class"
+        disagree = sorted(p for p in PATHS
+                          if tuples[p] != tuples["oracle"]) or ["oracle"]
+        return {"kind": kind, "disagrees_with_oracle": disagree,
+                "outcomes": {p: list(tuples[p]) for p in PATHS}}
+
+
+@contextlib.contextmanager
+def _engine_installed(on: bool):
+    """Install (or explicitly uninstall) the vectorized engine for the
+    duration, restoring the caller's configuration after."""
+    from .. import engine
+
+    was_vec = engine.is_vectorized()
+    was_batch = engine.is_batched_attestations()
+    if on:
+        engine.use_vectorized_epoch()
+        engine.use_batched_attestations()
+    else:
+        engine.use_interpreted_epoch()
+        engine.use_direct_attestations()
+    try:
+        yield
+    finally:
+        (engine.use_vectorized_epoch if was_vec
+         else engine.use_interpreted_epoch)()
+        (engine.use_batched_attestations if was_batch
+         else engine.use_direct_attestations)()
+
+
+def _defect_armed() -> bool:
+    return os.environ.get(DEFECT_ENV, "") == "engine"
+
+
+class DifferentialExecutor:
+    """Executes cases three ways against one (fork, preset) spec. The
+    serve path is pluggable: ``service`` (in-process SpecService) or a
+    ``client`` with a ``.call(method, params)`` surface (ServeClient —
+    the real wire). Exactly one of the two must be provided."""
+
+    def __init__(self, spec: Any, fork: str, preset: str,
+                 service: Any = None, client: Any = None) -> None:
+        if (service is None) == (client is None):
+            raise ValueError("provide exactly one of service=/client=")
+        self.spec = spec
+        self.fork = fork
+        self.preset = preset
+        self.service = service
+        self.client = client
+
+    # -- direct paths ---------------------------------------------------
+
+    def _run_direct(self, case: FuzzCase, engine_on: bool) -> Outcome:
+        spec = self.spec
+        try:
+            state = spec.BeaconState.decode_bytes(case.pre)
+        except Exception:
+            return Outcome("undecodable", "pre")
+        try:
+            block = spec.BeaconBlock.decode_bytes(case.block)
+        except Exception:
+            return Outcome("undecodable", "block")
+        with _engine_installed(engine_on):
+            try:
+                spec.process_block(state, block)
+            except REJECTED as e:
+                return Outcome("reject", type(e).__name__)
+            except Exception:
+                return Outcome("reject", "uncaught")
+        root = bytes(state.hash_tree_root())
+        if engine_on and _defect_armed() and len(block.body.attestations):
+            # the planted engine defect: a deterministic post-root
+            # perturbation on attestation-carrying blocks (test hook)
+            root = root[:-1] + bytes([root[-1] ^ 0x01])
+        return Outcome("accept", root.hex())
+
+    # -- served path ----------------------------------------------------
+
+    def _serve_params(self, case: FuzzCase) -> Dict[str, Any]:
+        from ..serve import protocol
+
+        return {"fork": self.fork, "preset": self.preset,
+                "pre": protocol.to_hex(case.pre),
+                "block": protocol.to_hex(case.block)}
+
+    def _run_served(self, case: FuzzCase) -> Outcome:
+        from ..serve import protocol
+
+        params = self._serve_params(case)
+        try:
+            if self.client is not None:
+                result = self.client.call("process_block", params)
+            else:
+                result = self.service.handle("process_block", params)
+        except protocol.RequestError as e:
+            return _serve_error_outcome(e.code, e.message)
+        except Exception as e:
+            # the client surfaces wire errors as exceptions carrying the
+            # error payload; anything else is the daemon's 500 surface
+            code = getattr(e, "code", protocol.INTERNAL)
+            return _serve_error_outcome(str(code),
+                                        getattr(e, "message", str(e)))
+        root = str(result.get("root", ""))
+        return Outcome("accept", root[2:] if root.startswith("0x") else root)
+
+    # -- entry point ----------------------------------------------------
+
+    def execute(self, case: FuzzCase) -> CaseResult:
+        return CaseResult(case=case, outcomes={
+            "oracle": self._run_direct(case, engine_on=False),
+            "engine": self._run_direct(case, engine_on=True),
+            "serve": self._run_served(case),
+        })
+
+
+def _serve_error_outcome(code: str, message: str) -> Outcome:
+    from ..serve import protocol
+
+    if code == protocol.BAD_REQUEST:
+        if "does not decode as BeaconState" in message:
+            return Outcome("undecodable", "pre")
+        if "does not decode as BeaconBlock" in message:
+            return Outcome("undecodable", "block")
+        m = _SERVE_CLASS_RE.search(message)
+        if m and m.group(1) in {c.__name__ for c in REJECTED}:
+            return Outcome("reject", m.group(1))
+        return Outcome("reject", "uncaught")
+    return Outcome("reject", "uncaught")

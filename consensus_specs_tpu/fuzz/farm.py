@@ -1,0 +1,394 @@
+"""The sharded differential fuzzing farm (docs/FUZZ.md, ROADMAP #4).
+
+One farm run = a fixed seeded corpus fanned out across ``workers``
+forked supervised processes on the ``sched.shard`` machinery's
+contract: a rank's slice is a pure function of (corpus, N, rank) via
+:func:`sched.shard.shard_rank`, so any slice is recomputable anywhere;
+each rank executes its cases through the three-path differential
+executor, shrinks what diverges, and journals findings + progress
+watermarks to its own fsync'd journal; the parent supervises every rank
+(transient death → respawn, which RESUMES from the rank journal;
+deterministic fault → the slice degrades to the in-process serial
+path), then merges the rank journals into the canonical
+``findings.jsonl`` — byte-identical for any worker count, completion
+order, or SIGKILL history (tests/test_fuzz_farm.py drills all three).
+
+Chaos sites (docs/RESILIENCE.md):
+
+- ``fuzz.exec`` — top of every case execution, inside the worker:
+  transient = the case retries (pure function, safe); deterministic =
+  the breaker opens and every later case on that worker degrades to an
+  oracle-only pass (counted ``fuzz.degraded_execs`` — coverage loss is
+  recorded, never silent); kill = the classic SIGKILL drill (the parent
+  respawns the rank, the journal resumes it).
+- ``fuzz.shrink`` — every shrink re-verification: transient = retried;
+  deterministic = shrinking aborts and the finding ships RAW.
+
+Spans/instants: ``fuzz.farm`` (parent), ``fuzz.worker`` (per rank per
+attempt), ``fuzz.case`` (per case, kind + mutation attrs),
+``fuzz.finding`` / ``fuzz.shrunk`` instants, ``fuzz.merge``. Counters:
+``fuzz.execs`` / ``fuzz.findings`` / ``fuzz.degraded_execs`` /
+``fuzz.shard_respawns`` / ``fuzz.shard_degraded``.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Dict, List
+
+from .. import obs
+from ..resilience import (
+    RetryPolicy,
+    TRANSIENT,
+    chaos,
+    record_event,
+    supervised,
+)
+from ..resilience import taxonomy
+from ..sched.shard import _Worker, shard_rank
+from . import journal as fjournal
+from .corpus import CorpusBuilder, FuzzCase
+from .executor import CaseResult, REJECTED, DifferentialExecutor, Outcome
+from .journal import FindingsJournal, merge_findings
+from .shrink import shrink_finding
+
+# one respawn per rank, same shape as the sharded generator
+WORKER_RETRY_POLICY = RetryPolicy(max_attempts=2, base_delay_s=0.1,
+                                  max_delay_s=1.0)
+
+RANK_RESULT_FMT = ".fuzz_rank{rank:04d}.result.json"
+
+_FAULT_BY_KIND = {
+    taxonomy.TRANSIENT: taxonomy.TransientFault,
+    taxonomy.DETERMINISTIC: taxonomy.DeterministicFault,
+    taxonomy.ENVIRONMENTAL: taxonomy.EnvironmentalFault,
+}
+
+
+@dataclass
+class FarmConfig:
+    out_dir: Path
+    fork: str = "phase0"
+    preset: str = "minimal"
+    seed: int = 1
+    cases: int = 96
+    workers: int = 2
+    serve_path: str = "service"      # "service" (in-process) | "daemon" (wire)
+    shrink: bool = True
+    max_shrink_steps: int = 400
+    progress_every: int = 16
+
+
+@dataclass
+class FarmReport:
+    config: FarmConfig
+    execs: int = 0
+    degraded_execs: int = 0
+    findings: int = 0
+    shrunk: int = 0
+    seconds: float = 0.0
+    merged: Dict[str, Dict[str, Any]] = field(default_factory=dict)
+    degraded_slices: int = 0
+    respawns: int = 0
+
+    @property
+    def execs_per_s(self) -> float:
+        return self.execs / self.seconds if self.seconds > 0 else 0.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        digest = fjournal.merged_digest(self.config.out_dir)
+        return {
+            "fork": self.config.fork, "preset": self.config.preset,
+            "seed": self.config.seed, "cases": self.config.cases,
+            "workers": self.config.workers,
+            "serve_path": self.config.serve_path,
+            "execs": self.execs, "degraded_execs": self.degraded_execs,
+            "findings": self.findings, "shrunk": self.shrunk,
+            "seconds": round(self.seconds, 3),
+            "execs_per_s": round(self.execs_per_s, 2),
+            "degraded_slices": self.degraded_slices,
+            "respawns": self.respawns,
+            "merged_findings": len(self.merged),
+            "merged_digest": digest[1] if digest else None,
+        }
+
+
+def slice_indices(cfg: FarmConfig, rank: int) -> List[int]:
+    """This rank's case indices — the shard function is the sharded
+    generator's, with the corpus key standing in for (runner, fork)."""
+    return [i for i in range(cfg.cases)
+            if shard_rank("fuzz", f"{cfg.fork}:{cfg.seed}", i,
+                          cfg.workers) == rank]
+
+
+# ---------------------------------------------------------------------------
+# worker body (runs forked, or in-process for a degraded slice)
+# ---------------------------------------------------------------------------
+
+
+def _oracle_only(executor: DifferentialExecutor, case: FuzzCase):
+    """The degraded exec: no differential coverage, but the corpus
+    position is consumed so resume/merge stay deterministic."""
+    out = executor._run_direct(case, engine_on=False)
+    return CaseResult(case=case, outcomes={
+        "oracle": out, "engine": out, "serve": out})
+
+
+def run_slice(cfg: FarmConfig, rank: int, label: str = "") -> Dict[str, Any]:
+    """Execute one rank's slice with journal resume. Returns the rank
+    counts dict (also written to the rank result file by the forked
+    wrapper)."""
+    from ..crypto import bls
+    from ..serve import SpecService, VerifyBatcher
+    from ..specs import build_spec
+
+    out_dir = Path(cfg.out_dir)
+    jr = FindingsJournal(out_dir, rank)
+    spec = build_spec(cfg.fork, cfg.preset)
+    builder = CorpusBuilder(spec, cfg.fork, cfg.preset, cfg.seed)
+
+    was_bls = bls.bls_active
+    bls.bls_active = False           # consistent across all three paths
+    service = SpecService(forks=(cfg.fork,), presets=(cfg.preset,),
+                          batcher=VerifyBatcher(linger_ms=1)).start()
+    daemon = client = None
+    if cfg.serve_path == "daemon":
+        from ..serve import ServeClient, ServeDaemon
+
+        daemon = ServeDaemon(service).start(warm=False)
+        client = ServeClient(daemon.port)
+        executor = DifferentialExecutor(spec, cfg.fork, cfg.preset,
+                                        client=client)
+    else:
+        executor = DifferentialExecutor(spec, cfg.fork, cfg.preset,
+                                        service=service)
+
+    counts = {"execs": jr.resumed_execs, "degraded_execs": 0,
+              "findings": len(jr.findings), "shrunk": len(jr.shrunk),
+              "new_findings": 0}
+    t0 = time.perf_counter()
+    try:
+        # resume debt first: journaled findings that never got shrunk
+        if cfg.shrink:
+            for case_id in jr.unshrunk():
+                case = builder.case(_index_from_id(case_id))
+                base = builder.bases()[case.base_index][1]
+                shrunk = shrink_finding(executor, case, base,
+                                        max_steps=cfg.max_shrink_steps)
+                jr.record_shrunk(case_id, shrunk)
+                counts["shrunk"] += 1
+
+        pending = [i for i in slice_indices(cfg, rank) if i > jr.watermark]
+        since_mark = 0
+        for i in pending:
+            case = builder.case(i)
+
+            def attempt(case: FuzzCase = case):
+                chaos("fuzz.exec")
+                return executor.execute(case)
+
+            def degraded(case: FuzzCase = case):
+                counts["degraded_execs"] += 1
+                obs.count("fuzz.degraded_execs")
+                return _oracle_only(executor, case)
+
+            with obs.span("fuzz.case", rank=rank, kind=case.kind,
+                          muts=",".join(case.mutations)):
+                result = supervised(attempt, domain="fuzz",
+                                    capability="fuzz.exec",
+                                    fallback=degraded)
+                counts["execs"] += 1
+                obs.count("fuzz.execs")
+                div = result.divergence
+                if div is not None:
+                    finding = _finding_record(case, div)
+                    if jr.record_finding(case.case_id, finding):
+                        counts["findings"] += 1
+                        counts["new_findings"] += 1
+                        obs.count("fuzz.findings")
+                        obs.instant("fuzz.finding", case=case.case_id,
+                                    kind=div["kind"])
+                        print(f"{label}FINDING {case.case_id}: {div['kind']} "
+                              f"({','.join(div['disagrees_with_oracle'])} "
+                              f"vs oracle)", file=sys.stderr)
+                    if cfg.shrink and case.case_id not in jr.shrunk:
+                        base = builder.bases()[case.base_index][1]
+                        shrunk = shrink_finding(
+                            executor, case, base,
+                            max_steps=cfg.max_shrink_steps)
+                        jr.record_shrunk(case.case_id, shrunk)
+                        counts["shrunk"] += 1
+                        obs.instant("fuzz.shrunk", case=case.case_id,
+                                    steps=shrunk["steps"],
+                                    size=shrunk["size"])
+            since_mark += 1
+            if since_mark >= cfg.progress_every:
+                jr.record_progress(i, counts["execs"])
+                since_mark = 0
+        if pending:
+            jr.record_progress(pending[-1], counts["execs"])
+    finally:
+        if client is not None:
+            client.close()
+        if daemon is not None:
+            daemon.drain(5)
+        else:
+            service.batcher.drain(5)
+        service.stop()
+        bls.bls_active = was_bls
+    counts["seconds"] = round(time.perf_counter() - t0, 3)
+    return counts
+
+
+def _index_from_id(case_id: str) -> int:
+    return int(case_id.split("-")[1])
+
+
+def _finding_record(case: FuzzCase, div: Dict[str, Any]) -> Dict[str, Any]:
+    """The journaled finding: divergence + enough case identity to
+    reproduce it (the pre state is recoverable from the corpus key +
+    base index; its digest pins it)."""
+    return {
+        "kind": div["kind"],
+        "disagrees_with_oracle": div["disagrees_with_oracle"],
+        "outcomes": div["outcomes"],
+        "case_kind": case.kind,
+        "mutations": list(case.mutations),
+        "base_index": case.base_index,
+        "fork": case.fork, "preset": case.preset,
+        "block": case.block.hex(),
+        "pre_sha256": hashlib.sha256(case.pre).hexdigest(),
+    }
+
+
+# ---------------------------------------------------------------------------
+# forked workers + supervision (the sched.shard pattern)
+# ---------------------------------------------------------------------------
+
+
+def _result_path(out_dir: Path, rank: int) -> Path:
+    return Path(out_dir) / RANK_RESULT_FMT.format(rank=rank)
+
+
+def _spawn_worker(cfg: FarmConfig, rank: int) -> _Worker:
+    trace_env = obs.child_env().get(obs.TRACE_ENV)
+    sys.stdout.flush()
+    sys.stderr.flush()
+    pid = os.fork()
+    if pid:
+        return _Worker(rank, pid)
+
+    # ---- child ----
+    code = taxonomy.EX_SOFTWARE
+    try:
+        obs.fork_child_reinit(trace_env)
+        with obs.span("fuzz.worker", rank=rank, workers=cfg.workers):
+            counts = run_slice(cfg, rank, label=f"[f{rank}] ")
+        result = _result_path(cfg.out_dir, rank)
+        result.parent.mkdir(parents=True, exist_ok=True)
+        with open(result, "w") as f:
+            f.write(json.dumps({"rank": rank, "counts": counts},
+                               sort_keys=True) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        code = 0
+    except BaseException as e:
+        import traceback
+
+        kind = taxonomy.classify(e)
+        try:
+            sys.stderr.write(f"[f{rank}] fuzz worker failed ({kind}): "
+                             f"{traceback.format_exc()}\n")
+        except Exception:
+            pass
+        code = taxonomy.exit_code_for(kind)
+    finally:
+        try:
+            sys.stdout.flush()
+            sys.stderr.flush()
+        except Exception:
+            pass
+        os._exit(code)
+    raise AssertionError("unreachable")  # pragma: no cover
+
+
+def run_farm(cfg: FarmConfig) -> FarmReport:
+    """Drive one sharded farm run: fork, supervise, respawn/degrade,
+    merge. The report aggregates rank counts + the merged findings."""
+    out_dir = Path(cfg.out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    report = FarmReport(config=cfg)
+    t0 = time.perf_counter()
+
+    with obs.span("fuzz.farm", workers=cfg.workers, cases=cfg.cases,
+                  fork=cfg.fork, seed=cfg.seed):
+        procs: Dict[int, _Worker] = {}
+        for rank in range(cfg.workers):
+            procs[rank] = _spawn_worker(cfg, rank)
+
+        for rank in range(cfg.workers):
+
+            def attempt(rank: int = rank) -> Dict[str, Any]:
+                proc = procs.pop(rank, None)
+                if proc is None:
+                    report.respawns += 1
+                    obs.count("fuzz.shard_respawns")
+                    record_event("retry", domain="fuzz.farm",
+                                 capability="fuzz.worker", kind=TRANSIENT,
+                                 detail=f"rank {rank}: respawning slice")
+                    proc = _spawn_worker(cfg, rank)
+                rc = proc.wait()
+                kind = taxonomy.classify_exit(rc)
+                if kind is not None:
+                    raise _FAULT_BY_KIND[kind](
+                        f"fuzz worker rank {rank} exited rc={rc}",
+                        domain="fuzz.farm")
+                with open(_result_path(out_dir, rank)) as f:
+                    return json.load(f)["counts"]
+
+            def degraded(rank: int = rank) -> Dict[str, Any]:
+                live = procs.pop(rank, None)
+                if live is not None:
+                    live.kill()
+                report.degraded_slices += 1
+                obs.count("fuzz.shard_degraded")
+                record_event("fallback", domain="fuzz.farm",
+                             capability="fuzz.worker",
+                             detail=f"rank {rank}: slice degraded to the "
+                                    "in-process serial path")
+                with obs.span("fuzz.worker", rank=rank, workers=cfg.workers,
+                              degraded=True):
+                    return run_slice(cfg, rank, label=f"[f{rank}*] ")
+
+            counts = supervised(attempt, domain="fuzz.farm",
+                                policy=WORKER_RETRY_POLICY,
+                                fallback=degraded)
+            report.execs += int(counts.get("execs", 0))
+            report.degraded_execs += int(counts.get("degraded_execs", 0))
+            report.findings += int(counts.get("findings", 0))
+            report.shrunk += int(counts.get("shrunk", 0))
+
+        with obs.span("fuzz.merge", workers=cfg.workers):
+            report.merged = merge_findings(out_dir, cfg.workers)
+        for rank in range(cfg.workers):
+            try:
+                _result_path(out_dir, rank).unlink()
+            except OSError:
+                pass
+
+    report.seconds = time.perf_counter() - t0
+    obs.instant("fuzz.farm_done", workers=cfg.workers, execs=report.execs,
+                findings=len(report.merged),
+                seconds=round(report.seconds, 3))
+    return report
+
+
+__all__ = [
+    "FarmConfig", "FarmReport", "run_farm", "run_slice", "slice_indices",
+    "REJECTED", "Outcome",
+]

@@ -1,0 +1,192 @@
+"""Crash-safe findings journal for the fuzz farm (docs/FUZZ.md).
+
+Same design family as the generator's digest journal
+(resilience/journal.py): per-rank append-only JSONL with fsync on every
+line that must survive a SIGKILL, merged deterministically into one
+canonical ``findings.jsonl`` after every rank lands.
+
+Per-rank journal (``.fuzz_journal.rank<R>.jsonl``) line types:
+
+    {"case": <id>, "finding": {...}}        a divergence, journaled the
+                                            moment it is confirmed
+                                            (fsync BEFORE shrinking)
+    {"case": <id>, "shrunk": {...}}         the shrink result, appended
+                                            after the pass completes
+    {"progress": <index>, "execs": <n>}     watermark: every case of
+                                            this rank's slice at or
+                                            below <index> has been
+                                            executed AND its findings
+                                            (if any) journaled
+
+Resume contract: a respawned rank skips slice indices at or below its
+watermark; indices above it re-execute, and a re-discovered finding
+whose case id is already journaled is NOT re-appended (dedup on load) —
+so a kill at ANY point loses no finding and duplicates none. A finding
+with no shrunk record re-enters the shrinker on resume.
+
+Merge: findings fold by case id (shrunk record attached to its
+finding), progress lines drop, output is written sorted-by-case-id with
+a canonical JSON encoding via tmp+fsync+rename — byte-identical for any
+worker count, completion order, or crash/resume history, because every
+record is a pure function of its case (no timestamps, no pids).
+"""
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+MERGED_NAME = "findings.jsonl"
+RANK_JOURNAL_FMT = ".fuzz_journal.rank{rank:04d}.jsonl"
+
+
+def rank_journal_name(rank: int) -> str:
+    return RANK_JOURNAL_FMT.format(rank=rank)
+
+
+def _load_lines(path: Path) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    if not path.exists():
+        return out
+    with open(path, "rb") as f:
+        for line in f:
+            # a kill mid-append leaves at most one torn trailing line
+            try:
+                entry = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(entry, dict):
+                out.append(entry)
+    return out
+
+
+def encode_finding(case_id: str, record: Dict[str, Any]) -> str:
+    """Canonical one-line encoding shared by rank appends and the
+    merge, so merged bytes are reproducible."""
+    return json.dumps({"case": case_id, **record}, sort_keys=True) + "\n"
+
+
+class FindingsJournal:
+    """One rank's fsync'd append stream + its resume view."""
+
+    def __init__(self, out_dir: Path, rank: int) -> None:
+        self.path = Path(out_dir) / rank_journal_name(rank)
+        self.rank = rank
+        self.findings: Dict[str, Dict[str, Any]] = {}
+        self.shrunk: Dict[str, Dict[str, Any]] = {}
+        self.watermark = -1
+        self.resumed_execs = 0
+        self._load()
+
+    def _load(self) -> None:
+        for entry in _load_lines(self.path):
+            case = entry.get("case")
+            if "finding" in entry and case:
+                self.findings[case] = entry["finding"]
+            elif "shrunk" in entry and case:
+                self.shrunk[case] = entry["shrunk"]
+            elif "progress" in entry:
+                self.watermark = max(self.watermark, int(entry["progress"]))
+                self.resumed_execs = max(self.resumed_execs,
+                                         int(entry.get("execs", 0)))
+
+    def _append(self, obj: Dict[str, Any], fsync: bool = True) -> None:
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        with open(self.path, "a") as f:
+            f.write(json.dumps(obj, sort_keys=True) + "\n")
+            f.flush()
+            if fsync:
+                os.fsync(f.fileno())
+
+    # -- the write surface ---------------------------------------------
+
+    def record_finding(self, case_id: str, finding: Dict[str, Any]) -> bool:
+        """Journal a confirmed divergence. Returns False (and appends
+        nothing) when the case is already journaled — the resume-path
+        dedup that makes re-execution after a kill idempotent."""
+        if case_id in self.findings:
+            return False
+        self._append({"case": case_id, "finding": finding})
+        self.findings[case_id] = finding
+        return True
+
+    def record_shrunk(self, case_id: str, shrunk: Dict[str, Any]) -> bool:
+        if case_id in self.shrunk:
+            return False
+        self._append({"case": case_id, "shrunk": shrunk})
+        self.shrunk[case_id] = shrunk
+        return True
+
+    def record_progress(self, index: int, execs: int) -> None:
+        """Watermark append — fsync'd, because the watermark is the
+        promise that everything at or below it needs no re-execution."""
+        self._append({"progress": index, "execs": execs})
+        self.watermark = max(self.watermark, index)
+
+    def unshrunk(self) -> List[str]:
+        """Findings still owed a shrink pass (resume picks these up)."""
+        return sorted(c for c in self.findings if c not in self.shrunk)
+
+
+def merge_findings(out_dir: Path, workers: int) -> Dict[str, Dict[str, Any]]:
+    """Fold every rank journal (plus any prior merged file) into the
+    canonical sorted ``findings.jsonl``. Completion-order independent;
+    idempotent; crash-safe (tmp+fsync+rename, rank journals removed
+    only after the rename lands)."""
+    out_dir = Path(out_dir)
+    merged_path = out_dir / MERGED_NAME
+    table: Dict[str, Dict[str, Any]] = {}
+    for entry in _load_lines(merged_path):
+        case = entry.pop("case", None)
+        if case:
+            table[case] = entry
+    rank_paths: List[Path] = []
+    for rank in range(workers):
+        path = out_dir / rank_journal_name(rank)
+        rank_paths.append(path)
+        for entry in _load_lines(path):
+            case = entry.get("case")
+            if not case:
+                continue
+            slot = table.setdefault(case, {})
+            if "finding" in entry:
+                slot.setdefault("finding", entry["finding"])
+            if "shrunk" in entry:
+                slot["shrunk"] = entry["shrunk"]
+
+    tmp = out_dir / f"{MERGED_NAME}.merge.{os.getpid()}"
+    with open(tmp, "w") as f:
+        for case in sorted(table):
+            f.write(encode_finding(case, table[case]))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, merged_path)
+    for path in rank_paths:
+        try:
+            path.unlink()
+        except OSError:
+            pass
+    return table
+
+
+def load_merged(out_dir: Path) -> Dict[str, Dict[str, Any]]:
+    table: Dict[str, Dict[str, Any]] = {}
+    for entry in _load_lines(Path(out_dir) / MERGED_NAME):
+        case = entry.pop("case", None)
+        if case:
+            table[case] = entry
+    return table
+
+
+def merged_digest(out_dir: Path) -> Optional[Tuple[int, str]]:
+    """(findings count, sha256 of the merged bytes) — the byte-identity
+    handle the drills compare across worker counts and resumes."""
+    import hashlib
+
+    path = Path(out_dir) / MERGED_NAME
+    if not path.exists():
+        return None
+    data = path.read_bytes()
+    return (len([ln for ln in data.splitlines() if ln.strip()]),
+            hashlib.sha256(data).hexdigest())

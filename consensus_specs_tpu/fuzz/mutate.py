@@ -1,0 +1,311 @@
+"""The fuzzer's shared mutation taxonomy (docs/FUZZ.md).
+
+Two layers, both pure functions of an explicit ``random.Random`` stream
+so a mutated case is reproducible from ``(base bytes, op name, seed)``
+alone — the property the sharded farm's deterministic merge and the
+shrinker's subset re-application both rest on:
+
+- **SSZ-level byte mutations** (:data:`BYTE_OPS`) — the corruption
+  taxonomy the vector replayer classifies when it *finds* it on disk
+  (truncated snappy, tampered bytes — tools/replay_vectors.py), turned
+  into an *applier*: truncation, bit flips, zeroed spans, duplicated
+  spans, appended junk. These attack the decode surface: most products
+  are undecodable, the interesting ones decode into containers the spec
+  never constructs.
+- **spec-level "wreckage" mutations** (:data:`WRECKAGE_OPS`) — a valid
+  decoded block damaged along the spec's own rejection ladder: bad or
+  out-of-range proposer index, stale/garbage FFG targets, overflowed or
+  off-by-one slots, duplicate and equivocating attestations, junk
+  randao reveals, sync-aggregate bit damage, phantom deposits. Some are
+  rejections, some are *accepted-but-different* (graffiti, sync bits) —
+  both matter: the differential contract is about agreement, not about
+  validity.
+
+Every op takes and returns bytes (byte ops) or mutates a decoded block
+in place (wreckage ops, returning a short human description or ``None``
+when the op does not apply to this block/fork). Op order inside the
+registries is stable and part of the corpus seed contract.
+"""
+from __future__ import annotations
+
+from random import Random
+from typing import Any, Callable, Dict, Optional
+
+# ---------------------------------------------------------------------------
+# SSZ-level byte mutations
+# ---------------------------------------------------------------------------
+
+
+def byte_truncate(data: bytes, rng: Random) -> bytes:
+    """Cut the tail off (the replayer's truncated-part corruption)."""
+    if len(data) < 2:
+        return data
+    keep = rng.randint(1, len(data) - 1)
+    return data[:keep]
+
+
+def byte_bitflip(data: bytes, rng: Random) -> bytes:
+    """Flip 1..8 random bits anywhere in the buffer."""
+    if not data:
+        return data
+    out = bytearray(data)
+    for _ in range(rng.randint(1, 8)):
+        i = rng.randrange(len(out))
+        out[i] ^= 1 << rng.randrange(8)
+    return bytes(out)
+
+
+def byte_zero_span(data: bytes, rng: Random) -> bytes:
+    """Zero a contiguous span (a half-flushed page of zeros)."""
+    if not data:
+        return data
+    start = rng.randrange(len(data))
+    length = rng.randint(1, min(64, len(data) - start))
+    return data[:start] + b"\x00" * length + data[start + length:]
+
+
+def byte_dup_span(data: bytes, rng: Random) -> bytes:
+    """Duplicate a span in place (shifts every later offset table)."""
+    if len(data) < 4:
+        return data
+    start = rng.randrange(len(data) - 2)
+    length = rng.randint(1, min(32, len(data) - start))
+    return data[:start + length] + data[start:start + length] + data[start + length:]
+
+
+def byte_extend(data: bytes, rng: Random) -> bytes:
+    """Append junk past the advertised end."""
+    return data + bytes(rng.getrandbits(8) for _ in range(rng.randint(1, 48)))
+
+
+BYTE_OPS: Dict[str, Callable[[bytes, Random], bytes]] = {
+    "truncate": byte_truncate,
+    "bitflip": byte_bitflip,
+    "zero_span": byte_zero_span,
+    "dup_span": byte_dup_span,
+    "extend": byte_extend,
+}
+
+
+def apply_byte_op(op: str, data: bytes, seed: str) -> bytes:
+    """Apply one named byte op with its own derived stream — the
+    shrinker re-applies subsets with the same per-op seed."""
+    return BYTE_OPS[op](data, Random(f"fuzz-byte:{op}:{seed}"))
+
+
+# ---------------------------------------------------------------------------
+# spec-level wreckage mutations (in-place on a decoded BeaconBlock)
+# ---------------------------------------------------------------------------
+
+
+def wreck_bad_proposer(spec: Any, block: Any, rng: Random) -> Optional[str]:
+    """A plausible-but-wrong proposer index (the right validator range,
+    the wrong seat — process_block_header must reject it)."""
+    block.proposer_index = (int(block.proposer_index) + rng.randint(1, 7)) % 2**16
+    return f"proposer_index -> {int(block.proposer_index)}"
+
+
+def wreck_huge_proposer(spec: Any, block: Any, rng: Random) -> Optional[str]:
+    """A proposer index far past the registry (the IndexError ladder)."""
+    block.proposer_index = 2**40 + rng.randint(0, 2**20)
+    return f"proposer_index -> {int(block.proposer_index)} (out of registry)"
+
+
+def wreck_overflow_slot(spec: Any, block: Any, rng: Random) -> Optional[str]:
+    """uint64-max slot: the overflow row every naive comparison trips on."""
+    block.slot = 2**64 - 1
+    return "slot -> 2**64-1"
+
+
+def wreck_wrong_slot(spec: Any, block: Any, rng: Random) -> Optional[str]:
+    """Off-by-one slot against the pre state (header check). Clamped to
+    the uint64 range: a prior overflow_slot op in the same tuple must
+    not push the setter past 2**64-1."""
+    delta = rng.choice((-1, 1, 2))
+    new = min(max(0, int(block.slot) + delta), 2**64 - 1)
+    block.slot = new
+    return f"slot {'+' if delta > 0 else ''}{delta}"
+
+
+def wreck_bad_parent(spec: Any, block: Any, rng: Random) -> Optional[str]:
+    """Flip one byte of parent_root (header check)."""
+    root = bytearray(bytes(block.parent_root))
+    i = rng.randrange(len(root))
+    root[i] ^= 0xFF
+    block.parent_root = bytes(root)
+    return f"parent_root byte {i} flipped"
+
+
+def wreck_stale_target(spec: Any, block: Any, rng: Random) -> Optional[str]:
+    """An attestation targeting a long-gone epoch (the stale-vote
+    rejection in process_attestation)."""
+    if not len(block.body.attestations):
+        return None
+    att = block.body.attestations[0]
+    att.data.target.epoch = max(0, int(att.data.target.epoch) - rng.randint(2, 5))
+    return f"attestations[0].target.epoch -> {int(att.data.target.epoch)}"
+
+
+def wreck_bad_source(spec: Any, block: Any, rng: Random) -> Optional[str]:
+    """Source checkpoint off the justified pair (FFG source check)."""
+    if not len(block.body.attestations):
+        return None
+    att = block.body.attestations[0]
+    att.data.source.epoch = int(att.data.source.epoch) + rng.randint(1, 3)
+    return f"attestations[0].source.epoch -> {int(att.data.source.epoch)}"
+
+
+def wreck_bad_committee_index(spec: Any, block: Any, rng: Random) -> Optional[str]:
+    """Committee index past committees_per_slot."""
+    if not len(block.body.attestations):
+        return None
+    att = block.body.attestations[0]
+    att.data.index = int(att.data.index) + rng.randint(16, 64)
+    return f"attestations[0].index -> {int(att.data.index)}"
+
+
+def wreck_bits_mismatch(spec: Any, block: Any, rng: Random) -> Optional[str]:
+    """Aggregation bits sized off the committee (length assert)."""
+    if not len(block.body.attestations):
+        return None
+    att = block.body.attestations[0]
+    bits = list(att.aggregation_bits) + [True]
+    att.aggregation_bits = type(att.aggregation_bits)(bits)
+    return f"attestations[0].aggregation_bits -> len {len(bits)}"
+
+
+def wreck_dup_attestation(spec: Any, block: Any, rng: Random) -> Optional[str]:
+    """The same attestation twice (must be accepted: inclusion is
+    idempotent on the participation path, additive on phase0 pending)."""
+    if not len(block.body.attestations):
+        return None
+    if len(block.body.attestations) >= int(spec.MAX_ATTESTATIONS):
+        return None
+    block.body.attestations.append(block.body.attestations[0])
+    return "attestations[0] duplicated"
+
+
+def wreck_equivocating_attestation(spec: Any, block: Any, rng: Random) -> Optional[str]:
+    """A second attestation from the same committee voting a different
+    head — equivocation as block content (both pass process_attestation;
+    slashing is fork-choice/evidence business, not the block path's)."""
+    if not len(block.body.attestations):
+        return None
+    if len(block.body.attestations) >= int(spec.MAX_ATTESTATIONS):
+        return None
+    twin = block.body.attestations[0].copy()
+    root = bytearray(bytes(twin.data.beacon_block_root))
+    root[0] ^= 0xFF
+    twin.data.beacon_block_root = bytes(root)
+    block.body.attestations.append(twin)
+    return "equivocating twin of attestations[0] appended"
+
+
+def wreck_randao_junk(spec: Any, block: Any, rng: Random) -> Optional[str]:
+    """Garbage randao reveal (rejected with BLS on; accepted — and
+    mixed into the state — with the kill-switch off)."""
+    block.body.randao_reveal = bytes(rng.getrandbits(8) for _ in range(96))
+    return "randao_reveal -> junk"
+
+
+def wreck_graffiti(spec: Any, block: Any, rng: Random) -> Optional[str]:
+    """Benign body damage: accepted, but the post-state root MUST move
+    (the header's body_root) — a differential tripwire for any path that
+    hashes a stale body."""
+    block.body.graffiti = bytes(rng.getrandbits(8) for _ in range(32))
+    return "graffiti -> random"
+
+
+def wreck_phantom_deposit_count(spec: Any, block: Any, rng: Random) -> Optional[str]:
+    """eth1 deposit_count promising deposits the body does not carry
+    (process_operations' expected-deposits assert)."""
+    block.body.eth1_data.deposit_count = (
+        int(block.body.eth1_data.deposit_count) + rng.randint(1, 4))
+    return f"eth1_data.deposit_count -> {int(block.body.eth1_data.deposit_count)}"
+
+
+def wreck_premature_exit(spec: Any, block: Any, rng: Random) -> Optional[str]:
+    """A voluntary exit before SHARD_COMMITTEE_PERIOD has elapsed."""
+    exit_op = spec.SignedVoluntaryExit(
+        message=spec.VoluntaryExit(epoch=0, validator_index=rng.randrange(8)))
+    if len(block.body.voluntary_exits) >= int(spec.MAX_VOLUNTARY_EXITS):
+        return None
+    block.body.voluntary_exits.append(exit_op)
+    return f"premature exit for validator {int(exit_op.message.validator_index)}"
+
+
+def wreck_sync_bits(spec: Any, block: Any, rng: Random) -> Optional[str]:
+    """Flip sync-committee participation bits (altair+): accepted with
+    BLS off, but participation rewards move the post-state root."""
+    body = block.body
+    if not hasattr(body, "sync_aggregate"):
+        return None
+    bits = list(body.sync_aggregate.sync_committee_bits)
+    for _ in range(rng.randint(1, max(1, len(bits) // 4))):
+        i = rng.randrange(len(bits))
+        bits[i] = not bits[i]
+    body.sync_aggregate.sync_committee_bits = type(
+        body.sync_aggregate.sync_committee_bits)(bits)
+    return "sync_committee_bits flipped"
+
+
+def wreck_truncated_sync_signature(spec: Any, block: Any, rng: Random) -> Optional[str]:
+    """A sync aggregate whose signature is damaged (altair+): with BLS
+    on this must reject; with the kill-switch off it is benign."""
+    body = block.body
+    if not hasattr(body, "sync_aggregate"):
+        return None
+    sig = bytearray(bytes(body.sync_aggregate.sync_committee_signature))
+    sig[-1] ^= 0x01
+    body.sync_aggregate.sync_committee_signature = bytes(sig)
+    return "sync_committee_signature tampered"
+
+
+WRECKAGE_OPS: Dict[str, Callable[[Any, Any, Random], Optional[str]]] = {
+    "bad_proposer": wreck_bad_proposer,
+    "huge_proposer": wreck_huge_proposer,
+    "overflow_slot": wreck_overflow_slot,
+    "wrong_slot": wreck_wrong_slot,
+    "bad_parent": wreck_bad_parent,
+    "stale_target": wreck_stale_target,
+    "bad_source": wreck_bad_source,
+    "bad_committee_index": wreck_bad_committee_index,
+    "bits_mismatch": wreck_bits_mismatch,
+    "dup_attestation": wreck_dup_attestation,
+    "equivocating_attestation": wreck_equivocating_attestation,
+    "randao_junk": wreck_randao_junk,
+    "graffiti": wreck_graffiti,
+    "phantom_deposit_count": wreck_phantom_deposit_count,
+    "premature_exit": wreck_premature_exit,
+    "sync_bits": wreck_sync_bits,
+    "truncated_sync_signature": wreck_truncated_sync_signature,
+}
+
+
+def apply_wreckage(spec: Any, block_bytes: bytes, ops: tuple,
+                   seed: str) -> Optional[bytes]:
+    """Decode the block, apply the named wreckage ops in order (each
+    with its own derived stream), re-encode. Returns None when the base
+    does not decode or no op applied — a pure function of
+    ``(block_bytes, ops, seed)``, which is what lets the shrinker drop
+    ops from the tuple and re-apply the rest bit-reproducibly."""
+    try:
+        block = spec.BeaconBlock.decode_bytes(block_bytes)
+    except Exception:
+        return None
+    applied = 0
+    for op in ops:
+        # an op that raises on this block (a composed mutation drove a
+        # field somewhere the op's own setter rejects) is "did not
+        # apply", not a worker crash — adversarial intermediates are
+        # exactly the corpus's job
+        try:
+            note = WRECKAGE_OPS[op](spec, block,
+                                    Random(f"fuzz-wreck:{op}:{seed}"))
+        except Exception:
+            note = None
+        if note is not None:
+            applied += 1
+    if not applied:
+        return None
+    return bytes(block.encode_bytes())

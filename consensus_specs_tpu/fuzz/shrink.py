@@ -1,0 +1,240 @@
+"""Automatic finding shrinker: reduce a divergent case to a minimal
+reproducer, re-verified against ALL THREE paths at every step
+(docs/FUZZ.md).
+
+Three deterministic passes, each step kept only when the SAME
+divergence (kind + which paths disagree with the oracle) persists:
+
+1. **mutation-subset minimization** — wreckage cases record the op
+   tuple that built them; ops are dropped greedily (each re-applied
+   subset is bit-reproducible because every op derives its own stream
+   from the case seed — :func:`mutate.apply_wreckage`).
+2. **field-level minimization** — when the candidate block decodes:
+   operation lists are emptied from the tail (attestations, slashings,
+   deposits, exits, bls changes), then noisy scalar fields are zeroed
+   (graffiti, randao_reveal, eth1_data).
+3. **byte-level minimization** — when the candidate does NOT decode
+   (pure byte corruption): greedy span-revert toward the valid base
+   bytes (delta-debugging lite), then tail-restore for truncations.
+
+Every re-verification passes the ``fuzz.shrink`` chaos site under the
+resilience supervisor: transient faults retry the step, a deterministic
+fault abandons shrinking and the finding ships RAW (``shrunk.aborted``)
+— a finding is never lost to a broken shrinker.
+
+The whole pass is a pure function of (case, executor configuration), so
+shrunk findings are byte-identical across worker counts and resumes —
+the property the farm's deterministic merge asserts.
+"""
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..resilience import chaos, supervised
+from .corpus import FuzzCase, case_seed
+from .executor import DifferentialExecutor
+from .mutate import apply_wreckage
+
+MAX_STEPS = 400
+
+# list-valued operation families to empty from the tail, in fixed order
+_BODY_LISTS = ("attestations", "attester_slashings", "proposer_slashings",
+               "deposits", "voluntary_exits", "bls_to_execution_changes")
+
+
+def _signature(result) -> Optional[Tuple[str, Tuple[str, ...]]]:
+    """The divergence identity a shrink step must preserve."""
+    d = result.divergence
+    if d is None:
+        return None
+    return (d["kind"], tuple(d["disagrees_with_oracle"]))
+
+
+class Shrinker:
+    def __init__(self, executor: DifferentialExecutor,
+                 max_steps: int = MAX_STEPS) -> None:
+        self.executor = executor
+        self.max_steps = max_steps
+        self.steps = 0
+        self.aborted = False
+
+    # -- the supervised re-verification step ----------------------------
+
+    def _still_diverges(self, case: FuzzCase,
+                        want: Tuple[str, Tuple[str, ...]]) -> bool:
+        if self.steps >= self.max_steps or self.aborted:
+            return False
+        self.steps += 1
+
+        def attempt() -> bool:
+            chaos("fuzz.shrink")
+            return _signature(self.executor.execute(case)) == want
+
+        def degraded() -> bool:
+            # a broken shrinker must never eat the finding: abandon
+            # shrinking, ship the raw case
+            self.aborted = True
+            return False
+
+        return bool(supervised(attempt, domain="fuzz",
+                               capability="fuzz.shrink", fallback=degraded))
+
+    # -- passes ---------------------------------------------------------
+
+    def _shrink_mutations(self, case: FuzzCase, base_block: bytes,
+                          want, removed: List[str]) -> FuzzCase:
+        """Greedily drop wreckage ops, re-applying the remainder from
+        the valid base with the original per-op streams."""
+        ops = list(case.mutations)
+        if case.kind != "wreck" or len(ops) <= 1:
+            return case
+        seed = case_seed(case.fork, case.preset, _seed_of(case),
+                         _index_of(case))
+        for op in list(ops):
+            trial_ops = tuple(o for o in ops if o != op)
+            if not trial_ops:
+                continue
+            blk = apply_wreckage(self.executor.spec, base_block, trial_ops, seed)
+            if blk is None:
+                continue
+            trial = replace(case, block=blk, mutations=trial_ops)
+            if self._still_diverges(trial, want):
+                ops.remove(op)
+                removed.append(f"op:{op}")
+                case = trial
+        return case
+
+    def _shrink_fields(self, case: FuzzCase, want,
+                       removed: List[str]) -> FuzzCase:
+        """Field-level minimization on a decodable block."""
+        spec = self.executor.spec
+        try:
+            block = spec.BeaconBlock.decode_bytes(case.block)
+        except Exception:
+            return case
+
+        def trial_case(blk: Any) -> FuzzCase:
+            return replace(case, block=bytes(blk.encode_bytes()))
+
+        # 1) empty each operation list from the tail
+        for name in _BODY_LISTS:
+            lst = getattr(block.body, name, None)
+            if lst is None:
+                continue
+            while len(lst):
+                candidate = block.copy()
+                cand_list = getattr(candidate.body, name)
+                cand_list.pop()
+                trial = trial_case(candidate)
+                if not self._still_diverges(trial, want):
+                    break
+                block = candidate
+                case = trial
+                removed.append(f"{name}[-1]")
+                lst = getattr(block.body, name)
+
+        # 2) zero the noisy scalar fields
+        zeroers: Tuple[Tuple[str, Callable[[Any], None]], ...] = (
+            ("graffiti", lambda b: setattr(b.body, "graffiti", b"\x00" * 32)),
+            ("randao_reveal",
+             lambda b: setattr(b.body, "randao_reveal", b"\x00" * 96)),
+            ("eth1_data",
+             lambda b: setattr(b.body, "eth1_data",
+                               type(b.body.eth1_data)(
+                                   deposit_count=b.body.eth1_data.deposit_count))),
+        )
+        for label, zero in zeroers:
+            candidate = block.copy()
+            try:
+                zero(candidate)
+            except Exception:
+                continue
+            if bytes(candidate.encode_bytes()) == bytes(block.encode_bytes()):
+                continue
+            trial = trial_case(candidate)
+            if self._still_diverges(trial, want):
+                block = candidate
+                case = trial
+                removed.append(f"zero:{label}")
+        return case
+
+    def _shrink_bytes(self, case: FuzzCase, base_block: bytes, want,
+                      removed: List[str]) -> FuzzCase:
+        """Byte-level revert toward the valid base (undecodable cases)."""
+        data = bytearray(case.block)
+        base = base_block
+        # tail-restore first: a truncated block grows back until the
+        # divergence depends on the cut
+        if len(data) < len(base):
+            trial = replace(case, block=bytes(data) + base[len(data):])
+            if self._still_diverges(trial, want):
+                data = bytearray(trial.block)
+                case = trial
+                removed.append("tail:restored")
+        # greedy half-span reverts of differing bytes
+        span = max(1, min(len(data), len(base)) // 2)
+        while span >= 1 and self.steps < self.max_steps and not self.aborted:
+            start = 0
+            changed = False
+            while start < min(len(data), len(base)):
+                end = min(start + span, len(data), len(base))
+                if data[start:end] != base[start:end]:
+                    trial_bytes = bytes(data[:start]) + base[start:end] + bytes(data[end:])
+                    trial = replace(case, block=trial_bytes)
+                    if self._still_diverges(trial, want):
+                        data = bytearray(trial_bytes)
+                        case = trial
+                        removed.append(f"revert:{start}+{end - start}")
+                        changed = True
+                start = end
+            if not changed:
+                span //= 2
+        return case
+
+
+def _seed_of(case: FuzzCase) -> int:
+    return int(case.case_id.split("-")[0][1:])
+
+
+def _index_of(case: FuzzCase) -> int:
+    return int(case.case_id.split("-")[1])
+
+
+def shrink_finding(executor: DifferentialExecutor, case: FuzzCase,
+                   base_block: Optional[bytes],
+                   max_steps: int = MAX_STEPS) -> Dict[str, Any]:
+    """Shrink one divergent case. Returns the shrunk record (or the raw
+    case marked unshrunk when the divergence is flaky or shrinking was
+    chaos-aborted)."""
+    first = executor.execute(case)
+    want = _signature(first)
+    if want is None:
+        return {"aborted": True, "reason": "divergence did not reproduce",
+                "steps": 1, "block": case.block.hex(),
+                "size": len(case.block)}
+    sh = Shrinker(executor, max_steps=max_steps)
+    removed: List[str] = []
+    shrunk = case
+    if base_block is not None:
+        shrunk = sh._shrink_mutations(shrunk, base_block, want, removed)
+    shrunk = sh._shrink_fields(shrunk, want, removed)
+    decodable = True
+    try:
+        executor.spec.BeaconBlock.decode_bytes(shrunk.block)
+    except Exception:
+        decodable = False
+    if not decodable and base_block is not None:
+        shrunk = sh._shrink_bytes(shrunk, base_block, want, removed)
+    final = executor.execute(shrunk)
+    return {
+        "aborted": sh.aborted,
+        "steps": sh.steps,
+        "removed": removed,
+        "mutations": list(shrunk.mutations),
+        "block": shrunk.block.hex(),
+        "size": len(shrunk.block),
+        "orig_size": len(case.block),
+        "kind": (final.divergence or {}).get("kind"),
+        "outcomes": (final.divergence or {}).get("outcomes"),
+    }

@@ -45,6 +45,13 @@ ENV_GAP = "environmental"
 
 _LOWER_IS_BETTER_SUFFIXES = ("_s", "_ms", "_us", "_seconds")
 
+# rate metrics end in "_per_s", which ALSO ends in "_s": rates are
+# higher-is-better and must be carved out before the duration suffixes
+# (the ledger's infer_unit makes the same distinction — a regression
+# here silently inverted the gate for any *_per_s metric, first
+# surfaced by perfgate_fuzz_execs_per_s's chaos drill)
+_RATE_MARKERS = ("per_s", "per_sec", "_rate")
+
 # MAD -> sigma for normally-distributed noise
 _MAD_SIGMA = 1.4826
 
@@ -64,6 +71,8 @@ DEFAULT_POLICY = Policy()
 
 def polarity(metric: str) -> int:
     """+1 when higher is better (rates, speedups), -1 for durations."""
+    if any(marker in metric for marker in _RATE_MARKERS):
+        return 1
     return -1 if metric.endswith(_LOWER_IS_BETTER_SUFFIXES) else 1
 
 

@@ -58,6 +58,20 @@ Sites are plain strings; the convention is plane.point:
   sim.epoch (every chain-simulator epoch rollover; a deterministic
              fault parks the REMAINDER of the run on the oracle path —
              the circuit-breaker response at epoch granularity)
+  fuzz.exec (top of every fuzz-farm case execution, INSIDE the forked
+             worker — docs/FUZZ.md: transient=the case retries (cases
+             are pure functions, a retry is safe); deterministic=the
+             breaker opens and every later case on that worker degrades
+             to an oracle-only pass (differential coverage loss is
+             counted fuzz.degraded_execs, never silent); kill=the
+             SIGKILL drill — the parent respawns the rank and its
+             findings journal resumes the slice with no lost and no
+             duplicated findings. Arm kill with
+             CONSENSUS_SPECS_TPU_CHAOS_STATE so one kill means one
+             worker across the farm — tests/test_fuzz_farm.py)
+  fuzz.shrink (every shrinker re-verification step: transient=the step
+             retries; deterministic=shrinking aborts and the finding is
+             journaled RAW — a broken shrinker never eats a finding)
 
 ``chaos(site)`` is a no-op dict probe when nothing is armed — cheap
 enough for hot paths.

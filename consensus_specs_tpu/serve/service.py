@@ -45,6 +45,14 @@ DEFAULT_PRESETS = ("minimal",)
 # Attestation, ...). Resolved per request against the matrix module.
 _TYPE_BLOCKLIST_PREFIX = "_"
 
+# the spec's invalid-block rejection ladder (the exception classes
+# process_block uses as control flow). Shared contract with the fuzz
+# farm's differential executor (fuzz/executor.py REJECTED): the served
+# path must classify exactly the same set as rejections, or a fuzz case
+# diverges on error surface alone.
+PROCESS_BLOCK_REJECTED = (AssertionError, IndexError, ValueError, KeyError,
+                          OverflowError)
+
 
 class SpecService:
     """The method surface one daemon serves. Thread-safe: handler
@@ -232,9 +240,12 @@ class SpecService:
             raise protocol.bad_request(f"block: does not decode as BeaconBlock ({e})")
         try:
             spec.process_block(state, block)
-        except (AssertionError, IndexError, ValueError) as e:
+        except PROCESS_BLOCK_REJECTED as e:
             # the spec's invalid-block surface: a structured rejection,
-            # not a daemon fault (mirrors how the generators classify it)
+            # not a daemon fault (mirrors how the generators classify it
+            # and the sim's intake paths — adversarial blocks from the
+            # fuzz corpus reach KeyError/OverflowError rungs too, and
+            # those are rejections, not 500s)
             raise protocol.bad_request(f"block rejected by {spec.fork} "
                                        f"process_block: {e!r}")
         return {"post": protocol.to_hex(state.encode_bytes()),

@@ -1,0 +1,238 @@
+"""Fuzz-plane units (docs/FUZZ.md): mutation/corpus determinism, the
+three-path differential executor's outcome contract, the planted-defect
+hook, the shrinker's minimality, and the chaos sites' semantics —
+everything in-process (the forked-farm drills live in
+tests/test_fuzz_farm.py)."""
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from consensus_specs_tpu import resilience as r
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.fuzz import (
+    BYTE_OPS,
+    CorpusBuilder,
+    DifferentialExecutor,
+    REJECTED,
+    WRECKAGE_OPS,
+    shrink_finding,
+)
+from consensus_specs_tpu.fuzz.executor import DEFECT_ENV
+from consensus_specs_tpu.fuzz.farm import FarmConfig, slice_indices
+from consensus_specs_tpu.fuzz.mutate import apply_byte_op, apply_wreckage
+from consensus_specs_tpu.serve import SpecService, VerifyBatcher
+from consensus_specs_tpu.serve.service import PROCESS_BLOCK_REJECTED
+from consensus_specs_tpu.specs import build_spec
+
+FORK, PRESET, SEED = "phase0", "minimal", 1
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return build_spec(FORK, PRESET)
+
+
+@pytest.fixture(scope="module")
+def builder(spec):
+    return CorpusBuilder(spec, FORK, PRESET, SEED)
+
+
+@pytest.fixture(scope="module")
+def executor(spec):
+    service = SpecService(forks=(FORK,), presets=(PRESET,),
+                          batcher=VerifyBatcher(linger_ms=1)).start()
+    yield DifferentialExecutor(spec, FORK, PRESET, service=service)
+    service.batcher.drain(5)
+    service.stop()
+
+
+@pytest.fixture(autouse=True)
+def _bls_off_and_clean_chaos():
+    was = bls.bls_active
+    bls.bls_active = False
+    os.environ.pop(DEFECT_ENV, None)
+    yield
+    bls.bls_active = was
+    os.environ.pop(DEFECT_ENV, None)
+    r.disarm()
+    r.clear()
+
+
+# -- contract pins -----------------------------------------------------------
+
+
+def test_rejection_ladder_shared_with_serve():
+    """The executor and the served path MUST classify the same exception
+    set as spec rejections, or error surface alone reads as divergence."""
+    assert REJECTED == PROCESS_BLOCK_REJECTED
+
+
+# -- mutation determinism ----------------------------------------------------
+
+
+def test_byte_ops_are_pure_functions():
+    data = bytes(range(256)) * 8
+    for op in BYTE_OPS:
+        a = apply_byte_op(op, data, "seed-x")
+        b = apply_byte_op(op, data, "seed-x")
+        assert a == b, op
+        assert apply_byte_op(op, data, "seed-y") != a or op == "truncate"
+
+
+def test_wreckage_pure_and_reapplicable(spec, builder):
+    _, block = builder.bases()[2]
+    for ops in (("bad_proposer",), ("graffiti", "dup_attestation"),
+                ("overflow_slot", "bad_parent")):
+        a = apply_wreckage(spec, block, ops, "s")
+        b = apply_wreckage(spec, block, ops, "s")
+        assert a is not None and a == b, ops
+        assert a != block
+
+
+def test_wreckage_inapplicable_returns_none(spec, builder):
+    _, block = builder.bases()[0]  # the first base carries no attestation
+    assert apply_wreckage(spec, block, ("stale_target",), "s") is None
+    assert apply_wreckage(spec, b"\x00\x01", ("graffiti",), "s") is None
+
+
+# -- corpus ------------------------------------------------------------------
+
+
+def test_corpus_is_a_pure_function_of_its_key(spec, builder):
+    twin = CorpusBuilder(spec, FORK, PRESET, SEED)
+    for i in (0, 1, 3, 5, 6, 17, 63):
+        a, b = builder.case(i), twin.case(i)
+        assert (a.case_id, a.pre, a.block, a.kind, a.mutations) == \
+               (b.case_id, b.pre, b.block, b.kind, b.mutations)
+
+
+def test_corpus_kind_mix(builder):
+    kinds = {builder.case(i).kind for i in range(16)}
+    assert {"valid", "wreck", "byte", "random"} <= kinds
+
+
+def test_slices_partition_the_corpus():
+    cfg = FarmConfig(out_dir=".", cases=64, workers=3)
+    slices = [slice_indices(cfg, rank) for rank in range(3)]
+    flat = sorted(i for s in slices for i in s)
+    assert flat == list(range(64))
+    assert all(s == sorted(s) for s in slices)
+
+
+def test_bases_are_oracle_valid(spec, builder, executor):
+    for i, _ in enumerate(builder.bases()):
+        case = builder.case(i * 8)  # the wheel puts "valid" at i % 8 == 0
+        assert case.kind == "valid"
+        result = executor.execute(case)
+        assert result.outcomes["oracle"].verdict == "accept", case.case_id
+        assert result.divergence is None
+
+
+# -- the differential executor -----------------------------------------------
+
+
+def test_three_paths_agree_on_the_clean_build(builder, executor):
+    seen = set()
+    for i in range(24):
+        result = executor.execute(builder.case(i))
+        assert result.divergence is None, (i, result.outcomes)
+        seen.add(result.outcomes["oracle"].verdict)
+    assert {"accept", "reject", "undecodable"} <= seen
+
+
+def test_wreck_rejects_consistently(spec, builder, executor):
+    _, block = builder.bases()[1]
+    mutated = apply_wreckage(spec, block, ("bad_proposer",), "t")
+    case = builder.case(1)
+    case = type(case)(case_id="t-bad-proposer", fork=FORK, preset=PRESET,
+                      pre=builder.bases()[1][0], block=mutated,
+                      kind="wreck", base_index=1, mutations=("bad_proposer",))
+    result = executor.execute(case)
+    assert result.divergence is None
+    assert result.outcomes["oracle"].verdict == "reject"
+    assert result.outcomes["serve"].detail == result.outcomes["oracle"].detail
+
+
+def test_undecodable_block_agrees(builder, executor):
+    base = builder.bases()[0]
+    case = type(builder.case(0))(
+        case_id="t-trunc", fork=FORK, preset=PRESET, pre=base[0],
+        block=base[1][:7], kind="byte", base_index=0,
+        mutations=("truncate",))
+    result = executor.execute(case)
+    assert result.divergence is None
+    assert result.outcomes["oracle"].verdict == "undecodable"
+    assert result.outcomes["oracle"].detail == "block"
+
+
+def test_planted_defect_is_an_engine_divergence(spec, builder, executor):
+    os.environ[DEFECT_ENV] = "engine"
+    case = next(c for c in (builder.case(i) for i in (0, 8, 16, 24, 32))
+                if len(spec.BeaconBlock.decode_bytes(c.block)
+                       .body.attestations))
+    assert case.kind == "valid"
+    result = executor.execute(case)
+    div = result.divergence
+    assert div is not None and div["kind"] == "post_root"
+    assert div["disagrees_with_oracle"] == ["engine"]
+    # oracle and serve still agree bit-for-bit
+    assert result.outcomes["oracle"] == result.outcomes["serve"]
+    del os.environ[DEFECT_ENV]
+    assert executor.execute(case).divergence is None
+
+
+# -- the shrinker ------------------------------------------------------------
+
+
+def _dup_att_case(spec, builder, index=63):
+    """A wreck case whose block carries 2 attestations (dup op)."""
+    case = builder.case(index)
+    block = spec.BeaconBlock.decode_bytes(case.block)
+    assert len(block.body.attestations) >= 2
+    return case
+
+
+def test_shrinker_reduces_to_single_attestation(spec, builder, executor):
+    os.environ[DEFECT_ENV] = "engine"
+    case = _dup_att_case(spec, builder)
+    base = builder.bases()[case.base_index][1]
+    shrunk = shrink_finding(executor, case, base)
+    assert not shrunk["aborted"]
+    assert shrunk["size"] < shrunk["orig_size"]
+    block = spec.BeaconBlock.decode_bytes(bytes.fromhex(shrunk["block"]))
+    assert len(block.body.attestations) == 1
+    # deterministic: a second pass lands on identical bytes
+    again = shrink_finding(executor, case, base)
+    assert again["block"] == shrunk["block"]
+    assert again["steps"] == shrunk["steps"]
+
+
+def test_shrinker_refuses_a_non_reproducing_case(builder, executor):
+    shrunk = shrink_finding(executor, builder.case(8),
+                            builder.bases()[0][1])
+    assert shrunk["aborted"] and "did not reproduce" in shrunk["reason"]
+
+
+def test_shrink_chaos_deterministic_ships_raw(spec, builder, executor):
+    """A deterministic fuzz.shrink fault aborts shrinking — the finding
+    survives raw, never lost to a broken shrinker."""
+    os.environ[DEFECT_ENV] = "engine"
+    case = _dup_att_case(spec, builder)
+    base = builder.bases()[case.base_index][1]
+    with r.inject("fuzz.shrink", "deterministic"):
+        shrunk = shrink_finding(executor, case, base)
+    assert shrunk["aborted"]
+    assert bytes.fromhex(shrunk["block"]) == case.block  # raw, unshrunk
+
+
+def test_shrink_chaos_transient_is_retried(spec, builder, executor):
+    os.environ[DEFECT_ENV] = "engine"
+    case = _dup_att_case(spec, builder)
+    base = builder.bases()[case.base_index][1]
+    with r.inject("fuzz.shrink", "transient", count=1):
+        shrunk = shrink_finding(executor, case, base)
+    assert not shrunk["aborted"]
+    block = spec.BeaconBlock.decode_bytes(bytes.fromhex(shrunk["block"]))
+    assert len(block.body.attestations) == 1

@@ -1,0 +1,174 @@
+"""Fuzz farm drills (docs/FUZZ.md, the test_gen_shard.py pattern): the
+sharded farm's merged findings must be byte-identical to a serial run
+for ANY worker count, after a SIGKILL'd worker (respawn resumes from
+the rank journal), after a SIGKILL'd PARENT (rerun resumes, no lost and
+no duplicated findings), and the chaos sites must degrade — never
+corrupt. All drills run the planted engine defect so findings exist to
+lose."""
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from consensus_specs_tpu import resilience as r
+from consensus_specs_tpu.fuzz.journal import MERGED_NAME, rank_journal_name
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+FARM = [sys.executable, str(REPO / "tools" / "fuzz_farm.py")]
+FINDINGS_EXIT = 3
+
+CASES = "48"
+
+
+def _env(defect: bool = True, chaos: str = "", chaos_state: str = ""):
+    env = dict(os.environ)
+    for k in ("CONSENSUS_SPECS_TPU_FUZZ_DEFECT", r.ENV_KNOB,
+              "CONSENSUS_SPECS_TPU_CHAOS_STATE"):
+        env.pop(k, None)
+    if defect:
+        env["CONSENSUS_SPECS_TPU_FUZZ_DEFECT"] = "engine"
+    if chaos:
+        env[r.ENV_KNOB] = chaos
+    if chaos_state:
+        env["CONSENSUS_SPECS_TPU_CHAOS_STATE"] = chaos_state
+    return env
+
+
+def _run(out_dir, workers="2", env=None, extra=(), timeout=300):
+    return subprocess.run(
+        FARM + ["--cases", CASES, "--workers", workers, "--seed", "1",
+                "--out", str(out_dir)] + list(extra),
+        env=env or _env(), cwd=str(REPO), capture_output=True, text=True,
+        timeout=timeout)
+
+
+def _merged(out_dir) -> bytes:
+    return (pathlib.Path(out_dir) / MERGED_NAME).read_bytes()
+
+
+@pytest.fixture(scope="module")
+def w1_run(tmp_path_factory):
+    """The reference: --workers 1 with the planted defect (the bytes
+    every sharded/killed/resumed variant must reproduce)."""
+    out = tmp_path_factory.mktemp("fuzz_w1")
+    proc = _run(out, workers="1")
+    assert proc.returncode == FINDINGS_EXIT, proc.stderr[-2000:]
+    merged = _merged(out)
+    findings = [json.loads(ln) for ln in merged.splitlines()]
+    assert len(findings) >= 3
+    assert all("finding" in f and "shrunk" in f for f in findings)
+    return merged
+
+
+def test_workers_2_merged_byte_identical(w1_run, tmp_path):
+    proc = _run(tmp_path / "v")
+    assert proc.returncode == FINDINGS_EXIT, proc.stderr[-2000:]
+    assert _merged(tmp_path / "v") == w1_run
+    # no per-rank leftovers survive the merge
+    assert not list((tmp_path / "v").glob(".fuzz_journal.rank*"))
+    assert not list((tmp_path / "v").glob(".fuzz_rank*"))
+
+
+def test_workers_3_merged_byte_identical(w1_run, tmp_path):
+    proc = _run(tmp_path / "v", workers="3")
+    assert proc.returncode == FINDINGS_EXIT, proc.stderr[-2000:]
+    assert _merged(tmp_path / "v") == w1_run
+
+
+def test_sigkilled_worker_respawns_and_resumes(w1_run, tmp_path):
+    """fuzz.exec chaos kind=kill SIGKILLs a worker mid-slice (counted
+    cross-process so the respawn does not re-fire); the parent
+    classifies the death transient, respawns the rank, the journal
+    resumes it, and the merged findings are STILL the w1 bytes."""
+    state = tmp_path / "chaos.state"
+    proc = _run(tmp_path / "v",
+                env=_env(chaos="fuzz.exec=kill:1:9", chaos_state=str(state)))
+    assert proc.returncode == FINDINGS_EXIT, (proc.returncode,
+                                              proc.stdout[-800:],
+                                              proc.stderr[-800:])
+    assert json.loads(state.read_text())["fuzz.exec"] >= 10  # really fired
+    assert "respawn" in proc.stdout
+    assert _merged(tmp_path / "v") == w1_run
+
+
+def test_sigkilled_parent_rerun_resumes_no_lost_no_dup(w1_run, tmp_path):
+    """The farm process itself is SIGKILL'd mid-run; rerunning the same
+    command resumes from the per-rank findings journals and the final
+    merged bytes equal the uninterrupted run's — nothing lost, nothing
+    re-reported."""
+    out = tmp_path / "v"
+    env = _env()
+    proc = subprocess.Popen(
+        FARM + ["--cases", CASES, "--workers", "2", "--seed", "1",
+                "--out", str(out)],
+        env=env, cwd=str(REPO), stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL, start_new_session=True)
+    # wait until at least one rank journal holds a finding, then kill -9
+    deadline = time.monotonic() + 120
+    journals = [out / rank_journal_name(rank) for rank in range(2)]
+    try:
+        while time.monotonic() < deadline:
+            if any(j.exists() and b'"finding"' in j.read_bytes()
+                   for j in journals):
+                break
+            if proc.poll() is not None:
+                break
+            time.sleep(0.01)
+        else:
+            pytest.fail("no rank journal appeared before the deadline")
+    finally:
+        if proc.poll() is None:
+            os.killpg(proc.pid, signal.SIGKILL)
+        proc.wait(30)
+    assert not (out / MERGED_NAME).exists() or proc.poll() == FINDINGS_EXIT
+    rerun = _run(out, env=env)
+    assert rerun.returncode == FINDINGS_EXIT, rerun.stderr[-2000:]
+    assert _merged(out) == w1_run
+
+
+def test_rerun_over_completed_dir_is_idempotent(w1_run, tmp_path):
+    out = tmp_path / "v"
+    assert _run(out).returncode == FINDINGS_EXIT
+    assert _run(out).returncode == FINDINGS_EXIT
+    assert _merged(out) == w1_run
+
+
+def test_fuzz_exec_transient_chaos_retries(w1_run, tmp_path):
+    proc = _run(tmp_path / "v", env=_env(chaos="fuzz.exec=transient:1"))
+    assert proc.returncode == FINDINGS_EXIT, proc.stderr[-2000:]
+    assert _merged(tmp_path / "v") == w1_run
+
+
+def test_fuzz_exec_deterministic_chaos_degrades_not_dies(tmp_path):
+    """A deterministic fuzz.exec fault opens the breaker: later cases on
+    that worker run oracle-only (differential coverage loss is COUNTED,
+    the farm completes). Findings may shrink — never the run."""
+    proc = _run(tmp_path / "v", env=_env(chaos="fuzz.exec=deterministic:1"))
+    assert proc.returncode in (0, FINDINGS_EXIT), proc.stderr[-2000:]
+    assert "degraded exec(s)" in proc.stdout
+    assert (tmp_path / "v" / MERGED_NAME).exists()
+
+
+def test_fuzz_shrink_deterministic_chaos_ships_raw_findings(tmp_path):
+    """fuzz.shrink deterministic fault: findings are journaled RAW
+    (shrunk.aborted) — a broken shrinker never eats a finding."""
+    proc = _run(tmp_path / "v", env=_env(chaos="fuzz.shrink=deterministic:1"))
+    assert proc.returncode == FINDINGS_EXIT, proc.stderr[-2000:]
+    findings = [json.loads(ln)
+                for ln in _merged(tmp_path / "v").splitlines()]
+    assert findings
+    assert all("finding" in f for f in findings)
+    assert any(f.get("shrunk", {}).get("aborted") for f in findings)
+
+
+def test_clean_build_zero_findings(tmp_path):
+    proc = _run(tmp_path / "v", env=_env(defect=False))
+    assert proc.returncode == 0, proc.stdout[-800:] + proc.stderr[-800:]
+    assert _merged(tmp_path / "v") == b""

@@ -102,6 +102,61 @@ def test_ssz_static_beacon_block_root_pinned():
     assert bytes(value.hash_tree_root()).hex() == SSZ_STATIC_BEACON_BLOCK_ROOT
 
 
+# hash_tree_root of seed-pinned random minimal-phase0 objects under EVERY
+# RandomizationMode plus the per-element chaos mode (rng seed
+# "pin:<Type>:<mode name>"). The fuzz corpus (fuzz/corpus.py), the
+# ssz_static derivation, and any other consumer of debug/random_value
+# seed their adversarial populations through this generator — these pins
+# are the seed-stability contract that keeps fuzz corpus seeds (and the
+# golden-vector test above) reproducible across refactors of the
+# generator's type walk or mode dispatch.
+RANDOM_VALUE_MODE_ROOTS = {
+    "Attestation": {
+        "random": "5b86bf29db16176adc09792f58896b5fc13e0def0439ab8862c667df3c46cb54",
+        "zero": "8cff4a2b733ad5b74df8450613cc002bb66f61364d86c6fa22adbbaca80cdb85",
+        "max": "6ad46af64da602f6c64df51e093d9bda9ba08718a8e92862c64a86be4b8f0b51",
+        "nil": "b58df76c36a650d8ecd9be9f1425836dfe55365ab353382f793ce9df082edbfd",
+        "one": "eac826b76d8d8d62cf4dbec26590c0633e84839384a90aa8d53a486ef787c505",
+        "max_count": "8ba25cbde1a6f1fd043a5ee4c05e40f90b9be545735cca5f10a472df4caed7e5",
+        "chaos": "d3b61083589fa9df6dfc4c4230f01bf3a6889929099c1c0444d05380c05e43e1",
+    },
+    "BeaconBlock": {
+        "random": "a32fcd3099e00bdef701c19ca022f52fe48b6918954434868386509db5ac1501",
+        "zero": "eade62f0457b2fdf48e7d3fc4b60736688286be7c7a3ac4c9a16a5e0600bd9e4",
+        "max": "6f2bfaab8bb13d9fc69185dc6d79cd3ceab3530e40f87f78e27ce00e032c6b02",
+        "nil": "93459caa8dbc59e54d64e7539dce8d2a6dab5bca8cee53032d2e2419e13c2484",
+        "one": "e2d072ed86065fd38a18cbafb3b1d1469ec2a40157f1aeccecc304850d6bd1f0",
+        "max_count": "bc1d23becf4de977b3bb9b4451ed720f9926c9cf0283e848320b9e4fdbef7e29",
+        "chaos": "c8896c33de82c54376d5ca837b4e982e4df2151b5aedbf498026cdfc2898bce3",
+    },
+}
+
+
+def test_random_value_mode_matrix_pinned():
+    from random import Random
+
+    from consensus_specs_tpu.debug.random_value import (
+        RandomizationMode,
+        get_random_ssz_object,
+    )
+
+    spec = build_spec("phase0", "minimal")
+    assert len(RandomizationMode) == 6  # a new mode must extend the pins
+    for typ_name, pins in RANDOM_VALUE_MODE_ROOTS.items():
+        typ = getattr(spec, typ_name)
+        got = {}
+        for mode in RandomizationMode:
+            rng = Random(f"pin:{typ_name}:{mode.to_name()}")
+            value = get_random_ssz_object(rng, typ, 1000, 10, mode, False)
+            got[mode.to_name()] = bytes(value.hash_tree_root()).hex()
+        rng = Random(f"pin:{typ_name}:chaos")
+        value = get_random_ssz_object(
+            rng, typ, 1000, 10, RandomizationMode.mode_random, True
+        )
+        got["chaos"] = bytes(value.hash_tree_root()).hex()
+        assert got == pins, typ_name
+
+
 # SHA-256 of every file of the sanity/multi_operations `full_house_block`
 # case (real BLS): pins the multi-family block construction AND the
 # blocks_count/blocks_<i> list-part emission contract

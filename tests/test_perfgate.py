@@ -198,6 +198,36 @@ def test_slowed_fleet_failover_fails_gate(tmp_path):
     assert "gate FAILED" in proc.stdout
 
 
+def test_slowed_fuzz_farm_fails_gate(tmp_path):
+    """The ISSUE-12 drill: differential fuzz throughput is
+    sentinel-gated — a chaos-slowed exec/compare loop (3x) against an
+    established baseline flags ``regressed`` and fails `make perfgate`.
+    The measurement itself asserts zero divergences on the clean build
+    AND full rejection-ladder coverage, so the gated rate can never
+    come from a corpus that stopped finding anything to compare."""
+    ledger_path = str(tmp_path / "ledger.jsonl")
+    summary_path = tmp_path / "summary.json"
+    proc = _run(["--ledger", ledger_path, "--json", str(summary_path)],
+                timeout=360)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    measured = json.loads(summary_path.read_text())["metrics"]
+    assert "perfgate_fuzz_execs_per_s" in measured
+
+    led = ledger_mod.Ledger(ledger_path)
+    base = measured["perfgate_fuzz_execs_per_s"]
+    for i in range(sentinel.DEFAULT_POLICY.min_history):
+        led.record_run({"perfgate_fuzz_execs_per_s": base * (1 + 0.01 * i)},
+                       source="perfgate", backend="host")
+
+    proc = _run(["--ledger", ledger_path],
+                env_extra={"CONSENSUS_SPECS_TPU_PERF_CHAOS": "perfgate_fuzz=3"},
+                timeout=360)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "perfgate_fuzz_execs_per_s" in proc.stdout
+    assert "regressed" in proc.stdout
+    assert "gate FAILED" in proc.stdout
+
+
 def test_budget_burning_daemon_fails_slo_gate(tmp_path):
     """The ISSUE-7 drill: `make perfgate` includes the serve SLO gate.
     A chaos-burned availability (0.5 vs the 0.999 objective) fails the

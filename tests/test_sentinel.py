@@ -20,6 +20,13 @@ def test_polarity_by_suffix():
     assert sentinel.polarity("epoch_vectorized_speedup") == 1
     assert sentinel.polarity("incremental_reroot_ms") == -1
     assert sentinel.polarity("block_128atts_mainnet_host_s") == -1
+    # rates end in "_per_s", which ALSO ends in "_s" — they are
+    # higher-is-better and must not read as durations (the inversion
+    # perfgate_fuzz_execs_per_s's gate drill caught)
+    assert sentinel.polarity("perfgate_fuzz_execs_per_s") == 1
+    assert sentinel.polarity("serve_verifies_per_s") == 1
+    assert sentinel.polarity("fuzz_execs_per_s") == 1
+    assert sentinel.polarity("chain_sim_slots_per_s") == 1
 
 
 def test_baseline_median_and_mad():

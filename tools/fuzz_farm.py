@@ -1,0 +1,248 @@
+"""Conformance fuzzing farm CLI (docs/FUZZ.md, ROADMAP #4).
+
+Modes:
+
+- **fixed-count run** (default): one sharded farm pass over ``--cases``
+  corpus indices; findings (shrunk) land in ``<out>/findings.jsonl``.
+  Exit 0 when the three paths agreed on every case, 3 when divergences
+  were found (the findings are the product — a nonzero exit makes a CI
+  long-haul impossible to ignore).
+- **long-haul** (``--minutes N``, the ``make fuzz FUZZ_MINUTES=N``
+  shape): successive rounds of ``--cases`` each, the corpus seed
+  advancing per round, until the time budget is spent. Crash-safe: a
+  SIGKILL'd farm re-run with the same arguments resumes the interrupted
+  round from the per-rank journals and loses/duplicates nothing.
+- **smoke** (``--smoke``, the citest slice): a deterministic two-pass
+  drill, seconds not minutes — (a) the CLEAN build must report ZERO
+  divergences over the pinned corpus, (b) with the planted engine
+  defect armed (the test-only ``CONSENSUS_SPECS_TPU_FUZZ_DEFECT`` hook,
+  same family as the perfgate chaos drills) the farm must FIND the
+  divergence and SHRINK it to a minimal reproducer (exactly one
+  attestation left, strictly smaller than the original for any
+  multi-attestation original). Banks ``fuzz_execs_per_s`` from the
+  clean pass.
+
+The ledger points (``--ledger``): ``fuzz_execs_per_s`` (differential
+executions per second through all three paths) and — long-haul only —
+``fuzz_findings``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import shutil
+import sys
+import tempfile
+import time
+from typing import Any, Dict, List, Optional
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from consensus_specs_tpu.fuzz import (  # noqa: E402
+    FarmConfig,
+    load_merged,
+    merged_digest,
+    run_farm,
+)
+from consensus_specs_tpu.fuzz.executor import DEFECT_ENV  # noqa: E402
+from consensus_specs_tpu.obs import ledger as ledger_mod  # noqa: E402
+
+FINDINGS_EXIT = 3
+
+
+def _print_report(label: str, rep: Dict[str, Any]) -> None:
+    print(f"fuzz {label}: {rep['execs']} execs in {rep['seconds']}s "
+          f"({rep['execs_per_s']}/s, {rep['workers']} worker(s), "
+          f"{rep['fork']}/{rep['preset']} seed {rep['seed']}) -> "
+          f"{rep['merged_findings']} finding(s)"
+          + (f", {rep['degraded_execs']} degraded exec(s)"
+             if rep['degraded_execs'] else "")
+          + (f", {rep['respawns']} respawn(s)" if rep['respawns'] else ""))
+
+
+def _bank(ledger_path: Optional[str], metrics: Dict[str, float],
+          source: str) -> None:
+    led = ledger_mod.Ledger(ledger_path) if ledger_path else ledger_mod.Ledger()
+    run_id = led.record_run(
+        metrics, source=source, backend="host",
+        environment=ledger_mod.environment_fingerprint())
+    print(f"fuzz: banked {sorted(metrics)} -> {led.path} (run {run_id})")
+
+
+def run_fixed(ns: argparse.Namespace) -> int:
+    out = pathlib.Path(ns.out or tempfile.mkdtemp(prefix="fuzz_farm_"))
+    cfg = FarmConfig(out_dir=out, fork=ns.fork, preset=ns.preset,
+                     seed=ns.seed, cases=ns.cases, workers=ns.workers,
+                     serve_path=ns.serve_path, shrink=not ns.no_shrink)
+    report = run_farm(cfg).to_dict()
+    _print_report("run", report)
+    for case, record in sorted(load_merged(out).items()):
+        f = record.get("finding", {})
+        s = record.get("shrunk", {})
+        print(f"  {case}: {f.get('kind')} "
+              f"({','.join(f.get('disagrees_with_oracle', []))}) "
+              f"{s.get('orig_size', '?')}B -> {s.get('size', '?')}B shrunk")
+    if ns.json_path:
+        ns.json_path.write_text(json.dumps(report, indent=2, sort_keys=True))
+    if ns.ledger is not None:
+        _bank(ns.ledger, {"fuzz_execs_per_s": report["execs_per_s"],
+                          "fuzz_findings": report["merged_findings"]},
+              source="fuzz_farm")
+    print(f"fuzz: findings journal at {out / 'findings.jsonl'}")
+    return FINDINGS_EXIT if report["merged_findings"] else 0
+
+
+def run_longhaul(ns: argparse.Namespace) -> int:
+    out = pathlib.Path(ns.out or "./fuzz-farm")
+    deadline = time.monotonic() + ns.minutes * 60.0
+    rounds: List[Dict[str, Any]] = []
+    seed = ns.seed
+    total_execs, t0 = 0, time.monotonic()
+    while time.monotonic() < deadline:
+        cfg = FarmConfig(out_dir=out, fork=ns.fork, preset=ns.preset,
+                         seed=seed, cases=ns.cases, workers=ns.workers,
+                         serve_path=ns.serve_path, shrink=not ns.no_shrink)
+        report = run_farm(cfg).to_dict()
+        _print_report(f"round {len(rounds)}", report)
+        rounds.append(report)
+        total_execs += report["execs"]
+        seed += 1
+    seconds = time.monotonic() - t0
+    findings = len(load_merged(out))
+    execs_per_s = round(total_execs / seconds, 2) if seconds > 0 else 0.0
+    print(f"fuzz long-haul: {len(rounds)} round(s), {total_execs} execs in "
+          f"{seconds:.1f}s ({execs_per_s}/s), {findings} finding(s) "
+          f"-> {out / 'findings.jsonl'}")
+    if ns.json_path:
+        ns.json_path.write_text(json.dumps(
+            {"rounds": rounds, "execs": total_execs,
+             "execs_per_s": execs_per_s, "findings": findings},
+            indent=2, sort_keys=True))
+    if ns.ledger is not None and rounds:
+        _bank(ns.ledger, {"fuzz_execs_per_s": execs_per_s,
+                          "fuzz_findings": findings}, source="fuzz_farm")
+    return FINDINGS_EXIT if findings else 0
+
+
+def run_smoke(ns: argparse.Namespace) -> int:
+    """The deterministic citest drill: clean build finds nothing, a
+    planted engine defect is found AND shrunk to a minimal reproducer."""
+    from consensus_specs_tpu.specs import build_spec
+
+    root = pathlib.Path(ns.out or tempfile.mkdtemp(prefix="fuzz_smoke_"))
+    cleanup = ns.out is None
+    failures: List[str] = []
+    try:
+        # pass 1 — clean build: ZERO divergences over the pinned corpus
+        clean_cfg = FarmConfig(out_dir=root / "clean", fork=ns.fork,
+                               preset=ns.preset, seed=ns.seed,
+                               cases=ns.cases, workers=ns.workers,
+                               serve_path=ns.serve_path)
+        os.environ.pop(DEFECT_ENV, None)
+        clean = run_farm(clean_cfg).to_dict()
+        _print_report("smoke/clean", clean)
+        if clean["merged_findings"] != 0:
+            failures.append(
+                f"clean build reported {clean['merged_findings']} "
+                f"divergence(s) — see {root / 'clean' / 'findings.jsonl'}")
+
+        # pass 2 — planted engine defect: must be FOUND and SHRUNK
+        os.environ[DEFECT_ENV] = "engine"
+        try:
+            planted = run_farm(FarmConfig(
+                out_dir=root / "planted", fork=ns.fork, preset=ns.preset,
+                seed=ns.seed, cases=ns.cases, workers=ns.workers,
+                serve_path=ns.serve_path)).to_dict()
+        finally:
+            os.environ.pop(DEFECT_ENV, None)
+        _print_report("smoke/planted", planted)
+        merged = load_merged(root / "planted")
+        if not merged:
+            failures.append("planted engine defect was NOT found")
+        spec = build_spec(ns.fork, ns.preset)
+        shrunk_ok = 0
+        for case, record in sorted(merged.items()):
+            f, s = record.get("finding", {}), record.get("shrunk")
+            if f.get("kind") != "post_root" or s is None or s.get("aborted"):
+                continue
+            block = spec.BeaconBlock.decode_bytes(bytes.fromhex(s["block"]))
+            if (len(block.body.attestations) == 1
+                    and s["size"] <= s["orig_size"]):
+                shrunk_ok += 1
+        if merged and not shrunk_ok:
+            failures.append("no finding shrank to the minimal "
+                            "single-attestation reproducer")
+        else:
+            print(f"fuzz smoke: {shrunk_ok}/{len(merged)} finding(s) shrunk "
+                  "to the minimal single-attestation reproducer")
+        if not any(rec.get("shrunk", {}).get("size", 1) <
+                   rec.get("shrunk", {}).get("orig_size", 0)
+                   for rec in merged.values()):
+            # at least one original carried >1 attestation, so at least
+            # one shrink must strictly reduce the byte size
+            failures.append("no finding strictly shrank")
+
+        # determinism pin: the planted findings digest is a pure
+        # function of (fork, preset, seed, corpus) — print it so CI
+        # logs expose any drift across reruns
+        digest = merged_digest(root / "planted")
+        print(f"fuzz smoke: planted findings digest "
+              f"{digest[1][:16]} ({digest[0]} line(s))" if digest
+              else "fuzz smoke: no planted findings digest")
+
+        if ns.ledger is not None:
+            _bank(ns.ledger, {"fuzz_execs_per_s": clean["execs_per_s"]},
+                  source="fuzz_smoke")
+    finally:
+        if cleanup:
+            shutil.rmtree(root, ignore_errors=True)
+    for f in failures:
+        print(f"fuzz smoke FAILED: {f}", file=sys.stderr)
+    print(f"fuzz smoke: {'FAILED' if failures else 'PASSED'}")
+    return 1 if failures else 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--smoke", action="store_true",
+                        help="deterministic clean + planted-defect drill")
+    parser.add_argument("--minutes", type=float, default=None,
+                        help="long-haul time budget (rounds of --cases)")
+    parser.add_argument("--cases", type=int, default=None,
+                        help="corpus size per run/round (default: 96 smoke, "
+                             "512 otherwise)")
+    parser.add_argument("--workers", type=int, default=2)
+    parser.add_argument("--seed", type=int, default=1)
+    parser.add_argument("--fork", default="phase0")
+    parser.add_argument("--preset", default="minimal")
+    parser.add_argument("--out", default=None,
+                        help="findings/journal directory (default: temp for "
+                             "smoke/fixed, ./fuzz-farm for long-haul)")
+    parser.add_argument("--serve-path", choices=("service", "daemon"),
+                        default=None,
+                        help="served path: in-process SpecService (default "
+                             "for smoke) or a real localhost daemon "
+                             "(default for long-haul)")
+    parser.add_argument("--no-shrink", action="store_true")
+    parser.add_argument("--ledger", default=None,
+                        help="bank fuzz_execs_per_s to this ledger path")
+    parser.add_argument("--json", dest="json_path", type=pathlib.Path,
+                        default=None)
+    ns = parser.parse_args(argv)
+
+    if ns.cases is None:
+        ns.cases = 96 if ns.smoke else 512
+    if ns.serve_path is None:
+        ns.serve_path = "daemon" if ns.minutes else "service"
+    if ns.smoke:
+        return run_smoke(ns)
+    if ns.minutes:
+        return run_longhaul(ns)
+    return run_fixed(ns)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -281,8 +281,10 @@ def html_report(led: ledger_mod.Ledger) -> str:
 
     serve_metric_names = sorted(m for m in series if m.startswith("serve_"))
     serve_rows = [_metric_row(m, slo_col=True) for m in serve_metric_names]
+    fuzz_metric_names = sorted(m for m in series if m.startswith("fuzz_"))
+    fuzz_rows = [_metric_row(m) for m in fuzz_metric_names]
     rows = [_metric_row(m) for m in sorted(series)
-            if m not in serve_metric_names]
+            if m not in serve_metric_names and m not in fuzz_metric_names]
 
     # the worker-sweep scaling curve (docs/GENPIPE.md "Sharded
     # generation"): latest gen_pipeline_w<N>_s point per worker count,
@@ -377,6 +379,11 @@ datapoints.</p>
 <th>points</th><th>sentinel</th><th>SLO</th></tr>
 {''.join(serve_rows)}
 </table>''' if serve_rows else '')}
+{(f'''<h2>Fuzzing farm (fuzz_*)</h2>
+<table><tr><th>metric</th><th>trajectory</th><th>latest</th><th>backend</th>
+<th>points</th><th>sentinel</th></tr>
+{''.join(fuzz_rows)}
+</table>''' if fuzz_rows else '')}
 {fleet_scaling_html}
 {gen_scaling_html}
 <h2>Metric trajectories</h2>

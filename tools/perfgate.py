@@ -61,6 +61,19 @@ jax import, no device, no tunnel):
                               cold ledger (chaos:
                               ``perfgate_overload=0.5``), from round
                               10 on (docs/SERVE.md "Overload control");
+- ``perfgate_fuzz_execs_per_s`` differential fuzz throughput: a
+                              deterministic synthetic corpus (valid,
+                              wreckage-mutated, byte-corrupted, and
+                              random-SSZ blocks) executed through the
+                              REAL three-path exec/compare machinery —
+                              interpreted oracle vs vectorized engine
+                              vs served wire path — with zero
+                              divergences asserted INSIDE the
+                              measurement (a diverging build must fail
+                              here, not ship a fast number). A slowed
+                              farm (chaos: ``perfgate_fuzz=3``)
+                              regresses this rate and fails the gate,
+                              from round 12 on (docs/FUZZ.md);
 - ``perfgate_fleet_failover_ms`` the serve fleet's kill-one failover
                               latency: a forked 3-replica fleet, one
                               replica SIGKILLed, the time to detect the
@@ -479,6 +492,54 @@ def measure_fleet_failover_ms() -> float:
         "perfgate_fleet_failover_ms")
 
 
+def measure_fuzz_execs_per_s() -> float:
+    """The conformance fuzzing farm's hot loop, end-to-end on host,
+    jax-free (docs/FUZZ.md): a pinned 40-case corpus slice — valid
+    bases from a short simulated chain plus wreckage/byte/random
+    mutants — through the REAL differential executor: every case runs
+    ``process_block`` on the interpreted oracle AND the vectorized
+    engine AND the served wire path (in-process SpecService), outcomes
+    normalized and compared. The metric is differential executions per
+    second. Two correctness asserts ride inside the measurement: the
+    clean build must report ZERO divergences, and the verdict
+    population must cover accept/reject/undecodable (a corpus that
+    stopped exercising the ladder must fail here, not drift silently).
+    """
+    from consensus_specs_tpu.crypto import bls
+    from consensus_specs_tpu.fuzz import CorpusBuilder, DifferentialExecutor
+    from consensus_specs_tpu.serve import SpecService, VerifyBatcher
+    from consensus_specs_tpu.specs import build_spec
+
+    n_cases = 40
+    spec = build_spec("phase0", "minimal")
+    builder = CorpusBuilder(spec, "phase0", "minimal", seed=7)
+    was_bls = bls.bls_active
+    bls.bls_active = False
+    service = SpecService(forks=("phase0",), presets=("minimal",),
+                          batcher=VerifyBatcher(linger_ms=1)).start()
+    try:
+        executor = DifferentialExecutor(spec, "phase0", "minimal",
+                                        service=service)
+        cases = [builder.case(i) for i in range(n_cases)]  # corpus not timed
+        verdicts = set()
+        t0 = time.perf_counter()
+        for case in cases:
+            result = executor.execute(case)
+            assert result.divergence is None, (
+                f"clean build diverged on {case.case_id}: "
+                f"{result.divergence}")
+            verdicts.add(result.outcomes["oracle"].verdict)
+        dt = time.perf_counter() - t0
+        assert verdicts >= {"accept", "reject", "undecodable"}, (
+            f"corpus stopped exercising the rejection ladder: {verdicts}")
+    finally:
+        service.batcher.drain(5)
+        service.stop()
+        bls.bls_active = was_bls
+    dt *= _chaos_factor("perfgate_fuzz_execs_per_s")
+    return n_cases / dt
+
+
 # the absolute no-collapse floor for the overload slice: goodput under
 # 3x overload must stay within this fraction of saturation goodput.
 # Absolute (like the SLO gate), because a cold ledger must still refuse
@@ -495,6 +556,7 @@ MEASUREMENTS: Tuple[Tuple[str, Callable[[], float]], ...] = (
     ("perfgate_chain_sim_ms", measure_chain_sim_ms),
     ("perfgate_overload_goodput_ratio", measure_overload_goodput_ratio),
     ("perfgate_fleet_failover_ms", measure_fleet_failover_ms),
+    ("perfgate_fuzz_execs_per_s", measure_fuzz_execs_per_s),
 )
 
 

@@ -288,6 +288,63 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             "respawns": sum(max(0, r["attempts"] - 1) for r in rank_rows),
         }
 
+    # --- fuzz farm section: the differential fuzzer's per-rank story
+    # (docs/FUZZ.md) — execs/s per rank (fuzz.case spans matched by the
+    # worker's pid), the mutation-kind tally, divergence/shrink counts,
+    # and chaos degradation split by site
+    fuzz_worker_spans = [s for s in spans if s.get("name") == "fuzz.worker"]
+    fuzz_case_spans = [s for s in spans if s.get("name") == "fuzz.case"]
+    fuzz: Dict[str, Any] = {}
+    if fuzz_case_spans or fuzz_worker_spans:
+        mut_tally: Dict[str, int] = {}
+        fuzz_case_by_pid: Dict[Any, List[float]] = {}
+        for s in fuzz_case_spans:
+            fuzz_case_by_pid.setdefault(s.get("pid"), []).append(
+                float(s.get("dur") or 0) / 1e3)
+            for mut in str((s.get("attrs") or {}).get("muts") or "").split(","):
+                if mut:
+                    mut_tally[mut] = mut_tally.get(mut, 0) + 1
+        fuzz_ranks: Dict[int, Dict[str, Any]] = {}
+        for s in fuzz_worker_spans:
+            a = s.get("attrs") or {}
+            rank = int(a.get("rank") or 0)
+            acc4 = fuzz_ranks.setdefault(rank, {
+                "rank": rank, "attempts": 0, "degraded": 0,
+                "wall_ms": 0.0, "execs": 0, "busy_ms": 0.0})
+            acc4["attempts"] += 1
+            acc4["degraded"] += 1 if a.get("degraded") else 0
+            acc4["wall_ms"] += float(s.get("dur") or 0) / 1e3
+            case_durs = fuzz_case_by_pid.get(s.get("pid"), [])
+            acc4["execs"] += len(case_durs)
+            acc4["busy_ms"] += sum(case_durs)
+        rank_rows2 = []
+        for rank in sorted(fuzz_ranks):
+            fr = fuzz_ranks[rank]
+            rank_rows2.append({
+                "rank": fr["rank"], "attempts": fr["attempts"],
+                "degraded": fr["degraded"],
+                "wall_ms": round(fr["wall_ms"], 3),
+                "execs": fr["execs"],
+                "execs_per_s": (round(fr["execs"] / (fr["wall_ms"] / 1e3), 1)
+                                if fr["wall_ms"] else None),
+            })
+        fuzz_degraded: Dict[str, int] = {}
+        for i in instants:
+            if str(i.get("name") or "").startswith("resilience."):
+                cap = str((i.get("attrs") or {}).get("capability") or "")
+                if cap.startswith("fuzz."):
+                    fuzz_degraded[cap] = fuzz_degraded.get(cap, 0) + 1
+        fuzz = {
+            "execs": len(fuzz_case_spans),
+            "findings": sum(1 for i in instants
+                            if i.get("name") == "fuzz.finding"),
+            "shrunk": sum(1 for i in instants
+                          if i.get("name") == "fuzz.shrunk"),
+            "mutation_kinds": dict(sorted(mut_tally.items())),
+            "ranks": rank_rows2,
+            "degraded_by_site": dict(sorted(fuzz_degraded.items())),
+        }
+
     # --- persistent compile cache traffic (sched.compile_cache instants:
     # every request that found a cached executable skipped its compile)
     cache_requests = sum(1 for i in instants
@@ -316,6 +373,7 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "gen_shard": gen_shard,
         "serve": serve,
         "sim": sim,
+        "fuzz": fuzz,
         "compile_cache": {
             "requests": cache_requests,
             "hits": cache_hits,
@@ -421,6 +479,27 @@ def print_summary(summary: Dict[str, Any]) -> None:
             deg = "  ".join(f"{k}={n}"
                             for k, n in sim["degraded_steps_by_site"].items())
             print(f"  chaos-degraded: {deg}")
+    fuzz = summary.get("fuzz") or {}
+    if fuzz:
+        print(f"\nfuzz farm: {fuzz['execs']} exec(s)  "
+              f"{fuzz['findings']} finding(s)  {fuzz['shrunk']} shrunk")
+        for r in fuzz.get("ranks", []):
+            flags = ""
+            if r["attempts"] > 1:
+                flags += f"  attempts={r['attempts']}"
+            if r["degraded"]:
+                flags += "  DEGRADED->in-process"
+            print(f"  rank {r['rank']}: {r['execs']} exec(s)  "
+                  f"wall {r['wall_ms']:.1f}ms  "
+                  f"{r['execs_per_s']} execs/s{flags}")
+        if fuzz.get("mutation_kinds"):
+            muts = "  ".join(f"{k}={n}"
+                             for k, n in fuzz["mutation_kinds"].items())
+            print(f"  mutations: {muts}")
+        if fuzz.get("degraded_by_site"):
+            deg = "  ".join(f"{k}={n}"
+                            for k, n in fuzz["degraded_by_site"].items())
+            print(f"  resilience-by-site: {deg}")
     cache = summary.get("compile_cache") or {}
     if cache.get("requests"):
         print(f"\ncompile cache: {cache['hits']} hit(s) / "

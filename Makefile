@@ -26,7 +26,7 @@ DEVICE_TESTS = tests/test_bls_device.py tests/test_curve_device.py \
         dryrun detect_generator_incomplete clean-vectors chaos trace perfgate perf-report gen-bench \
         gen-shard-smoke warm-cache serve serve-smoke serve-bench serve-canary slo-report sim \
         sim-smoke device-probe overload-drill overload-smoke fleet-drill fleet-smoke fuzz \
-        fuzz-smoke help
+        fuzz-smoke longhaul-smoke mission-report help
 
 # the fault-injection suite: supervisor/taxonomy units, chaos replay
 # (tampered vectors), induced backend failures, generator crash/resume
@@ -65,6 +65,8 @@ help:
 	@echo "sim-smoke             short chain-sim differential + chaos drill (the citest slice; docs/SIM.md)"
 	@echo "fuzz                  sharded differential fuzzing long-haul: oracle vs engine vs served path, FUZZ_MINUTES=N budget, findings shrunk + journaled -> ./fuzz-farm (docs/FUZZ.md)"
 	@echo "fuzz-smoke            deterministic fuzz drill (citest slice): clean build finds ZERO divergences; a planted engine defect is found AND shrunk; fuzz_execs_per_s -> $(LEDGER)"
+	@echo "longhaul-smoke        long-haul telemetry drill (citest slice): armed sim+fuzz run -> series journals + profile + byte-stable mission report; planted RSS leak must be flagged"
+	@echo "mission-report        merge a long-haul telemetry dir (LONGHAUL=<dir>) into one mission-control HTML report"
 	@echo "device-probe          opportunistic device probe: bank backend:jax ledger points for the headline keys when the tunnel is healthy"
 
 # parallelize like the reference (ref Makefile:100-106) when pytest-xdist
@@ -87,6 +89,7 @@ citest:
 	$(MAKE) gen-shard-smoke
 	$(MAKE) sim-smoke
 	$(MAKE) fuzz-smoke
+	$(MAKE) longhaul-smoke
 	$(MAKE) serve-smoke
 	$(MAKE) serve-canary
 	$(MAKE) overload-smoke
@@ -185,8 +188,13 @@ fleet-smoke:
 # SIM_VALIDATORS=512 (etc) scales the registry — non-default sizes bank
 # their own chain_sim_<N>v_* series (engine wins grow with validators)
 SIM_VALIDATORS ?= 64
+# LONGHAUL=<dir> arms the long-haul telemetry plane for sim/fuzz runs
+# (docs/OBSERVABILITY.md): per-process series journals + profiler +
+# watchdogs, merged into <dir>/report.html at the end of the run
+LONGHAUL ?=
+LONGHAUL_ENV = $(if $(LONGHAUL),CONSENSUS_SPECS_TPU_LONGHAUL=$(LONGHAUL))
 sim:
-	$(PYTHON) tools/sim_run.py --slots 2048 --validators $(SIM_VALIDATORS) --chaos-drill --ledger $(LEDGER)
+	$(LONGHAUL_ENV) $(PYTHON) tools/sim_run.py --slots 2048 --validators $(SIM_VALIDATORS) --chaos-drill --ledger $(LEDGER)
 
 sim-smoke:
 	$(PYTHON) tools/sim_run.py --slots 96 --chaos-drill --ledger $(LEDGER)
@@ -202,10 +210,22 @@ sim-smoke:
 FUZZ_MINUTES ?= 5
 FUZZ_WORKERS ?= 2
 fuzz:
-	$(PYTHON) tools/fuzz_farm.py --minutes $(FUZZ_MINUTES) --workers $(FUZZ_WORKERS) --ledger $(LEDGER)
+	$(LONGHAUL_ENV) $(PYTHON) tools/fuzz_farm.py --minutes $(FUZZ_MINUTES) --workers $(FUZZ_WORKERS) --ledger $(LEDGER)
 
 fuzz-smoke:
 	$(PYTHON) tools/fuzz_farm.py --smoke --ledger $(LEDGER)
+
+# the long-haul telemetry drill (docs/OBSERVABILITY.md "Long-haul
+# telemetry plane"): an armed sim+fuzz run must leave per-process
+# series journals, a collapsed-stack profile, ZERO watchdog findings,
+# and a byte-stable mission report; a planted ~25MB/s leak must be
+# flagged by the rss_leak watchdog. The citest slice.
+longhaul-smoke:
+	$(PYTHON) tools/longhaul_smoke.py
+
+mission-report:
+	$(if $(LONGHAUL),,$(error mission-report requires LONGHAUL=<telemetry dir>))
+	$(PYTHON) tools/mission_report.py $(LONGHAUL)
 
 # ROADMAP #2's second half: the moment the tunnel is healthy, bank
 # backend:"jax" datapoints for the round-4 headline keys by running just

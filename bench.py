@@ -137,6 +137,11 @@ def _emit() -> None:
     if oevs:
         seen = RESULTS.setdefault("events", [])
         seen.extend(e for e in oevs if e not in seen)
+    if obs.events_dropped():
+        # the bounded event buffer evicted history: say so, so a
+        # long-haul BENCH json's `events` key reads as a tail, not the
+        # whole run
+        RESULTS["events_dropped"] = obs.events_dropped()
     if not _IS_CHILD:
         # merge every process's span JSONL into ONE Perfetto-loadable
         # trace.json — on every parent exit path, so a deadline-killed

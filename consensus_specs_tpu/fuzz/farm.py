@@ -287,6 +287,7 @@ def _spawn_worker(cfg: FarmConfig, rank: int) -> _Worker:
     code = taxonomy.EX_SOFTWARE
     try:
         obs.fork_child_reinit(trace_env)
+        obs.timeseries.set_role(f"fuzz.rank{rank}")
         with obs.span("fuzz.worker", rank=rank, workers=cfg.workers):
             counts = run_slice(cfg, rank, label=f"[f{rank}] ")
         result = _result_path(cfg.out_dir, rank)
@@ -322,6 +323,7 @@ def run_farm(cfg: FarmConfig) -> FarmReport:
     merge. The report aggregates rank counts + the merged findings."""
     out_dir = Path(cfg.out_dir)
     out_dir.mkdir(parents=True, exist_ok=True)
+    obs.timeseries.ensure_started(role="fuzz.parent")
     report = FarmReport(config=cfg)
     t0 = time.perf_counter()
 

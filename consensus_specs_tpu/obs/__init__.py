@@ -26,6 +26,14 @@ Perfetto-loadable trace per run.
   (rolling median+MAD baselines; resilience-taxonomy classification so
   environment gaps never read as regressions). ``make perfgate`` gates
   CI on them.
+- :mod:`timeseries` / :mod:`proc` / :mod:`profile` / :mod:`watchdog` —
+  the long-haul telemetry plane (``CONSENSUS_SPECS_TPU_LONGHAUL``
+  knob): fsync'd per-process time-series journals of the metric
+  registry + ``/proc/self`` resource gauges, an armable collapsed-stack
+  sampling profiler, and online drift watchdogs (RSS leak slope,
+  throughput decay, queue creep, stalls) whose findings land in the
+  journal and the trace. ``tools/mission_report.py`` merges a whole
+  run into one mission-control HTML report.
 
 Instrumented planes: bls facade dispatch + oracle adjudication, engine
 ``dispatch_delta_kernel`` + every vectorized epoch stage, the ssz
@@ -48,6 +56,7 @@ from .core import (  # noqa: F401
     enabled,
     event,
     events,
+    events_dropped,
     fork_child_reinit,
     instant,
     is_root_process,
@@ -68,6 +77,14 @@ from .export import (  # noqa: F401
     to_chrome,
     validate_chrome,
 )
-from .metrics import count, observe, prometheus_text, publish, snapshot  # noqa: F401
+from .metrics import (  # noqa: F401
+    count,
+    gauge,
+    observe,
+    prometheus_text,
+    publish,
+    snapshot,
+)
 from . import ledger, sentinel  # noqa: F401  (perf evidence plane)
 from . import flightrec, slo  # noqa: F401  (request observability plane)
+from . import proc, profile, timeseries, watchdog  # noqa: F401  (long-haul plane)

@@ -116,6 +116,8 @@ def infer_unit(metric: str) -> Optional[str]:
         return "s"
     if "speedup" in metric or "scaling" in metric or metric == "vs_baseline":
         return "x"
+    if metric.endswith("_pct"):
+        return "%"
     return None
 
 

@@ -1,4 +1,4 @@
-"""Counters and histograms: thread-safe in-process aggregates.
+"""Counters, gauges, and histograms: thread-safe in-process aggregates.
 
 These are always live (no env gate — a dict update is cheaper than the
 question of whether to do it), queryable via :func:`snapshot`, and
@@ -17,6 +17,8 @@ from typing import Any, Dict, List, Optional
 _lock = threading.Lock()
 _counters: Dict[str, float] = {}
 _histograms: Dict[str, List[float]] = {}
+_hist_dropped: Dict[str, int] = {}
+_gauges: Dict[str, float] = {}
 
 _HIST_CAP = 4096  # per-name sample bound (reservoir-free: drop the tail)
 
@@ -29,12 +31,70 @@ def count(name: str, n: float = 1) -> None:
 
 def observe(name: str, value: float) -> None:
     """Record one sample into a histogram (bounded; extra samples still
-    bump the count so rates stay truthful)."""
+    bump the count so rates stay truthful, and the dropped tail is
+    COUNTED per histogram — long-haul runs saturate the window fast and
+    a silent drop would misrepresent every later percentile)."""
     with _lock:
         hist = _histograms.setdefault(name, [])
         if len(hist) < _HIST_CAP:
             hist.append(value)
+        else:
+            _hist_dropped[name] = _hist_dropped.get(name, 0) + 1
         _counters[name + ".count"] = _counters.get(name + ".count", 0) + 1
+
+
+def gauge(name: str, value: float) -> None:
+    """Set a point-in-time gauge (last write wins). The long-haul proc
+    sampler publishes ``proc.*`` through here each tick."""
+    with _lock:
+        _gauges[name] = float(value)
+
+
+def gauges() -> Dict[str, float]:
+    """Current gauge values (a copy)."""
+    with _lock:
+        return dict(_gauges)
+
+
+def counters() -> Dict[str, float]:
+    """Current counter values (a copy) — the cheap view the long-haul
+    flusher reads every tick (no histogram sorting)."""
+    with _lock:
+        return dict(_counters)
+
+
+def hist_summaries(
+    cache: Dict[str, Any],
+) -> Dict[str, Dict[str, Any]]:
+    """``{name: {count, p50, p99, dropped}}`` per histogram, with a
+    caller-held cache keyed on the unbounded ``count``: a histogram
+    that saw no new observation since the caller's last call reuses its
+    cached summary instead of re-copying and re-sorting the bounded
+    window. The long-haul flusher samples sub-second — without the
+    cache, every tick re-sorted EVERY histogram in the registry, which
+    was the armed plane's dominant overhead on a loaded process
+    (perfgate_obs_overhead_pct watches this)."""
+    with _lock:
+        counts = {
+            name: int(_counters.get(name + ".count", len(vals)))
+            for name, vals in _histograms.items() if vals
+        }
+        stale = [name for name, n in counts.items()
+                 if cache.get(name, (None, None))[0] != n]
+        windows = {name: list(_histograms[name]) for name in stale}
+        dropped = {name: int(_hist_dropped.get(name, 0)) for name in counts}
+    out: Dict[str, Dict[str, Any]] = {}
+    for name, n in counts.items():
+        if name not in windows:
+            out[name] = cache[name][1]
+            continue
+        ordered = sorted(windows[name])
+        summary = {"count": n, "p50": percentile(ordered, 50),
+                   "p99": percentile(ordered, 99),
+                   "dropped": dropped[name]}
+        cache[name] = (n, summary)
+        out[name] = summary
+    return out
 
 
 def percentile(samples: List[float], q: float) -> Optional[float]:
@@ -66,16 +126,22 @@ DEFAULT_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
 
 
 def snapshot(clear: bool = False) -> Dict[str, Any]:
-    """{counters: {...}, histograms: {name: {count,min,p50,p90,p99,max,
-    sum,samples,buckets}}} — ``buckets`` are CUMULATIVE counts per
-    ``le`` bound over the bounded sample window (``samples`` many;
-    ``count`` keeps the unbounded total so rates stay truthful)."""
+    """{counters: {...}, gauges: {...}, histograms: {name: {count,min,
+    p50,p90,p99,max,sum,samples,dropped,buckets}}} — ``buckets`` are
+    CUMULATIVE counts per ``le`` bound over the bounded sample window
+    (``samples`` many; ``count`` keeps the unbounded total so rates
+    stay truthful; ``dropped`` counts samples the bounded window
+    refused, so long-haul percentiles are honest about their basis)."""
     with _lock:
         counters = dict(_counters)
+        gauge_vals = dict(_gauges)
         hists = {name: list(vals) for name, vals in _histograms.items()}
+        dropped = dict(_hist_dropped)
         if clear:
             _counters.clear()
             _histograms.clear()
+            _hist_dropped.clear()
+            _gauges.clear()
     out_h = {}
     for name, vals in hists.items():
         if not vals:
@@ -96,9 +162,10 @@ def snapshot(clear: bool = False) -> Dict[str, Any]:
             "max": ordered[-1],
             "sum": sum(ordered),
             "samples": len(ordered),
+            "dropped": int(dropped.get(name, 0)),
             "buckets": buckets,
         }
-    return {"counters": counters, "histograms": out_h}
+    return {"counters": counters, "gauges": gauge_vals, "histograms": out_h}
 
 
 def publish() -> None:
@@ -157,6 +224,7 @@ def prometheus_text(snap: Optional[Dict[str, Any]] = None) -> str:
     if snap is None:
         snap = snapshot()
     counters: Dict[str, float] = snap.get("counters", {})
+    gauge_vals: Dict[str, float] = snap.get("gauges", {})
     hists: Dict[str, Dict[str, Any]] = snap.get("histograms", {})
     lines: List[str] = []
     hist_count_keys = {name + ".count" for name in hists}
@@ -166,6 +234,10 @@ def prometheus_text(snap: Optional[Dict[str, Any]] = None) -> str:
         pname = _prom_name(name)
         lines.append(f"# TYPE {pname} counter")
         lines.append(f"{pname} {counters[name]:g}")
+    for name in sorted(gauge_vals):
+        pname = _prom_name(name)
+        lines.append(f"# TYPE {pname} gauge")
+        lines.append(f"{pname} {gauge_vals[name]:g}")
     for name in sorted(hists):
         h = hists[name]
         pname = _prom_name(name)
@@ -174,6 +246,10 @@ def prometheus_text(snap: Optional[Dict[str, Any]] = None) -> str:
             if h.get(key) is not None:
                 lines.append(f'{pname}{{quantile="{q_label}"}} {h[key]:g}')
         lines.append(f"{pname}_count {h.get('count', 0):g}")
+        # the bounded window's refusals: a scrape consumer can tell a
+        # long-haul histogram's percentiles cover `samples`, not `count`
+        lines.append(f"# TYPE {pname}_dropped counter")
+        lines.append(f"{pname}_dropped {h.get('dropped', 0):g}")
         for suffix in ("min", "max"):
             if h.get(suffix) is not None:
                 lines.append(f"# TYPE {pname}_{suffix} gauge")
@@ -231,3 +307,5 @@ def reset() -> None:
     with _lock:
         _counters.clear()
         _histograms.clear()
+        _hist_dropped.clear()
+        _gauges.clear()

@@ -1,0 +1,97 @@
+"""Zero-dependency per-process resource sampler (docs/OBSERVABILITY.md
+"Long-haul telemetry plane").
+
+One call, :func:`sample`, returns the gauges a long-lived process must
+watch about itself — RSS, CPU time, open fds, thread count, GC
+pressure — read from ``/proc/self`` (pure stdlib, no psutil). On a
+host without procfs it degrades to ``resource.getrusage`` +
+``threading`` so the series journal still carries CPU/RSS evidence,
+just with coarser semantics (``ru_maxrss`` is a high-water mark, not
+the live RSS).
+
+The timeseries flusher (obs/timeseries.py) publishes every key here as
+a ``proc.<key>`` gauge each sampling tick, which is what the RSS
+leak-slope and stall watchdogs (obs/watchdog.py) watch.
+"""
+from __future__ import annotations
+
+import gc
+import os
+import threading
+import time
+from typing import Dict
+
+_PAGE = 4096
+try:
+    _PAGE = os.sysconf("SC_PAGE_SIZE")
+except (AttributeError, ValueError, OSError):  # pragma: no cover
+    pass
+
+_TICK = 100.0
+try:
+    _TICK = float(os.sysconf("SC_CLK_TCK"))
+except (AttributeError, ValueError, OSError):  # pragma: no cover
+    pass
+
+
+def _read(path: str) -> str:
+    with open(path, "rb") as f:
+        return f.read().decode("ascii", "replace")
+
+
+def _procfs_sample() -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    # /proc/self/statm: size resident shared ... (pages)
+    fields = _read("/proc/self/statm").split()
+    out["vm_bytes"] = float(fields[0]) * _PAGE
+    out["rss_bytes"] = float(fields[1]) * _PAGE
+    # /proc/self/stat: utime/stime are fields 14/15 (1-based), but the
+    # comm field (2) may itself contain spaces/parens — split after the
+    # LAST ')' to stay correct for any process name
+    stat = _read("/proc/self/stat")
+    rest = stat.rsplit(")", 1)[1].split()
+    # rest[0] is field 3 (state); utime = field 14 -> rest[11]
+    out["cpu_user_s"] = float(rest[11]) / _TICK
+    out["cpu_sys_s"] = float(rest[12]) / _TICK
+    out["cpu_s"] = out["cpu_user_s"] + out["cpu_sys_s"]
+    out["threads"] = float(rest[17])
+    out["fds"] = float(len(os.listdir("/proc/self/fd")))
+    return out
+
+
+def _fallback_sample() -> Dict[str, float]:  # pragma: no cover — non-procfs
+    out: Dict[str, float] = {}
+    try:
+        import resource
+
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        out["rss_bytes"] = float(ru.ru_maxrss) * 1024  # linux: kB
+        out["cpu_user_s"] = float(ru.ru_utime)
+        out["cpu_sys_s"] = float(ru.ru_stime)
+        out["cpu_s"] = out["cpu_user_s"] + out["cpu_sys_s"]
+    except Exception:
+        pass
+    out["threads"] = float(threading.active_count())
+    return out
+
+
+def sample() -> Dict[str, float]:
+    """Resource gauges for THIS process, plus GC counters. Never raises:
+    a vanished procfs entry mid-read degrades to the rusage fallback."""
+    try:
+        out = _procfs_sample()
+    except Exception:
+        out = _fallback_sample()
+    try:
+        stats = gc.get_stats()
+        out["gc_collections"] = float(sum(g.get("collections", 0) for g in stats))
+        out["gc_collected"] = float(sum(g.get("collected", 0) for g in stats))
+        out["gc_uncollectable"] = float(
+            sum(g.get("uncollectable", 0) for g in stats))
+    except Exception:  # pragma: no cover
+        pass
+    out["uptime_s"] = time.monotonic() - _T0
+    return out
+
+
+_T0 = time.monotonic()

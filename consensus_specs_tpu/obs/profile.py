@@ -1,0 +1,170 @@
+"""Armable in-process sampling profiler (docs/OBSERVABILITY.md
+"Long-haul telemetry plane").
+
+A daemon thread wakes ``hz`` times per second, grabs every live
+thread's frame via ``sys._current_frames()`` (one C-level dict copy —
+no tracing hooks, no sys.settrace overhead on the profiled code), and
+folds each stack into a collapsed-stack counter
+(``file:func;file:func;... count`` — the flamegraph.pl /
+speedscope-compatible format Parca-style continuous profilers emit).
+Output lands as ``profile-<pid>-<token>.collapsed`` in the long-haul
+directory, rewritten atomically (tmp + rename) every few seconds so a
+SIGKILL'd process still leaves its last flush behind.
+
+Arming is explicit (:func:`arm`) — the timeseries plane arms it when
+the ``CONSENSUS_SPECS_TPU_LONGHAUL`` knob carries a nonzero hz field —
+and unarmed cost is zero: no thread exists, no hooks are installed.
+``fork_child_reinit`` (obs/core.py) drops the inherited (dead) sampler
+thread and its counts so a COW child never double-reports its parent's
+stacks.
+"""
+from __future__ import annotations
+
+import atexit
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+_MAX_DEPTH = 64          # frames per stack
+_FLUSH_EVERY_S = 2.0     # periodic atomic rewrite (crash visibility —
+#                          forked workers exit via os._exit, so their
+#                          profiles only survive through these flushes)
+
+
+def _frame_label(code) -> str:
+    return f"{os.path.basename(code.co_filename)}:{code.co_name}"
+
+
+class SamplingProfiler(threading.Thread):
+    """The sampler thread. Use via :func:`arm`/:func:`disarm`."""
+
+    def __init__(self, hz: float, out_dir: str) -> None:
+        super().__init__(name="obs-profiler", daemon=True)
+        self.hz = max(0.5, float(hz))
+        self.out_dir = out_dir
+        self.pid = os.getpid()
+        self._token = os.urandom(3).hex()
+        self._halt = threading.Event()
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+        self.samples = 0
+
+    @property
+    def path(self) -> str:
+        return os.path.join(self.out_dir,
+                            f"profile-{self.pid}-{self._token}.collapsed")
+
+    def _sample(self) -> None:
+        me = self.ident
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue
+            stack: List[str] = []
+            plane_internal = False
+            f = frame
+            while f is not None and len(stack) < _MAX_DEPTH:
+                code = f.f_code
+                label = _frame_label(code)
+                # the telemetry plane profiling its own sampler loops
+                # is noise that drowns the busy stacks in mostly-idle
+                # processes; other idle threads (an app thread blocked
+                # on a queue) are real evidence and stay in
+                if label == "timeseries.py:run":
+                    plane_internal = True
+                    break
+                stack.append(label)
+                f = f.f_back
+            if plane_internal or not stack:
+                continue
+            key = ";".join(reversed(stack))
+            with self._lock:
+                self._counts[key] = self._counts.get(key, 0) + 1
+                self.samples += 1
+
+    def run(self) -> None:
+        interval = 1.0 / self.hz
+        last_flush = time.monotonic()
+        while not self._halt.wait(interval):
+            try:
+                self._sample()
+            except Exception:
+                continue
+            now = time.monotonic()
+            if now - last_flush >= _FLUSH_EVERY_S:
+                last_flush = now
+                self.flush()
+
+    def flush(self) -> Optional[str]:
+        """Atomic rewrite of the collapsed-stack file (sorted, so the
+        bytes are a pure function of the accumulated counts)."""
+        with self._lock:
+            if not self._counts:
+                return None
+            lines = [f"{stack} {n}" for stack, n in sorted(self._counts.items())]
+        os.makedirs(self.out_dir, exist_ok=True)
+        tmp = self.path + f".tmp.{self.pid}"
+        with open(tmp, "w") as f:
+            f.write("\n".join(lines) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        return self.path
+
+    def stop(self, timeout_s: float = 2.0) -> Optional[str]:
+        self._halt.set()
+        if self.is_alive() and threading.current_thread() is not self:
+            self.join(timeout_s)
+        return self.flush()
+
+
+_lock = threading.Lock()
+_profiler: Optional[SamplingProfiler] = None
+_atexit_installed = False
+
+
+def arm(hz: float, out_dir: str) -> bool:
+    """Start sampling at ``hz`` into ``out_dir`` (idempotent; a live
+    sampler is left running). Returns True when a sampler is armed."""
+    global _profiler, _atexit_installed
+    if hz <= 0:
+        return False
+    with _lock:
+        if _profiler is not None and _profiler.is_alive():
+            return True
+        _profiler = SamplingProfiler(hz, out_dir)
+        _profiler.start()
+        if not _atexit_installed:
+            _atexit_installed = True
+            atexit.register(disarm)
+        return True
+
+
+def disarm() -> Optional[str]:
+    """Stop sampling and write the final collapsed output. Idempotent;
+    returns the output path (None when nothing was sampled)."""
+    global _profiler
+    with _lock:
+        prof, _profiler = _profiler, None
+    if prof is None:
+        return None
+    return prof.stop()
+
+
+def armed() -> bool:
+    prof = _profiler
+    return prof is not None and prof.is_alive()
+
+
+def active() -> Optional[SamplingProfiler]:
+    return _profiler
+
+
+def fork_child_reinit() -> None:
+    """Post-``os.fork`` child reset: the sampler thread did not survive
+    the fork, and its counts/file belong to the parent — drop both. The
+    timeseries plane re-arms from the env knob afterwards."""
+    global _profiler
+    with _lock:
+        _profiler = None

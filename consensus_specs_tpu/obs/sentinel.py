@@ -43,7 +43,8 @@ REGRESSED = "regressed"
 NO_BASELINE = "no_baseline"
 ENV_GAP = "environmental"
 
-_LOWER_IS_BETTER_SUFFIXES = ("_s", "_ms", "_us", "_seconds")
+_LOWER_IS_BETTER_SUFFIXES = ("_s", "_ms", "_us", "_seconds",
+                             "_overhead_pct")
 
 # rate metrics end in "_per_s", which ALSO ends in "_s": rates are
 # higher-is-better and must be carved out before the duration suffixes

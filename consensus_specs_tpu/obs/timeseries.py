@@ -1,0 +1,383 @@
+"""Long-haul time-series journals: the telemetry plane's flusher
+(docs/OBSERVABILITY.md "Long-haul telemetry plane").
+
+One env knob arms the whole plane::
+
+    CONSENSUS_SPECS_TPU_LONGHAUL=<dir>[;<interval_s>[;<profile_hz>]]
+
+When armed, :func:`ensure_started` launches a daemon thread that every
+``interval_s`` (default 1.0):
+
+1. samples ``/proc/self`` (obs/proc.py) and publishes the readings as
+   ``proc.*`` gauges, plus any app-registered gauges
+   (:func:`register_gauge` — the serve daemon registers its live queue
+   depth here);
+2. snapshots the metric registry (counters + gauges + histogram
+   summaries) into ONE JSON line appended to a per-process
+   ``series-<pid>-<token>.jsonl`` journal — fsync'd per flush, so a
+   SIGKILL loses at most the in-flight line and the tail always parses
+   (crash-safe exactly like the generator journal); timestamps are
+   wall-anchored monotonic (``wall0 + (monotonic - mono0)``), the same
+   timeline spans use, so series and trace merge onto one axis;
+3. feeds the sample through the drift watchdogs (obs/watchdog.py) and
+   journals any findings as ``{"type": "finding", ...}`` lines next to
+   the samples (mirrored as ``obs.instant`` + ``watchdog.<kind>``
+   counters).
+
+The sampling profiler (obs/profile.py) arms into the same directory by
+default (19Hz — continuous profiling is the plane's point, and the
+whole armed tax is perfgate-gated under 3%); a third knob field of 0
+opts out, any other value re-pins the rate.
+
+Unarmed cost is one ``os.environ.get`` in :func:`ensure_started` — no
+thread, no locks, no allocation. Fork-safety: ``obs.fork_child_reinit``
+calls :func:`fork_child_reinit`, which abandons the inherited journal
+(its fd belongs to the parent) and drops the dead flusher thread and
+any registered gauge closures; the worker body's :func:`set_role` call
+right after restarts the plane under the worker's lane label — so COW
+children (fleet replicas, fuzz/gen ranks) each write their own
+correctly-labelled journal with no duplicate sampler threads.
+
+Abnormal exits leave a postmortem bundle: an uncaught exception (the
+chained ``sys.excepthook``) or an explicit :func:`postmortem_bundle`
+call writes ``postmortem-<pid>-<token>.json`` with the last-N samples,
+all findings, and the final counter snapshot — the first thing to read
+after a dead multi-hour run. ``tools/mission_report.py`` merges every
+process's journals + profiles + findings into one HTML report.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Deque, Dict, IO, List, Optional, Tuple
+
+from . import metrics, proc, profile, watchdog
+
+LONGHAUL_ENV = "CONSENSUS_SPECS_TPU_LONGHAUL"
+
+_TAIL_KEEP = 180         # samples retained for the postmortem bundle
+_DEFAULT_INTERVAL_S = 1.0
+_DEFAULT_PROFILE_HZ = 19.0   # continuous profiling is the plane's point:
+#                              armed = profiled (<3% total, perfgate-gated);
+#                              a third knob field of 0 opts out
+_MIN_INTERVAL_S = 0.01
+_FSYNC_MIN_S = 0.5       # fsync throttle (see _write_lines)
+
+
+def config_from_env() -> Optional[Tuple[str, float, float]]:
+    """``(dir, interval_s, profile_hz)`` from the knob, or None."""
+    raw = os.environ.get(LONGHAUL_ENV, "")
+    if not raw:
+        return None
+    parts = raw.split(";")
+    out_dir = parts[0]
+    if not out_dir:
+        return None
+    interval = _DEFAULT_INTERVAL_S
+    hz = _DEFAULT_PROFILE_HZ
+    try:
+        if len(parts) > 1 and parts[1]:
+            interval = float(parts[1])
+        if len(parts) > 2 and parts[2]:
+            hz = float(parts[2])
+    except ValueError:
+        pass
+    return out_dir, max(_MIN_INTERVAL_S, interval), max(0.0, hz)
+
+
+def _default_role() -> str:
+    return os.path.basename(sys.argv[0] or "python")[:48] or "python"
+
+
+class SeriesFlusher(threading.Thread):
+    """The background flusher. One per process, via module state."""
+
+    def __init__(self, out_dir: str, interval_s: float,
+                 role: Optional[str] = None) -> None:
+        super().__init__(name="obs-timeseries", daemon=True)
+        self.out_dir = out_dir
+        self.interval_s = interval_s
+        self.role = role or _default_role()
+        self.role_explicit = role is not None
+        self.pid = os.getpid()
+        self.wall0 = time.time()
+        self.mono0 = time.monotonic()
+        self._token = os.urandom(3).hex()
+        self._halt = threading.Event()
+        self._io_lock = threading.Lock()
+        self._fh: Optional[IO[str]] = None
+        self._last_fsync = 0.0
+        self.watchdog = watchdog.Watchdog()
+        self._hist_cache: Dict[str, Any] = {}
+        self.tail: Deque[Dict[str, Any]] = deque(maxlen=_TAIL_KEEP)
+        self.findings: List[Dict[str, Any]] = []
+        self.samples_written = 0
+
+    # -- timeline ----------------------------------------------------------
+
+    def now_us(self) -> float:
+        return (self.wall0 + (time.monotonic() - self.mono0)) * 1e6
+
+    @property
+    def path(self) -> str:
+        return os.path.join(self.out_dir,
+                            f"series-{self.pid}-{self._token}.jsonl")
+
+    # -- journal -----------------------------------------------------------
+
+    def _write_lines(self, records: List[Dict[str, Any]],
+                     force_fsync: bool = False) -> None:
+        """Append records as JSONL, flush always, fsync THROTTLED (at
+        most once per :data:`_FSYNC_MIN_S`, plus findings and the final
+        sample) — a SIGKILL loses at most the last sub-second of
+        samples and the tail still parses; an unthrottled fsync at
+        sub-second sampling intervals was the plane's dominant armed
+        overhead on a 1-CPU host (perfgate_obs_overhead_pct watches
+        this)."""
+        with self._io_lock:
+            if self._fh is None:
+                os.makedirs(self.out_dir, exist_ok=True)
+                self._fh = open(self.path, "a")
+                self._fh.write(json.dumps({
+                    "type": "series_header",
+                    "pid": self.pid,
+                    "role": self.role,
+                    "argv": " ".join(sys.argv[:4])[:160] or "python",
+                    "interval_s": self.interval_s,
+                    "ts": self.now_us(),
+                }, default=repr) + "\n")
+                force_fsync = True
+            for rec in records:
+                self._fh.write(json.dumps(rec, default=repr) + "\n")
+            self._fh.flush()
+            now = time.monotonic()
+            if force_fsync or now - self._last_fsync >= _FSYNC_MIN_S:
+                os.fsync(self._fh.fileno())
+                self._last_fsync = now
+
+    def sample_once(self, final: bool = False) -> Dict[str, Any]:
+        """One sampling tick: proc gauges -> registry snapshot -> sample
+        line (+ any watchdog finding lines). Findings and the final
+        sample fsync unconditionally; plain samples ride the throttle."""
+        for key, value in proc.sample().items():
+            metrics.gauge(f"proc.{key}", value)
+        for name, fn in list(_gauge_fns.items()):
+            try:
+                metrics.gauge(name, float(fn()))
+            except Exception:
+                continue
+        # the CHEAP registry view: counter/gauge dict copies + cached
+        # histogram summaries (only histograms that moved re-sort) —
+        # a full metrics.snapshot() per sub-second tick re-sorted every
+        # bounded window and dominated the armed overhead
+        counters: Dict[str, float] = metrics.counters()
+        gauges: Dict[str, float] = metrics.gauges()
+        hists = metrics.hist_summaries(self._hist_cache)
+        sample = {
+            "type": "sample",
+            "ts": self.now_us(),
+            "role": self.role,
+            "counters": counters,
+            "gauges": gauges,
+            "hists": hists,
+        }
+        records: List[Dict[str, Any]] = [sample]
+        now_s = time.monotonic()
+        for f in self.watchdog.check(now_s, counters, gauges):
+            finding = {"type": "finding", "ts": self.now_us(),
+                       "role": self.role, "pid": self.pid, **f}
+            records.append(finding)
+            self.findings.append(finding)
+            metrics.count(f"watchdog.{f['kind']}")
+            try:
+                from . import core
+
+                core.instant(f"watchdog.{f['kind']}", series=f["series"],
+                             detail=f["detail"], value=f["value"])
+            except Exception:
+                pass
+        self._write_lines(records, force_fsync=final or len(records) > 1)
+        self.tail.append(sample)
+        self.samples_written += 1
+        return sample
+
+    def run(self) -> None:
+        try:
+            self.sample_once()   # immediate first sample: short-lived
+        except Exception:        # workers still land >=1 line
+            pass
+        while not self._halt.wait(self.interval_s):
+            try:
+                self.sample_once()
+            except Exception:
+                metrics.count("timeseries.sample_errors")
+        try:
+            self.sample_once(final=True)   # final sample on clean stop
+        except Exception:
+            pass
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        self._halt.set()
+        if self.is_alive() and threading.current_thread() is not self:
+            self.join(timeout_s)
+
+    def close(self) -> None:
+        with self._io_lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except Exception:
+                    pass
+                self._fh = None
+
+
+_lock = threading.Lock()
+_flusher: Optional[SeriesFlusher] = None
+_gauge_fns: Dict[str, Callable[[], float]] = {}
+_prev_excepthook: Optional[Callable] = None
+
+
+def active() -> Optional[SeriesFlusher]:
+    """The live flusher, or None (armed state test hook)."""
+    return _flusher
+
+
+def ensure_started(role: Optional[str] = None) -> bool:
+    """Arm the plane if the env knob says so. Unarmed: ONE env check,
+    returns False. Armed: starts the flusher (idempotent) + profiler
+    (when hz > 0), installs the postmortem excepthook, returns True."""
+    global _flusher
+    cfg = config_from_env()
+    if cfg is None:
+        return False
+    out_dir, interval_s, hz = cfg
+    with _lock:
+        if _flusher is not None and _flusher.is_alive():
+            # an explicitly-labelled lane keeps its label (a fleet
+            # replica stays "serve.r0" even though its inner daemon
+            # also calls ensure_started with the generic role)
+            if role and not _flusher.role_explicit:
+                _flusher.role = role
+                _flusher.role_explicit = True
+            return True
+        _flusher = SeriesFlusher(out_dir, interval_s, role)
+        _flusher.start()
+    if hz > 0:
+        profile.arm(hz, out_dir)
+    _install_excepthook()
+    return True
+
+
+def set_role(role: str) -> None:
+    """Label this process's lane in the merged report (no-op unarmed).
+    Explicit labels are sticky — later generic ``ensure_started`` calls
+    never rename the lane. With the plane armed but not yet running in
+    this process (a freshly forked worker after
+    :func:`fork_child_reinit`), this STARTS it under ``role`` — the
+    worker's very first journal line then carries the right lane label
+    instead of racing the flusher's immediate first sample."""
+    fl = _flusher
+    if fl is not None:
+        fl.role = role
+        fl.role_explicit = True
+        return
+    ensure_started(role=role)
+
+
+def register_gauge(name: str, fn: Callable[[], float]) -> None:
+    """Poll ``fn`` each sampling tick and publish it as gauge ``name``
+    (serve queue depth, in-flight requests, ...). Safe unarmed — the
+    registry simply never gets polled. A raising fn is skipped."""
+    _gauge_fns[name] = fn
+
+
+def unregister_gauge(name: str) -> None:
+    _gauge_fns.pop(name, None)
+
+
+def stop(timeout_s: float = 5.0) -> Optional[str]:
+    """Stop the flusher (writing a final sample) and the profiler.
+    Returns the journal path, or None when the plane was not armed."""
+    global _flusher
+    with _lock:
+        fl, _flusher = _flusher, None
+    profile.disarm()
+    if fl is None:
+        return None
+    fl.stop(timeout_s)
+    fl.close()
+    return fl.path
+
+
+def fork_child_reinit() -> None:
+    """Post-``os.fork`` child reset (called from obs.fork_child_reinit):
+    drop the inherited flusher (its thread is dead in this process and
+    its fd/journal belong to the parent), the registered gauge closures
+    (they capture parent objects), and the profiler state. The child's
+    OWN journal starts when the worker body calls :func:`set_role`
+    (every fork site does, right after reinit) — starting here instead
+    would race the first sample against the relabel and stamp worker
+    lanes with the parent's argv."""
+    global _flusher
+    with _lock:
+        _flusher = None
+    _gauge_fns.clear()
+    profile.fork_child_reinit()
+
+
+def postmortem_bundle(reason: str) -> Optional[str]:
+    """Write the postmortem bundle NOW (armed processes only): last-N
+    samples, every finding, the final metric snapshot. fsync'd; returns
+    the path. Callable from failure paths; also fired by the chained
+    excepthook on an uncaught exception."""
+    fl = _flusher
+    cfg = config_from_env()
+    if cfg is None:
+        return None
+    out_dir = cfg[0]
+    token = fl._token if fl is not None else os.urandom(3).hex()
+    path = os.path.join(out_dir, f"postmortem-{os.getpid()}-{token}.json")
+    payload = {
+        "type": "postmortem",
+        "reason": str(reason)[:500],
+        "pid": os.getpid(),
+        "role": fl.role if fl is not None else _default_role(),
+        "ts": fl.now_us() if fl is not None else time.time() * 1e6,
+        "series_path": fl.path if fl is not None else None,
+        "tail": list(fl.tail) if fl is not None else [],
+        "findings": list(fl.findings) if fl is not None else [],
+        "snapshot": metrics.snapshot(),
+    }
+    try:
+        os.makedirs(out_dir, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(payload, f, default=repr)
+            f.flush()
+            os.fsync(f.fileno())
+    except Exception:
+        return None
+    return path
+
+
+def _install_excepthook() -> None:
+    global _prev_excepthook
+    if _prev_excepthook is not None:
+        return
+    _prev_excepthook = sys.excepthook
+
+    def _hook(exc_type, exc, tb):  # type: ignore[no-untyped-def]
+        try:
+            postmortem_bundle(f"uncaught {exc_type.__name__}: {exc}")
+            fl = _flusher
+            if fl is not None:
+                fl.sample_once()
+        except Exception:
+            pass
+        prev = _prev_excepthook or sys.__excepthook__
+        prev(exc_type, exc, tb)
+
+    sys.excepthook = _hook

@@ -1,0 +1,268 @@
+"""Online drift watchdogs over the live telemetry series
+(docs/OBSERVABILITY.md "Long-haul telemetry plane").
+
+The timeseries flusher feeds every sample through one
+:class:`Watchdog`, which keeps bounded per-series windows and emits
+structured findings the moment a long-haul run starts going wrong —
+hours before a human would read the journal:
+
+- ``rss_leak``          least-squares slope of ``proc.rss_bytes`` over
+                        the window exceeds the configured MB/s AND the
+                        absolute growth cleared the noise floor;
+- ``throughput_drift``  a watched progress counter's recent rate
+                        decayed below ``drift_drop_frac`` of its
+                        earlier rate in the same window (slots/s,
+                        execs/s, verifies/s decay detection);
+- ``queue_creep``       a watched depth gauge grew near-monotonically
+                        across the whole window (the metastable-failure
+                        precursor the overload plane sheds against);
+- ``stall``             no watched progress counter moved for
+                        ``stall_s`` while the process stayed alive.
+
+Findings are data, not exceptions: the flusher journals them as
+``{"type": "finding", ...}`` lines next to the samples, mirrors each
+as an ``obs.instant`` (``watchdog.<kind>``) and a
+``watchdog.<kind>`` counter, and the mission report renders them as
+anomaly annotations. Every threshold is overridable via
+``CONSENSUS_SPECS_TPU_WATCHDOG=k=v[,k=v...]`` (keys = the
+:class:`Thresholds` field names); watched series come from
+``CONSENSUS_SPECS_TPU_WATCHDOG_RATES`` / ``_DEPTHS`` (comma lists).
+A per-(kind, series) cooldown stops a persistent condition from
+flooding the journal.
+"""
+from __future__ import annotations
+
+import os
+from collections import deque
+from dataclasses import dataclass, fields
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+WATCHDOG_ENV = "CONSENSUS_SPECS_TPU_WATCHDOG"
+RATES_ENV = "CONSENSUS_SPECS_TPU_WATCHDOG_RATES"
+DEPTHS_ENV = "CONSENSUS_SPECS_TPU_WATCHDOG_DEPTHS"
+
+# progress counters watched by default: the long-running planes' hot
+# loops (span.* counters are auto-maintained by obs.metrics.observe, so
+# any instrumented site is watchable without new call sites)
+DEFAULT_RATES = (
+    "sim.blocks_proposed",
+    "fuzz.execs",
+    "serve.accepted",
+    "span.gen.case.count",
+)
+DEFAULT_DEPTHS = ("serve.queue_depth",)
+
+
+@dataclass
+class Thresholds:
+    """Watchdog knobs (env-overridable; documented thresholds in
+    docs/OBSERVABILITY.md)."""
+
+    window: int = 30               # samples per detector window
+    min_samples: int = 8           # fewer -> detectors stay silent
+    rss_slope_mb_per_s: float = 4.0
+    rss_min_growth_mb: float = 64.0
+    drift_drop_frac: float = 0.5   # recent < 50% of earlier = drift
+    drift_min_rate: float = 1.0    # /s floor — idle counters never drift
+    stall_s: float = 120.0
+    depth_min_growth: float = 64.0
+    cooldown_s: float = 60.0
+
+    @classmethod
+    def from_env(cls) -> "Thresholds":
+        t = cls()
+        raw = os.environ.get(WATCHDOG_ENV, "")
+        valid = {f.name: f.type for f in fields(cls)}
+        for clause in raw.split(","):
+            clause = clause.strip()
+            if not clause or "=" not in clause:
+                continue
+            key, _, value = clause.partition("=")
+            key = key.strip()
+            if key not in valid:
+                continue
+            try:
+                setattr(t, key, int(value) if key in ("window", "min_samples")
+                        else float(value))
+            except ValueError:
+                continue
+        return t
+
+
+def _env_list(env: str, default: Tuple[str, ...]) -> Tuple[str, ...]:
+    raw = os.environ.get(env, "")
+    if not raw:
+        return default
+    return tuple(s.strip() for s in raw.split(",") if s.strip())
+
+
+def _slope(points: List[Tuple[float, float]]) -> float:
+    """Least-squares slope of (t, v) points, units of v per second."""
+    n = len(points)
+    if n < 2:
+        return 0.0
+    mt = sum(t for t, _ in points) / n
+    mv = sum(v for _, v in points) / n
+    num = sum((t - mt) * (v - mv) for t, v in points)
+    den = sum((t - mt) ** 2 for t, _ in points)
+    return num / den if den else 0.0
+
+
+class Watchdog:
+    """Feed every sample via :meth:`check`; returns new findings."""
+
+    def __init__(self, thresholds: Optional[Thresholds] = None,
+                 rates: Optional[Tuple[str, ...]] = None,
+                 depths: Optional[Tuple[str, ...]] = None) -> None:
+        self.t = thresholds or Thresholds.from_env()
+        self.rates = rates if rates is not None else _env_list(
+            RATES_ENV, DEFAULT_RATES)
+        self.depths = depths if depths is not None else _env_list(
+            DEPTHS_ENV, DEFAULT_DEPTHS)
+        w = max(2, self.t.window)
+        self._rss: Deque[Tuple[float, float]] = deque(maxlen=w)
+        self._counter_hist: Dict[str, Deque[Tuple[float, float]]] = {}
+        self._depth_hist: Dict[str, Deque[Tuple[float, float]]] = {}
+        self._last_emit: Dict[Tuple[str, str], float] = {}
+        self._last_progress_t: Optional[float] = None
+        self._progress_seen = False
+        self.findings_total = 0
+
+    # -- helpers -----------------------------------------------------------
+
+    def _cooled(self, kind: str, series: str, now_s: float) -> bool:
+        key = (kind, series)
+        last = self._last_emit.get(key)
+        if last is not None and now_s - last < self.t.cooldown_s:
+            return False
+        self._last_emit[key] = now_s
+        return True
+
+    def _finding(self, kind: str, series: str, now_s: float,
+                 detail: str, value: float) -> Optional[Dict[str, Any]]:
+        if not self._cooled(kind, series, now_s):
+            return None
+        self.findings_total += 1
+        return {"kind": kind, "series": series, "detail": detail,
+                "value": round(value, 3)}
+
+    # -- detectors ---------------------------------------------------------
+
+    def _check_rss(self, now_s: float) -> List[Dict[str, Any]]:
+        pts = list(self._rss)
+        if len(pts) < self.t.min_samples:
+            return []
+        growth_mb = (pts[-1][1] - pts[0][1]) / (1 << 20)
+        slope_mb_s = _slope(pts) / (1 << 20)
+        if (slope_mb_s > self.t.rss_slope_mb_per_s
+                and growth_mb > self.t.rss_min_growth_mb):
+            f = self._finding(
+                "rss_leak", "proc.rss_bytes", now_s,
+                f"rss slope {slope_mb_s:.2f} MB/s over "
+                f"{pts[-1][0] - pts[0][0]:.1f}s (+{growth_mb:.1f} MB)",
+                slope_mb_s)
+            return [f] if f else []
+        return []
+
+    def _check_drift(self, now_s: float) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        for name, hist in self._counter_hist.items():
+            pts = list(hist)
+            # drift needs a FULL window (short bursts are not evidence)
+            # and a recent rate that is decayed-but-nonzero — a counter
+            # that stopped entirely is the stall detector's business,
+            # and a workload that simply finished must not read as drift
+            if len(pts) < max(self.t.min_samples, hist.maxlen or 0):
+                continue
+            mid = len(pts) // 2
+            def _rate(seg: List[Tuple[float, float]]) -> float:
+                dt = seg[-1][0] - seg[0][0]
+                return (seg[-1][1] - seg[0][1]) / dt if dt > 0 else 0.0
+            early, recent = _rate(pts[:mid + 1]), _rate(pts[mid:])
+            if (early >= self.t.drift_min_rate
+                    and 0 < recent < self.t.drift_drop_frac * early):
+                f = self._finding(
+                    "throughput_drift", name, now_s,
+                    f"{name} {early:.2f}/s -> {recent:.2f}/s "
+                    f"({recent / early:.0%} of earlier rate)",
+                    recent)
+                if f:
+                    out.append(f)
+        return out
+
+    def _check_depth(self, now_s: float) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        for name, hist in self._depth_hist.items():
+            pts = list(hist)
+            if len(pts) < self.t.min_samples or len(pts) < self._depth_win():
+                continue
+            growth = pts[-1][1] - pts[0][1]
+            steps = len(pts) - 1
+            rising = sum(1 for i in range(steps)
+                         if pts[i + 1][1] >= pts[i][1])
+            if growth >= self.t.depth_min_growth and rising >= 0.9 * steps:
+                f = self._finding(
+                    "queue_creep", name, now_s,
+                    f"{name} {pts[0][1]:.0f} -> {pts[-1][1]:.0f} over "
+                    f"{pts[-1][0] - pts[0][0]:.1f}s "
+                    f"({rising}/{steps} steps non-decreasing)",
+                    growth)
+                if f:
+                    out.append(f)
+        return out
+
+    def _depth_win(self) -> int:
+        return max(2, self.t.window)
+
+    def _check_stall(self, now_s: float) -> List[Dict[str, Any]]:
+        if not self._progress_seen or self._last_progress_t is None:
+            return []
+        idle = now_s - self._last_progress_t
+        if idle > self.t.stall_s:
+            f = self._finding(
+                "stall", "progress", now_s,
+                f"no watched progress counter moved for {idle:.0f}s "
+                f"(watching {', '.join(sorted(self._counter_hist))})",
+                idle)
+            return [f] if f else []
+        return []
+
+    # -- entry point -------------------------------------------------------
+
+    def check(self, now_s: float, counters: Dict[str, float],
+              gauges: Dict[str, float]) -> List[Dict[str, Any]]:
+        """Absorb one sample (monotonic seconds + the metric snapshot's
+        counters/gauges) and return any NEW findings."""
+        rss = gauges.get("proc.rss_bytes")
+        if rss is not None:
+            self._rss.append((now_s, float(rss)))
+        moved = False
+        for name in self.rates:
+            value = counters.get(name)
+            if value is None:
+                continue
+            hist = self._counter_hist.setdefault(
+                name, deque(maxlen=max(2, self.t.window)))
+            if hist and float(value) > hist[-1][1]:
+                moved = True
+            elif not hist and float(value) > 0:
+                moved = True
+            hist.append((now_s, float(value)))
+        if moved:
+            self._last_progress_t = now_s
+            self._progress_seen = True
+        elif self._progress_seen and self._last_progress_t is None:
+            self._last_progress_t = now_s
+        for name in self.depths:
+            value = gauges.get(name)
+            if value is None:
+                continue
+            self._depth_hist.setdefault(
+                name, deque(maxlen=self._depth_win())).append(
+                    (now_s, float(value)))
+        findings: List[Dict[str, Any]] = []
+        findings += self._check_rss(now_s)
+        findings += self._check_drift(now_s)
+        findings += self._check_depth(now_s)
+        findings += self._check_stall(now_s)
+        return findings

@@ -160,6 +160,7 @@ def _spawn_worker(generator_name: str, providers: Iterable[Any],
     code = taxonomy.EX_SOFTWARE
     try:
         obs.fork_child_reinit(trace_env)
+        obs.timeseries.set_role(f"gen.rank{rank}")
         with obs.span("sched.worker", rank=rank, workers=workers,
                       generator=generator_name):
             counts = gen_runner.run_slice(
@@ -271,6 +272,7 @@ def run_sharded(generator_name: str, providers: Iterable[Any],
     fault taxonomy, then merge. Returns the aggregated counts (the
     caller prints the summary and owns the exit status)."""
     workers = max(1, int(ns.workers))
+    obs.timeseries.ensure_started(role="gen.parent")
     # materialize: a degraded in-process slice iterates providers in THIS
     # process; a lazily-built iterable consumed here must not starve a
     # later respawned child (make_cases callables re-iterate freshly)

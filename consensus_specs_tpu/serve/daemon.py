@@ -386,6 +386,16 @@ class ServeDaemon:
         green."""
         self._server = _Server((self.host, self.requested_port), _Handler)
         self._server.daemon_ref = self
+        # long-haul telemetry (docs/OBSERVABILITY.md): when the knob is
+        # armed, this daemon writes a series journal and exposes its
+        # live queue pressure as gauges the queue-creep watchdog reads;
+        # unarmed cost is one env check + two dict writes
+        from ..obs import timeseries
+
+        if timeseries.ensure_started(role="serve.daemon"):
+            timeseries.register_gauge("serve.queue_depth",
+                                      self.service.batcher.depth)
+            timeseries.register_gauge("serve.inflight", lambda: self.inflight)
         if warm:
             from .lifecycle import warm_start
 

@@ -138,6 +138,9 @@ def _replica_child(cfg: FleetConfig, name: str, epoch: int, rdir: Path,
     code = taxonomy.EX_SOFTWARE
     try:
         obs.fork_child_reinit(trace_env)
+        from ..obs import timeseries
+
+        timeseries.set_role(f"serve.{name}")
         stop = threading.Event()
 
         def _on_term(signum: int, frame: Any) -> None:
@@ -217,8 +220,10 @@ class FleetSupervisor:
         COW — SpecService.start in every replica is then cache-hits
         only), fork every slot, wait for the fleet to go ready, start
         the monitor."""
+        from ..obs import timeseries
         from ..specs import build
 
+        timeseries.ensure_started(role="serve.fleet")
         with obs.span("serve.fleet.start", replicas=self.cfg.replicas):
             build.prebuild(forks=list(self.cfg.forks),
                            presets=tuple(self.cfg.presets))

@@ -1,0 +1,418 @@
+"""Tier-1 tests for the long-haul telemetry plane (ISSUE 13):
+obs/timeseries.py journals + fork-reinit, obs/profile.py collapsed
+stacks, the knob-unset zero-cost contract, the SIGKILL-mid-flush
+crash drill, the mission report's byte stability, and the
+events/histogram drop-count satellites."""
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from consensus_specs_tpu import obs
+from consensus_specs_tpu.obs import core as obs_core
+from consensus_specs_tpu.obs import metrics as obs_metrics
+from consensus_specs_tpu.obs import profile as obs_profile
+from consensus_specs_tpu.obs import timeseries
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+MB = 1 << 20
+
+_spec = importlib.util.spec_from_file_location(
+    "mission_report", str(REPO / "tools" / "mission_report.py"))
+assert _spec is not None and _spec.loader is not None
+mission_report = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(mission_report)
+
+
+@pytest.fixture()
+def longhaul(tmp_path, monkeypatch):
+    monkeypatch.setenv(timeseries.LONGHAUL_ENV, f"{tmp_path};0.02")
+    yield tmp_path
+    timeseries.stop()
+
+
+def _series_files(d):
+    return sorted(pathlib.Path(d).glob("series-*.jsonl"))
+
+
+def _records(path):
+    recs, _ = mission_report.parse_jsonl(str(path))
+    return recs
+
+
+# ---------------------------------------------------------------------------
+# the knob-unset contract: zero cost, no threads, no allocation
+# ---------------------------------------------------------------------------
+
+def test_unarmed_is_free(monkeypatch):
+    monkeypatch.delenv(timeseries.LONGHAUL_ENV, raising=False)
+    monkeypatch.delenv(obs.TRACE_ENV, raising=False)
+    before = threading.active_count()
+    assert timeseries.ensure_started(role="nope") is False
+    assert timeseries.active() is None
+    assert obs_profile.active() is None
+    assert threading.active_count() == before
+    # the span fast path stays the shared no-op SINGLETON — zero
+    # allocation, zero locks, whatever the long-haul plane does
+    assert obs.span("x") is obs_core._NOOP
+    timeseries.set_role("ignored")          # no-op, no crash
+    timeseries.register_gauge("g", lambda: 1.0)
+    timeseries.unregister_gauge("g")
+    assert timeseries.stop() is None
+
+
+# ---------------------------------------------------------------------------
+# armed basics
+# ---------------------------------------------------------------------------
+
+def test_armed_journal_and_gauges(longhaul):
+    assert timeseries.ensure_started(role="t.basic") is True
+    obs_metrics.count("sim.blocks_proposed", 7)
+    timeseries.register_gauge("t.depth", lambda: 42.0)
+    fl = timeseries.active()
+    assert fl is not None
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and fl.samples_written < 4:
+        time.sleep(0.02)
+    path = timeseries.stop()
+    assert path is not None and os.path.exists(path)
+    recs = _records(path)
+    header = recs[0]
+    assert header["type"] == "series_header"
+    assert header["role"] == "t.basic"
+    assert header["pid"] == os.getpid()
+    samples = [r for r in recs if r["type"] == "sample"]
+    assert len(samples) >= 4
+    last = samples[-1]
+    assert last["gauges"]["proc.rss_bytes"] > 0
+    assert last["gauges"]["proc.cpu_s"] > 0
+    assert last["gauges"]["proc.threads"] >= 1
+    assert last["gauges"]["t.depth"] == 42.0
+    assert last["counters"]["sim.blocks_proposed"] >= 7
+    # timestamps are wall-anchored monotonic: strictly increasing
+    ts = [s["ts"] for s in samples]
+    assert ts == sorted(ts)
+    timeseries.unregister_gauge("t.depth")
+
+
+def test_ensure_started_idempotent_and_role_stickiness(longhaul):
+    assert timeseries.ensure_started(role="first")
+    fl = timeseries.active()
+    assert timeseries.ensure_started(role="second")
+    assert timeseries.active() is fl                   # same flusher
+    assert fl.role == "first"                          # first explicit label sticks
+    timeseries.set_role("relabelled")
+    assert timeseries.ensure_started(role="generic")
+    assert fl.role == "relabelled"
+
+
+def test_knob_parsing(monkeypatch):
+    monkeypatch.setenv(timeseries.LONGHAUL_ENV, "/tmp/x;0.5;43")
+    assert timeseries.config_from_env() == ("/tmp/x", 0.5, 43.0)
+    monkeypatch.setenv(timeseries.LONGHAUL_ENV, "/tmp/x;;0")
+    assert timeseries.config_from_env() == ("/tmp/x", 1.0, 0.0)
+    monkeypatch.setenv(timeseries.LONGHAUL_ENV, "/tmp/x")
+    assert timeseries.config_from_env() == ("/tmp/x", 1.0, 19.0)
+    monkeypatch.setenv(timeseries.LONGHAUL_ENV, "/tmp/x;bogus;bogus")
+    assert timeseries.config_from_env() == ("/tmp/x", 1.0, 19.0)
+    monkeypatch.delenv(timeseries.LONGHAUL_ENV)
+    assert timeseries.config_from_env() is None
+
+
+def test_postmortem_bundle(longhaul):
+    assert timeseries.ensure_started(role="t.pm")
+    fl = timeseries.active()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and fl.samples_written < 2:
+        time.sleep(0.02)
+    path = timeseries.postmortem_bundle("drill reason")
+    assert path is not None
+    with open(path) as f:
+        pm = json.load(f)
+    assert pm["reason"] == "drill reason"
+    assert pm["role"] == "t.pm"
+    assert len(pm["tail"]) >= 2
+    assert "counters" in pm["snapshot"]
+
+
+# ---------------------------------------------------------------------------
+# profiler
+# ---------------------------------------------------------------------------
+
+def test_profiler_collapsed_stacks(tmp_path):
+    def _busy_marker_fn():
+        x = 0
+        for i in range(120000):
+            x += i * i
+        return x
+
+    assert obs_profile.arm(200, str(tmp_path)) is True
+    assert obs_profile.armed()
+    t_end = time.monotonic() + 0.4
+    while time.monotonic() < t_end:
+        _busy_marker_fn()
+    out = obs_profile.disarm()
+    assert out is not None and os.path.exists(out)
+    content = open(out).read()
+    assert "_busy_marker_fn" in content
+    # collapsed format: "frame;frame;... <count>" per line
+    for line in content.splitlines():
+        stack, _, n = line.rpartition(" ")
+        assert stack and int(n) >= 1
+    assert obs_profile.disarm() is None   # idempotent
+    assert not obs_profile.armed()
+
+
+def test_longhaul_knob_arms_profiler(tmp_path, monkeypatch):
+    monkeypatch.setenv(timeseries.LONGHAUL_ENV, f"{tmp_path};0.02;97")
+    try:
+        assert timeseries.ensure_started(role="t.prof")
+        assert obs_profile.armed()
+        t_end = time.monotonic() + 0.25
+        while time.monotonic() < t_end:
+            sum(i * i for i in range(10000))
+    finally:
+        timeseries.stop()
+    assert not obs_profile.armed()
+    profs = list(tmp_path.glob("profile-*.collapsed"))
+    assert profs and profs[0].stat().st_size > 0
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL mid-flush: the journal tail stays parseable, the merged
+# report byte-stable (satellite drill)
+# ---------------------------------------------------------------------------
+
+def test_sigkill_mid_flush_tail_parseable(tmp_path):
+    env = dict(os.environ)
+    env[timeseries.LONGHAUL_ENV] = f"{tmp_path};0.01"
+    code = (
+        "import time\n"
+        "from consensus_specs_tpu.obs import timeseries, metrics\n"
+        "assert timeseries.ensure_started(role='kill.victim')\n"
+        "print('armed', flush=True)\n"
+        "while True:\n"
+        "    metrics.count('work.items', 3)\n"
+        "    time.sleep(0.004)\n"
+    )
+    proc = subprocess.Popen([sys.executable, "-c", code], cwd=str(REPO),
+                            env=env, stdout=subprocess.PIPE, text=True)
+    try:
+        assert proc.stdout is not None
+        assert proc.stdout.readline().strip() == "armed"
+        deadline = time.monotonic() + 10
+        # wait until the journal is visibly mid-stream, then SIGKILL
+        while time.monotonic() < deadline:
+            files = _series_files(tmp_path)
+            if files and len(_records(files[0])) >= 6:
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail("victim never journaled 6 records")
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(10)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    (path,) = _series_files(tmp_path)
+    recs, torn = mission_report.parse_jsonl(str(path))
+    assert torn <= 1                       # at most the in-flight line
+    assert recs[0]["type"] == "series_header"
+    samples = [r for r in recs if r["type"] == "sample"]
+    assert len(samples) >= 5
+    assert samples[-1]["counters"]["work.items"] > 0
+    # the merged report over the killed journal renders byte-stable
+    html_a = mission_report.render_html(mission_report.load_run(str(tmp_path)))
+    html_b = mission_report.render_html(mission_report.load_run(str(tmp_path)))
+    assert html_a == html_b
+    assert "kill.victim" in html_a
+
+
+# ---------------------------------------------------------------------------
+# fork_child_reinit: no inherited journals, no duplicate samplers
+# (satellite drill — the fleet-replica / fuzz-rank / gen-shard path)
+# ---------------------------------------------------------------------------
+
+def test_fork_child_reinit_resets_flusher_and_profiler(tmp_path):
+    env = dict(os.environ)
+    env[timeseries.LONGHAUL_ENV] = f"{tmp_path};0.02;73"
+    code = (
+        "import json, os, sys, threading, time\n"
+        "from consensus_specs_tpu import obs\n"
+        "from consensus_specs_tpu.obs import metrics, profile, timeseries\n"
+        "assert timeseries.ensure_started(role='fork.parent')\n"
+        "metrics.count('parent.only', 11)\n"
+        "parent_fl = timeseries.active()\n"
+        "while parent_fl.samples_written < 2:\n"
+        "    time.sleep(0.01)\n"
+        "pid = os.fork()\n"
+        "if pid == 0:\n"
+        "    obs.fork_child_reinit(None)\n"
+        "    timeseries.set_role('fork.child')\n"
+        "    fl = timeseries.active()\n"
+        "    assert fl is not None and fl is not parent_fl\n"
+        "    assert fl.pid == os.getpid()\n"
+        "    samplers = [t for t in threading.enumerate()\n"
+        "                if t.name == 'obs-timeseries']\n"
+        "    assert len(samplers) == 1, samplers\n"
+        "    profs = [t for t in threading.enumerate()\n"
+        "             if t.name == 'obs-profiler']\n"
+        "    assert len(profs) == 1, profs\n"
+        "    assert metrics.snapshot()['counters'].get('parent.only') is None\n"
+        "    metrics.count('child.only', 5)\n"
+        "    while fl.samples_written < 3:\n"
+        "        time.sleep(0.01)\n"
+        "    timeseries.stop()\n"
+        "    os._exit(0)\n"
+        "_, status = os.waitpid(pid, 0)\n"
+        "assert status == 0, status\n"
+        "timeseries.stop()\n"
+        "print('forked ok', flush=True)\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", code], cwd=str(REPO),
+                          env=env, capture_output=True, text=True,
+                          timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    assert "forked ok" in proc.stdout
+    files = _series_files(tmp_path)
+    assert len(files) == 2, files            # one journal per process
+    # roles resolve like the mission report does: the last sample's
+    # role wins (a forked child's header lands before set_role runs)
+    run = mission_report.load_run(str(tmp_path))
+    by_role = {p["role"]: p for p in run["processes"]}
+    assert set(by_role) == {"fork.parent", "fork.child"}
+    assert by_role["fork.parent"]["pid"] != by_role["fork.child"]["pid"]
+    # the child's aggregates started fresh: parent counters absent
+    child_counters = by_role["fork.child"]["samples"][-1]["counters"]
+    assert "parent.only" not in child_counters
+    assert child_counters["child.only"] == 5
+
+
+# ---------------------------------------------------------------------------
+# satellites: event-buffer + histogram drop counting, gauges exposition
+# ---------------------------------------------------------------------------
+
+def test_events_dropped_counted(monkeypatch):
+    monkeypatch.delenv(obs.TRACE_ENV, raising=False)
+    obs.events(clear=True)
+    base = obs.events_dropped()
+    cap = obs_core._EVENTS.maxlen
+    for i in range(cap + 25):
+        obs.event("spam", i=i)
+    assert obs.events_dropped() == base + 25
+    assert len(obs.events()) == cap
+    obs.events(clear=True)
+
+
+def test_histogram_dropped_counted_and_exposed():
+    obs_metrics.reset()
+    try:
+        for i in range(obs_metrics._HIST_CAP + 13):
+            obs_metrics.observe("t_drop_ms", float(i % 7))
+        snap = obs_metrics.snapshot()
+        h = snap["histograms"]["t_drop_ms"]
+        assert h["samples"] == obs_metrics._HIST_CAP
+        assert h["dropped"] == 13
+        assert h["count"] == obs_metrics._HIST_CAP + 13
+        text = obs_metrics.prometheus_text(snap)
+        assert "t_drop_ms_dropped 13" in text.splitlines()
+        assert "# TYPE t_drop_ms_dropped counter" in text.splitlines()
+    finally:
+        obs_metrics.reset()
+
+
+def test_gauges_in_snapshot_and_prometheus():
+    obs_metrics.reset()
+    try:
+        obs_metrics.gauge("proc.rss_bytes", 12345.0)
+        obs_metrics.gauge("proc.rss_bytes", 23456.0)   # last write wins
+        snap = obs_metrics.snapshot()
+        assert snap["gauges"] == {"proc.rss_bytes": 23456.0}
+        text = obs_metrics.prometheus_text(snap)
+        assert "# TYPE proc_rss_bytes gauge" in text.splitlines()
+        assert "proc_rss_bytes 23456" in text.splitlines()
+    finally:
+        obs_metrics.reset()
+
+
+def test_obs_overhead_polarity_and_unit():
+    # the perfgate_obs_overhead_pct gate direction: lower is better,
+    # unit is % (a rising overhead must be able to read as `regressed`)
+    from consensus_specs_tpu.obs import ledger as ledger_mod
+    from consensus_specs_tpu.obs import sentinel
+
+    assert sentinel.polarity("perfgate_obs_overhead_pct") == -1
+    assert ledger_mod.infer_unit("perfgate_obs_overhead_pct") == "%"
+    # rates stay higher-is-better (the PR-12 regression pin)
+    assert sentinel.polarity("fuzz_execs_per_s") == 1
+
+
+# ---------------------------------------------------------------------------
+# mission report over a synthetic multi-process run
+# ---------------------------------------------------------------------------
+
+def _write_series(d, name, role, pid, samples, findings=()):
+    path = pathlib.Path(d) / name
+    with open(path, "w") as f:
+        f.write(json.dumps({"type": "series_header", "pid": pid,
+                            "role": role, "interval_s": 1.0,
+                            "ts": samples[0][0]}) + "\n")
+        for ts, rss, n in samples:
+            f.write(json.dumps({
+                "type": "sample", "ts": ts, "role": role,
+                "counters": {"work.items": n},
+                "gauges": {"proc.rss_bytes": rss, "proc.cpu_s": ts / 1e6},
+                "hists": {}}) + "\n")
+        for rec in findings:
+            f.write(json.dumps(rec) + "\n")
+    return path
+
+
+def test_mission_report_lanes_and_annotations(tmp_path):
+    t0 = 1_700_000_000_000_000.0
+    _write_series(tmp_path, "series-10-aaa.jsonl", "sim.driver", 10,
+                  [(t0 + i * 1e6, (100 + i) * MB, 10 * i) for i in range(12)])
+    _write_series(tmp_path, "series-20-bbb.jsonl", "fuzz.rank0", 20,
+                  [(t0 + i * 1e6, (200 + 30 * i) * MB, 5 * i)
+                   for i in range(12)],
+                  findings=[{"type": "finding", "ts": t0 + 8e6,
+                             "role": "fuzz.rank0", "pid": 20,
+                             "kind": "rss_leak", "series": "proc.rss_bytes",
+                             "detail": "rss slope 30.00 MB/s", "value": 30.0}])
+    (tmp_path / "profile-10-aaa.collapsed").write_text(
+        "main.py:main;sim.py:step 40\nmain.py:main;sim.py:attest 9\n")
+    run = mission_report.load_run(str(tmp_path))
+    summary = mission_report.summarize(run)
+    assert summary["processes"] == 2
+    assert summary["findings"] == 1
+    assert summary["findings_by_kind"] == {"rss_leak": 1}
+    assert summary["roles"] == ["fuzz.rank0", "sim.driver"]
+    html_a = mission_report.render_html(run)
+    html_b = mission_report.render_html(mission_report.load_run(str(tmp_path)))
+    assert html_a == html_b                      # byte-stable
+    assert "sim.driver" in html_a and "fuzz.rank0" in html_a
+    assert "rss_leak" in html_a                  # anomaly annotation
+    assert "sim.py:step" in html_a               # profile table
+    assert html_a.count("<svg") >= 3             # sparkline lanes
+
+
+def test_mission_report_bundle(tmp_path):
+    t0 = 1_700_000_000_000_000.0
+    _write_series(tmp_path, "series-10-aaa.jsonl", "r", 10,
+                  [(t0 + i * 1e6, 100 * MB, i) for i in range(50)])
+    out = tmp_path / "bundle"
+    manifest = mission_report.collect_bundle(str(tmp_path), str(out), tail=10)
+    assert (out / "MANIFEST.json").exists()
+    kept = (out / "series-10-aaa.jsonl").read_text().splitlines()
+    assert len(kept) == 10                       # the tail only
+    assert json.loads(kept[-1])["counters"]["work.items"] == 49
+    assert manifest["files"][0]["lines_total"] == 51

@@ -1,0 +1,143 @@
+"""Unit tests for the long-haul drift watchdogs (obs/watchdog.py):
+each detector's math on synthetic series, threshold env overrides, and
+the cooldown that stops a persistent condition from flooding the
+journal."""
+from __future__ import annotations
+
+import pytest
+
+from consensus_specs_tpu.obs import watchdog
+
+
+def _wd(**kw):
+    t = watchdog.Thresholds(window=10, min_samples=4, cooldown_s=1000.0,
+                            **kw)
+    return watchdog.Watchdog(t, rates=("work.items",),
+                             depths=("work.queue_depth",))
+
+
+MB = 1 << 20
+
+
+def test_rss_leak_fires_on_linear_growth():
+    wd = _wd(rss_slope_mb_per_s=2.0, rss_min_growth_mb=10.0)
+    findings = []
+    for i in range(10):
+        # +5 MB/s, 60 MB total growth
+        findings += wd.check(float(i), {}, {"proc.rss_bytes": 100 * MB + i * 5 * MB})
+    kinds = [f["kind"] for f in findings]
+    assert "rss_leak" in kinds
+    leak = next(f for f in findings if f["kind"] == "rss_leak")
+    assert leak["series"] == "proc.rss_bytes"
+    assert leak["value"] == pytest.approx(5.0, rel=0.2)
+
+
+def test_rss_flat_and_small_growth_stay_silent():
+    wd = _wd(rss_slope_mb_per_s=2.0, rss_min_growth_mb=10.0)
+    findings = []
+    for i in range(10):
+        findings += wd.check(float(i), {}, {"proc.rss_bytes": 100 * MB})
+    # steep slope but under the absolute growth floor: noise, not leak
+    wd2 = _wd(rss_slope_mb_per_s=0.1, rss_min_growth_mb=64.0)
+    for i in range(10):
+        findings += wd2.check(float(i), {}, {"proc.rss_bytes": 100 * MB + i * MB})
+    assert findings == []
+
+
+def test_throughput_drift_fires_on_decay_needs_full_window():
+    wd = _wd(drift_drop_frac=0.5, drift_min_rate=1.0)
+    findings = []
+    # early half: 100 items/s; recent half: 10 items/s (but nonzero)
+    value = 0.0
+    for i in range(10):
+        value += 100.0 if i < 5 else 10.0
+        findings += wd.check(float(i), {"work.items": value}, {})
+    kinds = [f["kind"] for f in findings]
+    assert "throughput_drift" in kinds
+    # same decay but only a half-full window: silent (burst != drift)
+    wd2 = _wd(drift_drop_frac=0.5, drift_min_rate=1.0)
+    value, quiet = 0.0, []
+    for i in range(5):
+        value += 100.0 if i < 2 else 10.0
+        quiet += wd2.check(float(i), {"work.items": value}, {})
+    assert quiet == []
+
+
+def test_counter_that_stops_entirely_is_not_drift():
+    # rate -> exactly 0 is the stall detector's business; a finished
+    # workload must not read as drift
+    wd = _wd(drift_drop_frac=0.5, drift_min_rate=1.0)
+    findings = []
+    value = 0.0
+    for i in range(10):
+        if i < 5:
+            value += 100.0
+        findings += wd.check(float(i), {"work.items": value}, {})
+    assert [f for f in findings if f["kind"] == "throughput_drift"] == []
+
+
+def test_stall_fires_after_threshold_idle():
+    wd = _wd(stall_s=5.0)
+    findings = []
+    findings += wd.check(0.0, {"work.items": 10.0}, {})
+    findings += wd.check(1.0, {"work.items": 20.0}, {})   # progress
+    for i in range(2, 10):
+        findings += wd.check(float(i), {"work.items": 20.0}, {})
+    kinds = [f["kind"] for f in findings]
+    assert "stall" in kinds
+    # cooldown: the persistent stall emits once, not every sample
+    assert kinds.count("stall") == 1
+
+
+def test_stall_needs_prior_progress():
+    wd = _wd(stall_s=2.0)
+    findings = []
+    for i in range(10):
+        findings += wd.check(float(i), {}, {})  # nothing ever moved
+    assert findings == []
+
+
+def test_queue_creep_fires_on_monotone_growth():
+    wd = _wd(depth_min_growth=50.0)
+    findings = []
+    for i in range(10):
+        findings += wd.check(float(i), {}, {"work.queue_depth": 10.0 * i})
+    assert "queue_creep" in [f["kind"] for f in findings]
+    # oscillating depth (healthy queue) stays silent
+    wd2 = _wd(depth_min_growth=50.0)
+    quiet = []
+    for i in range(10):
+        quiet += wd2.check(float(i), {}, {"work.queue_depth": 100.0 * (i % 2)})
+    assert quiet == []
+
+
+def test_cooldown_limits_repeat_findings():
+    t = watchdog.Thresholds(window=10, min_samples=4, cooldown_s=4.0,
+                            rss_slope_mb_per_s=1.0, rss_min_growth_mb=1.0)
+    wd = watchdog.Watchdog(t, rates=(), depths=())
+    findings = []
+    for i in range(20):
+        findings += wd.check(float(i), {}, {"proc.rss_bytes": i * 10 * MB})
+    # one finding per cooldown window, not one per sample
+    assert 2 <= len(findings) <= 6
+
+
+def test_thresholds_from_env(monkeypatch):
+    monkeypatch.setenv(watchdog.WATCHDOG_ENV,
+                       "window=7,rss_slope_mb_per_s=9.5,bogus=1,stall_s=3")
+    t = watchdog.Thresholds.from_env()
+    assert t.window == 7
+    assert t.rss_slope_mb_per_s == 9.5
+    assert t.stall_s == 3.0
+    assert t.min_samples == watchdog.Thresholds.min_samples  # untouched
+
+
+def test_watched_series_from_env(monkeypatch):
+    monkeypatch.setenv(watchdog.RATES_ENV, "a.x, b.y")
+    monkeypatch.setenv(watchdog.DEPTHS_ENV, "q.depth")
+    wd = watchdog.Watchdog(watchdog.Thresholds())
+    assert wd.rates == ("a.x", "b.y")
+    assert wd.depths == ("q.depth",)
+    monkeypatch.delenv(watchdog.RATES_ENV)
+    assert watchdog.Watchdog(watchdog.Thresholds()).rates == \
+        watchdog.DEFAULT_RATES

@@ -49,8 +49,29 @@ from consensus_specs_tpu.fuzz import (  # noqa: E402
 )
 from consensus_specs_tpu.fuzz.executor import DEFECT_ENV  # noqa: E402
 from consensus_specs_tpu.obs import ledger as ledger_mod  # noqa: E402
+from consensus_specs_tpu.obs import timeseries  # noqa: E402
 
 FINDINGS_EXIT = 3
+
+
+def _finish_longhaul_telemetry() -> None:
+    """When the CONSENSUS_SPECS_TPU_LONGHAUL knob armed this run, stop
+    the plane (final samples + profiler flush in every surviving rank
+    already landed at fork exit) and merge everything — parent + rank
+    series journals, profiles, watchdog findings — into the one
+    mission-control HTML report (tools/mission_report.py)."""
+    cfg = timeseries.config_from_env()
+    if cfg is None:
+        return
+    timeseries.stop()
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "mission_report", str(REPO / "tools" / "mission_report.py"))
+    assert spec is not None and spec.loader is not None
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.main([cfg[0]])
 
 
 def _print_report(label: str, rep: Dict[str, Any]) -> None:
@@ -92,6 +113,7 @@ def run_fixed(ns: argparse.Namespace) -> int:
                           "fuzz_findings": report["merged_findings"]},
               source="fuzz_farm")
     print(f"fuzz: findings journal at {out / 'findings.jsonl'}")
+    _finish_longhaul_telemetry()
     return FINDINGS_EXIT if report["merged_findings"] else 0
 
 
@@ -124,6 +146,7 @@ def run_longhaul(ns: argparse.Namespace) -> int:
     if ns.ledger is not None and rounds:
         _bank(ns.ledger, {"fuzz_execs_per_s": execs_per_s,
                           "fuzz_findings": findings}, source="fuzz_farm")
+    _finish_longhaul_telemetry()
     return FINDINGS_EXIT if findings else 0
 
 

@@ -1,0 +1,233 @@
+"""Long-haul telemetry smoke (the citest slice; docs/OBSERVABILITY.md
+"Long-haul telemetry plane").
+
+Usage:
+    python tools/longhaul_smoke.py [--out DIR] [--keep]
+
+A deterministic, seconds-not-hours drill of the whole plane:
+
+1. **armed run** — with ``CONSENSUS_SPECS_TPU_LONGHAUL`` pointing at a
+   scratch directory (50ms sampling, 31Hz profiler), run a short chain
+   simulation in-process and a 2-worker conformance-fuzz pass (forked
+   ranks — the fork-reinit path). Asserts: one series journal per
+   process (driver + every fuzz rank), samples carrying ``proc.*``
+   gauges and the sim/fuzz progress counters, ZERO watchdog findings
+   on the healthy run, and a non-empty collapsed-stack profile.
+2. **planted leak drill** — a subprocess whose only job is a list that
+   grows ~25 MB/s while armed with tight watchdog thresholds; the RSS
+   leak-slope watchdog must journal an ``rss_leak`` finding. A
+   telemetry plane that can't see a deliberate leak is decoration.
+3. **mission report** — merge the armed run into one HTML report,
+   assert the render is BYTE-STABLE (rendered twice, identical), and
+   assert the leak run's report carries the anomaly annotation.
+
+The healthy pass pins watchdog thresholds scaled to the smoke's 50ms
+sampling (drift needs full 30-sample windows of sustained decay —
+sub-second phase changes in a 20s smoke are not drift evidence; the
+drift math itself is unit-tested in tests/test_watchdog.py).
+
+Exit status: 0 = all assertions held; 1 = any failed.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import pathlib
+import shutil
+import subprocess
+import sys
+import tempfile
+import textwrap
+from typing import Any, Dict, List, Optional
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from consensus_specs_tpu.obs import timeseries, watchdog  # noqa: E402
+
+# healthy-pass thresholds, scaled to 50ms sampling: stall/rss stay
+# armed with bars a 20s smoke cannot trip accidentally; drift_min_rate
+# parks the drift detector (smoke phases are seconds, not drift)
+_SMOKE_WATCHDOG = ("window=40,min_samples=10,stall_s=60,"
+                   "rss_min_growth_mb=512,drift_min_rate=100000")
+
+_LEAK_WATCHDOG = ("window=24,min_samples=8,rss_slope_mb_per_s=2,"
+                  "rss_min_growth_mb=10,cooldown_s=60")
+
+
+def _mission_report():
+    spec = importlib.util.spec_from_file_location(
+        "mission_report", str(REPO / "tools" / "mission_report.py"))
+    assert spec is not None and spec.loader is not None
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _load_series(tele_dir: str, mod) -> Dict[str, Any]:
+    return mod.load_run(tele_dir)
+
+
+def _armed_run(tele: pathlib.Path, fuzz_out: pathlib.Path,
+               failures: List[str]) -> None:
+    """The in-process armed pass: sim slice + forked 2-rank fuzz pass."""
+    assert timeseries.ensure_started(role="smoke.driver")
+
+    from consensus_specs_tpu.sim import Scenario, ScenarioConfig
+    from consensus_specs_tpu.sim.driver import run_sim
+
+    cfg = ScenarioConfig(seed=11, slots=48, equivocations=1)
+    sim = run_sim(cfg, "vectorized", scenario=Scenario(cfg))
+    if not sim.checkpoints:
+        failures.append("sim slice produced no checkpoints")
+
+    from consensus_specs_tpu.crypto import bls
+    from consensus_specs_tpu.fuzz import FarmConfig, run_farm
+
+    was_bls = bls.bls_active
+    bls.bls_active = False
+    try:
+        rep = run_farm(FarmConfig(
+            out_dir=fuzz_out, fork="phase0", preset="minimal",
+            seed=9, cases=12, workers=2)).to_dict()
+    finally:
+        bls.bls_active = was_bls
+    if rep["merged_findings"]:
+        failures.append(
+            f"clean fuzz slice reported {rep['merged_findings']} finding(s)")
+
+    timeseries.stop()
+
+
+def _check_armed_artifacts(tele: pathlib.Path, failures: List[str],
+                           mr) -> None:
+    run = _load_series(str(tele), mr)
+    procs = run["processes"]
+    roles = sorted(str(p["role"]) for p in procs)
+    if len(procs) < 3:
+        failures.append(
+            f"expected >=3 series journals (driver + 2 fuzz ranks), "
+            f"got {len(procs)}: {roles}")
+    if not any(r.startswith("fuzz.rank") for r in roles):
+        failures.append(f"no fuzz rank journal (fork reinit broken?): {roles}")
+    driver = next((p for p in procs if p["role"] == "smoke.driver"), None)
+    if driver is None:
+        failures.append(f"no smoke.driver journal: {roles}")
+    else:
+        if len(driver["samples"]) < 3:
+            failures.append(
+                f"driver journal holds {len(driver['samples'])} sample(s)")
+        last = driver["samples"][-1] if driver["samples"] else {}
+        if not last.get("gauges", {}).get("proc.rss_bytes"):
+            failures.append("driver samples carry no proc.rss_bytes gauge")
+        if not last.get("counters", {}).get("sim.blocks_proposed"):
+            failures.append("driver samples carry no sim progress counter")
+    watchdog_findings = [f for p in procs for f in p["findings"]]
+    if watchdog_findings:
+        failures.append(
+            f"healthy run raised watchdog findings: "
+            f"{[(f.get('kind'), f.get('series')) for f in watchdog_findings]}")
+    profiles = run["profiles"]
+    if not profiles or not any(p["samples"] > 0 for p in profiles):
+        failures.append(f"no non-empty collapsed-stack profile in {tele}")
+
+
+def _leak_drill(leak_dir: pathlib.Path, failures: List[str], mr) -> None:
+    env = dict(os.environ)
+    env[timeseries.LONGHAUL_ENV] = f"{leak_dir};0.04"
+    env[watchdog.WATCHDOG_ENV] = _LEAK_WATCHDOG
+    code = textwrap.dedent("""
+        import sys, time
+        from consensus_specs_tpu.obs import timeseries
+        assert timeseries.ensure_started(role="leak.drill")
+        hog = []   # the planted leak: a list that only grows
+        for i in range(70):
+            hog.append(bytearray(1 << 20))
+            time.sleep(0.04)
+        timeseries.stop()
+        assert hog
+    """)
+    proc = subprocess.run([sys.executable, "-c", code], cwd=str(REPO),
+                          env=env, capture_output=True, text=True,
+                          timeout=120)
+    if proc.returncode != 0:
+        failures.append(f"leak drill subprocess failed: {proc.stderr[-400:]}")
+        return
+    run = _load_series(str(leak_dir), mr)
+    kinds = {str(f.get("kind")) for p in run["processes"]
+             for f in p["findings"]}
+    if "rss_leak" not in kinds:
+        failures.append(
+            f"planted ~25MB/s leak was NOT flagged by the rss_leak "
+            f"watchdog (findings: {sorted(kinds) or 'none'})")
+    else:
+        leaks = [f for p in run["processes"] for f in p["findings"]
+                 if f.get("kind") == "rss_leak"]
+        print(f"longhaul smoke: planted leak flagged — "
+              f"{leaks[0].get('detail')}")
+
+
+def _check_report(tele: pathlib.Path, leak_dir: pathlib.Path,
+                  failures: List[str], mr) -> None:
+    run = mr.load_run(str(tele))
+    html_a = mr.render_html(run)
+    html_b = mr.render_html(mr.load_run(str(tele)))
+    if html_a != html_b:
+        failures.append("mission report render is not byte-stable")
+    report_path = tele / "report.html"
+    report_path.write_text(html_a)
+    if "watchdog clean" not in html_a:
+        failures.append("healthy-run report missing the clean badge")
+    leak_html = mr.render_html(mr.load_run(str(leak_dir)))
+    if "rss_leak" not in leak_html:
+        failures.append("leak-run report missing the rss_leak annotation")
+    summary = mr.summarize(run)
+    print(f"longhaul smoke: report {report_path} — "
+          f"{summary['processes']} lane(s), {summary['samples']} samples, "
+          f"{summary['profiles']} profile(s), "
+          f"{summary['findings']} finding(s)")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--out", default=None,
+                        help="work directory (default: temp, removed)")
+    parser.add_argument("--keep", action="store_true",
+                        help="keep the work directory")
+    ns = parser.parse_args(argv)
+
+    root = pathlib.Path(ns.out or tempfile.mkdtemp(prefix="longhaul_smoke_"))
+    cleanup = ns.out is None and not ns.keep
+    tele = root / "telemetry"
+    leak_dir = root / "leak"
+    failures: List[str] = []
+    prev_lh = os.environ.get(timeseries.LONGHAUL_ENV)
+    prev_wd = os.environ.get(watchdog.WATCHDOG_ENV)
+    try:
+        os.environ[timeseries.LONGHAUL_ENV] = f"{tele};0.05;31"
+        os.environ[watchdog.WATCHDOG_ENV] = _SMOKE_WATCHDOG
+        mr = _mission_report()
+        _armed_run(tele, root / "fuzz", failures)
+        _check_armed_artifacts(tele, failures, mr)
+        _leak_drill(leak_dir, failures, mr)
+        if not failures or (tele.exists() and leak_dir.exists()):
+            _check_report(tele, leak_dir, failures, mr)
+    finally:
+        timeseries.stop()
+        for key, prev in ((timeseries.LONGHAUL_ENV, prev_lh),
+                          (watchdog.WATCHDOG_ENV, prev_wd)):
+            if prev is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = prev
+        if cleanup:
+            shutil.rmtree(root, ignore_errors=True)
+    for f in failures:
+        print(f"longhaul smoke FAILED: {f}", file=sys.stderr)
+    print(f"longhaul smoke: {'FAILED' if failures else 'PASSED'}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

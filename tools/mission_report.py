@@ -1,0 +1,466 @@
+"""Mission-control report: merge one long-haul run's telemetry into a
+single HTML page (docs/OBSERVABILITY.md "Long-haul telemetry plane").
+
+Usage:
+    python tools/mission_report.py <longhaul-dir> [--out report.html]
+                                   [--json OUT] [--bundle DIR] [--tail N]
+
+Input is the directory the ``CONSENSUS_SPECS_TPU_LONGHAUL`` knob pointed
+at: every process in the run (fleet replicas, fuzz ranks, gen shards,
+the sim driver) left a ``series-<pid>-<token>.jsonl`` journal there,
+the profiler left ``profile-<pid>-<token>.collapsed`` files, and
+abnormal exits left ``postmortem-*.json`` bundles. The report renders:
+
+- a run summary (processes, wall span, total samples, findings);
+- the findings table — every watchdog anomaly, by process and kind;
+- one LANE per process: role/pid, duration, RSS start→peak, CPU burn,
+  watched-counter rates, an RSS sparkline with finding markers at the
+  anomaly timestamps, and the busiest progress-counter sparkline;
+- top collapsed stacks per profiled process (where the hours went);
+- any postmortem bundles (reason + last findings).
+
+The output is BYTE-STABLE: a pure function of the input directory (no
+generation timestamps, sorted iteration everywhere), so re-rendering a
+journaled run is diffable and CI can assert reproducibility. Torn tail
+lines (a SIGKILL mid-append) are counted and skipped, never fatal.
+
+``--bundle DIR`` writes a postmortem bundle instead: the last ``--tail``
+lines of every series journal, all findings/postmortems/profiles, and
+``trace.json`` when present — the minimal artifact to attach to an
+incident report.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import html as html_mod
+import json
+import os
+import pathlib
+import shutil
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+# ---------------------------------------------------------------------------
+# loading (torn-tail tolerant)
+# ---------------------------------------------------------------------------
+
+def parse_jsonl(path: str) -> Tuple[List[Dict[str, Any]], int]:
+    """Records + torn-line count. A SIGKILL mid-append leaves at most
+    one unparseable tail line; any bad line is counted, never fatal."""
+    records: List[Dict[str, Any]] = []
+    torn = 0
+    try:
+        with open(path, "r", errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    torn += 1
+                    continue
+                if isinstance(rec, dict):
+                    records.append(rec)
+                else:
+                    torn += 1
+    except OSError:
+        return [], 0
+    return records, torn
+
+
+def load_run(run_dir: str) -> Dict[str, Any]:
+    """Everything one long-haul directory holds, merged + sorted."""
+    processes: List[Dict[str, Any]] = []
+    for path in sorted(glob.glob(os.path.join(run_dir, "series-*.jsonl"))):
+        records, torn = parse_jsonl(path)
+        header = next((r for r in records if r.get("type") == "series_header"),
+                      {})
+        samples = [r for r in records if r.get("type") == "sample"]
+        findings = [r for r in records if r.get("type") == "finding"]
+        role = (samples[-1].get("role") if samples else None) \
+            or header.get("role") or "?"
+        processes.append({
+            "file": os.path.basename(path),
+            "pid": header.get("pid"),
+            "role": role,
+            "interval_s": header.get("interval_s"),
+            "argv": header.get("argv", ""),
+            "samples": samples,
+            "findings": findings,
+            "torn_lines": torn,
+        })
+    processes.sort(key=lambda p: (str(p["role"]), str(p["pid"]), p["file"]))
+
+    profiles: List[Dict[str, Any]] = []
+    for path in sorted(glob.glob(os.path.join(run_dir, "profile-*.collapsed"))):
+        stacks: List[Tuple[str, int]] = []
+        total = 0
+        try:
+            with open(path, "r", errors="replace") as f:
+                for line in f:
+                    stack, _, n = line.rstrip("\n").rpartition(" ")
+                    if not stack:
+                        continue
+                    try:
+                        count = int(n)
+                    except ValueError:
+                        continue
+                    stacks.append((stack, count))
+                    total += count
+        except OSError:
+            continue
+        stacks.sort(key=lambda s: (-s[1], s[0]))
+        profiles.append({"file": os.path.basename(path),
+                         "samples": total, "stacks": stacks})
+
+    postmortems: List[Dict[str, Any]] = []
+    for path in sorted(glob.glob(os.path.join(run_dir, "postmortem-*.json"))):
+        try:
+            with open(path) as f:
+                pm = json.load(f)
+        except (OSError, ValueError):
+            continue
+        pm["file"] = os.path.basename(path)
+        postmortems.append(pm)
+
+    return {"dir": run_dir, "processes": processes, "profiles": profiles,
+            "postmortems": postmortems}
+
+
+# ---------------------------------------------------------------------------
+# analysis helpers
+# ---------------------------------------------------------------------------
+
+def _span_us(run: Dict[str, Any]) -> Tuple[Optional[float], Optional[float]]:
+    ts = [s["ts"] for p in run["processes"] for s in p["samples"]
+          if isinstance(s.get("ts"), (int, float))]
+    return (min(ts), max(ts)) if ts else (None, None)
+
+
+def _gauge_series(proc_rec: Dict[str, Any], name: str) -> List[Tuple[float, float]]:
+    out = []
+    for s in proc_rec["samples"]:
+        v = s.get("gauges", {}).get(name)
+        if isinstance(v, (int, float)):
+            out.append((float(s["ts"]), float(v)))
+    return out
+
+
+def _busiest_counter(proc_rec: Dict[str, Any]) -> Optional[str]:
+    """The watched-style progress counter that moved the most (total
+    growth) across this process's samples — its rate gets the lane's
+    second sparkline."""
+    first: Dict[str, float] = {}
+    last: Dict[str, float] = {}
+    for s in proc_rec["samples"]:
+        for k, v in s.get("counters", {}).items():
+            if k.endswith(".count") or not isinstance(v, (int, float)):
+                continue
+            first.setdefault(k, float(v))
+            last[k] = float(v)
+    growth = {k: last[k] - first[k] for k in last if last[k] > first[k]}
+    if not growth:
+        return None
+    return min(growth, key=lambda k: (-growth[k], k))
+
+
+def _counter_rates(proc_rec: Dict[str, Any],
+                   name: str) -> List[Tuple[float, float]]:
+    pts = []
+    prev: Optional[Tuple[float, float]] = None
+    for s in proc_rec["samples"]:
+        v = s.get("counters", {}).get(name)
+        if not isinstance(v, (int, float)):
+            continue
+        ts = float(s["ts"])
+        if prev is not None and ts > prev[0]:
+            rate = (float(v) - prev[1]) / ((ts - prev[0]) / 1e6)
+            pts.append((ts, max(0.0, rate)))
+        prev = (ts, float(v))
+    return pts
+
+
+def summarize(run: Dict[str, Any]) -> Dict[str, Any]:
+    t0, t1 = _span_us(run)
+    findings = [f for p in run["processes"] for f in p["findings"]]
+    by_kind: Dict[str, int] = {}
+    for f in findings:
+        by_kind[str(f.get("kind"))] = by_kind.get(str(f.get("kind")), 0) + 1
+    return {
+        "dir": run["dir"],
+        "processes": len(run["processes"]),
+        "samples": sum(len(p["samples"]) for p in run["processes"]),
+        "torn_lines": sum(p["torn_lines"] for p in run["processes"]),
+        "findings": len(findings),
+        "findings_by_kind": dict(sorted(by_kind.items())),
+        "profiles": len(run["profiles"]),
+        "profile_samples": sum(p["samples"] for p in run["profiles"]),
+        "postmortems": len(run["postmortems"]),
+        "wall_span_s": round((t1 - t0) / 1e6, 3) if t0 is not None else None,
+        "roles": sorted({str(p["role"]) for p in run["processes"]}),
+    }
+
+
+# ---------------------------------------------------------------------------
+# rendering (byte-stable: sorted, fixed float formats, no timestamps)
+# ---------------------------------------------------------------------------
+
+_W, _H = 340, 44
+
+
+def _sparkline(points: List[Tuple[float, float]],
+               t0: float, t1: float,
+               markers: Optional[List[float]] = None,
+               color: str = "#93c5fd") -> str:
+    if len(points) < 2:
+        return '<span class="dim">not enough samples</span>'
+    vs = [v for _, v in points]
+    vmin, vmax = min(vs), max(vs)
+    vspan = (vmax - vmin) or 1.0
+    tspan = (t1 - t0) or 1.0
+
+    def _xy(t: float, v: float) -> str:
+        x = (t - t0) / tspan * (_W - 4) + 2
+        y = _H - 4 - (v - vmin) / vspan * (_H - 8)
+        return f"{x:.1f},{y:.1f}"
+
+    line = " ".join(_xy(t, v) for t, v in points)
+    marks = ""
+    for mt in sorted(markers or []):
+        x = (mt - t0) / tspan * (_W - 4) + 2
+        marks += (f'<line x1="{x:.1f}" y1="2" x2="{x:.1f}" y2="{_H - 2}" '
+                  f'stroke="#b91c1c" stroke-width="1.5"/>')
+    return (f'<svg width="{_W}" height="{_H}" viewBox="0 0 {_W} {_H}">'
+            f'<polyline points="{line}" fill="none" stroke="{color}" '
+            f'stroke-width="1.3"/>{marks}</svg>'
+            f'<span class="dim"> {vmin:.6g} … {vmax:.6g}</span>')
+
+
+def _fmt_bytes(n: Optional[float]) -> str:
+    if n is None:
+        return "—"
+    return f"{n / (1 << 20):.1f} MB"
+
+
+def render_html(run: Dict[str, Any]) -> str:
+    t0, t1 = _span_us(run)
+    summary = summarize(run)
+    esc = html_mod.escape
+
+    parts: List[str] = []
+    parts.append(
+        "<!doctype html><html><head><meta charset='utf-8'>"
+        "<title>mission control — "
+        f"{esc(os.path.basename(os.path.normpath(run['dir'])))}</title>"
+        "<style>body{font:14px/1.45 system-ui,sans-serif;margin:24px;"
+        "color:#0f172a;max-width:1100px}table{border-collapse:collapse;"
+        "margin:8px 0}td,th{border:1px solid #cbd5e1;padding:3px 9px;"
+        "text-align:left;vertical-align:top}th{background:#f1f5f9}"
+        "code{background:#f1f5f9;padding:0 3px;border-radius:3px}"
+        ".dim{color:#64748b;font-size:12px}.lane{border:1px solid #cbd5e1;"
+        "border-radius:6px;padding:10px 14px;margin:14px 0}"
+        ".finding{color:#b91c1c;font-weight:600}"
+        ".clean{color:#15803d;font-weight:600}"
+        "h1{font-size:22px}h2{font-size:17px;margin-top:26px}"
+        "h3{font-size:15px;margin:4px 0 8px}</style></head><body>")
+    parts.append(f"<h1>Mission control — <code>{esc(run['dir'])}</code></h1>")
+
+    # run summary
+    span_txt = (f"{summary['wall_span_s']:.1f}s"
+                if summary["wall_span_s"] is not None else "—")
+    badge = (f"<span class='finding'>{summary['findings']} finding(s)</span>"
+             if summary["findings"] else
+             "<span class='clean'>watchdog clean</span>")
+    parts.append(
+        f"<p>{summary['processes']} process lane(s) · "
+        f"{summary['samples']} samples over {span_txt} · {badge} · "
+        f"{summary['profiles']} profile(s) "
+        f"({summary['profile_samples']} stack samples) · "
+        f"{summary['postmortems']} postmortem(s) · "
+        f"{summary['torn_lines']} torn journal line(s) skipped</p>")
+
+    # findings table
+    all_findings = [(p, f) for p in run["processes"] for f in p["findings"]]
+    if all_findings:
+        parts.append("<h2>Watchdog findings</h2><table><tr><th>role</th>"
+                     "<th>kind</th><th>series</th><th>t+ (s)</th>"
+                     "<th>value</th><th>detail</th></tr>")
+        for p, f in sorted(all_findings, key=lambda x: (
+                float(x[1].get("ts", 0)), str(x[0]["role"]))):
+            rel = ((float(f.get("ts", 0)) - t0) / 1e6
+                   if t0 is not None else 0.0)
+            parts.append(
+                "<tr>"
+                f"<td><code>{esc(str(p['role']))}</code></td>"
+                f"<td class='finding'>{esc(str(f.get('kind')))}</td>"
+                f"<td><code>{esc(str(f.get('series')))}</code></td>"
+                f"<td style='text-align:right'>{rel:.1f}</td>"
+                f"<td style='text-align:right'>{f.get('value', 0)}</td>"
+                f"<td>{esc(str(f.get('detail', '')))}</td></tr>")
+        parts.append("</table>")
+
+    # per-process lanes
+    parts.append("<h2>Process lanes</h2>")
+    for p in run["processes"]:
+        samples = p["samples"]
+        rss = _gauge_series(p, "proc.rss_bytes")
+        cpu = _gauge_series(p, "proc.cpu_s")
+        lane_t0 = samples[0]["ts"] if samples else None
+        lane_t1 = samples[-1]["ts"] if samples else None
+        dur = ((lane_t1 - lane_t0) / 1e6
+               if samples and len(samples) > 1 else 0.0)
+        finding_ts = [float(f["ts"]) for f in p["findings"]
+                      if isinstance(f.get("ts"), (int, float))]
+        parts.append("<div class='lane'>")
+        parts.append(
+            f"<h3><code>{esc(str(p['role']))}</code> "
+            f"<span class='dim'>pid {esc(str(p['pid']))} · "
+            f"{esc(p['file'])}</span></h3>")
+        stat_bits = [
+            f"{len(samples)} samples / {dur:.1f}s",
+            f"rss {_fmt_bytes(rss[0][1] if rss else None)} → "
+            f"{_fmt_bytes(max(v for _, v in rss) if rss else None)}",
+            f"cpu {cpu[-1][1] - cpu[0][1]:.2f}s" if len(cpu) > 1 else "cpu —",
+        ]
+        if p["findings"]:
+            kinds = sorted({str(f.get("kind")) for f in p["findings"]})
+            stat_bits.append(
+                f"<span class='finding'>{len(p['findings'])} finding(s): "
+                f"{esc(', '.join(kinds))}</span>")
+        else:
+            stat_bits.append("<span class='clean'>clean</span>")
+        if p["torn_lines"]:
+            stat_bits.append(f"{p['torn_lines']} torn line(s)")
+        parts.append(f"<p>{' · '.join(stat_bits)}</p>")
+        if rss and lane_t0 is not None:
+            parts.append(
+                "<p><code>proc.rss_bytes</code><br>"
+                + _sparkline(rss, lane_t0, lane_t1 or lane_t0 + 1,
+                             markers=finding_ts) + "</p>")
+        busiest = _busiest_counter(p)
+        if busiest and lane_t0 is not None:
+            rates = _counter_rates(p, busiest)
+            if len(rates) >= 2:
+                parts.append(
+                    f"<p><code>{esc(busiest)}</code> rate (/s)<br>"
+                    + _sparkline(rates, lane_t0, lane_t1 or lane_t0 + 1,
+                                 markers=finding_ts, color="#86efac")
+                    + "</p>")
+        parts.append("</div>")
+
+    # profiles
+    if run["profiles"]:
+        parts.append("<h2>Profiles (collapsed stacks, top 12 per process)"
+                     "</h2>")
+        for prof in run["profiles"]:
+            parts.append(
+                f"<p><code>{esc(prof['file'])}</code> "
+                f"<span class='dim'>{prof['samples']} samples</span></p>"
+                "<table><tr><th>samples</th><th>%</th><th>stack (leaf-most "
+                "last)</th></tr>")
+            for stack, n in prof["stacks"][:12]:
+                pct = 100.0 * n / prof["samples"] if prof["samples"] else 0.0
+                short = stack if len(stack) <= 220 else "…" + stack[-220:]
+                parts.append(
+                    f"<tr><td style='text-align:right'>{n}</td>"
+                    f"<td style='text-align:right'>{pct:.1f}</td>"
+                    f"<td><code>{esc(short)}</code></td></tr>")
+            parts.append("</table>")
+
+    # postmortems
+    if run["postmortems"]:
+        parts.append("<h2>Postmortem bundles</h2>")
+        for pm in run["postmortems"]:
+            parts.append(
+                f"<div class='lane'><h3><code>{esc(str(pm.get('role')))}"
+                f"</code> <span class='dim'>{esc(pm['file'])}</span></h3>"
+                f"<p class='finding'>{esc(str(pm.get('reason', '')))}</p>"
+                f"<p class='dim'>{len(pm.get('tail', []))} tail sample(s), "
+                f"{len(pm.get('findings', []))} finding(s) at exit</p></div>")
+
+    parts.append("</body></html>")
+    return "\n".join(parts) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# postmortem bundle collection
+# ---------------------------------------------------------------------------
+
+def collect_bundle(run_dir: str, out_dir: str, tail: int = 200) -> Dict[str, Any]:
+    """Copy the run's last-N series lines + findings + profiles +
+    postmortems (+ trace.json when present) into ``out_dir`` with a
+    MANIFEST.json — the attach-to-the-incident artifact."""
+    out = pathlib.Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    manifest: Dict[str, Any] = {"source": run_dir, "tail_lines": tail,
+                                "files": []}
+    for path in sorted(glob.glob(os.path.join(run_dir, "series-*.jsonl"))):
+        name = os.path.basename(path)
+        with open(path, "r", errors="replace") as f:
+            lines = f.readlines()
+        kept = lines[-tail:]
+        with open(out / name, "w") as f:
+            f.writelines(kept)
+        manifest["files"].append({"file": name, "lines_total": len(lines),
+                                  "lines_kept": len(kept)})
+    for pattern in ("profile-*.collapsed", "postmortem-*.json", "trace.json"):
+        for path in sorted(glob.glob(os.path.join(run_dir, pattern))):
+            shutil.copy2(path, out / os.path.basename(path))
+            manifest["files"].append({"file": os.path.basename(path),
+                                      "copied": True})
+    with open(out / "MANIFEST.json", "w") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
+    return manifest
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("dir", help="the long-haul telemetry directory")
+    parser.add_argument("--out", default=None,
+                        help="HTML output path (default <dir>/report.html)")
+    parser.add_argument("--json", dest="json_path", type=pathlib.Path,
+                        default=None, help="machine summary output")
+    parser.add_argument("--bundle", default=None,
+                        help="write a postmortem bundle to this dir instead")
+    parser.add_argument("--tail", type=int, default=200,
+                        help="series lines kept per journal in the bundle")
+    ns = parser.parse_args(argv)
+
+    if not os.path.isdir(ns.dir):
+        print(f"mission report: no such directory {ns.dir}", file=sys.stderr)
+        return 2
+    if ns.bundle:
+        manifest = collect_bundle(ns.dir, ns.bundle, tail=ns.tail)
+        print(f"mission report: bundled {len(manifest['files'])} file(s) "
+              f"-> {ns.bundle}")
+        return 0
+
+    run = load_run(ns.dir)
+    summary = summarize(run)
+    if not run["processes"]:
+        print(f"mission report: no series journals under {ns.dir}",
+              file=sys.stderr)
+        return 2
+    out = ns.out or os.path.join(ns.dir, "report.html")
+    html = render_html(run)
+    with open(out, "w") as f:
+        f.write(html)
+    print(f"mission report: {summary['processes']} lane(s), "
+          f"{summary['samples']} samples, {summary['findings']} finding(s) "
+          f"({', '.join(f'{k}={v}' for k, v in summary['findings_by_kind'].items()) or 'clean'}), "
+          f"{summary['profiles']} profile(s) -> {out}")
+    if ns.json_path is not None:
+        with open(ns.json_path, "w") as f:
+            json.dump(summary, f, indent=2, sort_keys=True)
+        print(f"json summary written to {ns.json_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

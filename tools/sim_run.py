@@ -45,6 +45,7 @@ sys.path.insert(0, str(REPO))
 
 from consensus_specs_tpu import resilience  # noqa: E402
 from consensus_specs_tpu.obs import ledger as ledger_mod  # noqa: E402
+from consensus_specs_tpu.obs import timeseries  # noqa: E402
 from consensus_specs_tpu.resilience import injection  # noqa: E402
 from consensus_specs_tpu.sim import (  # noqa: E402
     Scenario,
@@ -101,6 +102,12 @@ def main(argv: Optional[list] = None) -> int:
                         help="perf ledger path; 'off' disables banking")
     parser.add_argument("--json", dest="json_path", type=pathlib.Path, default=None)
     ns = parser.parse_args(argv)
+
+    # long-haul telemetry (docs/OBSERVABILITY.md): armed via the
+    # CONSENSUS_SPECS_TPU_LONGHAUL knob, this run journals slots/s,
+    # RSS, and watchdog findings into a per-process series file the
+    # mission report merges; unarmed this is one env check
+    timeseries.ensure_started(role="sim.driver")
 
     seed = ns.seed if ns.seed is not None else seed_from_env(0)
     config = ScenarioConfig(seed=seed, slots=ns.slots, fork=ns.fork,
@@ -187,6 +194,25 @@ def main(argv: Optional[list] = None) -> int:
             json.dump(summary, f, indent=2, sort_keys=True)
         print(f"json summary written to {ns.json_path}")
     print(f"sim: {'OK' if ok else 'FAILED'}")
+    if not ok:
+        # a diverged/failed long-horizon run leaves the postmortem
+        # bundle (last-N samples + findings) next to the series journal
+        bundle = timeseries.postmortem_bundle("sim divergence or drill failure")
+        if bundle:
+            print(f"sim: postmortem bundle -> {bundle}")
+    lh = timeseries.config_from_env()
+    if lh is not None:
+        # armed run: stop the plane and merge the journals + profiles
+        # + findings into the mission-control report
+        timeseries.stop()
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "mission_report", str(REPO / "tools" / "mission_report.py"))
+        assert spec is not None and spec.loader is not None
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        mod.main([lh[0]])
     return 0 if ok else 1
 
 

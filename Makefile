@@ -25,8 +25,8 @@ DEVICE_TESTS = tests/test_bls_device.py tests/test_curve_device.py \
 .PHONY: test citest test-fast test-device test-mainnet lint docs generate_tests gen_% replay bench \
         dryrun detect_generator_incomplete clean-vectors chaos trace perfgate perf-report gen-bench \
         gen-shard-smoke warm-cache serve serve-smoke serve-bench serve-canary slo-report sim \
-        sim-smoke device-probe overload-drill overload-smoke fleet-drill fleet-smoke fuzz \
-        fuzz-smoke longhaul-smoke mission-report help
+        sim-smoke sim-partition sim-partition-smoke device-probe overload-drill overload-smoke \
+        fleet-drill fleet-smoke fuzz fuzz-smoke longhaul-smoke mission-report help
 
 # the fault-injection suite: supervisor/taxonomy units, chaos replay
 # (tampered vectors), induced backend failures, generator crash/resume
@@ -63,6 +63,8 @@ help:
 	@echo "slo-report            serve SLO report: objectives, latest observations, 1h/6h/24h burn rates over $(LEDGER)"
 	@echo "sim                   2048-slot seeded chain simulation (forks/reorgs/equivocations), vectorized-vs-oracle differential + chaos drill -> $(LEDGER)"
 	@echo "sim-smoke             short chain-sim differential + chaos drill (the citest slice; docs/SIM.md)"
+	@echo "sim-partition         2048-slot partitioned multi-node sim: 3 nodes over the adversarial bus, scheduled partition/heal windows, per-node differential + convergence bound -> $(LEDGER)"
+	@echo "sim-partition-smoke   partitioned-sim drill battery (citest slice): kill-mid-epoch + kill-mid-snapshot + tampered-snapshot resume all byte-identical, sim.net/sim.checkpoint chaos, per-node differential"
 	@echo "fuzz                  sharded differential fuzzing long-haul: oracle vs engine vs served path, FUZZ_MINUTES=N budget, findings shrunk + journaled -> ./fuzz-farm (docs/FUZZ.md)"
 	@echo "fuzz-smoke            deterministic fuzz drill (citest slice): clean build finds ZERO divergences; a planted engine defect is found AND shrunk; fuzz_execs_per_s -> $(LEDGER)"
 	@echo "longhaul-smoke        long-haul telemetry drill (citest slice): armed sim+fuzz run -> series journals + profile + byte-stable mission report; planted RSS leak must be flagged"
@@ -88,6 +90,7 @@ citest:
 	$(MAKE) trace
 	$(MAKE) gen-shard-smoke
 	$(MAKE) sim-smoke
+	$(MAKE) sim-partition-smoke
 	$(MAKE) fuzz-smoke
 	$(MAKE) longhaul-smoke
 	$(MAKE) serve-smoke
@@ -198,6 +201,19 @@ sim:
 
 sim-smoke:
 	$(PYTHON) tools/sim_run.py --slots 96 --chaos-drill --ledger $(LEDGER)
+
+# the partitioned multi-node lane (docs/SIM.md "Partitioned network"):
+# N independent Stores over the seeded adversarial bus with scheduled
+# partition/heal windows — per-node oracle-vs-engine differential,
+# bounded post-heal convergence, crash-consistent snapshots; the smoke
+# is the kill/resume + tamper + chaos drill battery wired into citest.
+# SIM_NODES scales the node count; LONGHAUL arms the telemetry plane.
+SIM_NODES ?= 3
+sim-partition:
+	$(LONGHAUL_ENV) $(PYTHON) tools/sim_run.py --nodes $(SIM_NODES) --slots 2048 --ledger $(LEDGER)
+
+sim-partition-smoke:
+	$(PYTHON) tools/sim_partition_smoke.py --ledger $(LEDGER)
 
 # the conformance fuzzing farm (docs/FUZZ.md, ROADMAP #4): seeded
 # mutation corpus (SSZ byte corruption + spec-level wreckage) through

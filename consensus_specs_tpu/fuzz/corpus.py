@@ -29,7 +29,14 @@ from dataclasses import dataclass, field
 from random import Random
 from typing import Any, Iterator, List, Optional, Tuple
 
-from .mutate import BYTE_OPS, WRECKAGE_OPS, apply_byte_op, apply_wreckage
+from .mutate import (
+    ATT_WRECKAGE_OPS,
+    BYTE_OPS,
+    WRECKAGE_OPS,
+    apply_att_wreckage,
+    apply_byte_op,
+    apply_wreckage,
+)
 
 # corpus mix per 8 indices: 1 valid control, 4 wreckage, 2 byte, 1 random
 _KIND_WHEEL = ("valid", "wreck", "wreck", "byte", "wreck", "byte", "random",
@@ -45,10 +52,11 @@ class FuzzCase:
     fork: str
     preset: str
     pre: bytes
-    block: bytes
+    block: bytes                      # the payload: block OR attestation SSZ
     kind: str                         # valid | wreck | byte | random
     base_index: int                   # which valid base it derived from
     mutations: Tuple[str, ...] = field(default=())
+    target: str = "block"             # block | attestation (fork choice)
 
 
 def case_seed(fork: str, preset: str, seed: int, index: int) -> str:
@@ -66,6 +74,8 @@ class CorpusBuilder:
         self.preset = preset
         self.seed = seed
         self._bases: Optional[List[Tuple[bytes, bytes]]] = None
+        self._att_bases: Optional[List[bytes]] = None
+        self._fc_context: Optional[Any] = None
 
     # -- valid bases ----------------------------------------------------
 
@@ -124,6 +134,73 @@ class CorpusBuilder:
                                     mode=mode, chaos=False)
         return bytes(obj.encode_bytes()), mode.to_name()
 
+    # -- fork-choice attestation corpus (docs/FUZZ.md) -------------------
+
+    def att_bases(self) -> List[bytes]:
+        """Valid wire attestations the anchor store provably accepts —
+        the attestations carried by the signed fork-choice base chain,
+        as standalone SSZ payloads."""
+        if self._att_bases is None:
+            self._att_bases = _build_signed_chain(self.spec, self.seed)[2]
+        return self._att_bases
+
+    def fc_context(self):
+        """The shared fork-choice store context every attestation case
+        runs against: the signed base chain delivered into a fresh
+        Store, clock ticked one slot past the tip (so every base
+        attestation satisfies 'only affects subsequent slots'). A pure
+        function of ``(fork, preset, seed)`` — the serve daemon
+        rebuilds the identical context from the same key."""
+        if self._fc_context is None:
+            self._fc_context = build_fc_store(self.spec, self.seed)
+        return self._fc_context
+
+    def attestation_case(self, index: int) -> FuzzCase:
+        """The fork-choice attestation case at ``index`` — same recipe
+        wheel as the block corpus, over ``on_attestation``'s intake
+        ladder; ids are ``a<seed>-<index>-<kind>``."""
+        bases = self.att_bases()
+        rng = Random(case_seed(self.fork, self.preset, self.seed, index)
+                     + ":att")
+        kind = _KIND_WHEEL[index % len(_KIND_WHEEL)]
+        base_index = rng.randrange(len(bases))
+        att = bases[base_index]
+        mutations: Tuple[str, ...] = ()
+        seed_str = case_seed(self.fork, self.preset, self.seed, index) + ":att"
+
+        if kind == "wreck":
+            ops = tuple(rng.sample(sorted(ATT_WRECKAGE_OPS),
+                                   rng.randint(1, 2)))
+            mutated = apply_att_wreckage(self.spec, att, ops, seed_str)
+            if mutated is None:
+                kind, mutated = "valid", att
+            else:
+                mutations = ops
+            att = mutated
+        elif kind == "byte":
+            ops = tuple(rng.sample(sorted(BYTE_OPS), rng.randint(1, 2)))
+            for op in ops:
+                att = apply_byte_op(op, att, seed_str)
+            mutations = ops
+        elif kind == "random":
+            att, mode_name = self._random_attestation(rng)
+            mutations = (f"random:{mode_name}",)
+
+        case_id = f"a{self.seed:04d}-{index:06d}-{kind}"
+        return FuzzCase(case_id=case_id, fork=self.fork, preset=self.preset,
+                        pre=b"", block=att, kind=kind,
+                        base_index=base_index, mutations=mutations,
+                        target="attestation")
+
+    def _random_attestation(self, rng: Random) -> Tuple[bytes, str]:
+        from ..debug.random_value import RandomizationMode, get_random_ssz_object
+
+        mode = RandomizationMode(rng.randrange(6))
+        obj = get_random_ssz_object(rng, self.spec.Attestation,
+                                    max_bytes_length=128, max_list_length=8,
+                                    mode=mode, chaos=False)
+        return bytes(obj.encode_bytes()), mode.to_name()
+
 
 def _build_bases(spec: Any, seed: int, n_blocks: int = 6,
                  validators: int = 32) -> List[Tuple[bytes, bytes]]:
@@ -160,5 +237,71 @@ def _build_bases(spec: Any, seed: int, n_blocks: int = 6,
             state = pre.copy()
             spec.process_block(state, block)
         return bases
+    finally:
+        bls.bls_active = was_active
+
+
+def _build_signed_chain(spec: Any, seed: int, n_blocks: int = 6,
+                        validators: int = 32):
+    """The fork-choice twin of :func:`_build_bases`: the same short
+    chain shape, but with REAL state roots (``on_block`` runs the full
+    validating transition, so zeroed roots would reject). Returns
+    ``(genesis_state, signed_blocks, att_bases)`` where ``att_bases``
+    are the carried attestations as standalone SSZ — all pure functions
+    of ``(spec, seed)``."""
+    from ..crypto import bls
+    from ..test_framework.attestations import get_valid_attestation
+    from ..test_framework.block import build_empty_block_for_next_slot
+    from ..test_framework.block_processing import (
+        state_transition_and_sign_block,
+    )
+    from ..test_framework.genesis import create_genesis_state
+
+    was_active = bls.bls_active
+    bls.bls_active = False
+    try:
+        state = create_genesis_state(
+            spec, [spec.MAX_EFFECTIVE_BALANCE] * validators,
+            spec.MAX_EFFECTIVE_BALANCE)
+        genesis = state.copy()
+        signed_blocks = []
+        atts: List[bytes] = []
+        for i in range(n_blocks):
+            block = build_empty_block_for_next_slot(spec, state)
+            if i >= 1:
+                try:
+                    att = get_valid_attestation(spec, state, signed=False)
+                    block.body.attestations.append(att)
+                    atts.append(bytes(att.encode_bytes()))
+                except Exception:
+                    pass
+            signed_blocks.append(
+                state_transition_and_sign_block(spec, state, block))
+        return genesis, signed_blocks, atts
+    finally:
+        bls.bls_active = was_active
+
+
+def build_fc_store(spec: Any, seed: int) -> Any:
+    """The fork-choice anchor context for attestation intake fuzzing: a
+    fresh Store seeded with the signed base chain's genesis anchor,
+    ticked one slot past the chain tip, with every base block delivered
+    — a pure function of ``(spec, seed)`` shared by the in-process
+    executor and the serve daemon's ``fork_choice_attestation`` method."""
+    from ..crypto import bls
+
+    was_active = bls.bls_active
+    bls.bls_active = False
+    try:
+        genesis, signed_blocks, _atts = _build_signed_chain(spec, seed)
+        anchor_block = spec.BeaconBlock(
+            state_root=spec.hash_tree_root(genesis))
+        store = spec.get_forkchoice_store(genesis, anchor_block)
+        tip_slot = max(int(b.message.slot) for b in signed_blocks)
+        spec.on_tick(store, int(store.genesis_time)
+                     + (tip_slot + 1) * int(spec.config.SECONDS_PER_SLOT))
+        for signed in signed_blocks:
+            spec.on_block(store, signed)
+        return store
     finally:
         bls.bls_active = was_active

@@ -55,7 +55,8 @@ REJECTED = (AssertionError, IndexError, ValueError, KeyError, OverflowError)
 
 DEFECT_ENV = "CONSENSUS_SPECS_TPU_FUZZ_DEFECT"
 
-_SERVE_CLASS_RE = re.compile(r"process_block: ([A-Za-z_][A-Za-z0-9_]*)\(")
+_SERVE_CLASS_RE = re.compile(
+    r"(?:process_block|on_attestation): ([A-Za-z_][A-Za-z0-9_]*)\(")
 
 PATHS = ("oracle", "engine", "serve")
 
@@ -122,6 +123,45 @@ def _defect_armed() -> bool:
     return os.environ.get(DEFECT_ENV, "") == "engine"
 
 
+def _fc_defect_armed() -> bool:
+    # the fork-choice twin of the planted engine defect: perturbs the
+    # ENGINE path's accepted latest-message digest (test-only hook)
+    return os.environ.get(DEFECT_ENV, "") == "fc-engine"
+
+
+def latest_messages_digest(store: Any) -> str:
+    """The normalized accept detail for fork-choice intake: a canonical
+    digest over the store's LMD latest messages (what on_attestation
+    exists to update). Shared by the direct paths and the serve
+    daemon's ``fork_choice_attestation`` method."""
+    import hashlib
+
+    lines = sorted(
+        f"{int(i)}:{int(m.epoch)}:{bytes(m.root).hex()}"
+        for i, m in store.latest_messages.items())
+    return hashlib.sha256("\n".join(lines).encode()).hexdigest()
+
+
+def fresh_store_view(spec: Any, store: Any) -> Any:
+    """A per-case Store view over a shared anchor context: fresh
+    top-level containers (latest_messages / checkpoint_states /
+    equivocating_indices mutate per intake) over the shared read-only
+    blocks and states."""
+    return spec.Store(
+        time=store.time,
+        genesis_time=store.genesis_time,
+        justified_checkpoint=store.justified_checkpoint,
+        finalized_checkpoint=store.finalized_checkpoint,
+        best_justified_checkpoint=store.best_justified_checkpoint,
+        proposer_boost_root=store.proposer_boost_root,
+        equivocating_indices=set(store.equivocating_indices),
+        blocks=dict(store.blocks),
+        block_states=dict(store.block_states),
+        checkpoint_states=dict(store.checkpoint_states),
+        latest_messages=dict(store.latest_messages),
+    )
+
+
 class DifferentialExecutor:
     """Executes cases three ways against one (fork, preset) spec. The
     serve path is pluggable: ``service`` (in-process SpecService) or a
@@ -129,7 +169,8 @@ class DifferentialExecutor:
     the real wire). Exactly one of the two must be provided."""
 
     def __init__(self, spec: Any, fork: str, preset: str,
-                 service: Any = None, client: Any = None) -> None:
+                 service: Any = None, client: Any = None,
+                 fc_seed: int = 1) -> None:
         if (service is None) == (client is None):
             raise ValueError("provide exactly one of service=/client=")
         self.spec = spec
@@ -137,6 +178,8 @@ class DifferentialExecutor:
         self.preset = preset
         self.service = service
         self.client = client
+        self._fc_seed = fc_seed       # fork-choice context corpus key
+        self._fc_anchor: Any = None
 
     # -- direct paths ---------------------------------------------------
 
@@ -193,9 +236,66 @@ class DifferentialExecutor:
         root = str(result.get("root", ""))
         return Outcome("accept", root[2:] if root.startswith("0x") else root)
 
+    # -- fork-choice attestation intake (docs/FUZZ.md) -------------------
+
+    def _fc_store(self) -> Any:
+        if self._fc_anchor is None:
+            from .corpus import build_fc_store
+
+            self._fc_anchor = build_fc_store(self.spec, self._fc_seed)
+        return self._fc_anchor
+
+    def _run_att_direct(self, case: FuzzCase, engine_on: bool) -> Outcome:
+        spec = self.spec
+        try:
+            att = spec.Attestation.decode_bytes(case.block)
+        except Exception:
+            return Outcome("undecodable", "attestation")
+        store = fresh_store_view(spec, self._fc_store())
+        with _engine_installed(engine_on):
+            try:
+                spec.on_attestation(store, att, is_from_block=False)
+            except REJECTED as e:
+                return Outcome("reject", type(e).__name__)
+            except Exception:
+                return Outcome("reject", "uncaught")
+        digest = latest_messages_digest(store)
+        if engine_on and _fc_defect_armed():
+            digest = digest[:-1] + ("0" if digest[-1] != "0" else "1")
+        return Outcome("accept", digest)
+
+    def _run_att_served(self, case: FuzzCase) -> Outcome:
+        from ..serve import protocol
+
+        params = {"fork": self.fork, "preset": self.preset,
+                  "seed": self._fc_seed,
+                  "attestation": protocol.to_hex(case.block)}
+        try:
+            if self.client is not None:
+                result = self.client.call("fork_choice_attestation", params)
+            else:
+                result = self.service.handle("fork_choice_attestation",
+                                             params)
+        except protocol.RequestError as e:
+            return _serve_att_error_outcome(e.code, e.message)
+        except Exception as e:
+            code = getattr(e, "code", protocol.INTERNAL)
+            return _serve_att_error_outcome(str(code),
+                                            getattr(e, "message", str(e)))
+        return Outcome("accept", str(result.get("latest", "")))
+
+    def execute_attestation(self, case: FuzzCase) -> CaseResult:
+        return CaseResult(case=case, outcomes={
+            "oracle": self._run_att_direct(case, engine_on=False),
+            "engine": self._run_att_direct(case, engine_on=True),
+            "serve": self._run_att_served(case),
+        })
+
     # -- entry point ----------------------------------------------------
 
     def execute(self, case: FuzzCase) -> CaseResult:
+        if case.target == "attestation":
+            return self.execute_attestation(case)
         return CaseResult(case=case, outcomes={
             "oracle": self._run_direct(case, engine_on=False),
             "engine": self._run_direct(case, engine_on=True),
@@ -211,6 +311,19 @@ def _serve_error_outcome(code: str, message: str) -> Outcome:
             return Outcome("undecodable", "pre")
         if "does not decode as BeaconBlock" in message:
             return Outcome("undecodable", "block")
+        m = _SERVE_CLASS_RE.search(message)
+        if m and m.group(1) in {c.__name__ for c in REJECTED}:
+            return Outcome("reject", m.group(1))
+        return Outcome("reject", "uncaught")
+    return Outcome("reject", "uncaught")
+
+
+def _serve_att_error_outcome(code: str, message: str) -> Outcome:
+    from ..serve import protocol
+
+    if code == protocol.BAD_REQUEST:
+        if "does not decode as Attestation" in message:
+            return Outcome("undecodable", "attestation")
         m = _SERVE_CLASS_RE.search(message)
         if m and m.group(1) in {c.__name__ for c in REJECTED}:
             return Outcome("reject", m.group(1))

@@ -82,6 +82,10 @@ class FarmConfig:
     shrink: bool = True
     max_shrink_steps: int = 400
     progress_every: int = 16
+    target: str = "block"            # block | attestation (fork choice)
+    # regression seed records (findings.jsonl format) executed FIRST by
+    # rank 0 in every run — prior findings + the checked-in corpus
+    regression: List[Dict[str, Any]] = field(default_factory=list)
 
 
 @dataclass
@@ -134,7 +138,10 @@ def slice_indices(cfg: FarmConfig, rank: int) -> List[int]:
 def _oracle_only(executor: DifferentialExecutor, case: FuzzCase):
     """The degraded exec: no differential coverage, but the corpus
     position is consumed so resume/merge stay deterministic."""
-    out = executor._run_direct(case, engine_on=False)
+    if case.target == "attestation":
+        out = executor._run_att_direct(case, engine_on=False)
+    else:
+        out = executor._run_direct(case, engine_on=False)
     return CaseResult(case=case, outcomes={
         "oracle": out, "engine": out, "serve": out})
 
@@ -151,6 +158,8 @@ def run_slice(cfg: FarmConfig, rank: int, label: str = "") -> Dict[str, Any]:
     jr = FindingsJournal(out_dir, rank)
     spec = build_spec(cfg.fork, cfg.preset)
     builder = CorpusBuilder(spec, cfg.fork, cfg.preset, cfg.seed)
+    get_case = (builder.attestation_case if cfg.target == "attestation"
+                else builder.case)
 
     was_bls = bls.bls_active
     bls.bls_active = False           # consistent across all three paths
@@ -163,22 +172,74 @@ def run_slice(cfg: FarmConfig, rank: int, label: str = "") -> Dict[str, Any]:
         daemon = ServeDaemon(service).start(warm=False)
         client = ServeClient(daemon.port)
         executor = DifferentialExecutor(spec, cfg.fork, cfg.preset,
-                                        client=client)
+                                        client=client, fc_seed=cfg.seed)
     else:
         executor = DifferentialExecutor(spec, cfg.fork, cfg.preset,
-                                        service=service)
+                                        service=service, fc_seed=cfg.seed)
 
     counts = {"execs": jr.resumed_execs, "degraded_execs": 0,
               "findings": len(jr.findings), "shrunk": len(jr.shrunk),
               "new_findings": 0}
     t0 = time.perf_counter()
+    def _shrink_base(case: FuzzCase) -> bytes:
+        if case.target == "attestation":
+            return builder.att_bases()[case.base_index]
+        return builder.bases()[case.base_index][1]
+
     try:
-        # resume debt first: journaled findings that never got shrunk
+        # regression seeds first (docs/FUZZ.md "Regression seeds"):
+        # rank 0 replays prior findings + the checked-in corpus before
+        # its slice — a fixed divergence that returns is re-journaled
+        # (and re-found) ahead of any new coverage
+        if rank == 0 and cfg.regression:
+            from .regression import regression_cases
+
+            builders = {cfg.seed: builder}
+            for case in regression_cases(cfg.regression, cfg.fork,
+                                         cfg.preset, spec, builders):
+                with obs.span("fuzz.case", rank=rank, kind=case.kind,
+                              regression=True,
+                              muts=",".join(case.mutations)):
+                    result = executor.execute(case)
+                    counts["execs"] += 1
+                    obs.count("fuzz.regression_execs")
+                    div = result.divergence
+                    if div is None:
+                        continue
+                    finding = _finding_record(case, div)
+                    if jr.record_finding(case.case_id, finding):
+                        counts["findings"] += 1
+                        counts["new_findings"] += 1
+                        obs.count("fuzz.findings")
+                        obs.instant("fuzz.finding", case=case.case_id,
+                                    kind=div["kind"], regression=True)
+                        print(f"{label}REGRESSION RETURNED {case.case_id}: "
+                              f"{div['kind']}", file=sys.stderr)
+                    if case.case_id not in jr.shrunk:
+                        # regression payloads are already minimal —
+                        # journal them as-is, never re-shrink
+                        jr.record_shrunk(case.case_id, {
+                            "aborted": False, "steps": 0,
+                            "removed": ["regression: ships as-is"],
+                            "mutations": list(case.mutations),
+                            "block": case.block.hex(),
+                            "size": len(case.block),
+                            "orig_size": len(case.block),
+                            "kind": div["kind"],
+                            "outcomes": div["outcomes"]})
+                        counts["shrunk"] += 1
+
+        # resume debt next: journaled findings that never got shrunk.
+        # Only ids of THIS run's corpus key are reconstructable here —
+        # regression entries from other seeds/targets ship as-is.
+        own_prefix = ("a" if cfg.target == "attestation"
+                      else "f") + f"{cfg.seed:04d}-"
         if cfg.shrink:
             for case_id in jr.unshrunk():
-                case = builder.case(_index_from_id(case_id))
-                base = builder.bases()[case.base_index][1]
-                shrunk = shrink_finding(executor, case, base,
+                if not case_id.startswith(own_prefix):
+                    continue
+                case = get_case(_index_from_id(case_id))
+                shrunk = shrink_finding(executor, case, _shrink_base(case),
                                         max_steps=cfg.max_shrink_steps)
                 jr.record_shrunk(case_id, shrunk)
                 counts["shrunk"] += 1
@@ -186,7 +247,7 @@ def run_slice(cfg: FarmConfig, rank: int, label: str = "") -> Dict[str, Any]:
         pending = [i for i in slice_indices(cfg, rank) if i > jr.watermark]
         since_mark = 0
         for i in pending:
-            case = builder.case(i)
+            case = get_case(i)
 
             def attempt(case: FuzzCase = case):
                 chaos("fuzz.exec")
@@ -217,9 +278,8 @@ def run_slice(cfg: FarmConfig, rank: int, label: str = "") -> Dict[str, Any]:
                               f"({','.join(div['disagrees_with_oracle'])} "
                               f"vs oracle)", file=sys.stderr)
                     if cfg.shrink and case.case_id not in jr.shrunk:
-                        base = builder.bases()[case.base_index][1]
                         shrunk = shrink_finding(
-                            executor, case, base,
+                            executor, case, _shrink_base(case),
                             max_steps=cfg.max_shrink_steps)
                         jr.record_shrunk(case.case_id, shrunk)
                         counts["shrunk"] += 1
@@ -258,6 +318,7 @@ def _finding_record(case: FuzzCase, div: Dict[str, Any]) -> Dict[str, Any]:
         "disagrees_with_oracle": div["disagrees_with_oracle"],
         "outcomes": div["outcomes"],
         "case_kind": case.kind,
+        "target": case.target,
         "mutations": list(case.mutations),
         "base_index": case.base_index,
         "fork": case.fork, "preset": case.preset,

@@ -153,7 +153,10 @@ def merge_findings(out_dir: Path, workers: int) -> Dict[str, Dict[str, Any]]:
             if "finding" in entry:
                 slot.setdefault("finding", entry["finding"])
             if "shrunk" in entry:
-                slot["shrunk"] = entry["shrunk"]
+                # first-wins: shrunk records are pure functions of the
+                # case, and a regression replay's ships-as-is stub must
+                # never clobber an earlier real shrink result
+                slot.setdefault("shrunk", entry["shrunk"])
 
     tmp = out_dir / f"{MERGED_NAME}.merge.{os.getpid()}"
     with open(tmp, "w") as f:

@@ -282,6 +282,121 @@ WRECKAGE_OPS: Dict[str, Callable[[Any, Any, Random], Optional[str]]] = {
 }
 
 
+# ---------------------------------------------------------------------------
+# fork-choice attestation wreckage (in-place on a decoded Attestation):
+# each op drives one rung of on_attestation's rejection ladder —
+# validate_on_attestation's known-root/staleness/ordering asserts and
+# get_indexed_attestation's committee/bits checks (docs/FUZZ.md
+# "Fork-choice intake")
+# ---------------------------------------------------------------------------
+
+
+def att_stale_target(spec: Any, att: Any, rng: Random) -> Optional[str]:
+    """Target epoch behind the wall-clock window (wire staleness)."""
+    att.data.target.epoch = max(0, int(att.data.target.epoch) - rng.randint(2, 5))
+    return f"target.epoch -> {int(att.data.target.epoch)}"
+
+
+def att_future_target(spec: Any, att: Any, rng: Random) -> Optional[str]:
+    """Target epoch ahead of the store clock."""
+    att.data.target.epoch = int(att.data.target.epoch) + rng.randint(2, 4)
+    return f"target.epoch -> {int(att.data.target.epoch)}"
+
+
+def att_epoch_slot_mismatch(spec: Any, att: Any, rng: Random) -> Optional[str]:
+    """target.epoch != compute_epoch_at_slot(att.slot)."""
+    att.data.target.epoch = int(
+        spec.compute_epoch_at_slot(att.data.slot)) + 1
+    return "target.epoch off the slot's epoch"
+
+
+def att_unknown_beacon_root(spec: Any, att: Any, rng: Random) -> Optional[str]:
+    """LMD vote for a block the store has never seen (delay rung)."""
+    root = bytearray(bytes(att.data.beacon_block_root))
+    i = rng.randrange(len(root))
+    root[i] ^= 0xFF
+    att.data.beacon_block_root = bytes(root)
+    return f"beacon_block_root byte {i} flipped"
+
+
+def att_unknown_target_root(spec: Any, att: Any, rng: Random) -> Optional[str]:
+    root = bytearray(bytes(att.data.target.root))
+    i = rng.randrange(len(root))
+    root[i] ^= 0xFF
+    att.data.target.root = bytes(root)
+    return f"target.root byte {i} flipped"
+
+
+def att_future_slot(spec: Any, att: Any, rng: Random) -> Optional[str]:
+    """An attestation for a slot the store clock has not reached
+    ('only affects subsequent slots')."""
+    att.data.slot = int(att.data.slot) + rng.randint(8, 24)
+    att.data.target.epoch = spec.compute_epoch_at_slot(att.data.slot)
+    return f"slot -> {int(att.data.slot)} (future)"
+
+
+def att_overflow_slot(spec: Any, att: Any, rng: Random) -> Optional[str]:
+    att.data.slot = 2**64 - 1
+    return "slot -> 2**64-1"
+
+
+def att_bad_committee_index(spec: Any, att: Any, rng: Random) -> Optional[str]:
+    att.data.index = int(att.data.index) + rng.randint(16, 64)
+    return f"index -> {int(att.data.index)}"
+
+
+def att_zero_bits(spec: Any, att: Any, rng: Random) -> Optional[str]:
+    """No attester set: the indexed attestation comes out empty and
+    is_valid_indexed_attestation must reject it."""
+    bits = [False] * len(att.aggregation_bits)
+    att.aggregation_bits = type(att.aggregation_bits)(bits)
+    return "aggregation_bits zeroed"
+
+
+def att_bits_extend(spec: Any, att: Any, rng: Random) -> Optional[str]:
+    """Bits sized off the committee."""
+    bits = list(att.aggregation_bits) + [True]
+    att.aggregation_bits = type(att.aggregation_bits)(bits)
+    return f"aggregation_bits -> len {len(bits)}"
+
+
+ATT_WRECKAGE_OPS: Dict[str, Callable[[Any, Any, Random], Optional[str]]] = {
+    "att_stale_target": att_stale_target,
+    "att_future_target": att_future_target,
+    "att_epoch_slot_mismatch": att_epoch_slot_mismatch,
+    "att_unknown_beacon_root": att_unknown_beacon_root,
+    "att_unknown_target_root": att_unknown_target_root,
+    "att_future_slot": att_future_slot,
+    "att_overflow_slot": att_overflow_slot,
+    "att_bad_committee_index": att_bad_committee_index,
+    "att_zero_bits": att_zero_bits,
+    "att_bits_extend": att_bits_extend,
+}
+
+
+def apply_att_wreckage(spec: Any, att_bytes: bytes, ops: tuple,
+                       seed: str) -> Optional[bytes]:
+    """The attestation twin of :func:`apply_wreckage`: decode, apply the
+    named ops in order (per-op derived streams), re-encode. None when
+    nothing applied — same shrinker contract."""
+    try:
+        att = spec.Attestation.decode_bytes(att_bytes)
+    except Exception:
+        return None
+    applied = 0
+    for op in ops:
+        try:
+            note = ATT_WRECKAGE_OPS[op](spec, att,
+                                        Random(f"fuzz-wreck:{op}:{seed}"))
+        except Exception:
+            note = None
+        if note is not None:
+            applied += 1
+    if not applied:
+        return None
+    return bytes(att.encode_bytes())
+
+
 def apply_wreckage(spec: Any, block_bytes: bytes, ops: tuple,
                    seed: str) -> Optional[bytes]:
     """Decode the block, apply the named wreckage ops in order (each

@@ -1,0 +1,120 @@
+"""Regression seed corpus for the fuzz farm (docs/FUZZ.md
+"Regression seeds" — ROADMAP #4's named leftover).
+
+Every finding the farm ever shrinks is a test the build once failed;
+this module feeds them back as FIRST-PRIORITY cases so a fixed
+divergence can never silently return:
+
+- ``make fuzz`` loads any prior ``<out>/findings.jsonl`` (the long-haul
+  journal of the same output directory) at the start of every round;
+- the checked-in ``fuzz/regression/*.jsonl`` corpus (findings.jsonl
+  format, committed when a real divergence is fixed) rides along in
+  every run.
+
+A regression record is one findings.jsonl line — ``{"case": <id>,
+"finding": {...}, "shrunk": {...}}``. The executable payload prefers
+the SHRUNK reproducer (minimal by construction) and falls back to the
+raw finding's payload; the pre-state rebuilds from the corpus key
+recorded in the case id (a pure function, so regression cases need no
+state blobs in the repo). Regression cases keep their ORIGINAL case ids, so
+a re-discovered regression dedups against its own journal entry exactly
+like a resumed finding — reruns over a completed directory stay
+idempotent, and a checked-in case that coincides with the round's own
+corpus index is literally the same case.
+"""
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Dict, Iterable, List
+
+from .corpus import CorpusBuilder, FuzzCase
+
+REGRESSION_DIR = Path(__file__).parent / "regression"
+
+
+def load_regression_records(paths: Iterable[Path]) -> List[Dict[str, Any]]:
+    """Findings.jsonl-format records from every existing path, dedup'd
+    by case id, sorted for determinism. Torn lines are skipped (the
+    crash-safe journal contract: at most one torn tail per file)."""
+    by_case: Dict[str, Dict[str, Any]] = {}
+    for path in paths:
+        path = Path(path)
+        if not path.exists():
+            continue
+        with open(path, "rb") as f:
+            for line in f:
+                try:
+                    entry = json.loads(line)
+                except ValueError:
+                    continue
+                case = entry.get("case") if isinstance(entry, dict) else None
+                if not case:
+                    continue
+                record = by_case.setdefault(str(case), {})
+                if "finding" in entry:
+                    record.setdefault("finding", entry["finding"])
+                if "shrunk" in entry:
+                    record["shrunk"] = entry["shrunk"]
+    return [{"case": case, **by_case[case]} for case in sorted(by_case)]
+
+
+def checked_in_paths() -> List[Path]:
+    if not REGRESSION_DIR.is_dir():
+        return []
+    return sorted(REGRESSION_DIR.glob("*.jsonl"))
+
+
+def _seed_of_case_id(case_id: str) -> int:
+    stem = case_id.split("-")[0]
+    return int(stem.lstrip("regrafiuzd") or "0")
+
+
+def regression_cases(records: List[Dict[str, Any]], fork: str, preset: str,
+                     spec: Any,
+                     builders: Dict[int, CorpusBuilder]) -> List[FuzzCase]:
+    """Materialize executable cases from regression records for ONE
+    (fork, preset). Records for other forks/presets are skipped — a
+    farm run only replays what its spec can execute."""
+    cases: List[FuzzCase] = []
+    for record in records:
+        finding = record.get("finding") or {}
+        if not finding:
+            continue
+        if (finding.get("fork", fork) != fork
+                or finding.get("preset", preset) != preset):
+            continue
+        orig_id = str(record["case"]).removeprefix("regr-")
+        shrunk = record.get("shrunk") or {}
+        payload_hex = shrunk.get("block") or finding.get("block")
+        if not payload_hex:
+            continue
+        target = finding.get("target", "block")
+        try:
+            payload = bytes.fromhex(payload_hex)
+        except ValueError:
+            continue
+        seed = _seed_of_case_id(orig_id)
+        builder = builders.get(seed)
+        if builder is None:
+            builder = CorpusBuilder(spec, fork, preset, seed)
+            builders[seed] = builder
+        pre = b""
+        base_index = int(finding.get("base_index", 0))
+        if target == "block":
+            bases = builder.bases()
+            if base_index >= len(bases):
+                continue
+            pre = bases[base_index][0]
+        mutations = tuple(shrunk.get("mutations")
+                          or finding.get("mutations") or ())
+        cases.append(FuzzCase(
+            case_id=orig_id, fork=fork, preset=preset,
+            pre=pre, block=payload, kind=str(finding.get("case_kind",
+                                                         "wreck")),
+            base_index=base_index, mutations=mutations, target=target))
+    return cases
+
+
+__all__ = ["REGRESSION_DIR", "checked_in_paths", "load_regression_records",
+           "regression_cases"]

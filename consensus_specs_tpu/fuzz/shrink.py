@@ -34,7 +34,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from ..resilience import chaos, supervised
 from .corpus import FuzzCase, case_seed
 from .executor import DifferentialExecutor
-from .mutate import apply_wreckage
+from .mutate import apply_att_wreckage, apply_wreckage
 
 MAX_STEPS = 400
 
@@ -91,11 +91,15 @@ class Shrinker:
             return case
         seed = case_seed(case.fork, case.preset, _seed_of(case),
                          _index_of(case))
+        applier = apply_wreckage
+        if case.target == "attestation":
+            applier = apply_att_wreckage
+            seed += ":att"
         for op in list(ops):
             trial_ops = tuple(o for o in ops if o != op)
             if not trial_ops:
                 continue
-            blk = apply_wreckage(self.executor.spec, base_block, trial_ops, seed)
+            blk = applier(self.executor.spec, base_block, trial_ops, seed)
             if blk is None:
                 continue
             trial = replace(case, block=blk, mutations=trial_ops)
@@ -107,7 +111,10 @@ class Shrinker:
 
     def _shrink_fields(self, case: FuzzCase, want,
                        removed: List[str]) -> FuzzCase:
-        """Field-level minimization on a decodable block."""
+        """Field-level minimization on a decodable block (block targets
+        only; attestation payloads shrink by subset + byte passes)."""
+        if case.target != "block":
+            return case
         spec = self.executor.spec
         try:
             block = spec.BeaconBlock.decode_bytes(case.block)
@@ -219,9 +226,12 @@ def shrink_finding(executor: DifferentialExecutor, case: FuzzCase,
     if base_block is not None:
         shrunk = sh._shrink_mutations(shrunk, base_block, want, removed)
     shrunk = sh._shrink_fields(shrunk, want, removed)
+    decode_type = (executor.spec.Attestation
+                   if case.target == "attestation"
+                   else executor.spec.BeaconBlock)
     decodable = True
     try:
-        executor.spec.BeaconBlock.decode_bytes(shrunk.block)
+        decode_type.decode_bytes(shrunk.block)
     except Exception:
         decodable = False
     if not decodable and base_block is not None:

@@ -58,6 +58,21 @@ Sites are plain strings; the convention is plane.point:
   sim.epoch (every chain-simulator epoch rollover; a deterministic
              fault parks the REMAINDER of the run on the oracle path —
              the circuit-breaker response at epoch granularity)
+  sim.net  (every non-lossless edge schedule of the partitioned sim's
+            adversarial bus — docs/SIM.md "Partitioned network":
+            transient=the pure schedule computation retries and the
+            message REDELIVERS identically (the chain cannot move);
+            deterministic=the edge quarantines to LOSSLESS delivery
+            (the always-correct degradation: a perfect link) with a
+            recorded event — the run stays live and convergent)
+  sim.checkpoint (top of every crash-consistent snapshot attempt —
+            docs/SIM.md "Checkpoint/resume": transient=retried write;
+            deterministic=the boundary is SKIPPED with a recorded
+            event and the next boundary retries — a faulted snapshot
+            must never corrupt or stall the run)
+  sim.checkpoint.write (between payload files INSIDE the snapshot tmp
+            dir: the kill-mid-snapshot drill's SIGKILL site — a torn
+            tmp dir must be invisible to --resume)
   fuzz.exec (top of every fuzz-farm case execution, INSIDE the forked
              worker — docs/FUZZ.md: transient=the case retries (cases
              are pure functions, a retry is safe); deterministic=the

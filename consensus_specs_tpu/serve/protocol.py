@@ -57,7 +57,8 @@ API_PREFIX = f"/v{WIRE_VERSION}"
 # method name -> route (POST). GET routes: /metrics /healthz /readyz
 # /debug/requests /debug/slowest
 METHODS = ("verify", "verify_batch", "hash_tree_root",
-           "hash_tree_root_batch", "process_block")
+           "hash_tree_root_batch", "process_block",
+           "fork_choice_attestation")
 
 # introspection surface: scraped by monitors, never served traffic —
 # excluded from serve.request_ms accounting, the flight recorder, and

@@ -70,6 +70,10 @@ class SpecService:
         self.batcher = batcher or VerifyBatcher()
         self.request_timeout_s = request_timeout_s
         self._matrix: Dict[Tuple[str, str], Any] = {}
+        # fork-choice anchor stores for fork_choice_attestation, keyed
+        # (fork, preset, seed) — built lazily, shared read-only across
+        # requests via fresh per-request views
+        self._fc_anchors: Dict[Tuple, Any] = {}
         self._build_lock = threading.Lock()
         self.started_at = time.time()
         self.ready = False
@@ -250,6 +254,44 @@ class SpecService:
                                        f"process_block: {e!r}")
         return {"post": protocol.to_hex(state.encode_bytes()),
                 "root": protocol.to_hex(state.hash_tree_root())}
+
+    def _do_fork_choice_attestation(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        """Fork-choice intake as a served method (docs/FUZZ.md
+        "Fork-choice intake"): run ``on_attestation`` against the seeded
+        anchor store context — the same pure function of
+        ``(fork, preset, seed)`` the fuzz executor's direct paths build —
+        and answer the normalized latest-message digest. Wire params:
+        ``fork``/``preset``/``seed``/``attestation`` (hex). Rejections
+        classify on exactly the shared ladder so the served path can
+        never diverge from the oracle on error surface alone."""
+        from ..fuzz.corpus import build_fc_store
+        from ..fuzz.executor import fresh_store_view, latest_messages_digest
+
+        spec = self._spec(params)
+        seed = params.get("seed", 1)
+        if not isinstance(seed, int) or isinstance(seed, bool):
+            raise protocol.bad_request("seed: expected an integer")
+        att_bytes = protocol.from_hex(params.get("attestation"), "attestation")
+        try:
+            att = spec.Attestation.decode_bytes(att_bytes)
+        except Exception as e:
+            raise protocol.bad_request(
+                f"attestation: does not decode as Attestation ({e})")
+        key = (spec.fork, params.get("preset"), seed)
+        with self._build_lock:
+            anchor = self._fc_anchors.get(key)
+            if anchor is None:
+                anchor = build_fc_store(spec, seed)
+                self._fc_anchors[key] = anchor
+        store = fresh_store_view(spec, anchor)
+        try:
+            spec.on_attestation(store, att, is_from_block=False)
+        except PROCESS_BLOCK_REJECTED as e:
+            raise protocol.bad_request(
+                f"attestation rejected by {spec.fork} "
+                f"on_attestation: {e!r}")
+        return {"accepted": True,
+                "latest": latest_messages_digest(store)}
 
     # -- health --------------------------------------------------------
 

@@ -36,7 +36,21 @@ the perf ledger by ``bench.py --section chain_sim`` and
 """
 from __future__ import annotations
 
+from .checkpoint import SnapshotManager  # noqa: F401
 from .driver import ChainSim, SimResult, run_differential, run_sim  # noqa: F401
+from .net import (  # noqa: F401
+    MessageBus,
+    NetConfig,
+    PartitionWindow,
+    default_partitions,
+)
+from .partition import (  # noqa: F401
+    PartitionConfig,
+    PartitionedChainSim,
+    PartitionedResult,
+    run_partitioned,
+    run_partitioned_differential,
+)
 from .scenario import (  # noqa: F401
     SEED_ENV,
     ForkWindow,
@@ -50,11 +64,21 @@ __all__ = [
     "SEED_ENV",
     "ChainSim",
     "ForkWindow",
+    "MessageBus",
+    "NetConfig",
+    "PartitionConfig",
+    "PartitionWindow",
+    "PartitionedChainSim",
+    "PartitionedResult",
     "Scenario",
     "ScenarioConfig",
     "SimResult",
     "SlotPlan",
+    "SnapshotManager",
+    "default_partitions",
     "run_differential",
+    "run_partitioned",
+    "run_partitioned_differential",
     "run_sim",
     "seed_from_env",
 ]

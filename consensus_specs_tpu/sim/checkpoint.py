@@ -1,0 +1,279 @@
+"""Crash-consistent checkpoint/resume for the partitioned chain sim
+(docs/SIM.md "Checkpoint/resume").
+
+A multi-hour simulated day used to die unrecoverable at the first
+SIGKILL; this module makes any such run resumable to a byte-identical
+final chain. Every K epochs the driver hands over its full serializable
+state (``PartitionedChainSim.state_payload()`` — per-node Stores +
+head-state caches' source of truth, the bus's in-flight queue and
+cursors, scenario/equivocator stream positions, stats) and the manager
+lands it with the same discipline as the generator journal
+(resilience/journal.py):
+
+1. everything is written into a ``snap-<slot>.tmp.<pid>`` directory,
+   each file fsync'd;
+2. a ``MANIFEST.json`` with a sha256 per payload file is written LAST
+   and fsync'd — a snapshot without a valid manifest does not exist;
+3. the tmp dir is atomically renamed to ``snap-<slot>`` and the parent
+   directory fsync'd;
+4. older snapshots beyond ``keep`` are deleted only after the rename
+   lands.
+
+A SIGKILL at ANY point therefore leaves either the previous snapshots
+untouched (torn tmp dirs are ignored and swept) or the new one fully
+committed. Loading walks snapshots newest-first and **verifies every
+digest**: a tampered or truncated snapshot is rejected with a recorded
+event and the loader rolls back to the previous one — corruption can
+cost progress, never correctness.
+
+Chaos site ``sim.checkpoint`` (docs/RESILIENCE.md): fires at the top of
+every snapshot attempt. Transient faults retry the write (the payload
+is a pure function of sim state — safe); a deterministic fault SKIPS
+this boundary with a recorded event and the run continues unscathed —
+a faulted snapshot must never corrupt or stall the run; the next
+boundary simply tries again. ``sim.checkpoint.write`` fires between
+payload file writes inside the tmp dir, which is where the
+kill-mid-snapshot drill lands its SIGKILL.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import shutil
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+from .. import obs
+from ..obs import metrics
+from ..resilience import chaos, record_event, supervised
+
+SNAP_RE = re.compile(r"^snap-(\d{8})$")
+MANIFEST = "MANIFEST.json"
+PAYLOAD_FILES = ("meta.json", "nodes.json", "bus.json")
+
+
+# ---------------------------------------------------------------------------
+# Store (de)serialization — SSZ bytes + JSON scalars, no pickling
+# ---------------------------------------------------------------------------
+
+
+def store_to_dict(spec: Any, store: Any) -> Dict[str, Any]:
+    """One fork-choice Store as a JSON-able dict (SSZ payloads hex)."""
+    def cp(c) -> Dict[str, Any]:
+        return {"epoch": int(c.epoch), "root": bytes(c.root).hex()}
+
+    return {
+        "time": int(store.time),
+        "genesis_time": int(store.genesis_time),
+        "justified_checkpoint": cp(store.justified_checkpoint),
+        "finalized_checkpoint": cp(store.finalized_checkpoint),
+        "best_justified_checkpoint": cp(store.best_justified_checkpoint),
+        "proposer_boost_root": bytes(store.proposer_boost_root).hex(),
+        "equivocating_indices": sorted(int(i)
+                                       for i in store.equivocating_indices),
+        "blocks": {bytes(r).hex(): bytes(b.encode_bytes()).hex()
+                   for r, b in store.blocks.items()},
+        "block_states": {bytes(r).hex(): bytes(s.encode_bytes()).hex()
+                         for r, s in store.block_states.items()},
+        "checkpoint_states": [
+            {"epoch": int(c.epoch), "root": bytes(c.root).hex(),
+             "state": bytes(s.encode_bytes()).hex()}
+            for c, s in store.checkpoint_states.items()],
+        "latest_messages": {
+            str(int(i)): {"epoch": int(m.epoch),
+                          "root": bytes(m.root).hex()}
+            for i, m in store.latest_messages.items()},
+    }
+
+
+def store_from_dict(spec: Any, d: Dict[str, Any]) -> Any:
+    def cp(e) -> Any:
+        return spec.Checkpoint(epoch=spec.Epoch(e["epoch"]),
+                               root=spec.Root(bytes.fromhex(e["root"])))
+
+    store = spec.Store(
+        time=spec.uint64(d["time"]),
+        genesis_time=spec.uint64(d["genesis_time"]),
+        justified_checkpoint=cp(d["justified_checkpoint"]),
+        finalized_checkpoint=cp(d["finalized_checkpoint"]),
+        best_justified_checkpoint=cp(d["best_justified_checkpoint"]),
+        proposer_boost_root=spec.Root(
+            bytes.fromhex(d["proposer_boost_root"])),
+        equivocating_indices=set(
+            spec.ValidatorIndex(i) for i in d["equivocating_indices"]),
+    )
+    for root_hex, block_hex in d["blocks"].items():
+        store.blocks[spec.Root(bytes.fromhex(root_hex))] = (
+            spec.BeaconBlock.decode_bytes(bytes.fromhex(block_hex)))
+    for root_hex, state_hex in d["block_states"].items():
+        store.block_states[spec.Root(bytes.fromhex(root_hex))] = (
+            spec.BeaconState.decode_bytes(bytes.fromhex(state_hex)))
+    for entry in d["checkpoint_states"]:
+        c = spec.Checkpoint(epoch=spec.Epoch(entry["epoch"]),
+                            root=spec.Root(bytes.fromhex(entry["root"])))
+        store.checkpoint_states[c] = spec.BeaconState.decode_bytes(
+            bytes.fromhex(entry["state"]))
+    for idx, m in d["latest_messages"].items():
+        store.latest_messages[spec.ValidatorIndex(int(idx))] = (
+            spec.LatestMessage(epoch=spec.Epoch(m["epoch"]),
+                               root=spec.Root(bytes.fromhex(m["root"]))))
+    return store
+
+
+# ---------------------------------------------------------------------------
+# snapshot manager
+# ---------------------------------------------------------------------------
+
+
+def _fsync_dir(path: Path) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _write_json(path: Path, obj: Any) -> str:
+    data = json.dumps(obj, sort_keys=True).encode()
+    with open(path, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    return hashlib.sha256(data).hexdigest()
+
+
+class SnapshotManager:
+    """Owns one checkpoint directory: atomic snapshot writes, digest-
+    verified loads with rollback, bounded retention."""
+
+    def __init__(self, directory: os.PathLike, keep: int = 2) -> None:
+        self.dir = Path(directory)
+        self.keep = max(1, keep)
+        self.dir.mkdir(parents=True, exist_ok=True)
+
+    # -- write ----------------------------------------------------------
+
+    def maybe_snapshot(self, sim: Any, slot: int) -> bool:
+        """Snapshot under the resilience supervisor. Returns True when a
+        snapshot landed; False when this boundary was skipped (the
+        degradation contract: a faulted snapshot never corrupts or
+        stalls the run)."""
+
+        def attempt() -> bool:
+            chaos("sim.checkpoint")
+            with obs.span("sim.checkpoint.write", slot=slot):
+                self._write(sim.state_payload(), slot)
+            return True
+
+        def degraded() -> bool:
+            metrics.count("sim.checkpoint.skipped")
+            record_event("fallback", domain="sim.checkpoint",
+                         capability="sim.checkpoint",
+                         detail=f"snapshot at slot {slot} skipped; next "
+                                "boundary will retry")
+            obs.instant("sim.checkpoint.skipped", slot=slot)
+            return False
+
+        return bool(supervised(attempt, domain="sim.checkpoint",
+                               capability="sim.checkpoint",
+                               fallback=degraded))
+
+    def _write(self, payload: Dict[str, Any], slot: int) -> Path:
+        final = self.dir / f"snap-{slot:08d}"
+        tmp = self.dir / f"snap-{slot:08d}.tmp.{os.getpid()}"
+        if tmp.exists():
+            shutil.rmtree(tmp)
+        tmp.mkdir(parents=True)
+        meta = {k: payload[k] for k in payload if k not in ("nodes", "bus")}
+        digests = {"meta.json": _write_json(tmp / "meta.json", meta)}
+        # the kill-mid-snapshot drill lands HERE: a torn tmp dir with a
+        # committed meta but no manifest must be invisible to resume
+        chaos("sim.checkpoint.write")
+        digests["nodes.json"] = _write_json(tmp / "nodes.json",
+                                            payload["nodes"])
+        digests["bus.json"] = _write_json(tmp / "bus.json", payload["bus"])
+        _write_json(tmp / MANIFEST, {"slot": slot, "files": digests})
+        _fsync_dir(tmp)
+        if final.exists():
+            shutil.rmtree(final)
+        os.replace(tmp, final)
+        _fsync_dir(self.dir)
+        self._sweep()
+        metrics.count("sim.checkpoint.written")
+        obs.instant("sim.checkpoint.written", slot=slot)
+        return final
+
+    def _sweep(self) -> None:
+        """Drop torn tmp dirs and snapshots beyond the retention bound
+        (never the ones we may still roll back to)."""
+        for entry in self.dir.iterdir():
+            if ".tmp." in entry.name and entry.is_dir():
+                shutil.rmtree(entry, ignore_errors=True)
+        snaps = self.snapshots()
+        for slot, path in snaps[:-self.keep]:
+            shutil.rmtree(path, ignore_errors=True)
+
+    # -- read -----------------------------------------------------------
+
+    def snapshots(self) -> List[Tuple[int, Path]]:
+        out = []
+        for entry in sorted(self.dir.iterdir()):
+            m = SNAP_RE.match(entry.name)
+            if m and entry.is_dir():
+                out.append((int(m.group(1)), entry))
+        return out
+
+    def _verify(self, path: Path) -> Optional[Dict[str, Any]]:
+        """Digest-checked load of one snapshot; None when anything is
+        missing, torn, or tampered."""
+        try:
+            manifest = json.loads((path / MANIFEST).read_bytes())
+            files = manifest["files"]
+        except (OSError, ValueError, KeyError):
+            return None
+        parts: Dict[str, Any] = {}
+        for name in PAYLOAD_FILES:
+            want = files.get(name)
+            if want is None:
+                return None
+            try:
+                data = (path / name).read_bytes()
+            except OSError:
+                return None
+            if hashlib.sha256(data).hexdigest() != want:
+                return None
+            try:
+                parts[name] = json.loads(data)
+            except ValueError:
+                return None
+        payload = dict(parts["meta.json"])
+        payload["nodes"] = parts["nodes.json"]
+        payload["bus"] = parts["bus.json"]
+        return payload
+
+    def load_latest(self) -> Optional[Tuple[int, Dict[str, Any]]]:
+        """The newest VALID snapshot — tampered/truncated candidates are
+        rejected with a recorded event and the loader rolls back to the
+        previous one."""
+        for slot, path in reversed(self.snapshots()):
+            payload = self._verify(path)
+            if payload is not None:
+                obs.instant("sim.checkpoint.loaded", slot=slot)
+                metrics.count("sim.checkpoint.loaded")
+                return slot, payload
+            metrics.count("sim.checkpoint.rejected")
+            record_event("fault", domain="sim.checkpoint",
+                         capability="sim.checkpoint",
+                         kind="deterministic",
+                         detail=f"snapshot {path.name} failed digest "
+                                "verification; rolling back")
+            obs.instant("sim.checkpoint.rejected", snapshot=path.name)
+        return None
+
+
+__all__ = ["SnapshotManager", "store_from_dict", "store_to_dict"]

@@ -60,6 +60,49 @@ ENGINE_MODES = ("interpreted", "vectorized")
 _REJECTED = (AssertionError, KeyError, IndexError, ValueError)
 
 
+def attestation_includable(spec, state, att) -> bool:
+    """``process_attestation``'s rejection ladder (minus the signature,
+    which the builder already made valid) against a proposal state —
+    anything passing here is includable on that branch. Shared by the
+    single-node and partitioned drivers."""
+    data = att.data
+    try:
+        assert data.target.epoch in (spec.get_previous_epoch(state),
+                                     spec.get_current_epoch(state))
+        assert data.target.epoch == spec.compute_epoch_at_slot(data.slot)
+        assert (data.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY
+                <= state.slot <= data.slot + spec.SLOTS_PER_EPOCH)
+        assert data.index < spec.get_committee_count_per_slot(state, data.target.epoch)
+        committee = spec.get_beacon_committee(state, data.slot, data.index)
+        assert len(att.aggregation_bits) == len(committee)
+        if hasattr(state, "current_epoch_participation"):
+            spec.get_attestation_participation_flag_indices(
+                state, data, state.slot - data.slot)
+        elif data.target.epoch == spec.get_current_epoch(state):
+            assert data.source == state.current_justified_checkpoint
+        else:
+            assert data.source == state.previous_justified_checkpoint
+        return True
+    except _REJECTED:
+        return False
+
+
+def slashing_includable(spec, state, slashing) -> bool:
+    """``process_attester_slashing``'s preconditions against a proposal
+    state (shared by both drivers)."""
+    try:
+        att_1, att_2 = slashing.attestation_1, slashing.attestation_2
+        assert spec.is_slashable_attestation_data(att_1.data, att_2.data)
+        assert spec.is_valid_indexed_attestation(state, att_1)
+        assert spec.is_valid_indexed_attestation(state, att_2)
+        epoch = spec.get_current_epoch(state)
+        indices = set(att_1.attesting_indices) & set(att_2.attesting_indices)
+        return any(spec.is_slashable_validator(state.validators[i], epoch)
+                   for i in indices)
+    except _REJECTED:
+        return False
+
+
 @dataclass
 class SimResult:
     engine: str
@@ -214,44 +257,10 @@ class ChainSim:
         return True
 
     def _includable(self, state, att) -> bool:
-        """process_attestation's rejection ladder (minus the signature,
-        which the builder already made valid) against the proposal state
-        — anything passing here is includable on that branch."""
-        spec = self.spec
-        data = att.data
-        try:
-            assert data.target.epoch in (spec.get_previous_epoch(state),
-                                         spec.get_current_epoch(state))
-            assert data.target.epoch == spec.compute_epoch_at_slot(data.slot)
-            assert (data.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY
-                    <= state.slot <= data.slot + spec.SLOTS_PER_EPOCH)
-            assert data.index < spec.get_committee_count_per_slot(state, data.target.epoch)
-            committee = spec.get_beacon_committee(state, data.slot, data.index)
-            assert len(att.aggregation_bits) == len(committee)
-            if hasattr(state, "current_epoch_participation"):
-                spec.get_attestation_participation_flag_indices(
-                    state, data, state.slot - data.slot)
-            elif data.target.epoch == spec.get_current_epoch(state):
-                assert data.source == state.current_justified_checkpoint
-            else:
-                assert data.source == state.previous_justified_checkpoint
-            return True
-        except _REJECTED:
-            return False
+        return attestation_includable(self.spec, state, att)
 
     def _slashing_includable(self, state, slashing) -> bool:
-        spec = self.spec
-        try:
-            att_1, att_2 = slashing.attestation_1, slashing.attestation_2
-            assert spec.is_slashable_attestation_data(att_1.data, att_2.data)
-            assert spec.is_valid_indexed_attestation(state, att_1)
-            assert spec.is_valid_indexed_attestation(state, att_2)
-            epoch = spec.get_current_epoch(state)
-            indices = set(att_1.attesting_indices) & set(att_2.attesting_indices)
-            return any(spec.is_slashable_validator(state.validators[i], epoch)
-                       for i in indices)
-        except _REJECTED:
-            return False
+        return slashing_includable(self.spec, state, slashing)
 
     # -- per-slot mechanics -------------------------------------------------
 

@@ -1,0 +1,382 @@
+"""Seeded adversarial message bus for the partitioned chain simulator
+(docs/SIM.md "Partitioned network").
+
+The single-node sim (sim/driver.py) feeds one Store with perfect
+in-order delivery — the one condition fork choice exists to survive is
+the one it never produces. This module is the missing network: N
+simulated nodes exchange blocks, attestations and slashing evidence
+through a bus whose every decision — drop, delay, duplicate, reorder,
+partition cut — is a pure function of ``(seed, slot, edge, seq,
+attempt)``. Nothing is drawn from wall clocks, delivery history, or
+chain state, so a run is byte-reproducible and any prefix of it can be
+resumed from a checkpoint (sim/checkpoint.py) with the remaining
+deliveries identical to an uninterrupted run.
+
+Delivery semantics per edge ``src -> dst``:
+
+- a **timely block** (no drop, no delay dice) arrives the SAME slot in
+  the mid-slot phase — after the destination's own proposal, before its
+  attesters vote — exactly the mainnet timing attestation deadlines and
+  proposer boost are built around (attesters must see the block or FFG
+  participation starves); attestations and slashing evidence base at
+  next slot (the aggregation interval);
+- **drop** re-broadcasts: the attempt is lost and a retransmit is
+  scheduled ``retransmit_delay`` slots later (gossip + sync in real
+  clients); after ``max_attempts`` the message delivers unconditionally
+  — the bus is lossy but *eventually reliable*, which is what makes the
+  post-heal convergence bound provable rather than probabilistic;
+- **delay** defers delivery up to ``delay_max`` extra slots;
+- **duplicate** schedules a second copy (duplicate intake must ride the
+  spec's own idempotence, not a bus-side dedup);
+- **reorder**: everything due at one ``(slot, dst)`` is shuffled by a
+  seeded stream before intake;
+- **partition**: while a :class:`PartitionWindow` covers the send slot
+  and the edge crosses the group cut, the message is HELD and delivered
+  shortly after the heal (the mail the reconnecting peers exchange).
+
+Chaos site ``sim.net`` (docs/RESILIENCE.md): fires on every non-lossless
+edge schedule. A transient fault retries the pure schedule computation —
+the message is redelivered identically, so the chain cannot move. A
+deterministic fault QUARANTINES the edge to lossless delivery (the
+always-correct degradation: a perfectly reliable link) with a recorded
+event; with the breaker open, every later edge degrades the same way as
+it next sends. Either way the run stays live and convergent.
+"""
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from .. import obs
+from ..obs import metrics
+from ..resilience import chaos, record_event, supervised
+
+# message kinds on the wire (serialization dispatch for checkpointing)
+KIND_BLOCK = "block"
+KIND_ATTESTATION = "attestation"
+KIND_SLASHING = "slashing"
+
+# intra-slot delivery phases: TOP = before the destination's proposal
+# (the ordinary intake), MID = after proposals, before attestations
+# (where timely same-slot blocks land)
+PHASE_TOP = 0
+PHASE_MID = 1
+
+
+@dataclass(frozen=True)
+class PartitionWindow:
+    """One scheduled partition episode: between ``start`` and ``end``
+    (inclusive) the node set is split into ``groups``; edges crossing
+    the cut hold their traffic until shortly after the heal."""
+
+    start: int
+    end: int
+    groups: Tuple[Tuple[int, ...], ...]
+
+    def group_of(self, node: int) -> int:
+        for gi, members in enumerate(self.groups):
+            if node in members:
+                return gi
+        return -1
+
+    def crosses(self, src: int, dst: int) -> bool:
+        return self.group_of(src) != self.group_of(dst)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"start": self.start, "end": self.end,
+                "groups": [list(g) for g in self.groups]}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "PartitionWindow":
+        return cls(start=int(d["start"]), end=int(d["end"]),
+                   groups=tuple(tuple(int(n) for n in g)
+                                for g in d["groups"]))
+
+
+@dataclass(frozen=True)
+class NetConfig:
+    """Adversarial-delivery knobs. Defaults give a lossy, reordering
+    network that still converges within a couple of epochs of a heal."""
+
+    seed: int = 0
+    nodes: int = 3
+    p_drop: float = 0.08
+    p_delay: float = 0.12
+    delay_max: int = 2
+    p_duplicate: float = 0.06
+    max_attempts: int = 3          # drops before unconditional delivery
+    retransmit_delay: int = 2      # slots between re-broadcast attempts
+    heal_spread: int = 2           # held mail lands within this many slots
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"seed": self.seed, "nodes": self.nodes,
+                "p_drop": self.p_drop, "p_delay": self.p_delay,
+                "delay_max": self.delay_max,
+                "p_duplicate": self.p_duplicate,
+                "max_attempts": self.max_attempts,
+                "retransmit_delay": self.retransmit_delay,
+                "heal_spread": self.heal_spread}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "NetConfig":
+        return cls(seed=int(d["seed"]), nodes=int(d["nodes"]),
+                   p_drop=float(d["p_drop"]), p_delay=float(d["p_delay"]),
+                   delay_max=int(d["delay_max"]),
+                   p_duplicate=float(d["p_duplicate"]),
+                   max_attempts=int(d["max_attempts"]),
+                   retransmit_delay=int(d["retransmit_delay"]),
+                   heal_spread=int(d["heal_spread"]))
+
+
+def default_partitions(seed: int, slots: int, nodes: int,
+                       count: int = 2) -> Tuple[PartitionWindow, ...]:
+    """The scheduled partition plan: ``count`` non-overlapping windows,
+    each splitting the node set in two — a pure function of
+    ``(seed, slots, nodes, count)``."""
+    if nodes < 2 or slots < 64:
+        return ()
+    rng = random.Random(f"sim-net:{seed}:partitions:{slots}:{nodes}:{count}")
+    windows: List[PartitionWindow] = []
+    # leave the first two epochs clean (the chain needs a justified
+    # base) and the tail clear so the final heal can converge in-run
+    lo, hi = 20, slots - 28
+    if hi <= lo:
+        return ()
+    span = (hi - lo) // max(1, count)
+    if span < 14:
+        count = max(1, (hi - lo) // 14)
+        span = (hi - lo) // count
+    for i in range(count):
+        seg_lo = lo + i * span
+        length = rng.randint(10, min(18, max(10, span - 4)))
+        if seg_lo + length >= hi:
+            break
+        start = seg_lo + rng.randint(0, max(1, span - length - 2))
+        ids = list(range(nodes))
+        rng.shuffle(ids)
+        cut = rng.randint(1, nodes - 1)
+        windows.append(PartitionWindow(
+            start=start, end=start + length - 1,
+            groups=(tuple(sorted(ids[:cut])), tuple(sorted(ids[cut:])))))
+    return tuple(windows)
+
+
+@dataclass
+class _Entry:
+    """One scheduled delivery."""
+
+    deliver_slot: int
+    dst: int
+    src: int
+    kind: str
+    seq: int
+    obj: Any
+    phase: int = PHASE_TOP
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"deliver_slot": self.deliver_slot, "dst": self.dst,
+                "src": self.src, "kind": self.kind, "seq": self.seq,
+                "phase": self.phase,
+                "ssz": bytes(self.obj.encode_bytes()).hex()}
+
+
+# decoder table built per spec module (kind -> SSZ type attr)
+_KIND_TYPES = {KIND_BLOCK: "SignedBeaconBlock",
+               KIND_ATTESTATION: "Attestation",
+               KIND_SLASHING: "AttesterSlashing"}
+
+
+class MessageBus:
+    """The seeded adversarial bus. One instance per run; fully
+    serializable (``state_dict``/``restore_state``) so a checkpointed
+    run resumes with identical in-flight traffic."""
+
+    def __init__(self, config: NetConfig,
+                 partitions: Tuple[PartitionWindow, ...] = ()) -> None:
+        self.config = config
+        self.partitions = tuple(partitions)
+        self.queue: List[_Entry] = []
+        self.seq = 0
+        self.lossless_edges: Set[Tuple[int, int]] = set()
+        self.stats: Dict[str, int] = {
+            "sent": 0, "delivered": 0, "dropped_attempts": 0,
+            "delayed": 0, "duplicated": 0, "held": 0,
+            "quarantined_edges": 0,
+        }
+
+    # -- partition plan -------------------------------------------------
+
+    def window_at(self, slot: int) -> Optional[PartitionWindow]:
+        for w in self.partitions:
+            if w.start <= slot <= w.end:
+                return w
+        return None
+
+    # -- sending --------------------------------------------------------
+
+    def send(self, slot: int, src: int, kind: str, obj: Any,
+             extra_delay: int = 0) -> None:
+        """Broadcast ``obj`` from ``src`` to every other node through
+        the per-edge adversarial schedule."""
+        seq = self.seq
+        self.seq += 1
+        self.stats["sent"] += 1
+        for dst in range(self.config.nodes):
+            if dst == src:
+                continue
+            self._schedule_edge(slot, src, dst, kind, obj, seq, extra_delay)
+
+    def _schedule_edge(self, slot: int, src: int, dst: int, kind: str,
+                       obj: Any, seq: int, extra_delay: int) -> None:
+        edge = (src, dst)
+        if edge in self.lossless_edges:
+            # a quarantined edge is a perfect link: blocks timely
+            # (same-slot mid-phase), everything else next slot
+            if kind == KIND_BLOCK and extra_delay == 0:
+                self.queue.append(_Entry(slot, dst, src, kind, seq, obj,
+                                         PHASE_MID))
+            else:
+                self.queue.append(_Entry(slot + 1 + extra_delay, dst, src,
+                                         kind, seq, obj))
+            return
+
+        def attempt() -> List[Tuple[int, int]]:
+            # transient faults retry this pure computation — the
+            # message is redelivered on an identical schedule
+            chaos("sim.net")
+            return self._plan_edge(slot, src, dst, kind, seq, extra_delay)
+
+        def degraded() -> List[Tuple[int, int]]:
+            # deterministic fault: the edge is quarantined to lossless
+            # delivery — the always-correct network
+            if edge not in self.lossless_edges:
+                self.lossless_edges.add(edge)
+                self.stats["quarantined_edges"] += 1
+                metrics.count("sim.net.quarantined_edges")
+                record_event("fallback", domain="sim.net",
+                             capability="sim.net",
+                             detail=f"edge {src}->{dst} quarantined to "
+                                    "lossless delivery")
+                obs.instant("sim.net.edge_quarantined", src=src, dst=dst,
+                            slot=slot)
+            base = ((slot, PHASE_MID)
+                    if kind == KIND_BLOCK and extra_delay == 0
+                    else (slot + 1 + extra_delay, PHASE_TOP))
+            return [base]
+
+        plans = supervised(attempt, domain="sim.net", capability="sim.net",
+                           fallback=degraded)
+        for deliver, phase in plans:
+            self.queue.append(_Entry(deliver, dst, src, kind, seq, obj,
+                                     phase))
+
+    def _plan_edge(self, send_slot: int, src: int, dst: int, kind: str,
+                   seq: int, extra_delay: int,
+                   attempt: int = 0) -> List[Tuple[int, int]]:
+        """Delivery ``(slot, phase)`` plan for one edge transmission — a
+        pure function of ``(seed, send_slot, edge, kind, seq, attempt)``."""
+        cfg = self.config
+        rng = random.Random(f"sim-net:{cfg.seed}:{send_slot}:{src}>{dst}:"
+                            f"{seq}:{attempt}")
+        late_base = send_slot + 1 + extra_delay
+        window = self.window_at(send_slot)
+        if window is not None and window.crosses(src, dst):
+            # held across the cut: delivered shortly after the heal
+            self.stats["held"] += 1
+            metrics.count("sim.net.held")
+            return [(window.end + 1 + rng.randint(0, cfg.heal_spread),
+                     PHASE_TOP)]
+        r = rng.random()
+        if r < cfg.p_drop and attempt < cfg.max_attempts:
+            # this attempt is lost; a re-broadcast fires later (bounded:
+            # after max_attempts the message delivers unconditionally)
+            self.stats["dropped_attempts"] += 1
+            metrics.count("sim.net.dropped")
+            return self._plan_edge(send_slot + cfg.retransmit_delay, src,
+                                   dst, kind, seq, extra_delay, attempt + 1)
+        if r < cfg.p_drop + cfg.p_delay:
+            self.stats["delayed"] += 1
+            metrics.count("sim.net.delayed")
+            deliver = (late_base + rng.randint(1, cfg.delay_max), PHASE_TOP)
+        elif (kind == KIND_BLOCK and attempt == 0 and extra_delay == 0):
+            # a timely block crosses the wire within its own slot and
+            # lands mid-slot — after dst's proposal, before its
+            # attesters vote (the mainnet attestation-deadline timing)
+            deliver = (send_slot, PHASE_MID)
+        else:
+            deliver = (late_base, PHASE_TOP)
+        out = [deliver]
+        if rng.random() < cfg.p_duplicate:
+            self.stats["duplicated"] += 1
+            metrics.count("sim.net.duplicated")
+            out.append((deliver[0] + rng.randint(0, 1), PHASE_TOP))
+        return out
+
+    # -- delivery -------------------------------------------------------
+
+    def deliveries(self, slot: int, dst: int,
+                   phase: int = PHASE_TOP) -> List[Tuple[str, Any, int]]:
+        """Everything due for ``dst`` at ``slot``/``phase``,
+        adversarially reordered by a seeded shuffle. Returns
+        ``(kind, obj, src)``. Anything from an earlier slot is due at
+        the TOP phase regardless of its scheduled phase."""
+        def due_now(e: _Entry) -> bool:
+            if e.dst != dst:
+                return False
+            if e.deliver_slot < slot:
+                return phase == PHASE_TOP
+            return e.deliver_slot == slot and e.phase == phase
+        due = [e for e in self.queue if due_now(e)]
+        if not due:
+            return []
+        self.queue = [e for e in self.queue if not due_now(e)]
+        due.sort(key=lambda e: (e.deliver_slot, e.seq))
+        rng = random.Random(f"sim-net:{self.config.seed}:order:{slot}:"
+                            f"{dst}:{phase}")
+        rng.shuffle(due)
+        self.stats["delivered"] += len(due)
+        metrics.count("sim.net.delivered", len(due))
+        return [(e.kind, e.obj, e.src) for e in due]
+
+    def pending(self) -> int:
+        return len(self.queue)
+
+    # -- checkpoint serialization --------------------------------------
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "seq": self.seq,
+            "lossless_edges": sorted(list(e) for e in self.lossless_edges),
+            "stats": dict(self.stats),
+            "queue": [e.to_dict() for e in sorted(
+                self.queue, key=lambda e: (e.deliver_slot, e.dst, e.seq))],
+        }
+
+    def restore_state(self, spec: Any, state: Dict[str, Any]) -> None:
+        self.seq = int(state["seq"])
+        self.lossless_edges = {tuple(e) for e in state["lossless_edges"]}
+        self.stats = {k: int(v) for k, v in state["stats"].items()}
+        self.queue = []
+        for d in state["queue"]:
+            ssz_type = getattr(spec, _KIND_TYPES[d["kind"]])
+            obj = ssz_type.decode_bytes(bytes.fromhex(d["ssz"]))
+            self.queue.append(_Entry(int(d["deliver_slot"]), int(d["dst"]),
+                                     int(d["src"]), d["kind"],
+                                     int(d["seq"]), obj,
+                                     int(d.get("phase", PHASE_TOP))))
+
+
+def partitions_to_dicts(windows: Tuple[PartitionWindow, ...]) -> List[Dict[str, Any]]:
+    return [w.to_dict() for w in windows]
+
+
+def partitions_from_dicts(dicts: List[Dict[str, Any]]) -> Tuple[PartitionWindow, ...]:
+    return tuple(PartitionWindow.from_dict(d) for d in dicts)
+
+
+__all__ = [
+    "KIND_ATTESTATION", "KIND_BLOCK", "KIND_SLASHING", "MessageBus",
+    "NetConfig", "PartitionWindow", "default_partitions",
+    "partitions_from_dicts", "partitions_to_dicts",
+]

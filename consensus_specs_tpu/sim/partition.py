@@ -1,0 +1,951 @@
+"""Partitioned multi-node chain simulation (docs/SIM.md "Partitioned
+network").
+
+N simulated nodes, each owning its OWN fork-choice Store and state
+cache, connected only by the seeded adversarial message bus
+(:mod:`sim.net`). Nothing is shared: a node knows exactly what the bus
+delivered to it, so stale, duplicate, out-of-order and cross-partition
+intake exercise the spec's real rejection ladders
+(``validate_on_attestation``'s unknown-root / stale-target asserts,
+``on_block``'s missing-parent assert) instead of being simulated away.
+
+Mechanics per slot:
+
+- every node ``on_tick``s, then drains its bus deliveries (adversarially
+  reordered). A block whose parent has not arrived yet parks in the
+  node's pending buffer and retries next slot (the client-side sync
+  queue); a rejected wire attestation retries a few slots (it may
+  reference a block still in flight) before it is dropped for good.
+- the slot's proposer is discovered, not assigned: each node computes
+  the proposer index from ITS OWN head view and proposes only when that
+  validator is homed locally (``validator % nodes``). Agreeing nodes
+  elect exactly one proposer; partitioned groups each elect their own —
+  real competing branches, not scripted forks.
+- every node attests its own head with its locally-homed committee
+  members; attestations ride the bus to everyone else and arrive at the
+  node itself next slot (the spec's "only affects subsequent slots").
+- equivocation slashing evidence (scenario-planned) is built by one
+  node, applied to its Store, broadcast, and included in blocks through
+  ``slashing_includable`` — the same double path as the single driver.
+- at every epoch boundary each node records its own checkpoint digest
+  and prunes its Store at ITS OWN finality.
+
+**Eventual convergence** (the acceptance contract): after every
+partition heals, all honest nodes must reach an identical head root and
+FFG checkpoint digest within ``converge_within`` slots (bounded because
+the bus is eventually reliable — sim/net.py). The measured lag per heal
+feeds the ``sim.convergence_lag_slots`` histogram and the run FAILS if
+any heal misses the bound.
+
+**Differential**: :func:`run_partitioned_differential` replays the same
+configuration on the interpreted oracle and the vectorized engine and
+demands bit-identity of every node's checkpoint stream — the same
+contract as ``run_differential``, per node.
+
+Chaos sites: ``sim.step`` / ``sim.epoch`` (same semantics as the
+single-node driver: degrade to the interpreted-oracle engine path,
+chain must not move), plus the bus's ``sim.net`` and the snapshot
+plane's ``sim.checkpoint`` (sim/checkpoint.py).
+"""
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import random
+import time
+from dataclasses import dataclass, field as dc_field, replace
+from typing import Any, Dict, List, Optional, Tuple
+
+from .. import engine, obs
+from ..crypto import bls
+from ..obs import metrics
+from ..resilience import chaos, supervised
+from ..specs import build_spec
+from .driver import (
+    ENGINE_MODES,
+    _REJECTED,
+    attestation_includable,
+    slashing_includable,
+)
+from .net import (
+    KIND_ATTESTATION,
+    KIND_BLOCK,
+    KIND_SLASHING,
+    PHASE_MID,
+    MessageBus,
+    NetConfig,
+    PartitionWindow,
+    default_partitions,
+    partitions_from_dicts,
+    partitions_to_dicts,
+)
+from .scenario import Scenario, ScenarioConfig
+
+# bounded client-side retry queues (sync/gossip stand-ins)
+BLOCK_RETRIES = 16
+ATT_RETRIES = 8
+
+NODE_STAT_KEYS = (
+    "blocks_proposed", "blocks_delivered", "blocks_duplicate",
+    "blocks_rejected", "blocks_parked", "proposals_foreign",
+    "slashed_proposer_slots", "failed_proposals",
+    "attestations_sent", "attestations_accepted", "attestations_rejected",
+    "attestations_parked", "slashings_included", "reorgs", "pruned_blocks",
+)
+
+
+@dataclass(frozen=True)
+class PartitionConfig:
+    """One partitioned run. ``partitions=None`` derives the scheduled
+    windows from the seed (:func:`sim.net.default_partitions`)."""
+
+    seed: int = 0
+    slots: int = 256
+    fork: str = "altair"
+    preset: str = "minimal"
+    validators: int = 64
+    nodes: int = 3
+    p_empty: float = 0.04
+    equivocations: int = 2
+    equivocation_width: int = 2
+    sign: bool = False
+    net: NetConfig = dc_field(default_factory=NetConfig)
+    partitions: Optional[Tuple[PartitionWindow, ...]] = None
+    converge_within: Optional[int] = None   # default: 3 epochs
+    checkpoint_every: int = 4               # epochs between snapshots
+    # proposers cap per-block attestation inclusion below the spec max:
+    # the pool is deduplicated and pruned on-chain, but a smaller cap
+    # keeps interpreted-oracle block processing affordable at 3+ nodes
+    max_block_attestations: int = 16
+
+    def resolved_partitions(self) -> Tuple[PartitionWindow, ...]:
+        if self.partitions is not None:
+            return self.partitions
+        return default_partitions(self.seed, self.slots, self.nodes)
+
+    def resolved_net(self) -> NetConfig:
+        return replace(self.net, seed=self.seed, nodes=self.nodes)
+
+    def scenario_config(self) -> ScenarioConfig:
+        # the partitioned sim reuses the scenario's empty-slot and
+        # equivocation streams; explicit fork windows and late blocks
+        # are OFF — partitions and bus delays produce them organically
+        return ScenarioConfig(
+            seed=self.seed, slots=self.slots, fork=self.fork,
+            preset=self.preset, validators=self.validators,
+            p_empty=self.p_empty, p_fork=0.0, p_late=0.0,
+            equivocations=self.equivocations,
+            equivocation_width=self.equivocation_width, sign=self.sign)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "seed": self.seed, "slots": self.slots, "fork": self.fork,
+            "preset": self.preset, "validators": self.validators,
+            "nodes": self.nodes, "p_empty": self.p_empty,
+            "equivocations": self.equivocations,
+            "equivocation_width": self.equivocation_width,
+            "sign": self.sign, "net": self.resolved_net().to_dict(),
+            "partitions": partitions_to_dicts(self.resolved_partitions()),
+            "converge_within": self.converge_within,
+            "checkpoint_every": self.checkpoint_every,
+            "max_block_attestations": self.max_block_attestations,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "PartitionConfig":
+        return cls(
+            seed=int(d["seed"]), slots=int(d["slots"]), fork=d["fork"],
+            preset=d["preset"], validators=int(d["validators"]),
+            nodes=int(d["nodes"]), p_empty=float(d["p_empty"]),
+            equivocations=int(d["equivocations"]),
+            equivocation_width=int(d["equivocation_width"]),
+            sign=bool(d["sign"]), net=NetConfig.from_dict(d["net"]),
+            partitions=partitions_from_dicts(d["partitions"]),
+            converge_within=(None if d.get("converge_within") is None
+                             else int(d["converge_within"])),
+            checkpoint_every=int(d["checkpoint_every"]),
+            max_block_attestations=int(d.get("max_block_attestations", 16)))
+
+
+class _Node:
+    """One simulated node: its Store plus the client-side queues."""
+
+    def __init__(self, node_id: int, store: Any) -> None:
+        self.id = node_id
+        self.store = store
+        # inclusion pool: att root -> att, insertion-ordered, dedup'd;
+        # entries drop when seen on-chain (block intake) or past horizon
+        self.pool: Dict[bytes, Any] = {}
+        self.wire_next: List[Any] = []            # own atts, intake next slot
+        self.pending_blocks: List[Tuple[Any, int]] = []
+        self.pending_atts: List[Tuple[Any, int]] = []
+        self.slashing_queue: List[Any] = []
+        self.known_slashings: set = set()
+        self.checkpoints: List[Dict[str, Any]] = []
+        self.stats: Dict[str, int] = {k: 0 for k in NODE_STAT_KEYS}
+        self.prev_head: Optional[bytes] = None
+        self.head: Optional[bytes] = None
+        self.last_pruned_epoch = 0
+        self.step_states: Dict[Tuple[bytes, int], Any] = {}
+
+
+@dataclass
+class PartitionedResult:
+    engine: str
+    config: PartitionConfig
+    node_checkpoints: List[List[Dict[str, Any]]]
+    node_stats: List[Dict[str, int]]
+    stats: Dict[str, int]
+    net: Dict[str, int]
+    convergence: List[Dict[str, Any]]
+    converged: bool
+    final_heads: List[str]
+    seconds: float
+
+    @property
+    def slots_per_s(self) -> float:
+        return self.config.slots / self.seconds if self.seconds > 0 else 0.0
+
+    def digest(self) -> str:
+        """The byte-identity handle the kill/resume drills compare:
+        sha256 over everything deterministic (never wall time)."""
+        payload = {
+            "node_checkpoints": self.node_checkpoints,
+            "node_stats": self.node_stats,
+            "stats": self.stats,
+            "net": self.net,
+            "convergence": self.convergence,
+            "final_heads": self.final_heads,
+        }
+        return hashlib.sha256(
+            json.dumps(payload, sort_keys=True).encode()).hexdigest()
+
+    def chain_digest(self) -> str:
+        """Chain content only (per-node checkpoint streams + final
+        heads) — the handle for comparisons across runs whose snapshot
+        or degradation accounting legitimately differs (e.g. a
+        ``sim.checkpoint`` chaos run vs the clean baseline)."""
+        payload = {
+            "node_checkpoints": self.node_checkpoints,
+            "final_heads": self.final_heads,
+        }
+        return hashlib.sha256(
+            json.dumps(payload, sort_keys=True).encode()).hexdigest()
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "engine": self.engine,
+            "config": self.config.to_dict(),
+            "seconds": round(self.seconds, 3),
+            "slots_per_s": round(self.slots_per_s, 2),
+            "stats": dict(self.stats),
+            "net": dict(self.net),
+            "node_stats": [dict(s) for s in self.node_stats],
+            "convergence": list(self.convergence),
+            "converged": self.converged,
+            "final_heads": list(self.final_heads),
+            "digest": self.digest(),
+            "chain_digest": self.chain_digest(),
+            "checkpoints": sum(len(c) for c in self.node_checkpoints),
+        }
+
+
+class PartitionedChainSim:
+    """One partitioned run. Optionally checkpointing (``manager``) and
+    resumable (:meth:`from_snapshot`)."""
+
+    def __init__(self, config: PartitionConfig,
+                 engine_label: str = "interpreted",
+                 manager: Optional[Any] = None) -> None:
+        from ..test_framework.genesis import create_genesis_state
+
+        self.config = config
+        self.engine_label = engine_label
+        self.manager = manager
+        self.spec = build_spec(config.fork, config.preset)
+        self.scenario = Scenario(config.scenario_config())
+        self.partitions = config.resolved_partitions()
+        self.bus = MessageBus(config.resolved_net(), self.partitions)
+        spec = self.spec
+        self.spe = int(spec.SLOTS_PER_EPOCH)
+        self.converge_within = (config.converge_within
+                                if config.converge_within is not None
+                                else 3 * self.spe)
+
+        genesis = create_genesis_state(
+            spec, [spec.MAX_EFFECTIVE_BALANCE] * config.validators,
+            spec.MAX_EFFECTIVE_BALANCE)
+        anchor_block = spec.BeaconBlock(state_root=spec.hash_tree_root(genesis))
+        self.nodes = [
+            _Node(i, spec.get_forkchoice_store(genesis.copy(), anchor_block))
+            for i in range(config.nodes)
+        ]
+        self.stats: Dict[str, int] = {
+            "equivocations": 0, "degraded_steps": 0, "degraded_epochs": 0,
+            "snapshots_written": 0, "snapshots_skipped": 0,
+        }
+        # per-window convergence ledger; "lag" counts CONNECTED slots
+        # since the heal (the clock pauses while a later scheduled
+        # window has the network split again — convergence is bounded
+        # in connectivity, not in wall slots)
+        self.convergence: List[Dict[str, Any]] = [
+            {"window": i, "start": w.start, "heal": w.end,
+             "converged_slot": None, "lag": None, "connected_slots": 0}
+            for i, w in enumerate(self.partitions)
+        ]
+        self.next_slot = 1
+        self._oracle_forced = False
+        eq_rng = random.Random(f"chain-sim:{config.seed}:equiv")
+        self._equivocators = list(range(config.validators))
+        eq_rng.shuffle(self._equivocators)
+        self._equiv_consumed = 0
+
+    # -- plumbing -------------------------------------------------------
+
+    def _home(self, validator: int) -> int:
+        return int(validator) % self.config.nodes
+
+    def _state_at(self, node: _Node, root: bytes, slot: int):
+        key = (bytes(root), slot)
+        cached = node.step_states.get(key)
+        if cached is not None:
+            return cached
+        st = node.store.block_states[root]
+        if int(st.slot) < slot:
+            st = st.copy()
+            self.spec.process_slots(st, self.spec.Slot(slot))
+        node.step_states[key] = st
+        return st
+
+    def _is_ancestor(self, node: _Node, ancestor: bytes, root: bytes) -> bool:
+        spec, store = self.spec, node.store
+        try:
+            slot = store.blocks[ancestor].slot
+            return bytes(spec.get_ancestor(store, root, slot)) == bytes(ancestor)
+        except KeyError:
+            return False
+
+    # -- intake ---------------------------------------------------------
+
+    def _deliver_block(self, node: _Node, signed, retries: int = 0) -> None:
+        """``on_block`` plus the spec's implied intake of the block's
+        payload. A rejected block (parent still in flight, typically)
+        parks in the node's pending buffer — the client-side sync queue
+        — and retries next slot, ``BLOCK_RETRIES`` times."""
+        spec, store = self.spec, node.store
+        root = spec.hash_tree_root(signed.message)
+        if root in store.blocks:
+            node.stats["blocks_duplicate"] += 1
+            return
+        try:
+            spec.on_block(store, signed)
+        except _REJECTED:
+            if retries + 1 >= BLOCK_RETRIES:
+                node.stats["blocks_rejected"] += 1
+            else:
+                node.pending_blocks.append((signed, retries + 1))
+                node.stats["blocks_parked"] += 1
+                metrics.count("sim.net.blocks_parked")
+            return
+        for att in signed.message.body.attestations:
+            try:
+                spec.on_attestation(store, att, is_from_block=True)
+            except _REJECTED:
+                node.stats["attestations_rejected"] += 1
+        for slashing in signed.message.body.attester_slashings:
+            try:
+                spec.on_attester_slashing(store, slashing)
+            except _REJECTED:
+                pass
+        node.stats["blocks_delivered"] += 1
+
+    def _deliver_attestation(self, node: _Node, att, retries: int = 0) -> None:
+        try:
+            self.spec.on_attestation(node.store, att, is_from_block=False)
+        except _REJECTED:
+            # the vote may reference a block still in flight: park and
+            # retry a few slots before dropping for good
+            if retries + 1 >= ATT_RETRIES:
+                node.stats["attestations_rejected"] += 1
+            else:
+                node.pending_atts.append((att, retries + 1))
+                node.stats["attestations_parked"] += 1
+            return
+        node.stats["attestations_accepted"] += 1
+        node.pool.setdefault(bytes(self.spec.hash_tree_root(att)), att)
+
+    def _deliver_slashing(self, node: _Node, slashing) -> None:
+        digest = bytes(self.spec.hash_tree_root(slashing))
+        if digest in node.known_slashings:
+            return
+        node.known_slashings.add(digest)
+        try:
+            self.spec.on_attester_slashing(node.store, slashing)
+        except _REJECTED:
+            pass
+        node.slashing_queue.append(slashing)
+
+    def _intake(self, slot: int, node: _Node) -> None:
+        pending_blocks, node.pending_blocks = node.pending_blocks, []
+        for signed, retries in pending_blocks:
+            self._deliver_block(node, signed, retries)
+        pending_atts, node.pending_atts = node.pending_atts, []
+        for att, retries in pending_atts:
+            self._deliver_attestation(node, att, retries)
+        wire, node.wire_next = node.wire_next, []
+        for att in wire:
+            self._deliver_attestation(node, att)
+        for kind, obj, _src in self.bus.deliveries(slot, node.id):
+            if kind == KIND_BLOCK:
+                self._deliver_block(node, obj)
+            elif kind == KIND_ATTESTATION:
+                self._deliver_attestation(node, obj)
+            else:
+                self._deliver_slashing(node, obj)
+        # one same-slot retry of what this intake just parked: an
+        # attestation shuffled ahead of its own block (the reorder case)
+        # applies as soon as the block lands, like a client's pending
+        # queue draining on a new-block event
+        parked_now, node.pending_atts = node.pending_atts, []
+        for att, retries in parked_now:
+            node.stats["attestations_parked"] -= 1
+            self._deliver_attestation(node, att, retries - 1)
+
+    # -- per-slot mechanics --------------------------------------------
+
+    def _propose(self, slot: int, node: _Node) -> None:
+        from ..test_framework.block import build_empty_block
+        from ..test_framework.block_processing import (
+            state_transition_and_sign_block,
+        )
+
+        spec = self.spec
+        tip = node.head
+        view = self._state_at(node, tip, slot)
+        try:
+            block = build_empty_block(spec, view, spec.Slot(slot))
+        except _REJECTED:
+            node.stats["failed_proposals"] += 1
+            return
+        proposer = int(block.proposer_index)
+        if self._home(proposer) != node.id:
+            # the proposer lives on another node: from THIS node's view
+            # somebody else owns the slot (agreeing nodes elect exactly
+            # one proposer; split views may elect one per branch)
+            node.stats["proposals_foreign"] += 1
+            return
+        if view.validators[proposer].slashed:
+            node.stats["slashed_proposer_slots"] += 1
+            return
+
+        # newest-first up to the cap: fresh votes are what carries FFG
+        # justification on this branch; older pool entries re-include
+        # redundantly (the spec is idempotent about it) but boundedly.
+        # A vote included only on a branch that later LOSES is thereby
+        # re-included on the winner too — nothing is popped on intake,
+        # so reorgs cannot orphan votes out of existence.
+        cap = min(int(spec.MAX_ATTESTATIONS),
+                  self.config.max_block_attestations)
+        included = 0
+        for att in reversed(node.pool.values()):
+            if included >= cap:
+                break
+            if attestation_includable(spec, view, att):
+                block.body.attestations.append(att)
+                included += 1
+        if node.slashing_queue:
+            kept = []
+            for slashing in node.slashing_queue:
+                if (len(block.body.attester_slashings)
+                        < int(spec.MAX_ATTESTER_SLASHINGS)
+                        and slashing_includable(spec, view, slashing)):
+                    block.body.attester_slashings.append(slashing)
+                    node.stats["slashings_included"] += 1
+                else:
+                    kept.append(slashing)
+            node.slashing_queue = kept
+
+        try:
+            pre = node.store.block_states[tip].copy()
+            signed = state_transition_and_sign_block(spec, pre, block)
+        except Exception:
+            node.stats["failed_proposals"] += 1
+            return
+        node.stats["blocks_proposed"] += 1
+        metrics.count("sim.blocks_proposed")
+        self._deliver_block(node, signed)        # own block lands at once
+        self.bus.send(slot, node.id, KIND_BLOCK, signed)
+
+    def _attest(self, slot: int, node: _Node) -> None:
+        from ..test_framework.attestations import get_valid_attestation
+
+        spec = self.spec
+        head_state = self._state_at(node, node.head, slot)
+        epoch = spec.compute_epoch_at_slot(spec.Slot(slot))
+        committees = int(spec.get_committee_count_per_slot(head_state, epoch))
+        for index in range(committees):
+            committee = spec.get_beacon_committee(
+                head_state, spec.Slot(slot), spec.CommitteeIndex(index))
+            mine = {int(v) for v in committee if self._home(v) == node.id}
+            if not mine:
+                continue
+            try:
+                att = get_valid_attestation(
+                    spec, head_state, slot=spec.Slot(slot),
+                    index=spec.CommitteeIndex(index),
+                    filter_participant_set=lambda comm, v=mine: comm & v,
+                    signed=self.config.sign)
+            except _REJECTED:
+                continue
+            if not any(att.aggregation_bits):
+                continue
+            # pooled for inclusion at wire intake next slot (inclusion
+            # delay >= 1 anyway, so nothing is lost by not pooling now)
+            node.wire_next.append(att)
+            node.stats["attestations_sent"] += 1
+            metrics.count("sim.attestations")
+            self.bus.send(slot, node.id, KIND_ATTESTATION, att)
+
+    def _emit_equivocation(self, slot: int) -> None:
+        from ..test_framework.attester_slashings import (
+            get_valid_attester_slashing_by_indices,
+        )
+
+        spec = self.spec
+        node = self.nodes[slot % self.config.nodes]
+        width = max(1, int(self.config.equivocation_width))
+        if len(self._equivocators) - self._equiv_consumed < width:
+            return
+        indices = sorted(
+            self._equivocators[self._equiv_consumed:self._equiv_consumed + width])
+        self._equiv_consumed += width
+        state = self._state_at(node, node.head, slot)
+        try:
+            slashing = get_valid_attester_slashing_by_indices(
+                spec, state, indices, slot=spec.Slot(slot),
+                signed_1=self.config.sign, signed_2=self.config.sign)
+        except _REJECTED:
+            return
+        self._deliver_slashing(node, slashing)
+        self.bus.send(slot, node.id, KIND_SLASHING, slashing)
+        self.stats["equivocations"] += 1
+        metrics.count("sim.equivocations")
+        obs.instant("sim.equivocation", slot=slot, width=width, node=node.id)
+
+    # -- convergence ----------------------------------------------------
+
+    def _view_digest(self, node: _Node) -> Tuple[bytes, int, str, int, str]:
+        store = node.store
+        return (bytes(node.head),
+                int(store.justified_checkpoint.epoch),
+                bytes(store.justified_checkpoint.root).hex(),
+                int(store.finalized_checkpoint.epoch),
+                bytes(store.finalized_checkpoint.root).hex())
+
+    def _check_convergence(self, slot: int) -> None:
+        watching = [c for c in self.convergence
+                    if c["heal"] < slot and c["converged_slot"] is None]
+        if not watching:
+            return
+        connected = self.bus.window_at(slot) is None
+        if connected:
+            for c in watching:
+                c["connected_slots"] += 1
+        views = {self._view_digest(n) for n in self.nodes}
+        if len(views) != 1 or not connected:
+            return
+        for c in watching:
+            lag = c["connected_slots"]
+            c["converged_slot"] = slot
+            c["lag"] = lag
+            metrics.observe("sim.convergence_lag_slots", float(lag))
+            metrics.count("sim.net.heals_converged")
+            obs.instant("sim.net.converged", window=c["window"], slot=slot,
+                        lag=lag)
+
+    # -- slot step ------------------------------------------------------
+
+    def _step(self, slot: int) -> None:
+        spec = self.spec
+        plan = self.scenario.plan(slot)
+        for node in self.nodes:
+            node.step_states.clear()
+            spec.on_tick(node.store, node.store.genesis_time
+                         + slot * int(spec.config.SECONDS_PER_SLOT))
+            self._intake(slot, node)
+            node.head = spec.get_head(node.store)
+
+        # convergence is judged at the top of the slot, after intake and
+        # BEFORE this slot's proposal (a proposer always sees its own
+        # block one slot before everyone else — that skew is protocol,
+        # not divergence)
+        self._check_convergence(slot)
+
+        if plan.equivocate:
+            self._emit_equivocation(slot)
+
+        if plan.propose:
+            for node in self.nodes:
+                self._propose(slot, node)
+
+        # mid-slot: timely blocks proposed THIS slot cross the wire
+        # before anyone attests (the attestation-deadline timing that
+        # keeps FFG participation honest — docs/SIM.md)
+        for node in self.nodes:
+            for kind, obj, _src in self.bus.deliveries(slot, node.id,
+                                                       PHASE_MID):
+                if kind == KIND_BLOCK:
+                    self._deliver_block(node, obj)
+
+        for node in self.nodes:
+            # proposals and mid-slot deliveries may have moved this
+            # node's head: refresh before attesting
+            head = spec.get_head(node.store)
+            if (node.prev_head is not None
+                    and bytes(head) != bytes(node.prev_head)
+                    and not self._is_ancestor(node, node.prev_head, head)):
+                node.stats["reorgs"] += 1
+                metrics.count("sim.reorgs")
+            node.prev_head = head
+            node.head = head
+            self._attest(slot, node)
+
+    @contextlib.contextmanager
+    def _forced_oracle(self):
+        was_vec = engine.is_vectorized()
+        was_batch = engine.is_batched_attestations()
+        engine.use_interpreted_epoch()
+        engine.use_direct_attestations()
+        try:
+            yield
+        finally:
+            if was_vec:
+                engine.use_vectorized_epoch()
+            if was_batch:
+                engine.use_batched_attestations()
+
+    def _run_step(self, slot: int) -> None:
+        def attempt():
+            chaos("sim.step")
+            if self._oracle_forced:
+                with self._forced_oracle():
+                    self._step(slot)
+            else:
+                self._step(slot)
+
+        def degraded():
+            self.stats["degraded_steps"] += 1
+            metrics.count("sim.degraded_steps")
+            obs.instant("sim.degraded", site="sim.step", slot=slot)
+            with self._forced_oracle():
+                self._step(slot)
+
+        supervised(attempt, domain="sim", capability="sim.step",
+                   fallback=degraded)
+
+    # -- epoch rollover + pruning --------------------------------------
+
+    def _prune(self, node: _Node, slot: int) -> None:
+        spec, store = self.spec, node.store
+        fin = store.finalized_checkpoint
+        fin_epoch = int(fin.epoch)
+        if fin_epoch <= node.last_pruned_epoch:
+            return
+        node.last_pruned_epoch = fin_epoch
+        fin_slot = spec.compute_start_slot_at_epoch(fin.epoch)
+        keep = set()
+        for root in list(store.blocks):
+            try:
+                if bytes(spec.get_ancestor(store, root, fin_slot)) == bytes(fin.root):
+                    keep.add(bytes(root))
+            except KeyError:
+                continue
+        dropped = [r for r in list(store.blocks) if bytes(r) not in keep]
+        for root in dropped:
+            del store.blocks[root]
+            del store.block_states[root]
+        for index in [i for i, m in store.latest_messages.items()
+                      if bytes(m.root) not in keep]:
+            del store.latest_messages[index]
+        for cp in [c for c in store.checkpoint_states
+                   if int(c.epoch) < fin_epoch and c != store.justified_checkpoint]:
+            del store.checkpoint_states[cp]
+        horizon = slot - self.spe
+        node.pool = {k: a for k, a in node.pool.items()
+                     if int(a.data.slot) >= horizon}
+        if dropped:
+            node.stats["pruned_blocks"] += len(dropped)
+            metrics.count("sim.pruned_blocks", len(dropped))
+
+    def _epoch_rollover(self, slot: int) -> None:
+        spec = self.spec
+
+        def attempt():
+            chaos("sim.epoch")
+
+        def degraded():
+            self.stats["degraded_epochs"] += 1
+            self._oracle_forced = True
+            metrics.count("sim.degraded_epochs")
+            obs.instant("sim.degraded", site="sim.epoch", slot=slot)
+
+        supervised(attempt, domain="sim", capability="sim.epoch",
+                   fallback=degraded)
+
+        epoch = slot // self.spe
+        for node in self.nodes:
+            store = node.store
+            head = spec.get_head(store)
+            head_state = store.block_states[head]
+            node.checkpoints.append({
+                "node": node.id,
+                "epoch": epoch,
+                "slot": slot,
+                "head": bytes(head).hex(),
+                "head_slot": int(store.blocks[head].slot),
+                "state_root": bytes(spec.hash_tree_root(head_state)).hex(),
+                "justified_epoch": int(store.justified_checkpoint.epoch),
+                "finalized_epoch": int(store.finalized_checkpoint.epoch),
+            })
+            self._prune(node, slot)
+        metrics.count("sim.epochs")
+
+    # -- entry points ---------------------------------------------------
+
+    def run(self) -> PartitionedResult:
+        cfg = self.config
+        was_bls = bls.bls_active
+        bls.bls_active = bool(cfg.sign)
+        t0 = time.perf_counter()
+        try:
+            with obs.span("sim.partition.run", engine=self.engine_label,
+                          fork=cfg.fork, preset=cfg.preset, seed=cfg.seed,
+                          slots=cfg.slots, nodes=cfg.nodes,
+                          windows=len(self.partitions)):
+                for slot in range(self.next_slot, cfg.slots + 1):
+                    with obs.span("sim.slot", slot=slot):
+                        self._run_step(slot)
+                    rollover = (slot + 1) % self.spe == 0
+                    if rollover:
+                        with obs.span("sim.epoch", slot=slot):
+                            self._epoch_rollover(slot)
+                    # the snapshot (when due) is taken with next_slot
+                    # already advanced: a resume continues AFTER the
+                    # epoch whose checkpoints the snapshot contains
+                    self.next_slot = slot + 1
+                    if (rollover and self.manager is not None
+                            and (slot // self.spe) % max(
+                                1, cfg.checkpoint_every) == 0):
+                        # counted BEFORE the write so the snapshot's own
+                        # payload carries it — a resumed run's final
+                        # stats then match the uninterrupted run's
+                        self.stats["snapshots_written"] += 1
+                        if not self.manager.maybe_snapshot(self, slot):
+                            self.stats["snapshots_written"] -= 1
+                            self.stats["snapshots_skipped"] += 1
+        finally:
+            bls.bls_active = was_bls
+        seconds = time.perf_counter() - t0
+        return self._result(seconds)
+
+    def _result(self, seconds: float) -> PartitionedResult:
+        converged = all(
+            c["lag"] is not None and c["lag"] <= self.converge_within
+            for c in self.convergence)
+        return PartitionedResult(
+            engine=self.engine_label,
+            config=self.config,
+            node_checkpoints=[list(n.checkpoints) for n in self.nodes],
+            node_stats=[dict(n.stats) for n in self.nodes],
+            stats=dict(self.stats),
+            net=dict(self.bus.stats),
+            convergence=[dict(c) for c in self.convergence],
+            converged=converged,
+            final_heads=[bytes(n.head).hex() if n.head is not None else ""
+                         for n in self.nodes],
+            seconds=seconds,
+        )
+
+    # -- checkpoint serialization --------------------------------------
+
+    def state_payload(self) -> Dict[str, Any]:
+        """Everything the next process needs to continue this run with
+        byte-identical results (sim/checkpoint.py writes it)."""
+        from .checkpoint import store_to_dict
+
+        spec = self.spec
+        nodes = []
+        for node in self.nodes:
+            nodes.append({
+                "id": node.id,
+                "store": store_to_dict(spec, node.store),
+                "pool": [bytes(a.encode_bytes()).hex()
+                         for a in node.pool.values()],
+                "wire_next": [bytes(a.encode_bytes()).hex()
+                              for a in node.wire_next],
+                "pending_blocks": [
+                    {"ssz": bytes(b.encode_bytes()).hex(), "retries": r}
+                    for b, r in node.pending_blocks],
+                "pending_atts": [
+                    {"ssz": bytes(a.encode_bytes()).hex(), "retries": r}
+                    for a, r in node.pending_atts],
+                "slashing_queue": [bytes(s.encode_bytes()).hex()
+                                   for s in node.slashing_queue],
+                "known_slashings": sorted(d.hex()
+                                          for d in node.known_slashings),
+                "checkpoints": list(node.checkpoints),
+                "stats": dict(node.stats),
+                "prev_head": (bytes(node.prev_head).hex()
+                              if node.prev_head is not None else None),
+                "head": (bytes(node.head).hex()
+                         if node.head is not None else None),
+                "last_pruned_epoch": node.last_pruned_epoch,
+            })
+        return {
+            "config": self.config.to_dict(),
+            "engine": self.engine_label,
+            "next_slot": self.next_slot,
+            "stats": dict(self.stats),
+            "oracle_forced": self._oracle_forced,
+            "equiv_consumed": self._equiv_consumed,
+            "convergence": [dict(c) for c in self.convergence],
+            "bus": self.bus.state_dict(),
+            "nodes": nodes,
+        }
+
+    @classmethod
+    def from_snapshot(cls, payload: Dict[str, Any],
+                      engine_label: Optional[str] = None,
+                      manager: Optional[Any] = None) -> "PartitionedChainSim":
+        from .checkpoint import store_from_dict
+
+        config = PartitionConfig.from_dict(payload["config"])
+        sim = cls(config, engine_label=engine_label or payload["engine"],
+                  manager=manager)
+        spec = sim.spec
+        sim.next_slot = int(payload["next_slot"])
+        sim.stats = {k: int(v) for k, v in payload["stats"].items()}
+        sim._oracle_forced = bool(payload["oracle_forced"])
+        sim._equiv_consumed = int(payload["equiv_consumed"])
+        sim.convergence = [dict(c) for c in payload["convergence"]]
+        sim.bus.restore_state(spec, payload["bus"])
+
+        def _att(h):
+            return spec.Attestation.decode_bytes(bytes.fromhex(h))
+
+        for node, nd in zip(sim.nodes, payload["nodes"]):
+            node.store = store_from_dict(spec, nd["store"])
+            node.pool = {}
+            for h in nd["pool"]:
+                att = _att(h)
+                node.pool[bytes(spec.hash_tree_root(att))] = att
+            node.wire_next = [_att(h) for h in nd["wire_next"]]
+            node.pending_blocks = [
+                (spec.SignedBeaconBlock.decode_bytes(bytes.fromhex(e["ssz"])),
+                 int(e["retries"])) for e in nd["pending_blocks"]]
+            node.pending_atts = [(_att(e["ssz"]), int(e["retries"]))
+                                 for e in nd["pending_atts"]]
+            node.slashing_queue = [
+                spec.AttesterSlashing.decode_bytes(bytes.fromhex(h))
+                for h in nd["slashing_queue"]]
+            node.known_slashings = {bytes.fromhex(h)
+                                    for h in nd["known_slashings"]}
+            node.checkpoints = list(nd["checkpoints"])
+            node.stats = {k: int(v) for k, v in nd["stats"].items()}
+            node.prev_head = (bytes.fromhex(nd["prev_head"])
+                              if nd["prev_head"] else None)
+            node.head = bytes.fromhex(nd["head"]) if nd["head"] else None
+            node.last_pruned_epoch = int(nd["last_pruned_epoch"])
+            node.head = (spec.get_head(node.store)
+                         if node.head is None else node.head)
+        return sim
+
+
+# ---------------------------------------------------------------------------
+# run helpers (engine installation managed, like sim/driver.py)
+# ---------------------------------------------------------------------------
+
+
+@contextlib.contextmanager
+def _engine_mode(mode: str):
+    if mode not in ENGINE_MODES:
+        raise ValueError(f"unknown engine mode {mode!r} (have {ENGINE_MODES})")
+    was_vec = engine.is_vectorized()
+    was_batch = engine.is_batched_attestations()
+    if mode == "vectorized":
+        engine.use_vectorized_epoch()
+        engine.use_batched_attestations()
+    else:
+        engine.use_interpreted_epoch()
+        engine.use_direct_attestations()
+    try:
+        yield
+    finally:
+        (engine.use_vectorized_epoch if was_vec else engine.use_interpreted_epoch)()
+        (engine.use_batched_attestations if was_batch
+         else engine.use_direct_attestations)()
+
+
+def run_partitioned(config: PartitionConfig,
+                    engine_mode: str = "interpreted",
+                    manager: Optional[Any] = None,
+                    resume_payload: Optional[Dict[str, Any]] = None) -> PartitionedResult:
+    """One full (or resumed) partitioned run under one engine mode."""
+    if resume_payload is not None:
+        sim = PartitionedChainSim.from_snapshot(
+            resume_payload, engine_label=engine_mode, manager=manager)
+    else:
+        sim = PartitionedChainSim(config, engine_label=engine_mode,
+                                  manager=manager)
+    with _engine_mode(engine_mode):
+        return sim.run()
+
+
+def compare_node_checkpoints(a: PartitionedResult,
+                             b: PartitionedResult) -> List[Dict[str, Any]]:
+    """Field-level mismatches between two runs' per-node checkpoint
+    streams (the per-node differential contract)."""
+    mismatches: List[Dict[str, Any]] = []
+    for node_id, (ca_list, cb_list) in enumerate(
+            zip(a.node_checkpoints, b.node_checkpoints)):
+        if len(ca_list) != len(cb_list):
+            mismatches.append({"node": node_id, "field": "checkpoint_count",
+                               a.engine: len(ca_list),
+                               b.engine: len(cb_list)})
+        for ca, cb in zip(ca_list, cb_list):
+            for fld in ("head", "state_root", "head_slot",
+                        "justified_epoch", "finalized_epoch"):
+                if ca[fld] != cb[fld]:
+                    mismatches.append({"node": node_id, "epoch": ca["epoch"],
+                                       "field": fld, a.engine: ca[fld],
+                                       b.engine: cb[fld]})
+    return mismatches
+
+
+def run_partitioned_differential(config: PartitionConfig) -> Dict[str, Any]:
+    """The acceptance contract, per node: the same partitioned scenario
+    through the interpreted oracle and the vectorized engine must yield
+    bit-identical checkpoint streams on EVERY node, and both passes must
+    converge after every heal."""
+    oracle = run_partitioned(config, "interpreted")
+    vectorized = run_partitioned(config, "vectorized")
+    mismatches = compare_node_checkpoints(oracle, vectorized)
+    identical = not mismatches and oracle.digest() == vectorized.digest()
+    return {
+        "identical": identical,
+        "converged": oracle.converged and vectorized.converged,
+        "checkpoints": sum(len(c) for c in oracle.node_checkpoints),
+        "mismatches": mismatches,
+        "speedup": (round(oracle.seconds / vectorized.seconds, 3)
+                    if vectorized.seconds > 0 else None),
+        "oracle": oracle,
+        "vectorized": vectorized,
+    }
+
+
+__all__ = [
+    "PartitionConfig", "PartitionedChainSim", "PartitionedResult",
+    "compare_node_checkpoints", "run_partitioned",
+    "run_partitioned_differential",
+]

@@ -1,0 +1,218 @@
+"""Fork-choice attestation fuzzing (docs/FUZZ.md "Fork-choice intake")
+and regression seeds: three-path on_attestation differential (oracle vs
+engine vs served), mutation taxonomy coverage, the planted fc-engine
+defect, shrinker reuse, and the regression-corpus loader/replay."""
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.fuzz import CorpusBuilder, DifferentialExecutor
+from consensus_specs_tpu.fuzz.corpus import build_fc_store
+from consensus_specs_tpu.fuzz.executor import (
+    DEFECT_ENV,
+    fresh_store_view,
+    latest_messages_digest,
+)
+from consensus_specs_tpu.fuzz.mutate import ATT_WRECKAGE_OPS, apply_att_wreckage
+from consensus_specs_tpu.fuzz.regression import (
+    load_regression_records,
+    regression_cases,
+)
+from consensus_specs_tpu.fuzz.shrink import shrink_finding
+from consensus_specs_tpu.specs import build_spec
+
+FORK, PRESET, SEED = "phase0", "minimal", 7
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return build_spec(FORK, PRESET)
+
+
+@pytest.fixture(scope="module")
+def service(spec):
+    from consensus_specs_tpu.serve import SpecService, VerifyBatcher
+
+    was = bls.bls_active
+    bls.bls_active = False
+    svc = SpecService(forks=(FORK,), presets=(PRESET,),
+                      batcher=VerifyBatcher(linger_ms=1)).start()
+    yield svc
+    svc.batcher.drain(5)
+    svc.stop()
+    bls.bls_active = was
+
+
+@pytest.fixture()
+def executor(spec, service):
+    os.environ.pop(DEFECT_ENV, None)
+    yield DifferentialExecutor(spec, FORK, PRESET, service=service,
+                               fc_seed=SEED)
+    os.environ.pop(DEFECT_ENV, None)
+
+
+@pytest.fixture(scope="module")
+def builder(spec):
+    return CorpusBuilder(spec, FORK, PRESET, SEED)
+
+
+def test_attestation_corpus_is_pure_function(builder, spec):
+    b2 = CorpusBuilder(spec, FORK, PRESET, SEED)
+    for i in range(12):
+        a, b = builder.attestation_case(i), b2.attestation_case(i)
+        assert a == b
+        assert a.target == "attestation"
+        assert a.case_id.startswith("a")
+
+
+def test_fc_store_is_reproducible(spec):
+    a, b = build_fc_store(spec, SEED), build_fc_store(spec, SEED)
+    assert bytes(spec.get_head(a)) == bytes(spec.get_head(b))
+    assert latest_messages_digest(a) == latest_messages_digest(b)
+    assert len(a.blocks) == len(b.blocks) >= 6
+
+
+def test_valid_bases_accept_on_all_three_paths(executor, builder):
+    for i in (0, 8, 16):  # the wheel's valid-control slots
+        case = builder.attestation_case(i)
+        assert case.kind == "valid"
+        result = executor.execute(case)
+        assert result.divergence is None, result.divergence
+        assert result.outcomes["oracle"].verdict == "accept"
+        # the served digest equals the direct paths' digest exactly
+        assert (result.outcomes["serve"].detail
+                == result.outcomes["oracle"].detail)
+
+
+def test_clean_build_attestation_corpus_zero_divergence(executor, builder):
+    verdicts = set()
+    for i in range(32):
+        result = executor.execute(builder.attestation_case(i))
+        assert result.divergence is None, (i, result.divergence)
+        verdicts.add(result.outcomes["oracle"].verdict)
+    # the corpus exercises the full ladder, not one rung
+    assert verdicts >= {"accept", "reject", "undecodable"}
+
+
+@pytest.mark.parametrize("op", ("att_unknown_beacon_root",
+                                "att_future_slot",
+                                "att_zero_bits",
+                                "att_bad_committee_index"))
+def test_wreckage_ops_reject_identically(executor, builder, spec, op):
+    base = builder.att_bases()[0]
+    mutated = apply_att_wreckage(spec, base, (op,), f"t:{op}")
+    assert mutated is not None and mutated != base
+    from consensus_specs_tpu.fuzz.corpus import FuzzCase
+
+    case = FuzzCase(case_id=f"a0007-000001-wreck", fork=FORK, preset=PRESET,
+                    pre=b"", block=mutated, kind="wreck", base_index=0,
+                    mutations=(op,), target="attestation")
+    result = executor.execute(case)
+    assert result.divergence is None, result.divergence
+    assert result.outcomes["oracle"].verdict == "reject"
+    assert (result.outcomes["serve"].detail
+            == result.outcomes["oracle"].detail)
+
+
+def test_all_att_ops_apply_somewhere(builder, spec):
+    applied = set()
+    for op in ATT_WRECKAGE_OPS:
+        for base in builder.att_bases():
+            if apply_att_wreckage(spec, base, (op,), f"c:{op}") is not None:
+                applied.add(op)
+                break
+    assert applied == set(ATT_WRECKAGE_OPS)
+
+
+def test_planted_fc_defect_is_found_and_shrinks(executor, builder):
+    case = builder.attestation_case(0)
+    assert case.kind == "valid"
+    os.environ[DEFECT_ENV] = "fc-engine"
+    try:
+        result = executor.execute(case)
+        assert result.divergence is not None
+        assert result.divergence["kind"] == "post_root"
+        assert result.divergence["disagrees_with_oracle"] == ["engine"]
+        shrunk = shrink_finding(executor, case,
+                                builder.att_bases()[case.base_index])
+        assert not shrunk["aborted"]
+        assert shrunk["size"] <= len(case.block)
+    finally:
+        os.environ.pop(DEFECT_ENV, None)
+
+
+def test_fresh_store_view_isolates_cases(executor, builder, spec):
+    anchor = executor._fc_store()
+    before = latest_messages_digest(anchor)
+    case = builder.attestation_case(0)
+    executor.execute(case)
+    executor.execute(case)
+    assert latest_messages_digest(anchor) == before  # anchor untouched
+
+
+def test_serve_rejects_undecodable_and_bad_seed(service):
+    from consensus_specs_tpu.serve import protocol
+
+    with pytest.raises(protocol.RequestError) as e:
+        service.handle("fork_choice_attestation",
+                       {"fork": FORK, "preset": PRESET, "seed": SEED,
+                        "attestation": "0xdead"})
+    assert "does not decode as Attestation" in e.value.message
+    with pytest.raises(protocol.RequestError):
+        service.handle("fork_choice_attestation",
+                       {"fork": FORK, "preset": PRESET, "seed": "x",
+                        "attestation": "0x00"})
+
+
+# ---------------------------------------------------------------------------
+# regression seeds
+# ---------------------------------------------------------------------------
+
+
+def test_checked_in_regression_corpus_loads_and_replays_clean(spec, service):
+    from consensus_specs_tpu.fuzz.regression import checked_in_paths
+
+    paths = checked_in_paths()
+    assert paths, "checked-in fuzz/regression corpus is missing"
+    records = load_regression_records(paths)
+    assert records
+    builders = {}
+    cases = regression_cases(records, FORK, PRESET, spec, builders)
+    assert cases
+    executor = DifferentialExecutor(spec, FORK, PRESET, service=service)
+    for case in cases:
+        result = executor.execute(case)
+        assert result.divergence is None, (case.case_id, result.divergence)
+
+
+def test_regression_loader_dedups_and_prefers_shrunk(tmp_path):
+    rec = {"case": "f0007-000001-wreck",
+           "finding": {"block": "aa" * 4, "base_index": 0,
+                       "fork": FORK, "preset": PRESET}}
+    shrunk_line = {"case": "f0007-000001-wreck",
+                   "shrunk": {"block": "bb" * 2}}
+    p = tmp_path / "findings.jsonl"
+    p.write_text(json.dumps(rec) + "\n" + json.dumps(shrunk_line) + "\n"
+                 + json.dumps(rec) + "\n" + "{torn")
+    records = load_regression_records([p, tmp_path / "missing.jsonl"])
+    assert len(records) == 1
+    assert records[0]["shrunk"]["block"] == "bb" * 2
+
+
+def test_farm_runs_regression_cases_first(tmp_path, spec):
+    """An in-process rank-0 slice with regression seeds journals their
+    execution (and nothing diverges on a clean build)."""
+    from consensus_specs_tpu.fuzz import FarmConfig
+    from consensus_specs_tpu.fuzz.farm import run_slice
+    from consensus_specs_tpu.fuzz.regression import checked_in_paths
+
+    records = load_regression_records(checked_in_paths())
+    cfg = FarmConfig(out_dir=tmp_path, fork=FORK, preset=PRESET, seed=SEED,
+                     cases=8, workers=1, regression=records)
+    counts = run_slice(cfg, rank=0)
+    assert counts["execs"] >= len(records) + 8
+    assert counts["findings"] == 0

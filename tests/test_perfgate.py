@@ -228,6 +228,41 @@ def test_slowed_fuzz_farm_fails_gate(tmp_path):
     assert "gate FAILED" in proc.stdout
 
 
+def test_slowed_sim_checkpoint_fails_gate(tmp_path):
+    """The ISSUE-14 drill: the partitioned sim's snapshot round-trip
+    (fsync'd write + digest-verified load + restore, payload equality
+    asserted inside the measurement) is sentinel-gated — a chaos-slowed
+    plane (3x) against an established baseline flags ``regressed`` and
+    fails `make perfgate`. Both gate runs damp the obs-overhead slice
+    via its own chaos knob (0.5x armed time -> 0%): its ABSOLUTE <3%
+    ceiling is measurement-noise-prone on a loaded 1-CPU box and this
+    drill is about the sim-checkpoint metric, not the telemetry tax."""
+    ledger_path = str(tmp_path / "ledger.jsonl")
+    summary_path = tmp_path / "summary.json"
+    proc = _run(["--ledger", ledger_path, "--json", str(summary_path)],
+                env_extra={"CONSENSUS_SPECS_TPU_PERF_CHAOS":
+                           "perfgate_obs=0.5"},
+                timeout=480)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    measured = json.loads(summary_path.read_text())["metrics"]
+    assert "perfgate_sim_checkpoint_ms" in measured
+
+    led = ledger_mod.Ledger(ledger_path)
+    base = measured["perfgate_sim_checkpoint_ms"]
+    for i in range(sentinel.DEFAULT_POLICY.min_history):
+        led.record_run({"perfgate_sim_checkpoint_ms": base * (1 + 0.01 * i)},
+                       source="perfgate", backend="host")
+
+    proc = _run(["--ledger", ledger_path],
+                env_extra={"CONSENSUS_SPECS_TPU_PERF_CHAOS":
+                           "perfgate_sim_ckpt=3,perfgate_obs=0.5"},
+                timeout=480)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "perfgate_sim_checkpoint_ms" in proc.stdout
+    assert "regressed" in proc.stdout
+    assert "gate FAILED" in proc.stdout
+
+
 def test_budget_burning_daemon_fails_slo_gate(tmp_path):
     """The ISSUE-7 drill: `make perfgate` includes the serve SLO gate.
     A chaos-burned availability (0.5 vs the 0.999 objective) fails the

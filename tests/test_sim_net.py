@@ -1,0 +1,215 @@
+"""Adversarial message bus units (docs/SIM.md "Partitioned network"):
+pure-function delivery schedules, partition holds, duplicate/reorder
+behavior, chaos degradation (transient redelivery / deterministic
+lossless-edge quarantine), and checkpoint serialization round-trips."""
+from __future__ import annotations
+
+import pytest
+
+from consensus_specs_tpu import resilience
+from consensus_specs_tpu.resilience import injection
+from consensus_specs_tpu.sim.net import (
+    KIND_ATTESTATION,
+    KIND_BLOCK,
+    PHASE_MID,
+    PHASE_TOP,
+    MessageBus,
+    NetConfig,
+    PartitionWindow,
+    default_partitions,
+    partitions_from_dicts,
+    partitions_to_dicts,
+)
+
+
+class _Obj:
+    """Payload stand-in with the encode surface serialization needs."""
+
+    def __init__(self, blob: bytes = b"\x01\x02"):
+        self.blob = blob
+
+    def encode_bytes(self) -> bytes:
+        return self.blob
+
+
+@pytest.fixture(autouse=True)
+def _clean_sites():
+    resilience.clear("sim.net")
+    yield
+    resilience.clear("sim.net")
+    injection.disarm()
+
+
+def _drain(bus: MessageBus, dst: int, upto_slot: int):
+    out = []
+    for slot in range(1, upto_slot + 1):
+        out.extend((slot, PHASE_TOP, k) for k, _o, _s
+                   in bus.deliveries(slot, dst, PHASE_TOP))
+        out.extend((slot, PHASE_MID, k) for k, _o, _s
+                   in bus.deliveries(slot, dst, PHASE_MID))
+    return out
+
+
+def test_schedule_is_pure_function_of_seed():
+    for _ in range(2):
+        bus = MessageBus(NetConfig(seed=7, nodes=3))
+        plans = [bus._plan_edge(5, 0, 1, KIND_ATTESTATION, seq, 0)
+                 for seq in range(20)]
+        if _ == 0:
+            first = plans
+    assert plans == first
+
+
+def test_every_message_is_eventually_delivered():
+    cfg = NetConfig(seed=3, nodes=3, p_drop=0.4, p_delay=0.3)
+    bus = MessageBus(cfg)
+    for slot in range(1, 21):
+        bus.send(slot, 0, KIND_ATTESTATION, _Obj())
+    horizon = 20 + (cfg.max_attempts + 1) * cfg.retransmit_delay + cfg.delay_max + 2
+    got = _drain(bus, 1, horizon)
+    # 20 sends, each eventually delivered at least once (duplicates may
+    # add more) — the lossy bus is eventually reliable
+    assert len(got) >= 20
+    assert bus.pending() == 0 or all(e.dst != 1 for e in bus.queue)
+    assert bus.stats["dropped_attempts"] >= 1
+    assert bus.stats["delayed"] >= 1
+
+
+def test_timely_blocks_land_mid_slot():
+    bus = MessageBus(NetConfig(seed=1, nodes=2, p_drop=0.0, p_delay=0.0,
+                               p_duplicate=0.0))
+    bus.send(4, 0, KIND_BLOCK, _Obj())
+    assert bus.deliveries(4, 1, PHASE_TOP) == []
+    mid = bus.deliveries(4, 1, PHASE_MID)
+    assert [k for k, _o, _s in mid] == [KIND_BLOCK]
+
+
+def test_attestations_base_next_slot():
+    bus = MessageBus(NetConfig(seed=1, nodes=2, p_drop=0.0, p_delay=0.0,
+                               p_duplicate=0.0))
+    bus.send(4, 0, KIND_ATTESTATION, _Obj())
+    assert bus.deliveries(4, 1, PHASE_TOP) == []
+    assert bus.deliveries(4, 1, PHASE_MID) == []
+    assert len(bus.deliveries(5, 1, PHASE_TOP)) == 1
+
+
+def test_duplicates_occur_and_are_delivered_twice():
+    cfg = NetConfig(seed=2, nodes=2, p_drop=0.0, p_delay=0.0,
+                    p_duplicate=1.0)
+    bus = MessageBus(cfg)
+    bus.send(1, 0, KIND_ATTESTATION, _Obj())
+    got = _drain(bus, 1, 6)
+    assert len(got) == 2
+    assert bus.stats["duplicated"] == 1
+
+
+def test_partition_holds_cross_cut_traffic_until_heal():
+    window = PartitionWindow(start=5, end=9, groups=((0,), (1,)))
+    cfg = NetConfig(seed=4, nodes=2, p_drop=0.0, p_delay=0.0,
+                    p_duplicate=0.0, heal_spread=1)
+    bus = MessageBus(cfg, (window,))
+    bus.send(6, 0, KIND_ATTESTATION, _Obj())
+    # nothing before the heal
+    for slot in range(6, 10):
+        assert bus.deliveries(slot, 1, PHASE_TOP) == []
+        assert bus.deliveries(slot, 1, PHASE_MID) == []
+    held = _drain(bus, 1, 12)
+    assert len(held) == 1
+    assert bus.stats["held"] == 1
+    assert held[0][0] in (10, 11)  # end+1 .. end+1+heal_spread
+
+
+def test_same_group_traffic_flows_during_partition():
+    window = PartitionWindow(start=5, end=9, groups=((0, 1), (2,)))
+    bus = MessageBus(NetConfig(seed=4, nodes=3, p_drop=0.0, p_delay=0.0,
+                               p_duplicate=0.0), (window,))
+    bus.send(6, 0, KIND_ATTESTATION, _Obj())
+    assert len(bus.deliveries(7, 1, PHASE_TOP)) == 1    # same group
+    assert bus.deliveries(7, 2, PHASE_TOP) == []        # across the cut
+
+
+def test_reorder_is_deterministic():
+    def batch(seed):
+        bus = MessageBus(NetConfig(seed=seed, nodes=2, p_drop=0.0,
+                                   p_delay=0.0, p_duplicate=0.0))
+        for i in range(8):
+            bus.send(1, 0, KIND_ATTESTATION, _Obj(bytes([i])))
+        return [o.blob for _k, o, _s in bus.deliveries(2, 1, PHASE_TOP)]
+
+    a, b = batch(9), batch(9)
+    assert a == b
+    assert sorted(a) == [bytes([i]) for i in range(8)]
+    assert batch(10) != a  # a different seed shuffles differently
+
+
+def test_transient_chaos_redelivers_identically():
+    def run(with_fault):
+        resilience.clear("sim.net")
+        bus = MessageBus(NetConfig(seed=5, nodes=3))
+        if with_fault:
+            injection.arm("sim.net", "transient", count=2)
+        try:
+            for slot in range(1, 9):
+                bus.send(slot, 0, KIND_ATTESTATION, _Obj())
+        finally:
+            injection.disarm("sim.net")
+        return (sorted((e.deliver_slot, e.dst, e.seq, e.phase)
+                       for e in bus.queue), dict(bus.stats))
+
+    clean = run(False)
+    faulted = run(True)
+    assert clean == faulted
+    assert faulted[1]["quarantined_edges"] == 0
+
+
+def test_deterministic_chaos_quarantines_edge_to_lossless():
+    resilience.clear("sim.net")
+    bus = MessageBus(NetConfig(seed=5, nodes=3))
+    with injection.inject("sim.net", "deterministic", count=1):
+        for slot in range(1, 9):
+            bus.send(slot, 0, KIND_BLOCK, _Obj())
+    assert bus.stats["quarantined_edges"] >= 1
+    assert len(bus.lossless_edges) >= 1
+    # with the breaker open every edge degrades lossless: blocks land
+    # timely mid-slot, nothing is dropped or delayed from here on
+    before = dict(bus.stats)
+    bus.send(9, 0, KIND_BLOCK, _Obj())
+    assert bus.stats["dropped_attempts"] == before["dropped_attempts"]
+    assert bus.stats["delayed"] == before["delayed"]
+    got = bus.deliveries(9, 1, PHASE_MID) + bus.deliveries(9, 2, PHASE_MID)
+    assert len(got) == 2
+
+
+def test_bus_state_roundtrip(monkeypatch):
+    from consensus_specs_tpu.specs import build_spec
+
+    spec = build_spec("phase0", "minimal")
+    bus = MessageBus(NetConfig(seed=6, nodes=3))
+    att = spec.Attestation()
+    block = spec.SignedBeaconBlock()
+    bus.send(1, 0, KIND_ATTESTATION, att)
+    bus.send(1, 1, KIND_BLOCK, block)
+    state = bus.state_dict()
+
+    bus2 = MessageBus(NetConfig(seed=6, nodes=3))
+    bus2.restore_state(spec, state)
+    assert bus2.state_dict() == state
+    assert bus2.seq == bus.seq
+
+
+def test_default_partitions_pure_and_shaped():
+    a = default_partitions(1, 256, 3)
+    b = default_partitions(1, 256, 3)
+    assert a == b
+    assert len(a) >= 2
+    for w in a:
+        assert w.start < w.end
+        assert len(w.groups) == 2
+        assert sorted(n for g in w.groups for n in g) == [0, 1, 2]
+    spans = sorted((w.start, w.end) for w in a)
+    for (s1, e1), (s2, _) in zip(spans, spans[1:]):
+        assert e1 < s2  # never overlapping
+    assert default_partitions(2, 256, 3) != a
+    assert default_partitions(1, 32, 3) == ()  # too short for windows
+    roundtrip = partitions_from_dicts(partitions_to_dicts(a))
+    assert roundtrip == a

@@ -1,0 +1,140 @@
+"""Partitioned multi-node sim (docs/SIM.md "Partitioned network"):
+post-heal convergence property across seeds, per-node differential
+bit-identity, reproducibility, and the sim.net chaos contract at the
+driver level. The full drill battery (kill/resume, tamper) lives in
+tools/sim_partition_smoke.py and tests/test_sim_checkpoint.py."""
+from __future__ import annotations
+
+import pytest
+
+from consensus_specs_tpu import engine, resilience
+from consensus_specs_tpu.resilience import injection
+from consensus_specs_tpu.sim import PartitionConfig, run_partitioned
+from consensus_specs_tpu.sim.partition import (
+    compare_node_checkpoints,
+    run_partitioned_differential,
+)
+
+# short but partition-bearing: two windows, heals converged in-run
+SLOTS = 96
+
+
+@pytest.fixture(autouse=True)
+def _clean_engine():
+    engine.use_interpreted_epoch()
+    engine.use_direct_attestations()
+    resilience.clear("sim.net")
+    resilience.clear("sim.step")
+    resilience.clear("sim.epoch")
+    yield
+    engine.use_interpreted_epoch()
+    engine.use_direct_attestations()
+    resilience.clear("sim.net")
+    resilience.clear("sim.step")
+    resilience.clear("sim.epoch")
+    injection.disarm()
+
+
+@pytest.mark.parametrize("seed", (1, 2, 3))
+def test_partition_heal_converges_within_bound(seed):
+    """The eventual-convergence property across >=3 seeds: every
+    scheduled partition heals and all honest nodes reach an identical
+    head + FFG view within the bounded lag."""
+    cfg = PartitionConfig(seed=seed, slots=SLOTS, nodes=3)
+    windows = cfg.resolved_partitions()
+    assert len(windows) >= 1
+    res = run_partitioned(cfg, "vectorized")
+    assert res.converged, res.convergence
+    for c in res.convergence:
+        assert c["lag"] is not None
+        assert 1 <= c["lag"] <= res.config.slots
+        assert c["lag"] <= 3 * 8  # the default bound: 3 minimal epochs
+    # partitions actually produced competing branches somewhere
+    assert sum(s["reorgs"] for s in res.node_stats) >= 1
+    assert res.net["held"] >= 1
+
+
+def test_partitioned_run_is_reproducible():
+    cfg = PartitionConfig(seed=1, slots=64, nodes=3)
+    a = run_partitioned(cfg, "interpreted")
+    b = run_partitioned(cfg, "interpreted")
+    assert a.digest() == b.digest()
+    c = run_partitioned(PartitionConfig(seed=2, slots=64, nodes=3),
+                        "interpreted")
+    assert c.digest() != a.digest()
+
+
+def test_per_node_differential_identity():
+    """The acceptance pin (short horizon): interpreted oracle vs
+    vectorized engine, bit-identical checkpoint stream on EVERY node,
+    through two partition windows and their heals."""
+    cfg = PartitionConfig(seed=1, slots=SLOTS, nodes=3)
+    diff = run_partitioned_differential(cfg)
+    assert diff["identical"], diff["mismatches"][:5]
+    assert diff["converged"]
+    assert diff["checkpoints"] >= 3 * (SLOTS // 8 - 1)
+    assert diff["oracle"].node_stats == diff["vectorized"].node_stats
+    assert diff["oracle"].net == diff["vectorized"].net
+
+
+def test_nodes_have_distinct_views_during_partition():
+    """During a window the groups genuinely diverge (different heads),
+    which is what makes post-heal convergence a real property."""
+    cfg = PartitionConfig(seed=1, slots=SLOTS, nodes=3)
+    from consensus_specs_tpu.sim.partition import (
+        PartitionedChainSim,
+        _engine_mode,
+    )
+
+    sim = PartitionedChainSim(cfg, engine_label="interpreted")
+    window = sim.partitions[0]
+    split_seen = []
+    orig = PartitionedChainSim._check_convergence
+
+    def spy(self, slot):
+        if window.start + 2 <= slot <= window.end:
+            heads = {bytes(n.head) for n in self.nodes}
+            split_seen.append(len(heads) > 1)
+        orig(self, slot)
+
+    PartitionedChainSim._check_convergence = spy
+    try:
+        with _engine_mode("interpreted"):
+            sim.run()
+    finally:
+        PartitionedChainSim._check_convergence = orig
+    assert any(split_seen)
+
+
+def test_sim_net_transient_chaos_is_invisible():
+    cfg = PartitionConfig(seed=2, slots=64, nodes=3)
+    clean = run_partitioned(cfg, "vectorized")
+    resilience.clear("sim.net")
+    with injection.inject("sim.net", "transient", count=2, after=30):
+        faulted = run_partitioned(cfg, "vectorized")
+    resilience.clear("sim.net")
+    assert faulted.digest() == clean.digest()
+    assert faulted.net["quarantined_edges"] == 0
+
+
+def test_sim_net_deterministic_chaos_differential_holds():
+    """Deterministic sim.net fault: edges quarantine to lossless
+    delivery, the run still converges, and with the SAME injection on
+    both engine passes the per-node differential stays bit-identical."""
+    cfg = PartitionConfig(seed=2, slots=64, nodes=3)
+
+    def chaos_run(mode):
+        resilience.clear("sim.net")
+        try:
+            with injection.inject("sim.net", "deterministic", count=1,
+                                  after=50):
+                return run_partitioned(cfg, mode)
+        finally:
+            resilience.clear("sim.net")
+
+    oracle = chaos_run("interpreted")
+    vectorized = chaos_run("vectorized")
+    assert oracle.net["quarantined_edges"] >= 1
+    assert vectorized.converged
+    assert not compare_node_checkpoints(oracle, vectorized)
+    assert oracle.digest() == vectorized.digest()

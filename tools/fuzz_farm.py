@@ -48,6 +48,10 @@ from consensus_specs_tpu.fuzz import (  # noqa: E402
     run_farm,
 )
 from consensus_specs_tpu.fuzz.executor import DEFECT_ENV  # noqa: E402
+from consensus_specs_tpu.fuzz.regression import (  # noqa: E402
+    checked_in_paths,
+    load_regression_records,
+)
 from consensus_specs_tpu.obs import ledger as ledger_mod  # noqa: E402
 from consensus_specs_tpu.obs import timeseries  # noqa: E402
 
@@ -93,11 +97,25 @@ def _bank(ledger_path: Optional[str], metrics: Dict[str, float],
     print(f"fuzz: banked {sorted(metrics)} -> {led.path} (run {run_id})")
 
 
+def _regression_seeds(out: pathlib.Path) -> list:
+    """Prior findings of this output dir + the checked-in regression
+    corpus, as first-priority records (docs/FUZZ.md "Regression
+    seeds")."""
+    paths = [out / "findings.jsonl", *checked_in_paths()]
+    records = load_regression_records(paths)
+    if records:
+        print(f"fuzz: {len(records)} regression seed(s) loaded "
+              f"({len(checked_in_paths())} checked-in corpus file(s))")
+    return records
+
+
 def run_fixed(ns: argparse.Namespace) -> int:
     out = pathlib.Path(ns.out or tempfile.mkdtemp(prefix="fuzz_farm_"))
     cfg = FarmConfig(out_dir=out, fork=ns.fork, preset=ns.preset,
                      seed=ns.seed, cases=ns.cases, workers=ns.workers,
-                     serve_path=ns.serve_path, shrink=not ns.no_shrink)
+                     serve_path=ns.serve_path, shrink=not ns.no_shrink,
+                     target=ns.target,
+                     regression=_regression_seeds(out))
     report = run_farm(cfg).to_dict()
     _print_report("run", report)
     for case, record in sorted(load_merged(out).items()):
@@ -124,9 +142,14 @@ def run_longhaul(ns: argparse.Namespace) -> int:
     seed = ns.seed
     total_execs, t0 = 0, time.monotonic()
     while time.monotonic() < deadline:
+        # regression seeds reload EVERY round: findings from earlier
+        # rounds of this very run join the next round's first-priority
+        # cases, alongside the checked-in corpus
         cfg = FarmConfig(out_dir=out, fork=ns.fork, preset=ns.preset,
                          seed=seed, cases=ns.cases, workers=ns.workers,
-                         serve_path=ns.serve_path, shrink=not ns.no_shrink)
+                         serve_path=ns.serve_path, shrink=not ns.no_shrink,
+                         target=ns.target,
+                         regression=_regression_seeds(out))
         report = run_farm(cfg).to_dict()
         _print_report(f"round {len(rounds)}", report)
         rounds.append(report)
@@ -208,6 +231,55 @@ def run_smoke(ns: argparse.Namespace) -> int:
             # one shrink must strictly reduce the byte size
             failures.append("no finding strictly shrank")
 
+        # pass 3 — fork-choice attestation intake (docs/FUZZ.md
+        # "Fork-choice intake"): the clean build must report ZERO
+        # oracle/engine/served divergences over the attestation corpus
+        att_cfg = FarmConfig(out_dir=root / "att", fork=ns.fork,
+                             preset=ns.preset, seed=ns.seed, cases=32,
+                             workers=1, serve_path=ns.serve_path,
+                             target="attestation")
+        clean_att = run_farm(att_cfg).to_dict()
+        _print_report("smoke/attestation", clean_att)
+        if clean_att["merged_findings"] != 0:
+            failures.append(
+                f"clean fork-choice intake reported "
+                f"{clean_att['merged_findings']} divergence(s) — see "
+                f"{root / 'att' / 'findings.jsonl'}")
+
+        # pass 4 — planted fork-choice engine defect: a perturbed
+        # latest-message digest on the engine path must be FOUND
+        os.environ[DEFECT_ENV] = "fc-engine"
+        try:
+            planted_att = run_farm(FarmConfig(
+                out_dir=root / "att-planted", fork=ns.fork,
+                preset=ns.preset, seed=ns.seed, cases=32, workers=1,
+                serve_path=ns.serve_path, target="attestation")).to_dict()
+        finally:
+            os.environ.pop(DEFECT_ENV, None)
+        _print_report("smoke/att-planted", planted_att)
+        if not planted_att["merged_findings"]:
+            failures.append("planted fork-choice engine defect was "
+                            "NOT found")
+
+        # pass 5 — regression seeds: the planted findings fed back as
+        # first-priority cases must re-execute CLEAN on the fixed
+        # (unplanted) build and journal nothing new
+        regr_records = load_regression_records(
+            [root / "planted" / "findings.jsonl"])
+        regr_cfg = FarmConfig(out_dir=root / "regr", fork=ns.fork,
+                              preset=ns.preset, seed=ns.seed, cases=8,
+                              workers=1, serve_path=ns.serve_path,
+                              regression=regr_records)
+        regr = run_farm(regr_cfg).to_dict()
+        _print_report("smoke/regression", regr)
+        if not regr_records:
+            failures.append("no regression seeds loaded from the "
+                            "planted findings")
+        if regr["merged_findings"] != 0:
+            failures.append(
+                f"regression replay on the clean build reported "
+                f"{regr['merged_findings']} finding(s)")
+
         # determinism pin: the planted findings digest is a pure
         # function of (fork, preset, seed, corpus) — print it so CI
         # logs expose any drift across reruns
@@ -249,6 +321,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="served path: in-process SpecService (default "
                              "for smoke) or a real localhost daemon "
                              "(default for long-haul)")
+    parser.add_argument("--target", choices=("block", "attestation"),
+                        default="block",
+                        help="fuzz process_block (default) or the "
+                             "fork-choice on_attestation intake, both "
+                             "through all three paths (docs/FUZZ.md)")
     parser.add_argument("--no-shrink", action="store_true")
     parser.add_argument("--ledger", default=None,
                         help="bank fuzz_execs_per_s to this ledger path")

@@ -83,6 +83,15 @@ jax import, no device, no tunnel):
                               availability hot path, gated from round
                               11 on (chaos: ``perfgate_fleet=3``;
                               docs/SERVE.md "Fleet");
+- ``perfgate_sim_checkpoint_ms`` the partitioned sim's crash-consistent
+                              snapshot plane: one fsync'd write +
+                              digest-verified load + restore round-trip
+                              of a real 3-node multi-Store state,
+                              median of 3, with payload equality
+                              asserted inside the measurement — a
+                              slowed (chaos: ``perfgate_sim_ckpt=3``)
+                              or lossy plane fails the gate, from round
+                              14 on (docs/SIM.md "Checkpoint/resume");
 - ``perfgate_obs_overhead_pct`` the long-haul telemetry plane's armed
                               tax: one instrumented workload timed
                               unarmed vs armed (series flusher +
@@ -550,6 +559,53 @@ def measure_fuzz_execs_per_s() -> float:
     return n_cases / dt
 
 
+def measure_sim_checkpoint_ms() -> float:
+    """The partitioned sim's crash-consistent snapshot plane end-to-end
+    on host, jax-free (docs/SIM.md "Checkpoint/resume"): a short 3-node
+    partitioned run builds real multi-Store state (untimed), then the
+    metric times one full snapshot round-trip — fsync'd tmp+rename
+    WRITE of every node Store + bus + cursors, digest-verified LOAD,
+    and sim RESTORE — median of 3. Two correctness asserts ride inside
+    the measurement: the loaded payload must equal the written payload
+    field-for-field, and the restored sim must re-serialize to an
+    identical payload (a fast number from a lossy snapshot plane must
+    fail here, not ship). A slowed plane (chaos: ``perfgate_sim_ckpt=3``)
+    regresses this number and fails the gate."""
+    import shutil
+    import tempfile
+
+    from consensus_specs_tpu.sim import PartitionConfig, SnapshotManager
+    from consensus_specs_tpu.sim.partition import (
+        PartitionedChainSim,
+        _engine_mode,
+    )
+
+    cfg = PartitionConfig(seed=5, slots=16, nodes=3, partitions=())
+    sim = PartitionedChainSim(cfg)
+    with _engine_mode("interpreted"):
+        sim.run()
+    tmp = tempfile.mkdtemp(prefix="perfgate_simckpt_")
+    try:
+        mgr = SnapshotManager(tmp, keep=2)
+        times: List[float] = []
+        for i in range(3):
+            t0 = time.perf_counter()
+            payload = sim.state_payload()
+            mgr._write(payload, slot=16 + i)
+            loaded = mgr.load_latest()
+            assert loaded is not None, "snapshot did not load back"
+            restored = PartitionedChainSim.from_snapshot(loaded[1])
+            times.append(time.perf_counter() - t0)
+            assert loaded[1] == payload, "snapshot round-trip lost state"
+            re_payload = restored.state_payload()
+            assert re_payload == payload, (
+                "restored sim re-serializes differently")
+        times.sort()
+        return times[1] * 1e3 * _chaos_factor("perfgate_sim_ckpt")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def measure_obs_overhead_pct() -> float:
     """The long-haul telemetry plane's armed tax (docs/OBSERVABILITY.md
     "Long-haul telemetry plane"): one deterministic workload — numpy
@@ -678,6 +734,7 @@ MEASUREMENTS: Tuple[Tuple[str, Callable[[], float]], ...] = (
     ("perfgate_overload_goodput_ratio", measure_overload_goodput_ratio),
     ("perfgate_fleet_failover_ms", measure_fleet_failover_ms),
     ("perfgate_fuzz_execs_per_s", measure_fuzz_execs_per_s),
+    ("perfgate_sim_checkpoint_ms", measure_sim_checkpoint_ms),
     ("perfgate_obs_overhead_pct", measure_obs_overhead_pct),
 )
 

@@ -1,0 +1,255 @@
+"""Partitioned chain-sim smoke (the citest slice; docs/SIM.md
+"Partitioned network" / "Checkpoint/resume").
+
+One deterministic drill battery over a short partitioned run (3 nodes,
+2 scheduled partition/heal windows, seeded adversarial bus):
+
+1. **reference** — uninterrupted vectorized run with crash-consistent
+   snapshots; its digest is the byte-identity baseline, and every heal
+   must converge within the bounded lag.
+2. **differential** — the same configuration, interpreted oracle vs
+   vectorized engine: every node's checkpoint stream bit-identical.
+3. **kill-mid-epoch** — a subprocess run SIGKILLs itself at an
+   arbitrary slot (chaos ``sim.step=kill``); ``--resume`` must complete
+   the run to a final digest byte-identical to the reference.
+4. **kill-mid-snapshot** — the SIGKILL lands INSIDE a snapshot write
+   (chaos ``sim.checkpoint.write=kill``), leaving a torn tmp dir; the
+   resume must ignore it, roll back to the last committed snapshot, and
+   still finish byte-identical.
+5. **tampered snapshot** — the newest snapshot's payload is corrupted
+   on disk; the resume must reject it (digest verification), roll back
+   to the previous snapshot, and still finish byte-identical.
+6. **sim.net chaos** — transient: the bus redelivers and the run is
+   byte-identical to the clean baseline; deterministic: edges are
+   quarantined to lossless delivery, the run still converges, and
+   oracle-vs-vectorized (same injection on both passes) stays
+   bit-identical.
+7. **sim.checkpoint chaos** — a deterministic snapshot fault skips the
+   boundary with a recorded event; the CHAIN digest must not move.
+
+Exit 0 = all drills green; 1 otherwise. Banks
+``sim_partition_smoke_slots_per_s`` when ``--ledger`` is given.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Any, Dict, List, Optional
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from consensus_specs_tpu import resilience  # noqa: E402
+from consensus_specs_tpu.obs import ledger as ledger_mod  # noqa: E402
+from consensus_specs_tpu.resilience import injection  # noqa: E402
+from consensus_specs_tpu.sim import (  # noqa: E402
+    PartitionConfig,
+    SnapshotManager,
+    run_partitioned,
+    run_partitioned_differential,
+    seed_from_env,
+)
+
+SLOTS = 96
+NODES = 3
+CHECKPOINT_EVERY = 2
+
+
+def _run_cli(args: List[str], env_extra: Optional[Dict[str, str]] = None,
+             check: bool = False) -> subprocess.CompletedProcess:
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.pop(injection.ENV_KNOB, None)
+    env.pop("CONSENSUS_SPECS_TPU_CHAOS_STATE", None)
+    if env_extra:
+        env.update(env_extra)
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "sim_run.py"), *args],
+        env=env, capture_output=True, text=True)
+    if check and proc.returncode != 0:
+        print(proc.stdout, file=sys.stderr)
+        print(proc.stderr, file=sys.stderr)
+        raise RuntimeError(f"sim_run {args} -> rc={proc.returncode}")
+    return proc
+
+
+def _resume(ckpt_dir: pathlib.Path,
+            out_json: pathlib.Path) -> Dict[str, Any]:
+    _run_cli(["--resume", str(ckpt_dir), "--ledger", "off",
+              "--json", str(out_json)], check=True)
+    return json.loads(out_json.read_text())
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--slots", type=int, default=SLOTS)
+    parser.add_argument("--ledger", default=None)
+    ns = parser.parse_args(argv)
+
+    seed = seed_from_env(1)
+    root = pathlib.Path(tempfile.mkdtemp(prefix="sim_partition_smoke_"))
+    failures: List[str] = []
+    t0 = time.time()
+    base_args = ["--nodes", str(NODES), "--slots", str(ns.slots),
+                 "--seed", str(seed), "--engine", "vectorized",
+                 "--checkpoint-every", str(CHECKPOINT_EVERY),
+                 "--ledger", "off"]
+
+    def drill(name: str, cond: bool, detail: str = "") -> None:
+        print(f"sim-partition-smoke: {name}: {'OK' if cond else 'FAILED'}"
+              + (f" ({detail})" if detail else ""))
+        if not cond:
+            failures.append(f"{name}: {detail}")
+
+    try:
+        # 1. reference run (in-process, snapshots armed)
+        config = PartitionConfig(seed=seed, slots=ns.slots, nodes=NODES,
+                                 checkpoint_every=CHECKPOINT_EVERY)
+        ref_mgr = SnapshotManager(root / "ref")
+        ref = run_partitioned(config, "vectorized", manager=ref_mgr)
+        lags = [c["lag"] for c in ref.convergence]
+        drill("reference converged", ref.converged,
+              f"windows {[(c['heal'], c['lag']) for c in ref.convergence]}")
+        drill("snapshots written", ref.stats["snapshots_written"] >= 2,
+              str(ref.stats["snapshots_written"]))
+        ref_digest = ref.digest()
+
+        # 2. per-node differential (oracle vs vectorized)
+        diff = run_partitioned_differential(config)
+        drill("per-node differential", diff["identical"],
+              str(diff["mismatches"][:2]))
+        drill("differential converged", diff["converged"])
+
+        # 3. kill-mid-epoch -> resume byte-identical
+        kill_dir = root / "kill-epoch"
+        state = root / "chaos-state-1.json"
+        kill_after = max(10, ns.slots * 2 // 3)
+        proc = _run_cli(base_args + ["--checkpoint-dir", str(kill_dir)],
+                        env_extra={
+                            injection.ENV_KNOB:
+                                f"sim.step=kill:1:{kill_after}",
+                            "CONSENSUS_SPECS_TPU_CHAOS_STATE": str(state)})
+        killed = (proc.returncode in (-signal.SIGKILL, 128 + signal.SIGKILL)
+                  or proc.returncode == -9)
+        drill("kill-mid-epoch killed", killed, f"rc={proc.returncode}")
+        digest = _resume(kill_dir, root / "resume1.json")["partitioned"]["digest"]
+        drill("kill-mid-epoch resume byte-identical", digest == ref_digest,
+              f"{digest[:16]} vs {ref_digest[:16]}")
+
+        # 4. kill-mid-snapshot -> torn tmp ignored, resume byte-identical
+        kill_dir2 = root / "kill-snap"
+        state2 = root / "chaos-state-2.json"
+        proc = _run_cli(base_args + ["--checkpoint-dir", str(kill_dir2)],
+                        env_extra={
+                            injection.ENV_KNOB:
+                                "sim.checkpoint.write=kill:1:2",
+                            "CONSENSUS_SPECS_TPU_CHAOS_STATE": str(state2)})
+        killed = proc.returncode == -9 or proc.returncode == 137
+        drill("kill-mid-snapshot killed", killed, f"rc={proc.returncode}")
+        torn = [p.name for p in kill_dir2.iterdir() if ".tmp." in p.name]
+        drill("kill-mid-snapshot left torn tmp", bool(torn), str(torn))
+        digest = _resume(kill_dir2,
+                         root / "resume2.json")["partitioned"]["digest"]
+        drill("kill-mid-snapshot resume byte-identical",
+              digest == ref_digest, f"{digest[:16]} vs {ref_digest[:16]}")
+
+        # 5. tampered snapshot -> rejected, rolled back, byte-identical
+        tamper_dir = root / "tamper"
+        shutil.copytree(root / "ref", tamper_dir)
+        # drop the final snapshot's run state back to an earlier one by
+        # tampering the NEWEST snapshot: resume must reject it and roll
+        # back to the previous snapshot, then still reach the same end
+        mgr = SnapshotManager(tamper_dir)
+        snaps = mgr.snapshots()
+        drill("retention keeps 2 snapshots", len(snaps) == 2,
+              str([p.name for _, p in snaps]))
+        newest = snaps[-1][1] / "nodes.json"
+        blob = bytearray(newest.read_bytes())
+        blob[len(blob) // 2] ^= 0xFF
+        newest.write_bytes(bytes(blob))
+        summary = _resume(tamper_dir, root / "resume3.json")
+        drill("tampered snapshot rejected (rolled back to previous)",
+              summary["resumed_from_slot"] == snaps[0][0],
+              f"resumed from {summary['resumed_from_slot']}, "
+              f"expected {snaps[0][0]}")
+        digest = summary["partitioned"]["digest"]
+        drill("tampered-snapshot resume byte-identical",
+              digest == ref_digest, f"{digest[:16]} vs {ref_digest[:16]}")
+
+        # 6a. sim.net transient chaos: the retried schedule computation
+        # redelivers identically — chain AND bus accounting must match
+        # the clean reference (the full digests differ only by the
+        # reference's snapshot counters, so compare chain + net)
+        resilience.clear("sim.net")
+        with injection.inject("sim.net", "transient", count=2, after=40):
+            transient = run_partitioned(config, "vectorized")
+        resilience.clear("sim.net")
+        drill("sim.net transient redelivery byte-identical",
+              (transient.chain_digest() == ref.chain_digest()
+               and transient.net == ref.net))
+
+        # 6b. sim.net deterministic chaos: edges quarantined to lossless,
+        # still converges, and the differential holds under the SAME
+        # injection on both passes
+        def _net_chaos_run(mode: str):
+            resilience.clear("sim.net")
+            try:
+                with injection.inject("sim.net", "deterministic", count=1,
+                                      after=60):
+                    return run_partitioned(config, mode)
+            finally:
+                resilience.clear("sim.net")
+
+        net_oracle = _net_chaos_run("interpreted")
+        net_vec = _net_chaos_run("vectorized")
+        drill("sim.net quarantine fired",
+              net_vec.net["quarantined_edges"] >= 1,
+              str(net_vec.net["quarantined_edges"]))
+        drill("sim.net chaos run converged", net_vec.converged)
+        drill("sim.net chaos differential",
+              net_oracle.chain_digest() == net_vec.chain_digest())
+
+        # 7. sim.checkpoint deterministic chaos: boundary skipped, chain
+        # digest unmoved
+        resilience.clear("sim.checkpoint")
+        try:
+            with injection.inject("sim.checkpoint", "deterministic",
+                                  count=1):
+                ckpt_chaos = run_partitioned(
+                    config, "vectorized",
+                    manager=SnapshotManager(root / "ckpt-chaos"))
+        finally:
+            resilience.clear("sim.checkpoint")
+        drill("sim.checkpoint chaos skipped a boundary",
+              ckpt_chaos.stats["snapshots_skipped"] >= 1,
+              str(ckpt_chaos.stats["snapshots_skipped"]))
+        drill("sim.checkpoint chaos chain unmoved",
+              ckpt_chaos.chain_digest() == ref.chain_digest())
+
+        if ns.ledger is not None and not failures:
+            led = ledger_mod.Ledger(ns.ledger)
+            run_id = led.record_run(
+                {"sim_partition_smoke_slots_per_s": round(ref.slots_per_s, 2),
+                 "sim_convergence_lag_slots": float(max(lags))},
+                source="sim_partition_smoke", backend="host")
+            print(f"sim-partition-smoke: banked -> {led.path} ({run_id})")
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    print(f"sim-partition-smoke: {'FAILED' if failures else 'PASSED'} "
+          f"in {time.time() - t0:.1f}s")
+    for f in failures:
+        print(f"sim-partition-smoke FAILED: {f}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

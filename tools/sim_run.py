@@ -8,6 +8,20 @@ Usage:
     python tools/sim_run.py [--slots N] [--seed N] [--fork F] [--preset P]
                             [--validators N] [--engine MODE] [--chaos-drill]
                             [--sign] [--ledger PATH|off] [--json OUT]
+                            [--nodes N] [--partitions N]
+                            [--checkpoint-dir D] [--checkpoint-every K]
+                            [--resume D] [--converge-within N]
+
+Partitioned mode (``--nodes >= 2``, docs/SIM.md "Partitioned network"):
+N simulated nodes with independent Stores exchange blocks/attestations
+through the seeded adversarial bus (drop/delay/duplicate/reorder +
+scheduled partition windows). The differential engine contract holds
+PER NODE, every heal must converge within the bounded lag, and
+``--checkpoint-dir`` arms crash-consistent snapshots every K epochs so
+a SIGKILLed run resumes via ``--resume <dir>`` to a byte-identical
+final chain (single-engine runs only; the differential mode runs both
+passes in-process). Ledger: ``chain_sim_partition_slots_per_s``,
+``chain_sim_partition_speedup``, ``sim_convergence_lag_slots``.
 
 Engine modes:
     differential (default)  oracle pass + vectorized pass, checkpoint
@@ -57,6 +71,13 @@ from consensus_specs_tpu.sim.driver import (  # noqa: E402
     run_differential,
     run_sim,
 )
+from consensus_specs_tpu.sim.checkpoint import SnapshotManager  # noqa: E402
+from consensus_specs_tpu.sim.net import default_partitions  # noqa: E402
+from consensus_specs_tpu.sim.partition import (  # noqa: E402
+    PartitionConfig,
+    run_partitioned,
+    run_partitioned_differential,
+)
 
 
 def chaos_drill(config: ScenarioConfig, scenario: Scenario,
@@ -82,6 +103,140 @@ def chaos_drill(config: ScenarioConfig, scenario: Scenario,
     }
 
 
+def _finish_longhaul() -> None:
+    lh = timeseries.config_from_env()
+    if lh is None:
+        return
+    timeseries.stop()
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "mission_report", str(REPO / "tools" / "mission_report.py"))
+    assert spec is not None and spec.loader is not None
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.main([lh[0]])
+
+
+def run_partition_mode(ns) -> int:
+    """The partitioned multi-node lane: adversarial bus + partition/heal
+    windows + per-node differential + optional checkpoint/resume."""
+    timeseries.ensure_started(role="sim.partition")
+    summary: Dict[str, Any] = {}
+    ok = True
+    metrics: Dict[str, float] = {}
+
+    manager = None
+    if ns.checkpoint_dir is not None:
+        manager = SnapshotManager(ns.checkpoint_dir)
+
+    if ns.resume is not None:
+        mgr = SnapshotManager(ns.resume)
+        loaded = mgr.load_latest()
+        if loaded is None:
+            print(f"sim: no valid snapshot under {ns.resume}",
+                  file=sys.stderr)
+            return 1
+        slot, payload = loaded
+        engine_mode = (payload["engine"] if ns.engine == "differential"
+                       else ns.engine)
+        print(f"sim: resuming from snapshot at slot {slot} "
+              f"({payload['config']['slots']} total, engine {engine_mode})")
+        result = run_partitioned(None, engine_mode, manager=mgr,
+                                 resume_payload=payload)
+        summary["resumed_from_slot"] = slot
+        summary["partitioned"] = result.to_dict()
+        ok = result.converged
+        print(f"sim: partition resume done — digest {result.digest()}")
+        print(f"sim: convergence {result.convergence}")
+    else:
+        seed = ns.seed if ns.seed is not None else seed_from_env(0)
+        config = PartitionConfig(
+            seed=seed, slots=ns.slots, fork=ns.fork, preset=ns.preset,
+            validators=ns.validators, nodes=ns.nodes, sign=ns.sign,
+            partitions=default_partitions(seed, ns.slots, ns.nodes,
+                                          ns.partitions),
+            converge_within=ns.converge_within,
+            checkpoint_every=ns.checkpoint_every)
+        windows = [(w.start, w.end) for w in config.resolved_partitions()]
+        print(f"sim: partitioned {ns.slots} slots of {ns.fork}/{ns.preset}, "
+              f"seed {seed}, {ns.nodes} nodes, windows {windows}")
+        vtag = "" if ns.validators == 64 else f"_{ns.validators}v"
+
+        if ns.engine == "differential":
+            diff = run_partitioned_differential(config)
+            oracle, vectorized = diff["oracle"], diff["vectorized"]
+            summary["oracle"] = oracle.to_dict()
+            summary["vectorized"] = vectorized.to_dict()
+            summary["identical"] = diff["identical"]
+            summary["mismatches"] = diff["mismatches"]
+            ok = diff["identical"] and diff["converged"]
+            print(f"sim: oracle {oracle.seconds:.1f}s "
+                  f"({oracle.slots_per_s:.1f} slots/s), vectorized "
+                  f"{vectorized.seconds:.1f}s ({vectorized.slots_per_s:.1f} "
+                  f"slots/s), speedup {diff['speedup']}x")
+            print(f"sim: {diff['checkpoints']} per-node checkpoints "
+                  f"{'BIT-IDENTICAL' if diff['identical'] else 'DIVERGED'}"
+                  + ("" if diff["identical"]
+                     else f" — {diff['mismatches'][:3]}"))
+            print(f"sim: convergence "
+                  f"{'OK' if diff['converged'] else 'FAILED'} "
+                  f"{oracle.convergence}")
+            result = vectorized
+            metrics[f"chain_sim{vtag}_partition_slots_per_s"] = round(
+                vectorized.slots_per_s, 2)
+            if diff["speedup"] is not None:
+                metrics[f"chain_sim{vtag}_partition_speedup"] = diff["speedup"]
+        else:
+            result = run_partitioned(config, ns.engine, manager=manager)
+            summary["partitioned"] = result.to_dict()
+            ok = result.converged
+            print(f"sim: {ns.engine} {result.seconds:.1f}s "
+                  f"({result.slots_per_s:.1f} slots/s) — digest "
+                  f"{result.digest()}")
+            print(f"sim: convergence {result.convergence}")
+            if ns.engine == "vectorized":
+                metrics[f"chain_sim{vtag}_partition_slots_per_s"] = round(
+                    result.slots_per_s, 2)
+        lags = [c["lag"] for c in result.convergence if c["lag"] is not None]
+        if lags:
+            metrics["sim_convergence_lag_slots"] = float(max(lags))
+        net = result.net
+        print(f"sim: net — {net['sent']} sent, {net['delivered']} "
+              f"delivered, {net['dropped_attempts']} dropped attempts, "
+              f"{net['delayed']} delayed, {net['duplicated']} duplicated, "
+              f"{net['held']} held across cuts, "
+              f"{net['quarantined_edges']} quarantined edges")
+        if result.stats.get("snapshots_written"):
+            print(f"sim: {result.stats['snapshots_written']} snapshot(s) "
+                  f"written"
+                  + (f", {result.stats['snapshots_skipped']} skipped"
+                     if result.stats.get("snapshots_skipped") else ""))
+
+    if metrics and ns.ledger != "off":
+        path = ns.ledger or ledger_mod.default_path()
+        if path:
+            run_id = ledger_mod.Ledger(path).record_run(
+                metrics, source="chain_sim_partition", backend="host",
+                extra={"sim": {"slots": ns.slots, "nodes": ns.nodes,
+                               "identical": ok}})
+            summary["ledger"] = {"path": path, "run_id": run_id}
+            print(f"sim: banked {sorted(metrics)} -> {path} ({run_id})")
+
+    if ns.json_path is not None:
+        with open(ns.json_path, "w") as f:
+            json.dump(summary, f, indent=2, sort_keys=True)
+        print(f"json summary written to {ns.json_path}")
+    print(f"sim: {'OK' if ok else 'FAILED'}")
+    if not ok:
+        bundle = timeseries.postmortem_bundle(
+            "partitioned sim divergence or convergence failure")
+        if bundle:
+            print(f"sim: postmortem bundle -> {bundle}")
+    _finish_longhaul()
+    return 0 if ok else 1
+
+
 def main(argv: Optional[list] = None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--slots", type=int, default=2048)
@@ -101,7 +256,25 @@ def main(argv: Optional[list] = None) -> int:
     parser.add_argument("--ledger", default=None,
                         help="perf ledger path; 'off' disables banking")
     parser.add_argument("--json", dest="json_path", type=pathlib.Path, default=None)
+    parser.add_argument("--nodes", type=int, default=1,
+                        help=">=2 switches to the partitioned multi-node "
+                             "sim over the adversarial bus (docs/SIM.md)")
+    parser.add_argument("--partitions", type=int, default=2,
+                        help="scheduled partition/heal windows (seeded)")
+    parser.add_argument("--converge-within", type=int, default=None,
+                        help="post-heal convergence bound in slots "
+                             "(default: 3 epochs)")
+    parser.add_argument("--checkpoint-dir", type=pathlib.Path, default=None,
+                        help="arm crash-consistent snapshots into this dir")
+    parser.add_argument("--checkpoint-every", type=int, default=4,
+                        help="epochs between snapshots")
+    parser.add_argument("--resume", type=pathlib.Path, default=None,
+                        help="resume a partitioned run from its newest "
+                             "valid snapshot in this dir")
     ns = parser.parse_args(argv)
+
+    if ns.nodes >= 2 or ns.resume is not None:
+        return run_partition_mode(ns)
 
     # long-haul telemetry (docs/OBSERVABILITY.md): armed via the
     # CONSENSUS_SPECS_TPU_LONGHAUL knob, this run journals slots/s,

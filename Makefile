@@ -26,7 +26,8 @@ DEVICE_TESTS = tests/test_bls_device.py tests/test_curve_device.py \
         dryrun detect_generator_incomplete clean-vectors chaos trace perfgate perf-report gen-bench \
         gen-shard-smoke warm-cache serve serve-smoke serve-bench serve-canary slo-report sim \
         sim-smoke sim-partition sim-partition-smoke device-probe overload-drill overload-smoke \
-        fleet-drill fleet-smoke fuzz fuzz-smoke longhaul-smoke mission-report help
+        fleet-drill fleet-smoke fuzz fuzz-smoke longhaul-smoke mission-report \
+        chain-health-smoke chain-report help
 
 # the fault-injection suite: supervisor/taxonomy units, chaos replay
 # (tampered vectors), induced backend failures, generator crash/resume
@@ -69,6 +70,8 @@ help:
 	@echo "fuzz-smoke            deterministic fuzz drill (citest slice): clean build finds ZERO divergences; a planted engine defect is found AND shrunk; fuzz_execs_per_s -> $(LEDGER)"
 	@echo "longhaul-smoke        long-haul telemetry drill (citest slice): armed sim+fuzz run -> series journals + profile + byte-stable mission report; planted RSS leak must be flagged"
 	@echo "mission-report        merge a long-haul telemetry dir (LONGHAUL=<dir>) into one mission-control HTML report"
+	@echo "chain-health-smoke    consensus-health drill (citest slice): clean partitioned run flags NOTHING; planted finality stall (40% muted attesters) and unscheduled split-brain are each flagged by the right watchdog with a replayable forensic bundle; armed == unarmed bit-identical"
+	@echo "chain-report          render a run's chain journals (LONGHAUL=<dir>) into the chain-health HTML report"
 	@echo "device-probe          opportunistic device probe: bank backend:jax ledger points for the headline keys when the tunnel is healthy"
 
 # parallelize like the reference (ref Makefile:100-106) when pytest-xdist
@@ -91,6 +94,7 @@ citest:
 	$(MAKE) gen-shard-smoke
 	$(MAKE) sim-smoke
 	$(MAKE) sim-partition-smoke
+	$(MAKE) chain-health-smoke
 	$(MAKE) fuzz-smoke
 	$(MAKE) longhaul-smoke
 	$(MAKE) serve-smoke
@@ -242,6 +246,20 @@ longhaul-smoke:
 mission-report:
 	$(if $(LONGHAUL),,$(error mission-report requires LONGHAUL=<telemetry dir>))
 	$(PYTHON) tools/mission_report.py $(LONGHAUL)
+
+# the consensus-health drill (docs/OBSERVABILITY.md "Consensus health
+# plane"): a clean partitioned run must flag NOTHING (scheduled
+# partition windows are excused via the sim/net.py export), a planted
+# finality stall (40% muted attesters) and a planted unscheduled
+# split-brain must each be flagged by the RIGHT watchdog with a
+# replayable forensic bundle (store dumps + intake rings + seeded bus
+# schedule), and an armed run must be bit-identical to an unarmed one.
+chain-health-smoke:
+	$(PYTHON) tools/chain_health_smoke.py --ledger $(LEDGER)
+
+chain-report:
+	$(if $(LONGHAUL),,$(error chain-report requires LONGHAUL=<telemetry dir>))
+	$(PYTHON) tools/chain_report.py $(LONGHAUL)
 
 # ROADMAP #2's second half: the moment the tunnel is healthy, bank
 # backend:"jax" datapoints for the round-4 headline keys by running just

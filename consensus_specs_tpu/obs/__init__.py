@@ -34,6 +34,14 @@ Perfetto-loadable trace per run.
   throughput decay, queue creep, stalls) whose findings land in the
   journal and the trace. ``tools/mission_report.py`` merges a whole
   run into one mission-control HTML report.
+- :mod:`chain` — the consensus health plane
+  (``CONSENSUS_SPECS_TPU_CHAIN_HEALTH`` knob, armed by default):
+  chain-level gauges (per-node head/finality/participation/forks),
+  consensus watchdogs (finality_stall, participation_droop,
+  split_brain, reorg_storm — excused inside scheduled partition
+  windows), per-node fork-choice intake black boxes, and forensic
+  bundles written the moment the chain looks sick.
+  ``tools/chain_report.py`` renders a run's chain timeline.
 
 Instrumented planes: bls facade dispatch + oracle adjudication, engine
 ``dispatch_delta_kernel`` + every vectorized epoch stage, the ssz
@@ -88,3 +96,4 @@ from .metrics import (  # noqa: F401
 from . import ledger, sentinel  # noqa: F401  (perf evidence plane)
 from . import flightrec, slo  # noqa: F401  (request observability plane)
 from . import proc, profile, timeseries, watchdog  # noqa: F401  (long-haul plane)
+from . import chain  # noqa: F401  (consensus health plane)

@@ -1,0 +1,531 @@
+"""Consensus health plane: chain-level telemetry, consensus watchdogs,
+and the divergence black-box recorder (docs/OBSERVABILITY.md
+"Consensus health plane").
+
+Every observability layer before this one watches *processes* — spans,
+RSS, queue depths, request latencies. Nothing watched the *chain*: a
+multi-hour simulated mainnet day can limp through low participation,
+a finality stall, or a deepening reorg storm and only fail at the
+end-of-run differential. Following the Dapper/Monarch split between
+request tracing and domain-level monitoring, this module is the
+domain-level monitor:
+
+- a **chain-health metric family** registered as plain gauges/counters/
+  histograms in the existing registry (so it flows into the long-haul
+  time-series journals and every ``/metrics`` exposition with zero new
+  plumbing): per-node head slot, justified/finalized epoch, finality
+  lag, pending-queue depths, live fork count; per-epoch participation
+  rate; reorg events with a depth histogram; attestation inclusion
+  distance;
+- **consensus watchdogs** (:class:`~.watchdog.ChainWatchdog`, knobs via
+  ``CONSENSUS_SPECS_TPU_CHAIN_HEALTH``): finality_stall,
+  participation_droop, split_brain, reorg_storm — slot-indexed, gated
+  by the scheduled partition windows sim/net.py exports so planned
+  splits and their heals never false-positive;
+- a **black-box recorder**: each node keeps a bounded ring of recent
+  fork-choice intake (message id, arrival slot/phase, accept/reject
+  class). Any watchdog finding — or an explicit convergence/differential
+  failure — triggers a forensic bundle: per-node Store dumps + intake
+  rings + the seeded bus schedule slice + the config (seed included),
+  enough to replay the divergence without rerunning the day;
+- a **chain journal** (``chain-<pid>-<token>.jsonl`` next to the
+  long-haul series journals): one line per slot/epoch/reorg/finding,
+  rendered by ``tools/chain_report.py`` and the mission report's
+  "Chain health" section.
+
+Armed by default (a handful of dict writes per *slot*, not per
+operation — ``perfgate_chain_health_overhead_pct`` holds the armed sim
+under the same <3% ceiling as the process plane);
+``CONSENSUS_SPECS_TPU_CHAIN_HEALTH=off`` disarms it entirely. The
+plane is strictly observational: an armed and an unarmed run of the
+same config produce bit-identical chains (asserted inside the perfgate
+measurement and the chain-health smoke).
+"""
+from __future__ import annotations
+
+import json
+import os
+from collections import deque
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
+
+from . import metrics
+from .watchdog import (  # noqa: F401  (re-exported knobs)
+    CHAIN_HEALTH_ENV,
+    ChainThresholds,
+    ChainWatchdog,
+    chain_health_disarmed,
+)
+
+# intake outcome classes the black-box ring records (the spec's real
+# rejection ladder, as the sims exercise it)
+INTAKE_ACCEPTED = "accepted"
+INTAKE_REJECTED = "rejected"
+INTAKE_PARKED = "parked"
+INTAKE_DUPLICATE = "duplicate"
+
+_RING_DEFAULT = 512
+_JOURNAL_FLUSH_EVERY = 64      # buffered lines between flushes
+_TAIL_KEEP = 256               # slot rows retained for the bundle
+
+GAUGE_HELP: Dict[str, str] = {
+    "chain.head_slot": "Best head slot across nodes (chain position)",
+    "chain.finalized_epoch": "Best finalized epoch across nodes",
+    "chain.finality_lag_epochs":
+        "Worst current-epoch minus finalized-epoch gap across nodes "
+        "(lower is better)",
+    "chain.participation_rate":
+        "Best previous-epoch target-participation fraction across nodes "
+        "(the FFG justification input)",
+    "chain.fork_count": "Most live branch tips any node's Store holds",
+    "chain.net_partitioned":
+        "1 while a scheduled partition window covers the current slot "
+        "(sim/net.py export; watchdogs are excused inside)",
+    "chain.reorgs": "Reorg events observed (head moved to a non-ancestor)",
+    "chain.reorg_depth": "Reorg depth in slots (old head to common ancestor)",
+    "chain.inclusion_distance_slots":
+        "Attestation inclusion distance (block slot minus attestation slot)",
+}
+
+
+def node_gauge_help(nodes: int) -> Dict[str, str]:
+    """HELP texts for the per-node series of an ``nodes``-node run."""
+    out: Dict[str, str] = {}
+    for i in range(nodes):
+        out.update({
+            f"chain.n{i}.head_slot": f"Node {i} fork-choice head slot",
+            f"chain.n{i}.justified_epoch": f"Node {i} justified epoch",
+            f"chain.n{i}.finalized_epoch": f"Node {i} finalized epoch",
+            f"chain.n{i}.finality_lag_epochs":
+                f"Node {i} current-epoch minus finalized-epoch gap",
+            f"chain.n{i}.pending_blocks":
+                f"Node {i} blocks parked awaiting a parent (sync queue)",
+            f"chain.n{i}.pending_atts":
+                f"Node {i} attestations parked awaiting their block",
+            f"chain.n{i}.fork_count":
+                f"Node {i} live branch tips (Store leaves above finality)",
+            f"chain.n{i}.participation_rate":
+                f"Node {i} previous-epoch target-participation fraction",
+        })
+    return out
+
+
+def register_descriptions(nodes: int = 1) -> None:
+    """Register the family's HELP texts (prometheus exposition
+    metadata) — the serve daemon calls this on its startup path so a
+    fleet's ``/metrics`` rollup carries self-documenting chain gauges."""
+    metrics.describe_many(GAUGE_HELP)
+    metrics.describe_many(node_gauge_help(nodes))
+
+
+# ---------------------------------------------------------------------------
+# metric math (unit-tested directly in tests/test_chain_health.py)
+# ---------------------------------------------------------------------------
+
+def participation_rate(spec, state) -> Optional[float]:
+    """Previous-epoch target-participation fraction of ``state`` —
+    EXACTLY the balance ratio the interpreted epoch transition feeds
+    into FFG justification (``weigh_justification_and_finalization``):
+
+    - altair+: unslashed TIMELY_TARGET participants of the previous
+      epoch (``get_unslashed_participating_indices``) total balance over
+      total active balance;
+    - phase0: ``get_attesting_balance`` of the matching-target previous-
+      epoch attestations over total active balance.
+
+    Returns None when the state cannot answer (mid-genesis shapes)."""
+    try:
+        total = int(spec.get_total_active_balance(state))
+        if not total:
+            return None
+        prev = spec.get_previous_epoch(state)
+        if hasattr(state, "previous_epoch_participation"):
+            indices = spec.get_unslashed_participating_indices(
+                state, spec.TIMELY_TARGET_FLAG_INDEX, prev)
+            part = int(spec.get_total_balance(state, indices))
+        else:
+            atts = spec.get_matching_target_attestations(state, prev)
+            part = int(spec.get_attesting_balance(state, atts))
+        return part / total
+    except Exception:
+        return None
+
+
+def reorg_depth(store, old_head, new_head) -> int:
+    """Depth of a reorg in slots: the old head's slot minus the slot of
+    the deepest common ancestor of old and new head (>= 1 for any real
+    reorg). When the old branch was already pruned out of the Store the
+    fallback is the old head's slot minus the finalized slot — the
+    deepest a surviving reorg can reach."""
+    blocks = {bytes(root): block for root, block in store.blocks.items()}
+    new_ancestry = set()
+    cursor = bytes(new_head)
+    while cursor in blocks:
+        new_ancestry.add(cursor)
+        parent = bytes(blocks[cursor].parent_root)
+        if parent == cursor:
+            break
+        cursor = parent
+    old = blocks.get(bytes(old_head))
+    if old is None:
+        return 0
+    old_slot = int(old.slot)
+    cursor = bytes(old_head)
+    while cursor in blocks and cursor not in new_ancestry:
+        cursor = bytes(blocks[cursor].parent_root)
+    if cursor in new_ancestry:
+        return max(0, old_slot - int(blocks[cursor].slot))
+    # old branch severed (pruned): bound by finality
+    try:
+        fin_root = bytes(store.finalized_checkpoint.root)
+        fin_slot = int(blocks[fin_root].slot) if fin_root in blocks else 0
+        return max(0, old_slot - fin_slot)
+    except Exception:
+        return max(0, old_slot)
+
+
+def fork_count(store, cap: int = 4096) -> int:
+    """Live branch tips: Store blocks that are nobody's parent. 1 on a
+    clean chain; every competing branch adds a tip. Skipped (returns -1)
+    past ``cap`` blocks — an unpruned pathological Store must not turn
+    the health plane into the hot path."""
+    blocks = store.blocks
+    if len(blocks) > cap:
+        return -1
+    parents = {bytes(b.parent_root) for b in blocks.values()}
+    return sum(1 for root in blocks if bytes(root) not in parents)
+
+
+# ---------------------------------------------------------------------------
+# black-box recorder
+# ---------------------------------------------------------------------------
+
+class BlackBox:
+    """One node's bounded ring of recent fork-choice intake: what
+    arrived, when (slot + phase), and what the spec's rejection ladder
+    did with it. This is the flight recorder a divergence post-mortem
+    reads first: two nodes' rings pin the exact message whose differing
+    fate forked their views."""
+
+    __slots__ = ("node", "ring")
+
+    def __init__(self, node: int, capacity: int = _RING_DEFAULT) -> None:
+        self.node = node
+        self.ring: Deque[Tuple[int, str, str, str, str]] = deque(
+            maxlen=max(16, int(capacity)))
+
+    def record(self, slot: int, phase: str, kind: str, msg_id: str,
+               outcome: str) -> None:
+        self.ring.append((int(slot), phase, kind, msg_id, outcome))
+
+    def entries(self) -> List[Dict[str, Any]]:
+        return [{"slot": s, "phase": p, "kind": k, "id": m, "outcome": o}
+                for s, p, k, m, o in self.ring]
+
+
+# ---------------------------------------------------------------------------
+# the plane
+# ---------------------------------------------------------------------------
+
+class ChainHealth:
+    """One instance per sim run (single- or multi-node). The owning
+    driver feeds it at slot/epoch boundaries; it publishes the metric
+    family, runs the consensus watchdogs, journals the chain timeline,
+    and writes forensic bundles the moment something is wrong.
+
+    ``bundle_cb`` is the owning sim's zero-arg callable returning the
+    heavyweight forensic payload (per-node Store dumps + bus state);
+    the plane itself adds findings, rings, and the timeline tail.
+    ``out_dir`` defaults to the long-haul telemetry directory when that
+    plane is armed, else no journal is written (metrics/watchdogs still
+    run)."""
+
+    def __init__(
+        self,
+        nodes: int,
+        slots_per_epoch: int,
+        windows: Tuple[Tuple[int, int], ...] = (),
+        thresholds: Optional[ChainThresholds] = None,
+        out_dir: Optional[str] = None,
+        label: str = "chain",
+        bundle_cb: Optional[Callable[[], Dict[str, Any]]] = None,
+        max_bundles: int = 2,
+        ring_capacity: int = _RING_DEFAULT,
+    ) -> None:
+        self.nodes = int(nodes)
+        self.spe = int(slots_per_epoch)
+        self.label = label
+        self.bundle_cb = bundle_cb
+        self.max_bundles = int(max_bundles)
+        self.watchdog = ChainWatchdog(thresholds, windows=windows,
+                                      slots_per_epoch=slots_per_epoch)
+        self.rings = [BlackBox(i, ring_capacity) for i in range(self.nodes)]
+        self.findings: List[Dict[str, Any]] = []
+        self.bundles: List[str] = []
+        self.tail: Deque[Dict[str, Any]] = deque(maxlen=_TAIL_KEEP)
+        self._reorgs_pending = 0
+        self._token = os.urandom(3).hex()
+        self._pid = os.getpid()
+        self._buffer: List[str] = []
+        self._fh = None
+        if out_dir is None:
+            from . import timeseries
+
+            cfg = timeseries.config_from_env()
+            out_dir = cfg[0] if cfg is not None else None
+        self.out_dir = out_dir
+        register_descriptions(self.nodes)
+        self._header = {"type": "chain_header", "label": label,
+                        "nodes": self.nodes, "spe": self.spe,
+                        "pid": self._pid,
+                        "windows": [list(w) for w in self.watchdog.windows]}
+        self._journal(dict(self._header))
+
+    def set_out_dir(self, out_dir: Optional[str]) -> None:
+        """Re-point (or arm) the journal directory after construction —
+        drills arm an explicit directory without the long-haul knob. The
+        header is re-emitted so the new journal is self-describing."""
+        self.out_dir = out_dir
+        self._buffer = []
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except Exception:
+                pass
+            self._fh = None
+        if out_dir is not None:
+            self._journal(dict(self._header))
+
+    # -- scheduled-window plumbing (drills re-point it) --------------------
+
+    def set_windows(self, windows: Tuple[Tuple[int, int], ...]) -> None:
+        self.watchdog.set_windows(windows)
+
+    # -- journal -----------------------------------------------------------
+
+    @property
+    def journal_path(self) -> Optional[str]:
+        if self.out_dir is None:
+            return None
+        return os.path.join(self.out_dir,
+                            f"chain-{self._pid}-{self._token}.jsonl")
+
+    def _journal(self, record: Dict[str, Any], flush: bool = False,
+                 fsync: bool = False) -> None:
+        if self.out_dir is None:
+            return
+        self._buffer.append(json.dumps(record, default=repr))
+        if not (flush or fsync
+                or len(self._buffer) >= _JOURNAL_FLUSH_EVERY):
+            return
+        try:
+            if self._fh is None:
+                os.makedirs(self.out_dir, exist_ok=True)
+                self._fh = open(self.journal_path, "a")
+            self._fh.write("\n".join(self._buffer) + "\n")
+            self._buffer = []
+            self._fh.flush()
+            if fsync:
+                os.fsync(self._fh.fileno())
+        except OSError:
+            self._buffer = []
+
+    def close(self) -> None:
+        """Flush the journal tail (end of run)."""
+        self._journal({"type": "chain_close"}, fsync=True)
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except Exception:
+                pass
+            self._fh = None
+
+    # -- intake / event recorders ------------------------------------------
+
+    def record_intake(self, node: int, slot: int, phase: str, kind: str,
+                      msg_id: str, outcome: str) -> None:
+        """One fork-choice intake decision into the node's black box."""
+        if 0 <= node < self.nodes:
+            self.rings[node].record(slot, phase, kind, msg_id, outcome)
+        metrics.count(f"chain.intake.{outcome}")
+
+    def record_reorg(self, node: int, slot: int, depth: int) -> None:
+        metrics.count("chain.reorgs")
+        metrics.observe("chain.reorg_depth", float(depth))
+        # only DEEP reorgs feed the storm detector: depth-1 head swaps
+        # are ordinary gossip weather on a lossy network
+        if depth >= self.watchdog.t.reorg_storm_min_depth:
+            self._reorgs_pending += 1
+        self._journal({"type": "chain_reorg", "slot": int(slot),
+                       "node": int(node), "depth": int(depth)})
+
+    def record_inclusion(self, block_slot: int, att_slot: int) -> None:
+        """Attestation rode a block: distance = block slot − attestation
+        slot (spec bounds: [MIN_ATTESTATION_INCLUSION_DELAY,
+        SLOTS_PER_EPOCH])."""
+        metrics.observe("chain.inclusion_distance_slots",
+                        float(int(block_slot) - int(att_slot)))
+
+    # -- slot/epoch boundaries ---------------------------------------------
+
+    def on_slot(self, slot: int, views: List[Dict[str, Any]],
+                partitioned: bool = False) -> List[Dict[str, Any]]:
+        """Top-of-slot observation (post-intake, pre-proposal). Each
+        view: ``{head, head_slot, justified_epoch, finalized_epoch,
+        pending_blocks, pending_atts, fork_count}`` (``head`` = root
+        hex). Publishes the gauge family, runs the slot watchdogs,
+        journals the row; returns new findings."""
+        epoch = slot // self.spe
+        heads: List[str] = []
+        row: List[List[int]] = []
+        for i, view in enumerate(views):
+            lag = max(0, epoch - int(view["finalized_epoch"]))
+            metrics.gauge(f"chain.n{i}.head_slot", view["head_slot"])
+            metrics.gauge(f"chain.n{i}.justified_epoch",
+                          view["justified_epoch"])
+            metrics.gauge(f"chain.n{i}.finalized_epoch",
+                          view["finalized_epoch"])
+            metrics.gauge(f"chain.n{i}.finality_lag_epochs", lag)
+            metrics.gauge(f"chain.n{i}.pending_blocks",
+                          view.get("pending_blocks", 0))
+            metrics.gauge(f"chain.n{i}.pending_atts",
+                          view.get("pending_atts", 0))
+            if view.get("fork_count") is not None:
+                metrics.gauge(f"chain.n{i}.fork_count", view["fork_count"])
+            heads.append(str(view.get("head", "")))
+            row.append([int(view["head_slot"]), int(view["justified_epoch"]),
+                        int(view["finalized_epoch"]), int(lag),
+                        int(view.get("pending_blocks", 0)),
+                        int(view.get("pending_atts", 0)),
+                        int(view.get("fork_count") or 0)])
+        if views:
+            metrics.gauge("chain.head_slot",
+                          max(v["head_slot"] for v in views))
+            metrics.gauge("chain.finalized_epoch",
+                          max(v["finalized_epoch"] for v in views))
+            metrics.gauge("chain.finality_lag_epochs",
+                          max(0, epoch - min(int(v["finalized_epoch"])
+                                             for v in views)))
+            forks = [v["fork_count"] for v in views
+                     if v.get("fork_count") is not None]
+            if forks:
+                metrics.gauge("chain.fork_count", max(forks))
+        metrics.gauge("chain.net_partitioned", 1.0 if partitioned else 0.0)
+
+        reorgs, self._reorgs_pending = self._reorgs_pending, 0
+        findings = self.watchdog.on_slot(slot, heads, reorgs=reorgs)
+        slot_row = {"type": "chain_slot", "slot": int(slot),
+                    "part": 1 if partitioned else 0, "nodes": row,
+                    "heads": [h[:16] for h in heads]}
+        self.tail.append(slot_row)
+        self._journal(slot_row)
+        if findings:
+            self._absorb(findings)
+        return findings
+
+    def on_epoch(self, epoch: int, slot: int,
+                 participations: List[Optional[float]],
+                 finalized_epochs: List[int]) -> List[Dict[str, Any]]:
+        """Epoch-rollover observation: per-node previous-epoch
+        participation + finalized epochs. Returns new findings."""
+        rates = [p for p in participations if p is not None]
+        best = max(rates) if rates else None
+        if best is not None:
+            metrics.gauge("chain.participation_rate", best)
+        for i, p in enumerate(participations):
+            if p is not None:
+                metrics.gauge(f"chain.n{i}.participation_rate", p)
+        findings = self.watchdog.on_epoch(epoch, slot,
+                                          [int(f) for f in finalized_epochs],
+                                          best)
+        self._journal({"type": "chain_epoch", "epoch": int(epoch),
+                       "slot": int(slot),
+                       "participation": [None if p is None else round(p, 6)
+                                         for p in participations],
+                       "finalized": [int(f) for f in finalized_epochs]},
+                      flush=True)
+        if findings:
+            self._absorb(findings)
+        return findings
+
+    # -- findings + forensics ----------------------------------------------
+
+    def _absorb(self, findings: List[Dict[str, Any]]) -> None:
+        """Route findings into every sink the process plane uses: the
+        metric registry, the trace, the long-haul series journal, the
+        chain journal — and trigger the forensic bundle."""
+        from . import core, timeseries
+
+        for f in findings:
+            self.findings.append(f)
+            metrics.count(f"watchdog.{f['kind']}")
+            try:
+                core.instant(f"watchdog.{f['kind']}", series=f["series"],
+                             detail=f["detail"], value=f["value"],
+                             slot=f.get("slot"))
+            except Exception:
+                pass
+            try:
+                timeseries.record_finding(dict(f))
+            except Exception:
+                pass
+            self._journal({"type": "finding", **f}, fsync=True)
+        self.write_bundle("watchdog: " + ", ".join(
+            sorted({f["kind"] for f in findings})))
+
+    def write_bundle(self, reason: str,
+                     extra: Optional[Dict[str, Any]] = None) -> Optional[str]:
+        """Write the forensic bundle NOW (bounded: at most
+        ``max_bundles`` per run). Contents: reason, findings so far, the
+        timeline tail, every node's intake ring, plus the owning sim's
+        heavyweight payload (Store dumps, bus schedule slice, config —
+        the replay-without-rerunning-the-day material)."""
+        if self.out_dir is None or len(self.bundles) >= self.max_bundles:
+            return None
+        payload: Dict[str, Any] = {
+            "type": "chain_forensics",
+            "label": self.label,
+            "reason": str(reason)[:500],
+            "pid": self._pid,
+            "findings": list(self.findings),
+            "tail": list(self.tail),
+            "intake_rings": [r.entries() for r in self.rings],
+            "windows": [list(w) for w in self.watchdog.windows],
+        }
+        if extra:
+            payload.update(extra)
+        if self.bundle_cb is not None:
+            try:
+                payload.update(self.bundle_cb())
+            except Exception as e:
+                payload["bundle_cb_error"] = repr(e)
+        path = os.path.join(
+            self.out_dir,
+            f"chain-forensics-{self._pid}-{self._token}"
+            f"-{len(self.bundles)}.json")
+        try:
+            os.makedirs(self.out_dir, exist_ok=True)
+            with open(path, "w") as f:
+                json.dump(payload, f, default=repr)
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError:
+            return None
+        self.bundles.append(path)
+        return path
+
+
+def build(nodes: int, slots_per_epoch: int, **kwargs: Any) -> Optional[ChainHealth]:
+    """The arming decision in one place: a :class:`ChainHealth` unless
+    ``CONSENSUS_SPECS_TPU_CHAIN_HEALTH`` disarms the plane."""
+    if chain_health_disarmed():
+        return None
+    return ChainHealth(nodes, slots_per_epoch, **kwargs)
+
+
+__all__ = [
+    "BlackBox", "CHAIN_HEALTH_ENV", "ChainHealth", "ChainThresholds",
+    "ChainWatchdog", "GAUGE_HELP", "INTAKE_ACCEPTED", "INTAKE_DUPLICATE",
+    "INTAKE_PARKED", "INTAKE_REJECTED", "build", "chain_health_disarmed",
+    "fork_count", "node_gauge_help", "participation_rate",
+    "reorg_depth", "register_descriptions",
+]

@@ -118,6 +118,13 @@ def infer_unit(metric: str) -> Optional[str]:
         return "x"
     if metric.endswith("_pct"):
         return "%"
+    # chain-health lag series (sim_convergence_lag_slots,
+    # chain_finality_lag_epochs): slot/epoch counts, lower-is-better —
+    # obs.sentinel.polarity makes the same carve-out
+    if metric.endswith("_lag_slots") or metric.endswith("_slots"):
+        return "slots"
+    if metric.endswith("_epochs"):
+        return "epochs"
     return None
 
 

@@ -19,6 +19,10 @@ _counters: Dict[str, float] = {}
 _histograms: Dict[str, List[float]] = {}
 _hist_dropped: Dict[str, int] = {}
 _gauges: Dict[str, float] = {}
+# metric HELP texts (prometheus exposition metadata). Registered once
+# per process (metrics.describe); deliberately NOT cleared by reset() —
+# descriptions are schema, not samples.
+_descriptions: Dict[str, str] = {}
 
 _HIST_CAP = 4096  # per-name sample bound (reservoir-free: drop the tail)
 
@@ -54,6 +58,28 @@ def gauges() -> Dict[str, float]:
     """Current gauge values (a copy)."""
     with _lock:
         return dict(_gauges)
+
+
+def describe(name: str, help_text: str) -> None:
+    """Register a HELP text for a metric (prometheus exposition
+    metadata): :func:`prometheus_text` emits ``# HELP`` lines for
+    described metrics so scraped/aggregated expositions stay
+    self-documenting. Registration is idempotent (last write wins) and
+    survives :func:`reset` — descriptions are schema, not samples."""
+    with _lock:
+        _descriptions[name] = str(help_text)
+
+
+def describe_many(helps: Dict[str, str]) -> None:
+    """Bulk :func:`describe` (the chain-health family registers ~a dozen
+    series at arm time)."""
+    with _lock:
+        _descriptions.update({k: str(v) for k, v in helps.items()})
+
+
+def description(name: str) -> Optional[str]:
+    with _lock:
+        return _descriptions.get(name)
 
 
 def counters() -> Dict[str, float]:
@@ -203,6 +229,17 @@ def _prom_name(name: str) -> str:
     return out
 
 
+def _help_line(pname: str, name: str) -> List[str]:
+    """The ``# HELP`` line for a described metric (prometheus text
+    format: backslashes and newlines escaped), or nothing."""
+    with _lock:
+        text = _descriptions.get(name)
+    if not text:
+        return []
+    escaped = text.replace("\\", "\\\\").replace("\n", "\\n")
+    return [f"# HELP {pname} {escaped}"]
+
+
 def prometheus_text(snap: Optional[Dict[str, Any]] = None) -> str:
     """Prometheus text-format exposition of :func:`snapshot`.
 
@@ -232,15 +269,18 @@ def prometheus_text(snap: Optional[Dict[str, Any]] = None) -> str:
         if name in hist_count_keys:
             continue
         pname = _prom_name(name)
+        lines.extend(_help_line(pname, name))
         lines.append(f"# TYPE {pname} counter")
         lines.append(f"{pname} {counters[name]:g}")
     for name in sorted(gauge_vals):
         pname = _prom_name(name)
+        lines.extend(_help_line(pname, name))
         lines.append(f"# TYPE {pname} gauge")
         lines.append(f"{pname} {gauge_vals[name]:g}")
     for name in sorted(hists):
         h = hists[name]
         pname = _prom_name(name)
+        lines.extend(_help_line(pname, name))
         lines.append(f"# TYPE {pname} summary")
         for q_label, key in (("0.5", "p50"), ("0.9", "p90"), ("0.99", "p99")):
             if h.get(key) is not None:
@@ -285,17 +325,51 @@ def parse_prometheus(text: str) -> Dict[str, float]:
     return out
 
 
+def parse_prometheus_types(text: str) -> Dict[str, str]:
+    """``{family-name: type}`` from an exposition's ``# TYPE`` lines
+    (the promtool metadata :func:`aggregate_prometheus` keys its
+    per-family rollup rules on). HELP and other comments are ignored."""
+    out: Dict[str, str] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("# TYPE "):
+            continue
+        rest = line[len("# TYPE "):]
+        family, _, ftype = rest.rpartition(" ")
+        if family and ftype:
+            out[family] = ftype
+    return out
+
+
+# gauge families that are LEVELS of a shared external quantity (a chain
+# position, an epoch number, a rate of one chain) rather than per-replica
+# load: summing them across a fleet is meaningless, the rollup wants the
+# most-advanced view. Keyed by suffix; depth/level gauges like
+# serve_queue_depth keep summing (total queued work IS the fleet's sum).
+_LEVEL_GAUGE_SUFFIXES = ("_slot", "_epoch", "_epochs", "_rate",
+                         "_lag_slots", "_partitioned")
+
+
 def aggregate_prometheus(texts: List[str]) -> Dict[str, float]:
     """Fleet-level /metrics rollup (docs/SERVE.md "Fleet"): counters,
-    histogram ``_bucket``/``_sum``/``_count`` series, and gauges SUM
+    histogram ``_bucket``/``_sum``/``_count`` series, and load gauges SUM
     across replicas; percentile/quantile summary gauges (``_p50`` etc.)
     take the MAX instead — a fleet's pessimistic tail, since summing
-    per-replica percentiles is meaningless."""
+    per-replica percentiles is meaningless. Gauge families (per the
+    exposition's own ``# TYPE`` lines) whose name marks them as a LEVEL
+    of one shared chain (``*_slot``/``*_epoch(s)``/``*_rate``/
+    ``*_lag_slots`` — the chain-health family) also MAX: N replicas
+    observing one chain at head slot 640 roll up to 640, not 640·N."""
     out: Dict[str, float] = {}
     quantile = re.compile(r"_p\d+(\{|$)|quantile=")
     for text in texts:
+        level_gauges = {family
+                        for family, ftype in parse_prometheus_types(text).items()
+                        if ftype == "gauge"
+                        and family.endswith(_LEVEL_GAUGE_SUFFIXES)}
         for key, value in parse_prometheus(text).items():
-            if quantile.search(key):
+            family = key.partition("{")[0]
+            if quantile.search(key) or family in level_gauges:
                 out[key] = max(out.get(key, value), value)
             else:
                 out[key] = out.get(key, 0.0) + value

@@ -44,7 +44,14 @@ NO_BASELINE = "no_baseline"
 ENV_GAP = "environmental"
 
 _LOWER_IS_BETTER_SUFFIXES = ("_s", "_ms", "_us", "_seconds",
-                             "_overhead_pct")
+                             "_overhead_pct",
+                             # chain-health lag series: a convergence lag
+                             # of 9 slots or a finality lag of 5 epochs
+                             # growing is the chain getting SICKER —
+                             # lower is better (obs.ledger.infer_unit
+                             # makes the same _lag_slots/_epochs
+                             # carve-out)
+                             "_lag_slots", "_slots", "_epochs")
 
 # rate metrics end in "_per_s", which ALSO ends in "_s": rates are
 # higher-is-better and must be carved out before the duration suffixes

@@ -329,6 +329,24 @@ def fork_child_reinit() -> None:
     profile.fork_child_reinit()
 
 
+def record_finding(finding: Dict[str, Any]) -> None:
+    """Journal an externally-produced finding (the consensus watchdogs
+    in obs/chain.py fire at slot boundaries, not sampling ticks) through
+    the active flusher, exactly like the flusher's own watchdog
+    findings: one fsync'd ``{"type": "finding", ...}`` line in the
+    series journal, retained for the postmortem bundle. No-op unarmed."""
+    fl = _flusher
+    if fl is None:
+        return
+    rec = {"type": "finding", "ts": fl.now_us(), "role": fl.role,
+           "pid": fl.pid, **finding}
+    try:
+        fl._write_lines([rec], force_fsync=True)
+    except Exception:
+        return
+    fl.findings.append(rec)
+
+
 def postmortem_bundle(reason: str) -> Optional[str]:
     """Write the postmortem bundle NOW (armed processes only): last-N
     samples, every finding, the final metric snapshot. fsync'd; returns

@@ -40,6 +40,7 @@ from typing import Any, Deque, Dict, List, Optional, Tuple
 WATCHDOG_ENV = "CONSENSUS_SPECS_TPU_WATCHDOG"
 RATES_ENV = "CONSENSUS_SPECS_TPU_WATCHDOG_RATES"
 DEPTHS_ENV = "CONSENSUS_SPECS_TPU_WATCHDOG_DEPTHS"
+CHAIN_HEALTH_ENV = "CONSENSUS_SPECS_TPU_CHAIN_HEALTH"
 
 # progress counters watched by default: the long-running planes' hot
 # loops (span.* counters are auto-maintained by obs.metrics.observe, so
@@ -106,6 +107,254 @@ def _slope(points: List[Tuple[float, float]]) -> float:
     num = sum((t - mt) * (v - mv) for t, v in points)
     den = sum((t - mt) ** 2 for t, _ in points)
     return num / den if den else 0.0
+
+
+# ---------------------------------------------------------------------------
+# consensus watchdogs (docs/OBSERVABILITY.md "Consensus health plane")
+#
+# The process watchdogs above ask "is this PROCESS healthy"; these ask
+# "is the CHAIN healthy" — slot-indexed, not wall-indexed, fed by the
+# chain-health plane (obs/chain.py) at slot/epoch boundaries with the
+# per-node consensus view. Scheduled partition windows (exported by
+# sim/net.py) EXCUSE the detectors: a planned split legitimately stalls
+# finality, drops participation and forks the head, and must not read as
+# the chain being sick — only UNSCHEDULED versions of those symptoms do.
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ChainThresholds:
+    """Consensus-watchdog knobs, env-overridable via
+    ``CONSENSUS_SPECS_TPU_CHAIN_HEALTH=k=v[,k=v...]`` (the value
+    ``off``/``0`` disarms the whole plane — obs/chain.py checks)."""
+
+    finality_stall_epochs: int = 4    # frozen finality epochs before a finding
+    genesis_grace_epochs: int = 3     # the chain cannot finalize before ~e3
+    participation_floor: float = 2.0 / 3.0
+    droop_epochs: int = 2             # consecutive sub-floor epochs before a
+    #                                   finding (one starved epoch on a lossy
+    #                                   bus is weather; a justification quorum
+    #                                   problem persists)
+    split_brain_slots: int = 24       # connected slots of head disagreement
+    #                                   before a finding — the partitioned
+    #                                   sim's own convergence bound (3
+    #                                   minimal-preset epochs): honest
+    #                                   connected nodes that have not
+    #                                   converged by then are split
+    reorg_storm_count: int = 12       # deep reorgs within reorg_storm_window
+    reorg_storm_window: int = 32      # ... slots (across all nodes)
+    reorg_storm_min_depth: int = 3    # calibrated against the adversarial
+    #                                   bus: depth-1/2 head swaps are routine
+    #                                   gossip weather on a lossy network
+    #                                   (~p50 of the clean sim's depth
+    #                                   histogram), not a storm
+    heal_grace_slots: int = 16        # post-heal slots excused (re-justify)
+    cooldown_slots: int = 64          # per-kind finding cooldown
+
+    _INT_FIELDS = ("finality_stall_epochs", "genesis_grace_epochs",
+                   "droop_epochs", "split_brain_slots", "reorg_storm_count",
+                   "reorg_storm_window", "reorg_storm_min_depth",
+                   "heal_grace_slots", "cooldown_slots")
+
+    @classmethod
+    def from_env(cls) -> "ChainThresholds":
+        t = cls()
+        raw = os.environ.get(CHAIN_HEALTH_ENV, "")
+        valid = {f.name for f in fields(cls)}
+        for clause in raw.split(","):
+            clause = clause.strip()
+            if not clause or "=" not in clause:
+                continue
+            key, _, value = clause.partition("=")
+            key = key.strip()
+            if key not in valid:
+                continue
+            try:
+                setattr(t, key, int(value) if key in cls._INT_FIELDS
+                        else float(value))
+            except ValueError:
+                continue
+        return t
+
+
+def chain_health_disarmed() -> bool:
+    """True when the env knob explicitly disarms the chain-health plane
+    (``CONSENSUS_SPECS_TPU_CHAIN_HEALTH=off|0|none``). Default: armed —
+    the plane is cheap enough to ship on (perfgate-gated <3%)."""
+    return os.environ.get(CHAIN_HEALTH_ENV, "").strip().lower() in (
+        "off", "0", "none", "false")
+
+
+class ChainWatchdog:
+    """Slot-indexed consensus detectors over the chain-health view:
+
+    - ``finality_stall``      no node's finalized epoch advanced for
+                              ``finality_stall_epochs`` consecutive
+                              non-excused epochs while head slots moved;
+    - ``participation_droop`` the best (most-informed) node saw less
+                              than ``participation_floor`` of the stake
+                              attest target over a full non-excused
+                              epoch;
+    - ``split_brain``         the nodes' heads disagreed for more than
+                              ``split_brain_slots`` consecutive slots
+                              the schedule says are CONNECTED (scheduled
+                              windows + post-heal grace are protocol,
+                              not divergence);
+    - ``reorg_storm``         more than ``reorg_storm_count`` reorgs
+                              (across all nodes) inside a
+                              ``reorg_storm_window``-slot window,
+                              outside windows/grace.
+
+    Findings are shaped exactly like the process watchdog's
+    (``kind``/``series``/``detail``/``value`` + ``slot``) so they ride
+    the same journal/mission-report pipeline. ``windows`` is the
+    scheduled-partition export from sim/net.py: ``[(start, end), ...]``
+    in slots."""
+
+    def __init__(self, thresholds: Optional[ChainThresholds] = None,
+                 windows: Tuple[Tuple[int, int], ...] = (),
+                 slots_per_epoch: int = 8) -> None:
+        self.t = thresholds or ChainThresholds.from_env()
+        self.windows = tuple((int(a), int(b)) for a, b in windows)
+        self.spe = max(1, int(slots_per_epoch))
+        self._disagree_streak = 0
+        self._frozen_epochs = 0
+        self._droop_streak = 0
+        self._last_finalized: Optional[int] = None
+        self._reorg_slots: Deque[int] = deque()
+        self._last_emit_slot: Dict[str, int] = {}
+        self.findings_total = 0
+
+    # -- schedule gating ----------------------------------------------------
+
+    def set_windows(self, windows: Tuple[Tuple[int, int], ...]) -> None:
+        """Replace the scheduled-partition export (drills plant an
+        UNSCHEDULED split by clearing it)."""
+        self.windows = tuple((int(a), int(b)) for a, b in windows)
+
+    def excused(self, slot: int) -> bool:
+        """Inside a scheduled window, or within the post-heal grace
+        (nodes legitimately disagree/under-participate while the held
+        mail lands and FFG re-justifies)."""
+        for start, end in self.windows:
+            if start <= slot <= end + self.t.heal_grace_slots:
+                return True
+        return False
+
+    def _epoch_excused(self, epoch: int) -> bool:
+        lo, hi = epoch * self.spe, (epoch + 1) * self.spe - 1
+        return any(self.excused(s) for s in range(lo, hi + 1))
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _cooled(self, kind: str, slot: int) -> bool:
+        last = self._last_emit_slot.get(kind)
+        if last is not None and slot - last < self.t.cooldown_slots:
+            return False
+        self._last_emit_slot[kind] = slot
+        return True
+
+    def _finding(self, kind: str, series: str, slot: int, detail: str,
+                 value: float) -> Optional[Dict[str, Any]]:
+        if not self._cooled(kind, slot):
+            return None
+        self.findings_total += 1
+        return {"kind": kind, "series": series, "slot": slot,
+                "detail": detail, "value": round(float(value), 3)}
+
+    # -- slot-boundary detectors --------------------------------------------
+
+    def on_slot(self, slot: int, heads: List[str],
+                reorgs: int = 0) -> List[Dict[str, Any]]:
+        """One top-of-slot observation (post-intake, pre-proposal — the
+        point where connected honest nodes agree): per-node head roots
+        and the number of reorgs any node recorded this slot."""
+        out: List[Dict[str, Any]] = []
+        excused = self.excused(slot)
+
+        distinct = len({h for h in heads if h})
+        if distinct > 1 and not excused:
+            self._disagree_streak += 1
+            if self._disagree_streak > self.t.split_brain_slots:
+                f = self._finding(
+                    "split_brain", "chain.head_slot", slot,
+                    f"{distinct} distinct heads across {len(heads)} nodes "
+                    f"for {self._disagree_streak} connected slots "
+                    f"(> {self.t.split_brain_slots}) with no scheduled "
+                    f"partition", float(self._disagree_streak))
+                if f:
+                    out.append(f)
+        else:
+            self._disagree_streak = 0
+
+        if reorgs:
+            self._reorg_slots.extend([slot] * int(reorgs))
+        while self._reorg_slots and \
+                self._reorg_slots[0] <= slot - self.t.reorg_storm_window:
+            self._reorg_slots.popleft()
+        if not excused and len(self._reorg_slots) > self.t.reorg_storm_count:
+            f = self._finding(
+                "reorg_storm", "chain.reorgs", slot,
+                f"{len(self._reorg_slots)} reorgs of depth >= "
+                f"{self.t.reorg_storm_min_depth} inside "
+                f"{self.t.reorg_storm_window} slots "
+                f"(> {self.t.reorg_storm_count})",
+                float(len(self._reorg_slots)))
+            if f:
+                out.append(f)
+        return out
+
+    # -- epoch-boundary detectors -------------------------------------------
+
+    def on_epoch(self, epoch: int, slot: int, finalized_epochs: List[int],
+                 participation: Optional[float]) -> List[Dict[str, Any]]:
+        """One epoch-rollover observation: per-node finalized epochs and
+        the best node's previous-epoch target-participation fraction."""
+        out: List[Dict[str, Any]] = []
+        excused = self._epoch_excused(epoch)
+        past_genesis = epoch >= self.t.genesis_grace_epochs
+
+        best_finalized = max(finalized_epochs) if finalized_epochs else 0
+        if (self._last_finalized is not None
+                and best_finalized <= self._last_finalized
+                and past_genesis and not excused):
+            self._frozen_epochs += 1
+            if self._frozen_epochs > self.t.finality_stall_epochs:
+                f = self._finding(
+                    "finality_stall", "chain.finalized_epoch", slot,
+                    f"finalized epoch frozen at {best_finalized} for "
+                    f"{self._frozen_epochs} epochs "
+                    f"(> {self.t.finality_stall_epochs}) while the head "
+                    f"reached slot {slot}", float(self._frozen_epochs))
+                if f:
+                    out.append(f)
+        elif (self._last_finalized is None
+                or best_finalized > self._last_finalized):
+            self._frozen_epochs = 0
+        self._last_finalized = max(best_finalized,
+                                   self._last_finalized or 0)
+
+        # participation reported at rollover E covers epoch E-1 (the
+        # completed previous-epoch flags): a window overlapping EITHER
+        # epoch excuses the droop, and the streak only counts over
+        # consecutive countable epochs
+        droop_excused = excused or self._epoch_excused(max(0, epoch - 1))
+        if participation is None or droop_excused or not past_genesis:
+            pass  # not evidence either way: the streak carries
+        elif participation < self.t.participation_floor:
+            self._droop_streak += 1
+            if self._droop_streak >= self.t.droop_epochs:
+                f = self._finding(
+                    "participation_droop", "chain.participation_rate", slot,
+                    f"target participation {participation:.1%} < "
+                    f"{self.t.participation_floor:.1%} for "
+                    f"{self._droop_streak} consecutive epochs outside any "
+                    f"scheduled partition window", float(participation))
+                if f:
+                    out.append(f)
+        else:
+            self._droop_streak = 0
+        return out
 
 
 class Watchdog:

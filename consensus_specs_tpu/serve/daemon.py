@@ -396,6 +396,16 @@ class ServeDaemon:
             timeseries.register_gauge("serve.queue_depth",
                                       self.service.batcher.depth)
             timeseries.register_gauge("serve.inflight", lambda: self.inflight)
+        # the consensus health plane's exposition metadata (obs/chain.py):
+        # a daemon ingesting a chain (the sim as a client, the
+        # fork_choice_attestation wire path) publishes chain.* gauges
+        # into the same registry; registering the family's HELP/TYPE
+        # descriptions here makes every /metrics scrape — and the
+        # fleet's aggregate_prometheus rollup, which MAXes level gauges
+        # by their TYPE — self-describing
+        from ..obs import chain as obs_chain
+
+        obs_chain.register_descriptions()
         if warm:
             from .lifecycle import warm_start
 

@@ -49,6 +49,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from .. import engine, obs
 from ..crypto import bls
+from ..obs import chain as chain_health
 from ..obs import metrics
 from ..resilience import chaos, supervised
 from ..specs import build_spec
@@ -206,6 +207,13 @@ class ChainSim:
         self._equivocators = list(range(config.validators))
         eq_rng.shuffle(self._equivocators)
         self._step_states: Dict[Tuple[bytes, int], Any] = {}
+        self._cur_slot = 0
+        # the consensus health plane (obs/chain.py): the single-node sim
+        # is a 1-node chain — finality/participation/reorg telemetry and
+        # watchdogs apply; split-brain cannot (one view)
+        self.health = chain_health.build(
+            1, int(spec.SLOTS_PER_EPOCH),
+            label=f"sim.{engine_label}", bundle_cb=self._forensic_payload)
 
     # -- plumbing -----------------------------------------------------------
 
@@ -236,16 +244,26 @@ class ChainSim:
         attestations and attester slashings (test_framework/fork_choice
         add_block semantics)."""
         spec, store = self.spec, self.store
+        health = self.health
+        msg_id = bytes(spec.hash_tree_root(signed_block.message)).hex()[:16] \
+            if health is not None else ""
+        phase = "late" if late else "top"
         try:
             spec.on_block(store, signed_block)
         except _REJECTED:
             self.stats["blocks_dropped"] += 1
+            if health is not None:
+                health.record_intake(0, self._cur_slot, phase, "block",
+                                     msg_id, "rejected")
             return False
+        block_slot = int(signed_block.message.slot)
         for att in signed_block.message.body.attestations:
             try:
                 spec.on_attestation(store, att, is_from_block=True)
             except _REJECTED:
                 self.stats["attestations_rejected"] += 1
+            if health is not None:
+                health.record_inclusion(block_slot, int(att.data.slot))
         for slashing in signed_block.message.body.attester_slashings:
             try:
                 spec.on_attester_slashing(store, slashing)
@@ -254,6 +272,9 @@ class ChainSim:
         self.stats["blocks_delivered"] += 1
         if late:
             self.stats["late_delivered"] += 1
+        if health is not None:
+            health.record_intake(0, self._cur_slot, phase, "block", msg_id,
+                                 "accepted")
         return True
 
     def _includable(self, state, att) -> bool:
@@ -397,9 +418,24 @@ class ChainSim:
         metrics.count("sim.equivocations")
         obs.instant("sim.equivocation", slot=slot, width=width)
 
+    def _node_view(self) -> Dict[str, Any]:
+        """The single node's consensus view for the health plane."""
+        spec, store = self.spec, self.store
+        head = spec.get_head(store)
+        return {
+            "head": bytes(head).hex(),
+            "head_slot": int(store.blocks[head].slot),
+            "justified_epoch": int(store.justified_checkpoint.epoch),
+            "finalized_epoch": int(store.finalized_checkpoint.epoch),
+            "pending_blocks": len(self.late_queue),
+            "pending_atts": len(self.wire),
+            "fork_count": chain_health.fork_count(store),
+        }
+
     def _step(self, slot: int, plan) -> None:
         spec, store = self.spec, self.store
         self._step_states.clear()
+        self._cur_slot = slot
         spec.on_tick(store, store.genesis_time
                      + slot * int(spec.config.SECONDS_PER_SLOT))
 
@@ -415,6 +451,11 @@ class ChainSim:
                 spec.on_attestation(store, att, is_from_block=False)
             except _REJECTED:
                 self.stats["attestations_rejected"] += 1
+
+        # top-of-slot chain-health observation (post-intake, pre-proposal
+        # — the same point the partitioned lane samples)
+        if self.health is not None:
+            self.health.on_slot(slot, [self._node_view()])
 
         if plan.equivocate:
             self._emit_equivocation(slot)
@@ -443,6 +484,10 @@ class ChainSim:
             self.stats["reorgs"] += 1
             metrics.count("sim.reorgs")
             obs.instant("sim.reorg", slot=slot)
+            if self.health is not None:
+                self.health.record_reorg(
+                    0, slot, chain_health.reorg_depth(store, self.prev_head,
+                                                      head))
         self.prev_head = head
 
     # -- degradation + epoch rollover --------------------------------------
@@ -513,6 +558,11 @@ class ChainSim:
             "finalized_epoch": int(store.finalized_checkpoint.epoch),
         })
         metrics.count("sim.epochs")
+        if self.health is not None:
+            self.health.on_epoch(
+                epoch, slot,
+                [chain_health.participation_rate(spec, head_state)],
+                [int(store.finalized_checkpoint.epoch)])
         self._prune(slot)
 
     def _prune(self, slot: int) -> None:
@@ -552,6 +602,27 @@ class ChainSim:
             self.stats["pruned_blocks"] += len(dropped)
             metrics.count("sim.pruned_blocks", len(dropped))
 
+    # -- forensics ----------------------------------------------------------
+
+    def _forensic_payload(self) -> Dict[str, Any]:
+        """The single-node half of a chain forensic bundle: the Store
+        dump + the (seeded) config — with the intake ring the plane
+        itself adds."""
+        import dataclasses
+
+        from .checkpoint import store_to_dict
+
+        return {
+            "engine": self.engine_label,
+            "slot": self._cur_slot,
+            "config": dataclasses.asdict(self.config),
+            "stats": dict(self.stats),
+            "nodes": [{"id": 0,
+                       "head": (bytes(self.prev_head).hex()
+                                if self.prev_head is not None else None),
+                       "store": store_to_dict(self.spec, self.store)}],
+        }
+
     # -- entry point --------------------------------------------------------
 
     def run(self) -> SimResult:
@@ -573,6 +644,8 @@ class ChainSim:
                             self._epoch_rollover(slot)
         finally:
             bls.bls_active = was_bls
+            if self.health is not None:
+                self.health.close()
         seconds = time.perf_counter() - t0
         return SimResult(
             engine=self.engine_label, fork=cfg.fork, preset=cfg.preset,
@@ -587,7 +660,9 @@ def run_sim(config: ScenarioConfig, engine_mode: str = "interpreted",
     """One full run under one engine mode (installation scoped + restored)."""
     sim = ChainSim(config, scenario=scenario, engine_label=engine_mode)
     with _engine_mode(engine_mode):
-        return sim.run()
+        result = sim.run()
+    result.sim = sim  # forensic access (bundle on differential mismatch)
+    return result
 
 
 def compare_checkpoints(a: SimResult, b: SimResult) -> List[Dict[str, Any]]:
@@ -617,6 +692,14 @@ def run_differential(config: ScenarioConfig) -> Dict[str, Any]:
     oracle = run_sim(config, "interpreted", scenario=scenario)
     vectorized = run_sim(config, "vectorized", scenario=scenario)
     mismatches = compare_checkpoints(oracle, vectorized)
+    if mismatches:
+        # an oracle-vs-engine mismatch ships both sides' forensics (the
+        # black-box bundle: store dump + intake ring + seeded config)
+        for result in (oracle, vectorized):
+            sim = getattr(result, "sim", None)
+            if sim is not None and sim.health is not None:
+                sim.health.write_bundle("oracle-vs-engine checkpoint mismatch",
+                                        {"mismatches": mismatches[:20]})
     return {
         "identical": not mismatches,
         "checkpoints": len(oracle.checkpoints),

@@ -213,6 +213,16 @@ class MessageBus:
                 return w
         return None
 
+    def scheduled_windows(self) -> Tuple[Tuple[int, int], ...]:
+        """The partition schedule as ``((start, end), ...)`` slot spans —
+        the export the consensus watchdogs (obs/chain.py) gate on:
+        finality stalls, participation droops and head disagreement
+        INSIDE a scheduled window (or its post-heal grace) are the
+        planned experiment, not the chain being sick. An unscheduled
+        split — the same bus behavior with no exported window — is
+        exactly what the split_brain watchdog exists to flag."""
+        return tuple((int(w.start), int(w.end)) for w in self.partitions)
+
     # -- sending --------------------------------------------------------
 
     def send(self, slot: int, src: int, kind: str, obj: Any,

@@ -59,6 +59,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from .. import engine, obs
 from ..crypto import bls
+from ..obs import chain as chain_health
 from ..obs import metrics
 from ..resilience import chaos, supervised
 from ..specs import build_spec
@@ -114,6 +115,10 @@ class PartitionConfig:
     partitions: Optional[Tuple[PartitionWindow, ...]] = None
     converge_within: Optional[int] = None   # default: 3 epochs
     checkpoint_every: int = 4               # epochs between snapshots
+    # fraction of validators that never attest (seed-derived subset):
+    # the chain-health smoke's planted finality stall mutes 40% so FFG
+    # never reaches the 2/3 justification quorum
+    mute_attesters: float = 0.0
     # proposers cap per-block attestation inclusion below the spec max:
     # the pool is deduplicated and pruned on-chain, but a smaller cap
     # keeps interpreted-oracle block processing affordable at 3+ nodes
@@ -150,6 +155,7 @@ class PartitionConfig:
             "converge_within": self.converge_within,
             "checkpoint_every": self.checkpoint_every,
             "max_block_attestations": self.max_block_attestations,
+            "mute_attesters": self.mute_attesters,
         }
 
     @classmethod
@@ -165,7 +171,8 @@ class PartitionConfig:
             converge_within=(None if d.get("converge_within") is None
                              else int(d["converge_within"])),
             checkpoint_every=int(d["checkpoint_every"]),
-            max_block_attestations=int(d.get("max_block_attestations", 16)))
+            max_block_attestations=int(d.get("max_block_attestations", 16)),
+            mute_attesters=float(d.get("mute_attesters", 0.0)))
 
 
 class _Node:
@@ -296,10 +303,27 @@ class PartitionedChainSim:
         ]
         self.next_slot = 1
         self._oracle_forced = False
+        self._cur_slot = 0
         eq_rng = random.Random(f"chain-sim:{config.seed}:equiv")
         self._equivocators = list(range(config.validators))
         eq_rng.shuffle(self._equivocators)
         self._equiv_consumed = 0
+        # planted-stall knob: a seed-derived subset of validators that
+        # never attest (pure function of (seed, validators, fraction))
+        mute_rng = random.Random(f"chain-sim:{config.seed}:mute")
+        ids = list(range(config.validators))
+        mute_rng.shuffle(ids)
+        self._muted = frozenset(
+            ids[:int(round(config.mute_attesters * config.validators))])
+        # the consensus health plane (obs/chain.py): observational only —
+        # armed and unarmed runs are bit-identical by construction; the
+        # scheduled-window export keeps planned partitions from reading
+        # as split-brain/stall findings
+        self.health = chain_health.build(
+            config.nodes, self.spe,
+            windows=self.bus.scheduled_windows(),
+            label=f"sim.partition.{engine_label}",
+            bundle_cb=self._forensic_payload)
 
     # -- plumbing -------------------------------------------------------
 
@@ -328,39 +352,65 @@ class PartitionedChainSim:
 
     # -- intake ---------------------------------------------------------
 
-    def _deliver_block(self, node: _Node, signed, retries: int = 0) -> None:
+    def _deliver_block(self, node: _Node, signed, retries: int = 0,
+                       phase: str = "top") -> None:
         """``on_block`` plus the spec's implied intake of the block's
         payload. A rejected block (parent still in flight, typically)
         parks in the node's pending buffer — the client-side sync queue
-        — and retries next slot, ``BLOCK_RETRIES`` times."""
+        — and retries next slot, ``BLOCK_RETRIES`` times. ``phase``
+        labels the black-box intake entry (top/mid/own/retry)."""
         spec, store = self.spec, node.store
         root = spec.hash_tree_root(signed.message)
+        msg_id = bytes(root).hex()[:16]
+        health = self.health
         if root in store.blocks:
             node.stats["blocks_duplicate"] += 1
+            if health is not None:
+                health.record_intake(node.id, self._cur_slot, phase,
+                                     "block", msg_id, "duplicate")
             return
         try:
             spec.on_block(store, signed)
         except _REJECTED:
             if retries + 1 >= BLOCK_RETRIES:
                 node.stats["blocks_rejected"] += 1
+                outcome = "rejected"
             else:
                 node.pending_blocks.append((signed, retries + 1))
                 node.stats["blocks_parked"] += 1
                 metrics.count("sim.net.blocks_parked")
+                outcome = "parked"
+            if health is not None:
+                health.record_intake(node.id, self._cur_slot, phase,
+                                     "block", msg_id, outcome)
             return
+        block_slot = int(signed.message.slot)
         for att in signed.message.body.attestations:
             try:
                 spec.on_attestation(store, att, is_from_block=True)
             except _REJECTED:
                 node.stats["attestations_rejected"] += 1
+            if health is not None and node.id == 0:
+                # inclusion distance is a chain property, not a view
+                # property: count each on-chain attestation once (node 0
+                # stands in; converged nodes see identical blocks)
+                health.record_inclusion(block_slot, int(att.data.slot))
         for slashing in signed.message.body.attester_slashings:
             try:
                 spec.on_attester_slashing(store, slashing)
             except _REJECTED:
                 pass
         node.stats["blocks_delivered"] += 1
+        if health is not None:
+            health.record_intake(node.id, self._cur_slot, phase, "block",
+                                 msg_id, "accepted")
 
-    def _deliver_attestation(self, node: _Node, att, retries: int = 0) -> None:
+    def _deliver_attestation(self, node: _Node, att, retries: int = 0,
+                             phase: str = "top") -> None:
+        health = self.health
+        # a cheap stable id (slot:index) — hashing every rejected vote
+        # would put tree roots on the intake hot path for ring cosmetics
+        msg_id = f"att:{int(att.data.slot)}:{int(att.data.index)}"
         try:
             self.spec.on_attestation(node.store, att, is_from_block=False)
         except _REJECTED:
@@ -368,16 +418,28 @@ class PartitionedChainSim:
             # retry a few slots before dropping for good
             if retries + 1 >= ATT_RETRIES:
                 node.stats["attestations_rejected"] += 1
+                outcome = "rejected"
             else:
                 node.pending_atts.append((att, retries + 1))
                 node.stats["attestations_parked"] += 1
+                outcome = "parked"
+            if health is not None:
+                health.record_intake(node.id, self._cur_slot, phase,
+                                     "attestation", msg_id, outcome)
             return
         node.stats["attestations_accepted"] += 1
         node.pool.setdefault(bytes(self.spec.hash_tree_root(att)), att)
+        if health is not None:
+            health.record_intake(node.id, self._cur_slot, phase,
+                                 "attestation", msg_id, "accepted")
 
     def _deliver_slashing(self, node: _Node, slashing) -> None:
         digest = bytes(self.spec.hash_tree_root(slashing))
         if digest in node.known_slashings:
+            if self.health is not None:
+                self.health.record_intake(node.id, self._cur_slot, "top",
+                                          "slashing", digest.hex()[:16],
+                                          "duplicate")
             return
         node.known_slashings.add(digest)
         try:
@@ -385,14 +447,18 @@ class PartitionedChainSim:
         except _REJECTED:
             pass
         node.slashing_queue.append(slashing)
+        if self.health is not None:
+            self.health.record_intake(node.id, self._cur_slot, "top",
+                                      "slashing", digest.hex()[:16],
+                                      "accepted")
 
     def _intake(self, slot: int, node: _Node) -> None:
         pending_blocks, node.pending_blocks = node.pending_blocks, []
         for signed, retries in pending_blocks:
-            self._deliver_block(node, signed, retries)
+            self._deliver_block(node, signed, retries, phase="retry")
         pending_atts, node.pending_atts = node.pending_atts, []
         for att, retries in pending_atts:
-            self._deliver_attestation(node, att, retries)
+            self._deliver_attestation(node, att, retries, phase="retry")
         wire, node.wire_next = node.wire_next, []
         for att in wire:
             self._deliver_attestation(node, att)
@@ -410,7 +476,7 @@ class PartitionedChainSim:
         parked_now, node.pending_atts = node.pending_atts, []
         for att, retries in parked_now:
             node.stats["attestations_parked"] -= 1
-            self._deliver_attestation(node, att, retries - 1)
+            self._deliver_attestation(node, att, retries - 1, phase="retry")
 
     # -- per-slot mechanics --------------------------------------------
 
@@ -474,7 +540,7 @@ class PartitionedChainSim:
             return
         node.stats["blocks_proposed"] += 1
         metrics.count("sim.blocks_proposed")
-        self._deliver_block(node, signed)        # own block lands at once
+        self._deliver_block(node, signed, phase="own")  # lands at once
         self.bus.send(slot, node.id, KIND_BLOCK, signed)
 
     def _attest(self, slot: int, node: _Node) -> None:
@@ -487,7 +553,8 @@ class PartitionedChainSim:
         for index in range(committees):
             committee = spec.get_beacon_committee(
                 head_state, spec.Slot(slot), spec.CommitteeIndex(index))
-            mine = {int(v) for v in committee if self._home(v) == node.id}
+            mine = {int(v) for v in committee
+                    if self._home(v) == node.id and int(v) not in self._muted}
             if not mine:
                 continue
             try:
@@ -566,9 +633,23 @@ class PartitionedChainSim:
 
     # -- slot step ------------------------------------------------------
 
+    def _node_view(self, node: _Node) -> Dict[str, Any]:
+        """One node's consensus view for the health plane (obs/chain.py)."""
+        store = node.store
+        return {
+            "head": bytes(node.head).hex(),
+            "head_slot": int(store.blocks[node.head].slot),
+            "justified_epoch": int(store.justified_checkpoint.epoch),
+            "finalized_epoch": int(store.finalized_checkpoint.epoch),
+            "pending_blocks": len(node.pending_blocks),
+            "pending_atts": len(node.pending_atts),
+            "fork_count": chain_health.fork_count(store),
+        }
+
     def _step(self, slot: int) -> None:
         spec = self.spec
         plan = self.scenario.plan(slot)
+        self._cur_slot = slot
         for node in self.nodes:
             node.step_states.clear()
             spec.on_tick(node.store, node.store.genesis_time
@@ -581,6 +662,13 @@ class PartitionedChainSim:
         # block one slot before everyone else — that skew is protocol,
         # not divergence)
         self._check_convergence(slot)
+
+        # the chain-health plane observes the same post-intake,
+        # pre-proposal point (connected honest nodes agree here)
+        if self.health is not None:
+            self.health.on_slot(
+                slot, [self._node_view(n) for n in self.nodes],
+                partitioned=self.bus.window_at(slot) is not None)
 
         if plan.equivocate:
             self._emit_equivocation(slot)
@@ -596,7 +684,7 @@ class PartitionedChainSim:
             for kind, obj, _src in self.bus.deliveries(slot, node.id,
                                                        PHASE_MID):
                 if kind == KIND_BLOCK:
-                    self._deliver_block(node, obj)
+                    self._deliver_block(node, obj, phase="mid")
 
         for node in self.nodes:
             # proposals and mid-slot deliveries may have moved this
@@ -607,6 +695,11 @@ class PartitionedChainSim:
                     and not self._is_ancestor(node, node.prev_head, head)):
                 node.stats["reorgs"] += 1
                 metrics.count("sim.reorgs")
+                if self.health is not None:
+                    self.health.record_reorg(
+                        node.id, slot,
+                        chain_health.reorg_depth(node.store, node.prev_head,
+                                                 head))
             node.prev_head = head
             node.head = head
             self._attest(slot, node)
@@ -694,6 +787,8 @@ class PartitionedChainSim:
                    fallback=degraded)
 
         epoch = slot // self.spe
+        participations: List[Optional[float]] = []
+        finalized: List[int] = []
         for node in self.nodes:
             store = node.store
             head = spec.get_head(store)
@@ -708,8 +803,41 @@ class PartitionedChainSim:
                 "justified_epoch": int(store.justified_checkpoint.epoch),
                 "finalized_epoch": int(store.finalized_checkpoint.epoch),
             })
+            if self.health is not None:
+                participations.append(
+                    chain_health.participation_rate(spec, head_state))
+                finalized.append(int(store.finalized_checkpoint.epoch))
             self._prune(node, slot)
         metrics.count("sim.epochs")
+        if self.health is not None:
+            self.health.on_epoch(epoch, slot, participations, finalized)
+
+    # -- forensics ------------------------------------------------------
+
+    def _forensic_payload(self) -> Dict[str, Any]:
+        """The heavyweight half of a chain forensic bundle
+        (obs/chain.py): every node's full Store dump, the in-flight bus
+        state, and the (seeded) config — with the intake rings the plane
+        itself adds, enough to replay the divergence without rerunning
+        the day."""
+        from .checkpoint import store_to_dict
+
+        spec = self.spec
+        return {
+            "engine": self.engine_label,
+            "slot": self._cur_slot,
+            "config": self.config.to_dict(),
+            "convergence": [dict(c) for c in self.convergence],
+            "node_stats": [dict(n.stats) for n in self.nodes],
+            "nodes": [{
+                "id": n.id,
+                "head": (bytes(n.head).hex() if n.head is not None else None),
+                "store": store_to_dict(spec, n.store),
+            } for n in self.nodes],
+            "bus": {"config": self.bus.config.to_dict(),
+                    "windows": partitions_to_dicts(self.partitions),
+                    "state": self.bus.state_dict()},
+        }
 
     # -- entry points ---------------------------------------------------
 
@@ -747,7 +875,17 @@ class PartitionedChainSim:
         finally:
             bls.bls_active = was_bls
         seconds = time.perf_counter() - t0
-        return self._result(seconds)
+        result = self._result(seconds)
+        if self.health is not None:
+            if not result.converged:
+                # a heal that never converged IS the divergence the
+                # black box exists for: ship the bundle before anything
+                # else reads the result
+                self.health.write_bundle(
+                    "convergence failure: "
+                    f"{[c for c in result.convergence if c['lag'] is None or c['lag'] > self.converge_within]}"[:400])
+            self.health.close()
+        return result
 
     def _result(self, seconds: float) -> PartitionedResult:
         converged = all(
@@ -899,7 +1037,9 @@ def run_partitioned(config: PartitionConfig,
         sim = PartitionedChainSim(config, engine_label=engine_mode,
                                   manager=manager)
     with _engine_mode(engine_mode):
-        return sim.run()
+        result = sim.run()
+    result.sim = sim  # forensic access (bundle on differential mismatch)
+    return result
 
 
 def compare_node_checkpoints(a: PartitionedResult,
@@ -932,6 +1072,14 @@ def run_partitioned_differential(config: PartitionConfig) -> Dict[str, Any]:
     vectorized = run_partitioned(config, "vectorized")
     mismatches = compare_node_checkpoints(oracle, vectorized)
     identical = not mismatches and oracle.digest() == vectorized.digest()
+    if not identical:
+        # an oracle-vs-engine mismatch ships both sides' forensics
+        for result in (oracle, vectorized):
+            sim = getattr(result, "sim", None)
+            if sim is not None and sim.health is not None:
+                sim.health.write_bundle(
+                    "oracle-vs-engine checkpoint mismatch",
+                    {"mismatches": mismatches[:20]})
     return {
         "identical": identical,
         "converged": oracle.converged and vectorized.converged,

@@ -1,0 +1,392 @@
+"""Consensus health plane (ISSUE 15): chain metric math (reorg depth,
+participation exactness, inclusion-distance edges), the consensus
+watchdogs' firing/excusal contracts, the black-box recorder, and the
+forensic bundle."""
+import json
+import os
+import sys
+from types import SimpleNamespace
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from consensus_specs_tpu.obs import chain, metrics
+from consensus_specs_tpu.obs.watchdog import (
+    CHAIN_HEALTH_ENV,
+    ChainThresholds,
+    ChainWatchdog,
+    chain_health_disarmed,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_metrics():
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+# -- reorg depth -------------------------------------------------------------
+
+def _fake_store(blocks, finalized_root=b"\x00" * 32, finalized_epoch=0):
+    """A Store shaped like the spec's for reorg_depth: blocks maps
+    root -> (slot, parent_root)."""
+    return SimpleNamespace(
+        blocks={root: SimpleNamespace(slot=slot, parent_root=parent)
+                for root, (slot, parent) in blocks.items()},
+        finalized_checkpoint=SimpleNamespace(
+            root=finalized_root, epoch=finalized_epoch),
+    )
+
+
+A, B, C, D, E = (bytes([i]) * 32 for i in range(1, 6))
+
+
+def test_reorg_depth_common_ancestor():
+    # A(1) <- B(2) <- C(3)  and  A(1) <- D(2) <- E(4): C -> E reorgs
+    # back to A, depth = old head slot 3 - ancestor slot 1 = 2
+    store = _fake_store({A: (1, A), B: (2, A), C: (3, B),
+                         D: (2, A), E: (4, D)})
+    assert chain.reorg_depth(store, C, E) == 2
+    # sibling swap at equal height: B -> D, ancestor A, depth 1
+    assert chain.reorg_depth(store, B, D) == 1
+    # fast-forward (new head descends from old) is depth 0
+    assert chain.reorg_depth(store, B, C) == 0
+
+
+def test_reorg_depth_pruned_old_branch_bounds_at_finality():
+    # the old head's branch was pruned out: fall back to finalized slot
+    store = _fake_store({A: (4, A), E: (9, A)}, finalized_root=A)
+    store.blocks[C] = SimpleNamespace(slot=7, parent_root=B)  # orphaned
+    assert chain.reorg_depth(store, C, E) == 3  # 7 - finalized slot 4
+
+
+def test_reorg_depth_across_sim_fork_windows():
+    """A PR-8 scenario with known (seeded) fork windows: every planned
+    winning fork that actually reorgs must record a depth >= 1 bounded
+    by the longest fork window + late-block slack."""
+    from consensus_specs_tpu.sim import Scenario, ScenarioConfig
+    from consensus_specs_tpu.sim.driver import run_sim
+
+    cfg = ScenarioConfig(seed=1, slots=48, equivocations=1)
+    scenario = Scenario(cfg)
+    assert scenario.fork_windows, "seed 1 must plan fork windows"
+    assert any(w.wins for w in scenario.fork_windows)
+    result = run_sim(cfg, "interpreted", scenario=scenario)
+    snap = metrics.snapshot()
+    h = snap["histograms"].get("chain.reorg_depth")
+    assert result.stats["reorgs"] >= 1, "seed 1's winning window must reorg"
+    assert h is not None and h["count"] == result.stats["reorgs"]
+    longest = max(w.end - w.start + 1 for w in scenario.fork_windows)
+    assert 1 <= h["min"] and h["max"] <= longest + cfg.late_max + 2
+
+
+# -- participation exactness -------------------------------------------------
+
+def test_participation_rate_matches_manual_flag_count():
+    """Altair exactness: the plane's rate must equal an independent
+    manual count of unslashed TIMELY_TARGET flags over active balance —
+    the exact quantity the interpreted epoch transition justifies on."""
+    from consensus_specs_tpu.sim import Scenario, ScenarioConfig
+    from consensus_specs_tpu.sim.driver import ChainSim, _engine_mode
+
+    cfg = ScenarioConfig(seed=5, slots=24, fork="altair")
+    sim = ChainSim(cfg, scenario=Scenario(cfg))
+    with _engine_mode("interpreted"):
+        sim.run()
+    spec = sim.spec
+    head = spec.get_head(sim.store)
+    state = sim.store.block_states[head]
+
+    rate = chain.participation_rate(spec, state)
+    assert rate is not None and 0.0 < rate <= 1.0
+
+    prev = spec.get_previous_epoch(state)
+    incr = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    flag = spec.ParticipationFlags(2 ** spec.TIMELY_TARGET_FLAG_INDEX)
+    active = part = 0
+    for i, v in enumerate(state.validators):
+        if not spec.is_active_validator(v, prev):
+            continue
+        active += int(v.effective_balance)
+        if (not v.slashed
+                and int(state.previous_epoch_participation[i]) & int(flag)):
+            part += int(v.effective_balance)
+    manual = max(incr, part) / max(incr, active)
+    assert rate == pytest.approx(manual, abs=1e-12)
+
+
+def test_participation_rate_phase0_path():
+    from consensus_specs_tpu.sim import Scenario, ScenarioConfig
+    from consensus_specs_tpu.sim.driver import ChainSim, _engine_mode
+
+    cfg = ScenarioConfig(seed=5, slots=24, fork="phase0")
+    sim = ChainSim(cfg, scenario=Scenario(cfg))
+    with _engine_mode("interpreted"):
+        sim.run()
+    spec = sim.spec
+    state = sim.store.block_states[spec.get_head(sim.store)]
+    rate = chain.participation_rate(spec, state)
+    assert rate is not None and 0.0 < rate <= 1.0
+    atts = spec.get_matching_target_attestations(
+        state, spec.get_previous_epoch(state))
+    expected = (int(spec.get_attesting_balance(state, atts))
+                / int(spec.get_total_active_balance(state)))
+    assert rate == pytest.approx(expected, abs=1e-12)
+
+
+# -- inclusion distance ------------------------------------------------------
+
+def test_inclusion_distance_edges():
+    health = chain.ChainHealth(1, 8, out_dir=None)
+    health.record_inclusion(block_slot=5, att_slot=4)    # slot-1 inclusion
+    health.record_inclusion(block_slot=12, att_slot=4)   # max delay (spe=8)
+    h = metrics.snapshot()["histograms"]["chain.inclusion_distance_slots"]
+    assert h["min"] == 1.0   # MIN_ATTESTATION_INCLUSION_DELAY
+    assert h["max"] == 8.0   # SLOTS_PER_EPOCH
+    assert h["count"] == 2
+
+
+def test_sim_inclusion_distances_within_spec_bounds():
+    from consensus_specs_tpu.sim import Scenario, ScenarioConfig
+    from consensus_specs_tpu.sim.driver import run_sim
+
+    cfg = ScenarioConfig(seed=3, slots=32)
+    run_sim(cfg, "interpreted", scenario=Scenario(cfg))
+    h = metrics.snapshot()["histograms"]["chain.inclusion_distance_slots"]
+    assert h["count"] > 0
+    assert h["min"] >= 1.0 and h["max"] <= 8.0  # minimal preset spe
+
+
+# -- consensus watchdogs -----------------------------------------------------
+
+def _t(**kw):
+    t = ChainThresholds()
+    for k, v in kw.items():
+        setattr(t, k, v)
+    return t
+
+
+def test_finality_stall_fires_past_grace_and_threshold():
+    wd = ChainWatchdog(_t(finality_stall_epochs=3, genesis_grace_epochs=2),
+                       slots_per_epoch=8)
+    found = []
+    for epoch in range(12):
+        found += wd.on_epoch(epoch, epoch * 8 + 7, [0, 0, 0], 0.9)
+    kinds = [f["kind"] for f in found]
+    assert kinds == ["finality_stall"]
+    assert found[0]["slot"] == 5 * 8 + 7  # grace 2 + threshold 3 epochs
+
+
+def test_finality_advance_resets_stall():
+    wd = ChainWatchdog(_t(finality_stall_epochs=3, genesis_grace_epochs=0),
+                       slots_per_epoch=8)
+    found = []
+    for epoch in range(10):
+        fin = epoch - 1 if epoch else 0  # advances every epoch
+        found += wd.on_epoch(epoch, epoch * 8 + 7, [fin], 0.9)
+    assert not found
+
+
+def test_finality_stall_excused_inside_scheduled_window():
+    # every epoch overlaps the scheduled window: the freeze never counts
+    wd = ChainWatchdog(_t(finality_stall_epochs=2, genesis_grace_epochs=0,
+                          heal_grace_slots=0),
+                       windows=((0, 95),), slots_per_epoch=8)
+    found = []
+    for epoch in range(12):
+        found += wd.on_epoch(epoch, epoch * 8 + 7, [0], 0.9)
+    assert not found
+
+
+def test_participation_droop_needs_consecutive_epochs():
+    wd = ChainWatchdog(_t(droop_epochs=2, genesis_grace_epochs=0),
+                       slots_per_epoch=8)
+    assert not wd.on_epoch(1, 15, [1], 0.5)          # one bad epoch: weather
+    assert not wd.on_epoch(2, 23, [2], 0.9)          # recovered: reset
+    assert not wd.on_epoch(3, 31, [3], 0.5)
+    found = wd.on_epoch(4, 39, [4], 0.5)             # second consecutive
+    assert [f["kind"] for f in found] == ["participation_droop"]
+
+
+def test_participation_droop_excused_by_window_over_measured_epoch():
+    # rollover at epoch 3 reports epoch 2's participation; a window
+    # covering epoch 2 excuses it even though epoch 3 is clear
+    wd = ChainWatchdog(_t(droop_epochs=1, genesis_grace_epochs=0,
+                          heal_grace_slots=0),
+                       windows=((16, 23),), slots_per_epoch=8)
+    assert not wd.on_epoch(3, 31, [0], 0.2)
+    # far past the window: the droop counts again
+    assert wd.on_epoch(10, 87, [0], 0.2)
+
+
+def test_split_brain_counts_connected_slots_only():
+    wd = ChainWatchdog(_t(split_brain_slots=4, heal_grace_slots=2),
+                       windows=((10, 20),), slots_per_epoch=8)
+    found = []
+    for slot in range(40):
+        found += wd.on_slot(slot, ["aa", "bb"])
+    assert found, "a persistent unexcused split must fire"
+    first = found[0]
+    assert first["kind"] == "split_brain"
+    # slots 0..4 disagree (streak 5 > 4 at slot 4): fires before the
+    # window; inside the window + grace the streak resets
+    assert first["slot"] == 4
+
+
+def test_split_brain_agreement_resets_streak():
+    wd = ChainWatchdog(_t(split_brain_slots=4), slots_per_epoch=8)
+    found = []
+    for slot in range(30):
+        heads = ["aa", "bb"] if slot % 3 else ["aa", "aa"]
+        found += wd.on_slot(slot, heads)
+    assert not found
+
+
+def test_reorg_storm_threshold_and_window():
+    wd = ChainWatchdog(_t(reorg_storm_count=5, reorg_storm_window=16),
+                       slots_per_epoch=8)
+    found = []
+    for slot in range(12):
+        found += wd.on_slot(slot, ["aa"], reorgs=1)
+    kinds = {f["kind"] for f in found}
+    assert kinds == {"reorg_storm"}
+    # sparse deep reorgs (outside the window) never accumulate
+    wd2 = ChainWatchdog(_t(reorg_storm_count=5, reorg_storm_window=16),
+                        slots_per_epoch=8)
+    found2 = []
+    for slot in range(0, 400, 20):
+        found2 += wd2.on_slot(slot, ["aa"], reorgs=1)
+    assert not found2
+
+
+def test_shallow_reorgs_do_not_feed_the_storm():
+    health = chain.ChainHealth(1, 8, out_dir=None,
+                               thresholds=_t(reorg_storm_count=2,
+                                             reorg_storm_window=32,
+                                             reorg_storm_min_depth=3))
+    for slot in range(20):
+        health.record_reorg(0, slot, depth=1)   # gossip weather
+        assert not health.on_slot(slot, [{
+            "head": "aa", "head_slot": slot, "justified_epoch": 0,
+            "finalized_epoch": 0}])
+    assert metrics.counters()["chain.reorgs"] == 20  # still counted
+
+
+def test_chain_thresholds_from_env(monkeypatch):
+    monkeypatch.setenv(CHAIN_HEALTH_ENV,
+                       "finality_stall_epochs=9,participation_floor=0.5,"
+                       "bogus=1,split_brain_slots=abc")
+    t = ChainThresholds.from_env()
+    assert t.finality_stall_epochs == 9
+    assert t.participation_floor == 0.5
+    assert t.split_brain_slots == ChainThresholds().split_brain_slots
+    assert not chain_health_disarmed()
+    monkeypatch.setenv(CHAIN_HEALTH_ENV, "off")
+    assert chain_health_disarmed()
+    assert chain.build(1, 8) is None
+
+
+# -- black box + forensic bundle ---------------------------------------------
+
+def test_blackbox_ring_is_bounded():
+    box = chain.BlackBox(0, capacity=16)
+    for i in range(100):
+        box.record(i, "top", "attestation", f"m{i}", "accepted")
+    entries = box.entries()
+    assert len(entries) == 16
+    assert entries[0]["slot"] == 84 and entries[-1]["slot"] == 99
+
+
+def test_finding_triggers_journal_and_bundle(tmp_path):
+    health = chain.ChainHealth(
+        2, 8, out_dir=str(tmp_path),
+        thresholds=_t(split_brain_slots=3),
+        bundle_cb=lambda: {"config": {"seed": 7}, "nodes": [{"id": 0}]})
+    health.record_intake(0, 1, "top", "block", "abcd", "accepted")
+    health.record_intake(1, 1, "top", "block", "abcd", "rejected")
+    view = [{"head": "aa", "head_slot": 1, "justified_epoch": 0,
+             "finalized_epoch": 0},
+            {"head": "bb", "head_slot": 1, "justified_epoch": 0,
+             "finalized_epoch": 0}]
+    findings = []
+    for slot in range(8):
+        findings += health.on_slot(slot, view)
+    assert [f["kind"] for f in findings] == ["split_brain"]
+    health.close()
+
+    journal = list(tmp_path.glob("chain-*.jsonl"))
+    assert len(journal) == 1
+    lines = [json.loads(ln) for ln in
+             journal[0].read_text().splitlines() if ln]
+    types = {ln["type"] for ln in lines}
+    assert {"chain_header", "chain_slot", "finding"} <= types
+
+    bundles = list(tmp_path.glob("chain-forensics-*.json"))
+    assert len(bundles) == 1
+    bundle = json.loads(bundles[0].read_text())
+    assert bundle["reason"].startswith("watchdog: split_brain")
+    assert bundle["config"] == {"seed": 7}       # bundle_cb payload merged
+    assert len(bundle["intake_rings"]) == 2      # one ring per node
+    assert bundle["intake_rings"][0][0]["outcome"] == "accepted"
+    assert bundle["intake_rings"][1][0]["outcome"] == "rejected"
+    assert bundle["findings"][0]["kind"] == "split_brain"
+    assert bundle["tail"], "timeline tail missing"
+
+
+def test_bundle_count_is_bounded(tmp_path):
+    health = chain.ChainHealth(1, 8, out_dir=str(tmp_path), max_bundles=2)
+    for i in range(5):
+        health.write_bundle(f"reason {i}")
+    assert len(list(tmp_path.glob("chain-forensics-*.json"))) == 2
+
+
+def test_gauge_family_published_from_on_slot():
+    health = chain.ChainHealth(2, 8, out_dir=None)
+    health.on_slot(17, [
+        {"head": "aa", "head_slot": 17, "justified_epoch": 1,
+         "finalized_epoch": 1, "pending_blocks": 3, "pending_atts": 5,
+         "fork_count": 2},
+        {"head": "aa", "head_slot": 16, "justified_epoch": 1,
+         "finalized_epoch": 0, "pending_blocks": 0, "pending_atts": 0,
+         "fork_count": 1},
+    ], partitioned=True)
+    g = metrics.gauges()
+    assert g["chain.n0.head_slot"] == 17
+    assert g["chain.n1.finalized_epoch"] == 0
+    assert g["chain.head_slot"] == 17            # best across nodes
+    assert g["chain.finality_lag_epochs"] == 2   # worst across nodes (e2-e0)
+    assert g["chain.n0.pending_blocks"] == 3
+    assert g["chain.fork_count"] == 2
+    assert g["chain.net_partitioned"] == 1.0
+
+
+def test_chain_report_renders_byte_stable(tmp_path):
+    health = chain.ChainHealth(2, 8, out_dir=str(tmp_path),
+                               thresholds=_t(split_brain_slots=3))
+    views = [{"head": h, "head_slot": 1, "justified_epoch": 0,
+              "finalized_epoch": 0} for h in ("aa", "bb")]
+    for slot in range(10):
+        health.on_slot(slot, views)
+    health.on_epoch(1, 15, [0.9, 0.85], [0, 0])
+    health.record_reorg(0, 5, 3)
+    health.close()
+
+    import importlib.util
+    import pathlib
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    spec = importlib.util.spec_from_file_location(
+        "chain_report", str(repo / "tools" / "chain_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    assert spec.loader is not None
+    spec.loader.exec_module(mod)
+    run = mod.load_chain(str(tmp_path))
+    assert len(run["lanes"]) == 1
+    summary = mod.summarize_chain(run)
+    assert summary["findings"] >= 1 and summary["reorgs"] == 1
+    html_a = mod.render_html(run)
+    html_b = mod.render_html(mod.load_chain(str(tmp_path)))
+    assert html_a == html_b
+    assert "split_brain" in html_a and "participation_rate" in html_a

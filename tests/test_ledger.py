@@ -128,6 +128,18 @@ def test_backfill_all_five_historical_rounds():
     assert len(led.runs()) == 5
 
 
+def test_infer_unit_chain_health_suffixes():
+    """ISSUE 15 satellite: the `_lag_slots` / `_epochs` suffixes carry
+    units (slots/epochs) instead of falling into the unit-less default —
+    and the pre-existing conventions stay untouched."""
+    assert ledger_mod.infer_unit("sim_convergence_lag_slots") == "slots"
+    assert ledger_mod.infer_unit("chain_finality_lag_epochs") == "epochs"
+    assert ledger_mod.infer_unit("perfgate_chain_health_overhead_pct") == "%"
+    # rates whose stem mentions slots stay rates
+    assert ledger_mod.infer_unit("chain_sim_partition_slots_per_s") == "/s"
+    assert ledger_mod.infer_unit("block_128atts_mainnet_host_s") == "s"
+
+
 def test_default_path_env_knob(monkeypatch, tmp_path):
     monkeypatch.setenv(ledger_mod.LEDGER_ENV, str(tmp_path / "x.jsonl"))
     assert ledger_mod.default_path() == str(tmp_path / "x.jsonl")

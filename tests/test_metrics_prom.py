@@ -169,3 +169,86 @@ def test_histogram_quantile_summary_still_present_alongside_buckets():
     assert 'lat_ms{quantile="0.5"} 5' in text
     assert 'lat_ms_hist_bucket{le="5"} 5' in text
     assert "lat_ms_hist_count 10" in text
+
+
+# -- HELP metadata + gauge TYPE discipline (ISSUE 15 satellite) --------------
+
+def test_gauges_expose_help_and_type_lines():
+    metrics.describe("chain.n0.head_slot", "Node 0 fork-choice head slot")
+    metrics.gauge("chain.n0.head_slot", 640)
+    metrics.gauge("undescribed_gauge", 1)
+    text = metrics.prometheus_text()
+    lines = text.strip().splitlines()
+    i_help = lines.index("# HELP chain_n0_head_slot Node 0 fork-choice "
+                         "head slot")
+    i_type = lines.index("# TYPE chain_n0_head_slot gauge")
+    assert i_help == i_type - 1          # HELP immediately precedes TYPE
+    assert "chain_n0_head_slot 640" in lines
+    # undescribed metrics still get TYPE but no fabricated HELP
+    assert "# TYPE undescribed_gauge gauge" in lines
+    assert not any(ln.startswith("# HELP undescribed_gauge")
+                   for ln in lines)
+
+
+def test_help_text_is_escaped():
+    metrics.describe("weird.gauge", "line1\nline2 \\ backslash")
+    metrics.gauge("weird.gauge", 1)
+    text = metrics.prometheus_text()
+    assert "# HELP weird_gauge line1\\nline2 \\\\ backslash" in text
+
+
+def test_described_counter_gets_help_line():
+    metrics.describe("chain.reorgs", "Reorg events observed")
+    metrics.count("chain.reorgs", 2)
+    text = metrics.prometheus_text()
+    assert "# HELP chain_reorgs Reorg events observed" in text
+    assert "# TYPE chain_reorgs counter" in text
+
+
+def test_help_lines_round_trip_through_parse():
+    """promtool-style parser contract: HELP/TYPE lines never leak into
+    parsed sample values, and the full exposition round-trips."""
+    metrics.describe("chain.n0.head_slot", "Node 0 head slot")
+    metrics.gauge("chain.n0.head_slot", 640)
+    metrics.count("serve.accepted", 3)
+    text = metrics.prometheus_text()
+    parsed = metrics.parse_prometheus(text)
+    assert parsed["chain_n0_head_slot"] == 640
+    assert parsed["serve_accepted"] == 3
+    assert not any(k.startswith("#") for k in parsed)
+    types = metrics.parse_prometheus_types(text)
+    assert types["chain_n0_head_slot"] == "gauge"
+    assert types["serve_accepted"] == "counter"
+
+
+def test_aggregate_maxes_level_gauges_sums_load_gauges():
+    """Fleet rollup of the chain gauge family: N replicas observing ONE
+    chain at head slot 640 roll up to 640 (MAX by the family's TYPE
+    gauge + level suffix), while load gauges (queue depth) and counters
+    keep summing, and quantile summaries keep their pessimistic MAX."""
+    def exposition(head, fin, rate, depth, accepted):
+        metrics.reset()
+        metrics.gauge("chain.n0.head_slot", head)
+        metrics.gauge("chain.n0.finalized_epoch", fin)
+        metrics.gauge("chain.participation_rate", rate)
+        metrics.gauge("serve.queue_depth", depth)
+        metrics.count("serve.accepted", accepted)
+        return metrics.prometheus_text()
+
+    a = exposition(640, 18, 0.93, 5, 100)
+    b = exposition(638, 17, 0.91, 7, 50)
+    metrics.reset()
+    agg = metrics.aggregate_prometheus([a, b])
+    assert agg["chain_n0_head_slot"] == 640          # MAX: chain position
+    assert agg["chain_n0_finalized_epoch"] == 18     # MAX
+    assert agg["chain_participation_rate"] == 0.93   # MAX
+    assert agg["serve_queue_depth"] == 12            # SUM: fleet load
+    assert agg["serve_accepted"] == 150              # SUM: counter
+
+
+def test_aggregate_without_type_lines_keeps_legacy_sums():
+    # bare expositions (no TYPE metadata) keep the historical contract:
+    # everything sums except quantile-style names
+    texts = ["chain_n0_head_slot 640\n", "chain_n0_head_slot 638\n"]
+    agg = metrics.aggregate_prometheus(texts)
+    assert agg["chain_n0_head_slot"] == 1278

@@ -325,6 +325,33 @@ def test_clean_overload_ratio_passes_floor(tmp_path):
     assert summary["metrics"]["perfgate_overload_goodput_ratio"] >= 0.6
 
 
+@pytest.mark.slow
+def test_slowed_chain_health_plane_fails_gate(tmp_path):
+    """The ISSUE-15 drill: perfgate_chain_health_overhead_pct is gated
+    ABSOLUTELY against its <3% ceiling (like the obs-overhead slice, so
+    a cold ledger cannot ship a consensus-health plane that taxes the
+    armed sim) — the chaos knob inflates the armed pass 1.5x, reading
+    as ~50% overhead, and the gate must FAIL ``over_ceiling`` while the
+    evidence still banks. The obs slice is damped via its own knob
+    (this drill is about the chain plane, not the telemetry tax).
+    Marked slow: a full extra perfgate run."""
+    ledger_path = str(tmp_path / "ledger.jsonl")
+    summary_path = tmp_path / "summary.json"
+    proc = _run(["--ledger", ledger_path, "--json", str(summary_path)],
+                env_extra={"CONSENSUS_SPECS_TPU_PERF_CHAOS":
+                           "perfgate_chain_health=1.5,perfgate_obs=0.5"},
+                timeout=480)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "over_ceiling" in proc.stdout
+    assert "gate FAILED" in proc.stdout
+    summary = json.loads(summary_path.read_text())
+    assert summary["chain_health"]["ok"] is False
+    assert summary["chain_health"]["observed"] >= \
+        summary["chain_health"]["ceiling"]
+    led = ledger_mod.Ledger(ledger_path)
+    assert len(led.series("perfgate_chain_health_overhead_pct")) == 1
+
+
 def test_environmental_gap_does_not_fail_gate(tmp_path):
     """The device-unreachable shape at the gate level: an established
     jax-backend baseline that this (host-only) run cannot exercise is an

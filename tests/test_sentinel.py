@@ -27,6 +27,13 @@ def test_polarity_by_suffix():
     assert sentinel.polarity("serve_verifies_per_s") == 1
     assert sentinel.polarity("fuzz_execs_per_s") == 1
     assert sentinel.polarity("chain_sim_slots_per_s") == 1
+    # chain-health lag series (ISSUE 15): slot/epoch lags growing is
+    # the chain getting sicker — lower-is-better, and the rate carve-out
+    # must still win for *_slots_per_s
+    assert sentinel.polarity("sim_convergence_lag_slots") == -1
+    assert sentinel.polarity("chain_finality_lag_epochs") == -1
+    assert sentinel.polarity("chain_sim_partition_slots_per_s") == 1
+    assert sentinel.polarity("perfgate_chain_health_overhead_pct") == -1
 
 
 def test_baseline_median_and_mad():

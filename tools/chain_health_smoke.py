@@ -1,0 +1,256 @@
+"""Chain-health smoke (the citest slice; docs/OBSERVABILITY.md
+"Consensus health plane").
+
+Usage:
+    python tools/chain_health_smoke.py [--out DIR] [--keep] [--ledger P]
+
+A deterministic, seconds-not-hours drill of the whole consensus-health
+plane over the partitioned multi-node sim:
+
+1. **clean run** — 96 slots, 3 nodes, the seed's default scheduled
+   partition/heal windows, plane armed with a journal directory. The
+   watchdogs must flag NOTHING (scheduled windows and their heals are
+   excused by the sim/net.py window export), the chain journal must
+   carry every slot row, the gauges must land in the metric registry
+   and the ``/metrics`` exposition with HELP/TYPE lines, no forensic
+   bundle may exist, and ``chain_report.py`` must render byte-stable.
+2. **planted finality stall** — same chain, no partitions, 40% of
+   attesters muted (seed-derived subset): FFG can never reach its 2/3
+   quorum, finalized epoch freezes while head slots advance. The
+   ``finality_stall`` watchdog MUST flag it and a forensic bundle MUST
+   be written — with per-node Store dumps that load back through
+   ``store_from_dict`` (replayable, not decorative), every node's
+   intake ring, and the seeded bus config.
+3. **planted split-brain** — a partition that never heals, deliberately
+   NOT exported to the health plane (an *unscheduled* split is exactly
+   what the watchdog exists for): the ``split_brain`` watchdog MUST
+   flag it, with a forensic bundle.
+4. **overhead + bit-identity** — the clean configuration re-run with
+   the plane disarmed must produce a byte-identical chain digest (the
+   plane is observational by construction; the <3% overhead ceiling is
+   gated separately in ``make perfgate``).
+
+Exit status: 0 = all assertions held; 1 = any failed. Banks
+``chain_finality_lag_epochs`` + ``chain_health_smoke_slots_per_s``
+when ``--ledger`` is given.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import shutil
+import sys
+import tempfile
+import time
+from typing import Any, Dict, List, Optional
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from consensus_specs_tpu.obs import chain as chain_mod  # noqa: E402
+from consensus_specs_tpu.obs import ledger as ledger_mod  # noqa: E402
+from consensus_specs_tpu.obs import metrics  # noqa: E402
+from consensus_specs_tpu.sim import seed_from_env  # noqa: E402
+from consensus_specs_tpu.sim.net import PartitionWindow  # noqa: E402
+from consensus_specs_tpu.sim.partition import (  # noqa: E402
+    PartitionConfig,
+    PartitionedChainSim,
+    _engine_mode,
+)
+
+SLOTS = 96
+NODES = 3
+
+
+def _chain_report():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "chain_report", str(REPO / "tools" / "chain_report.py"))
+    assert spec is not None and spec.loader is not None
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _run(config: PartitionConfig, out_dir: Optional[pathlib.Path],
+         unscheduled: bool = False, armed: bool = True):
+    """One in-process partitioned pass with the plane pointed at
+    ``out_dir``. ``unscheduled=True`` clears the health plane's window
+    export (the bus still partitions — a split the operator never
+    scheduled). ``armed=False`` runs with the plane off entirely."""
+    prev = os.environ.get(chain_mod.CHAIN_HEALTH_ENV)
+    if not armed:
+        os.environ[chain_mod.CHAIN_HEALTH_ENV] = "off"
+    try:
+        sim = PartitionedChainSim(config, engine_label="interpreted")
+    finally:
+        if not armed:
+            if prev is None:
+                os.environ.pop(chain_mod.CHAIN_HEALTH_ENV, None)
+            else:
+                os.environ[chain_mod.CHAIN_HEALTH_ENV] = prev
+    if sim.health is not None:
+        sim.health.set_out_dir(str(out_dir) if out_dir is not None else None)
+        if unscheduled:
+            sim.health.set_windows(())
+    with _engine_mode("interpreted"):
+        result = sim.run()
+    return sim, result
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--out", default=None,
+                        help="work directory (default: temp, removed)")
+    parser.add_argument("--keep", action="store_true")
+    parser.add_argument("--slots", type=int, default=SLOTS)
+    parser.add_argument("--ledger", default=None)
+    ns = parser.parse_args(argv)
+
+    seed = seed_from_env(1)
+    root = pathlib.Path(ns.out or tempfile.mkdtemp(prefix="chain_health_"))
+    cleanup = ns.out is None and not ns.keep
+    failures: List[str] = []
+    t0 = time.time()
+
+    def drill(name: str, cond: bool, detail: str = "") -> None:
+        print(f"chain-health-smoke: {name}: {'OK' if cond else 'FAILED'}"
+              + (f" ({detail})" if detail else ""))
+        if not cond:
+            failures.append(f"{name}: {detail}")
+
+    try:
+        # 1. clean run: scheduled windows, armed plane, zero findings
+        clean_dir = root / "clean"
+        cfg = PartitionConfig(seed=seed, slots=ns.slots, nodes=NODES)
+        sim, result = _run(cfg, clean_dir)
+        kinds = sorted({f["kind"] for f in sim.health.findings})
+        drill("clean run converged", result.converged)
+        drill("clean run flags nothing", not kinds, str(kinds))
+        drill("clean run wrote no forensic bundle", not sim.health.bundles,
+              str(sim.health.bundles))
+        journal = list(clean_dir.glob("chain-*.jsonl"))
+        drill("chain journal written", len(journal) == 1,
+              str([p.name for p in journal]))
+
+        snap = metrics.snapshot()
+        gauges = snap["gauges"]
+        drill("chain gauges published",
+              all(f"chain.n{i}.head_slot" in gauges for i in range(NODES))
+              and "chain.participation_rate" in gauges,
+              str(sorted(k for k in gauges if k.startswith("chain."))[:6]))
+        drill("inclusion-distance histogram populated",
+              "chain.inclusion_distance_slots" in snap["histograms"])
+        exposition = metrics.prometheus_text()
+        drill("/metrics carries HELP+TYPE for chain gauges",
+              "# HELP chain_n0_head_slot" in exposition
+              and "# TYPE chain_n0_head_slot gauge" in exposition)
+
+        mod = _chain_report()
+        run = mod.load_chain(str(clean_dir))
+        html_a = mod.render_html(run)
+        html_b = mod.render_html(mod.load_chain(str(clean_dir)))
+        drill("chain report byte-stable", html_a == html_b)
+        (clean_dir / "chain-report.html").write_text(html_a)
+        rows = run["lanes"][0]["slots"] if run["lanes"] else []
+        drill("journal carries every slot row", len(rows) == ns.slots,
+              f"{len(rows)}/{ns.slots}")
+
+        lag = gauges.get("chain.finality_lag_epochs")
+
+        # 2. planted finality stall: 40% of attesters muted, no windows
+        stall_dir = root / "stall"
+        stall_cfg = PartitionConfig(seed=seed, slots=ns.slots, nodes=NODES,
+                                    partitions=(), mute_attesters=0.4)
+        stall_sim, _ = _run(stall_cfg, stall_dir)
+        stall_kinds = {f["kind"] for f in stall_sim.health.findings}
+        drill("planted stall flagged finality_stall",
+              "finality_stall" in stall_kinds, str(sorted(stall_kinds)))
+        drill("stall wrote a forensic bundle",
+              bool(stall_sim.health.bundles),
+              str(stall_sim.health.bundles))
+        if stall_sim.health.bundles:
+            _check_bundle(stall_sim.health.bundles[0], stall_cfg, drill)
+
+        # 3. planted split-brain: a never-healing partition the plane
+        #    was never told about
+        split_dir = root / "split"
+        window = PartitionWindow(start=16, end=10**6,
+                                 groups=((0,), (1, 2)))
+        split_cfg = PartitionConfig(seed=seed, slots=64, nodes=NODES,
+                                    partitions=(window,))
+        split_sim, _ = _run(split_cfg, split_dir, unscheduled=True)
+        split_kinds = {f["kind"] for f in split_sim.health.findings}
+        drill("planted split-brain flagged split_brain",
+              "split_brain" in split_kinds, str(sorted(split_kinds)))
+        drill("split-brain wrote a forensic bundle",
+              bool(split_sim.health.bundles))
+
+        # 4. the plane is observational: disarmed re-run, identical chain
+        _, unarmed = _run(cfg, None, armed=False)
+        drill("armed and unarmed chains bit-identical",
+              unarmed.digest() == result.digest(),
+              f"{unarmed.digest()[:16]} vs {result.digest()[:16]}")
+
+        if ns.ledger is not None and not failures:
+            led = ledger_mod.Ledger(ns.ledger)
+            points: Dict[str, Any] = {
+                "chain_health_smoke_slots_per_s": round(result.slots_per_s, 2),
+            }
+            if lag is not None:
+                points["chain_finality_lag_epochs"] = float(lag)
+            run_id = led.record_run(points, source="chain_health_smoke",
+                                    backend="host")
+            print(f"chain-health-smoke: banked {sorted(points)} -> "
+                  f"{led.path} ({run_id})")
+    finally:
+        if cleanup:
+            shutil.rmtree(root, ignore_errors=True)
+
+    print(f"chain-health-smoke: {'FAILED' if failures else 'PASSED'} "
+          f"in {time.time() - t0:.1f}s")
+    for f in failures:
+        print(f"chain-health-smoke FAILED: {f}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+def _check_bundle(path: str, config: PartitionConfig, drill) -> None:
+    """The bundle must be REPLAYABLE, not decorative: config round-trips,
+    every node's Store dump loads back, rings + bus schedule present."""
+    from consensus_specs_tpu.sim.checkpoint import store_from_dict
+    from consensus_specs_tpu.specs import build_spec
+
+    with open(path) as f:
+        bundle = json.load(f)
+    drill("bundle carries reason + findings",
+          bool(bundle.get("reason")) and bool(bundle.get("findings")))
+    # to_dict RESOLVES seed-derived fields (net, partitions), so the
+    # replay handle's property is a stable round-trip, not dataclass
+    # equality with the pre-resolution config
+    rt = PartitionConfig.from_dict(bundle["config"])
+    drill("bundle config round-trips (seeded replay handle)",
+          rt.to_dict() == bundle["config"]
+          and rt.seed == config.seed and rt.slots == config.slots
+          and rt.mute_attesters == config.mute_attesters)
+    drill("bundle carries every node's intake ring",
+          len(bundle.get("intake_rings") or []) == config.nodes
+          and all(bundle["intake_rings"]))
+    drill("bundle carries the bus schedule slice",
+          "state" in (bundle.get("bus") or {})
+          and "config" in (bundle.get("bus") or {}))
+    spec = build_spec(config.fork, config.preset)
+    try:
+        stores = [store_from_dict(spec, n["store"]) for n in bundle["nodes"]]
+        heads_ok = all(len(s.blocks) > 0 for s in stores)
+    except Exception:
+        heads_ok = False
+        stores = []
+    drill("bundle store dumps load back (replayable)",
+          len(stores) == config.nodes and heads_ok)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,362 @@
+"""Chain-health report: render a run's chain journals into one HTML
+page (docs/OBSERVABILITY.md "Consensus health plane").
+
+Usage:
+    python tools/chain_report.py <dir> [--out report.html] [--json OUT]
+
+Input is the directory the chain-health plane journaled into (the
+``CONSENSUS_SPECS_TPU_LONGHAUL`` directory of an armed run, or the
+explicit ``out_dir`` a drill passed): every armed sim pass left a
+``chain-<pid>-<token>.jsonl`` timeline there and any watchdog finding /
+convergence failure / differential mismatch left a
+``chain-forensics-*.json`` bundle. The report renders, per journal
+lane:
+
+- per-node head-slot and finality (finalized-epoch) lanes;
+- the participation sparkline with the 2/3 justification floor marked;
+- reorg markers (depth-annotated) and scheduled partition windows;
+- watchdog finding annotations (kind @ slot);
+
+plus the forensic-bundle inventory (reason, nodes, ring sizes).
+
+The output is BYTE-STABLE: a pure function of the input directory
+(sorted iteration, fixed float formats, no timestamps), so re-rendering
+a journaled run is diffable and the smoke asserts reproducibility.
+Torn tail lines are counted and skipped, never fatal.
+
+``tools/mission_report.py`` embeds the same lanes as its "Chain
+health" section via :func:`render_chain_section`.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import html as html_mod
+import json
+import os
+import pathlib
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+# ---------------------------------------------------------------------------
+# loading (torn-tail tolerant, like the mission report)
+# ---------------------------------------------------------------------------
+
+def _parse_jsonl(path: str) -> Tuple[List[Dict[str, Any]], int]:
+    records: List[Dict[str, Any]] = []
+    torn = 0
+    try:
+        with open(path, "r", errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    torn += 1
+                    continue
+                if isinstance(rec, dict):
+                    records.append(rec)
+                else:
+                    torn += 1
+    except OSError:
+        return [], 0
+    return records, torn
+
+
+def load_chain(run_dir: str) -> Dict[str, Any]:
+    """Everything one directory's chain journals + forensic bundles
+    hold, merged + sorted (pure function of the directory)."""
+    lanes: List[Dict[str, Any]] = []
+    for path in sorted(glob.glob(os.path.join(run_dir, "chain-*.jsonl"))):
+        records, torn = _parse_jsonl(path)
+        header = next((r for r in records if r.get("type") == "chain_header"),
+                      {})
+        lanes.append({
+            "file": os.path.basename(path),
+            "label": header.get("label", "?"),
+            "nodes": int(header.get("nodes") or 1),
+            "spe": int(header.get("spe") or 8),
+            "windows": [tuple(w) for w in header.get("windows") or []],
+            "slots": [r for r in records if r.get("type") == "chain_slot"],
+            "epochs": [r for r in records if r.get("type") == "chain_epoch"],
+            "reorgs": [r for r in records if r.get("type") == "chain_reorg"],
+            "findings": [r for r in records if r.get("type") == "finding"],
+            "torn_lines": torn,
+        })
+    forensics: List[Dict[str, Any]] = []
+    for path in sorted(glob.glob(os.path.join(run_dir,
+                                              "chain-forensics-*.json"))):
+        try:
+            with open(path) as f:
+                bundle = json.load(f)
+        except (OSError, ValueError):
+            continue
+        forensics.append({
+            "file": os.path.basename(path),
+            "reason": bundle.get("reason", ""),
+            "label": bundle.get("label", "?"),
+            "slot": bundle.get("slot"),
+            "findings": len(bundle.get("findings") or []),
+            "nodes": len(bundle.get("nodes") or []),
+            "ring_entries": sum(len(r) for r in
+                                bundle.get("intake_rings") or []),
+        })
+    return {"dir": run_dir, "lanes": lanes, "forensics": forensics}
+
+
+def summarize_chain(run: Dict[str, Any]) -> Dict[str, Any]:
+    findings = [f for lane in run["lanes"] for f in lane["findings"]]
+    by_kind: Dict[str, int] = {}
+    for f in findings:
+        by_kind[str(f.get("kind"))] = by_kind.get(str(f.get("kind")), 0) + 1
+    last_slots = [lane["slots"][-1] for lane in run["lanes"]
+                  if lane["slots"]]
+    return {
+        "dir": run["dir"],
+        "lanes": len(run["lanes"]),
+        "slots_journaled": sum(len(lane["slots"]) for lane in run["lanes"]),
+        "findings": len(findings),
+        "findings_by_kind": dict(sorted(by_kind.items())),
+        "reorgs": sum(len(lane["reorgs"]) for lane in run["lanes"]),
+        "max_head_slot": max((max(n[0] for n in s["nodes"])
+                              for s in last_slots), default=None),
+        "forensic_bundles": len(run["forensics"]),
+        "torn_lines": sum(lane["torn_lines"] for lane in run["lanes"]),
+    }
+
+
+# ---------------------------------------------------------------------------
+# rendering (byte-stable)
+# ---------------------------------------------------------------------------
+
+_W, _H = 420, 46
+_NODE_COLORS = ("#60a5fa", "#34d399", "#f472b6", "#fbbf24", "#a78bfa",
+                "#f87171", "#2dd4bf", "#fb923c")
+
+
+def _poly(points: List[Tuple[float, float]], s0: float, s1: float,
+          vmin: float, vmax: float, color: str) -> str:
+    if len(points) < 2:
+        return ""
+    sspan = (s1 - s0) or 1.0
+    vspan = (vmax - vmin) or 1.0
+
+    def xy(s: float, v: float) -> str:
+        x = (s - s0) / sspan * (_W - 4) + 2
+        y = _H - 4 - (v - vmin) / vspan * (_H - 8)
+        return f"{x:.1f},{y:.1f}"
+
+    pts = " ".join(xy(s, v) for s, v in points)
+    return (f'<polyline points="{pts}" fill="none" stroke="{color}" '
+            f'stroke-width="1.2"/>')
+
+
+def _slot_chart(series: List[List[Tuple[float, float]]], s0: float,
+                s1: float, windows: List[Tuple[int, int]],
+                markers: List[Tuple[float, str]],
+                floor: Optional[float] = None,
+                vmin: Optional[float] = None,
+                vmax: Optional[float] = None) -> str:
+    """One slot-indexed multi-line chart: per-node series, shaded
+    scheduled windows, red finding/reorg markers, optional floor line."""
+    values = [v for pts in series for _, v in pts]
+    if not values:
+        return '<span class="dim">no samples</span>'
+    lo = min(values) if vmin is None else vmin
+    hi = max(values) if vmax is None else vmax
+    if floor is not None:
+        lo, hi = min(lo, floor), max(hi, floor)
+    sspan = (s1 - s0) or 1.0
+    parts = [f'<svg width="{_W}" height="{_H}" viewBox="0 0 {_W} {_H}">']
+    for start, end in sorted(windows):
+        if end < s0 or start > s1:
+            continue
+        x0 = (max(start, s0) - s0) / sspan * (_W - 4) + 2
+        x1 = (min(end, s1) - s0) / sspan * (_W - 4) + 2
+        parts.append(f'<rect x="{x0:.1f}" y="2" '
+                     f'width="{max(1.0, x1 - x0):.1f}" height="{_H - 4}" '
+                     f'fill="#e2e8f0"/>')
+    if floor is not None:
+        vspan = (hi - lo) or 1.0
+        y = _H - 4 - (floor - lo) / vspan * (_H - 8)
+        parts.append(f'<line x1="2" y1="{y:.1f}" x2="{_W - 2}" y2="{y:.1f}" '
+                     f'stroke="#94a3b8" stroke-width="0.8" '
+                     f'stroke-dasharray="4 3"/>')
+    for i, pts in enumerate(series):
+        parts.append(_poly(pts, s0, s1, lo, hi,
+                           _NODE_COLORS[i % len(_NODE_COLORS)]))
+    for slot, color in sorted(markers):
+        x = (slot - s0) / sspan * (_W - 4) + 2
+        parts.append(f'<line x1="{x:.1f}" y1="2" x2="{x:.1f}" '
+                     f'y2="{_H - 2}" stroke="{color}" stroke-width="1.4"/>')
+    parts.append("</svg>")
+    parts.append(f'<span class="dim"> {lo:.6g} … {hi:.6g}</span>')
+    return "".join(parts)
+
+
+def _lane_html(lane: Dict[str, Any]) -> str:
+    esc = html_mod.escape
+    slots = lane["slots"]
+    parts = [f"<div class='lane'><h3><code>{esc(str(lane['label']))}</code> "
+             f"<span class='dim'>{esc(lane['file'])}</span></h3>"]
+    if not slots:
+        parts.append("<p class='dim'>no slot rows</p></div>")
+        return "".join(parts)
+    s0, s1 = float(slots[0]["slot"]), float(slots[-1]["slot"])
+    nodes = lane["nodes"]
+    finding_marks = [(float(f.get("slot") or 0), "#b91c1c")
+                     for f in lane["findings"]]
+    stat_bits = [
+        f"{len(slots)} slot rows · {nodes} node(s) · spe {lane['spe']}",
+        f"{len(lane['reorgs'])} reorg(s)",
+        (f"<span class='finding'>{len(lane['findings'])} finding(s): "
+         + esc(", ".join(sorted({str(f.get('kind'))
+                                 for f in lane['findings']})))
+         + "</span>") if lane["findings"]
+        else "<span class='clean'>clean</span>",
+    ]
+    if lane["torn_lines"]:
+        stat_bits.append(f"{lane['torn_lines']} torn line(s)")
+    parts.append(f"<p>{' · '.join(stat_bits)}</p>")
+
+    head = [[(float(s["slot"]), float(s["nodes"][i][0])) for s in slots
+             if i < len(s["nodes"])] for i in range(nodes)]
+    fin = [[(float(s["slot"]), float(s["nodes"][i][2])) for s in slots
+            if i < len(s["nodes"])] for i in range(nodes)]
+    parts.append("<p>per-node <code>head_slot</code> "
+                 "(grey = scheduled partition windows, red = findings)<br>"
+                 + _slot_chart(head, s0, s1, lane["windows"], finding_marks)
+                 + "</p>")
+    parts.append("<p>per-node <code>finalized_epoch</code><br>"
+                 + _slot_chart(fin, s0, s1, lane["windows"], finding_marks)
+                 + "</p>")
+    epochs = lane["epochs"]
+    if epochs:
+        part_series = []
+        for i in range(nodes):
+            pts = [(float(e["slot"]), float(e["participation"][i]))
+                   for e in epochs
+                   if i < len(e.get("participation") or [])
+                   and e["participation"][i] is not None]
+            part_series.append(pts)
+        parts.append("<p>per-node <code>participation_rate</code> "
+                     "(dashed = the 2/3 justification floor)<br>"
+                     + _slot_chart(part_series, s0, s1, lane["windows"],
+                                   finding_marks, floor=2.0 / 3.0,
+                                   vmin=0.0, vmax=1.0) + "</p>")
+    if lane["reorgs"]:
+        reorg_marks = [(float(r["slot"]), "#d97706") for r in lane["reorgs"]]
+        depth = [[(float(r["slot"]), float(r["depth"]))
+                  for r in lane["reorgs"]]]
+        parts.append("<p><code>reorg depth</code> at reorg slots (orange)"
+                     "<br>" + _slot_chart(depth, s0, s1, lane["windows"],
+                                          reorg_marks, vmin=0.0) + "</p>")
+    if lane["findings"]:
+        parts.append("<table><tr><th>kind</th><th>slot</th><th>series</th>"
+                     "<th>detail</th></tr>")
+        for f in sorted(lane["findings"],
+                        key=lambda f: (float(f.get("slot") or 0),
+                                       str(f.get("kind")))):
+            parts.append(
+                f"<tr><td class='finding'>{esc(str(f.get('kind')))}</td>"
+                f"<td style='text-align:right'>{f.get('slot')}</td>"
+                f"<td><code>{esc(str(f.get('series')))}</code></td>"
+                f"<td>{esc(str(f.get('detail', '')))}</td></tr>")
+        parts.append("</table>")
+    parts.append("</div>")
+    return "".join(parts)
+
+
+def render_chain_section(run: Dict[str, Any]) -> str:
+    """The embeddable "Chain health" fragment (mission report uses it):
+    one lane per chain journal + the forensic-bundle inventory."""
+    esc = html_mod.escape
+    parts: List[str] = []
+    for lane in run["lanes"]:
+        parts.append(_lane_html(lane))
+    if run["forensics"]:
+        parts.append("<h3>Forensic bundles (black-box recorder)</h3>"
+                     "<table><tr><th>file</th><th>reason</th><th>slot</th>"
+                     "<th>nodes</th><th>ring entries</th></tr>")
+        for b in run["forensics"]:
+            parts.append(
+                f"<tr><td><code>{esc(b['file'])}</code></td>"
+                f"<td class='finding'>{esc(str(b['reason']))}</td>"
+                f"<td style='text-align:right'>{b.get('slot')}</td>"
+                f"<td style='text-align:right'>{b['nodes']}</td>"
+                f"<td style='text-align:right'>{b['ring_entries']}</td></tr>")
+        parts.append("</table>")
+    return "\n".join(parts)
+
+
+def render_html(run: Dict[str, Any]) -> str:
+    esc = html_mod.escape
+    summary = summarize_chain(run)
+    badge = (f"<span class='finding'>{summary['findings']} finding(s)</span>"
+             if summary["findings"] else
+             "<span class='clean'>watchdogs clean</span>")
+    head = (
+        "<!doctype html><html><head><meta charset='utf-8'>"
+        "<title>chain health — "
+        f"{esc(os.path.basename(os.path.normpath(run['dir'])))}</title>"
+        "<style>body{font:14px/1.45 system-ui,sans-serif;margin:24px;"
+        "color:#0f172a;max-width:1100px}table{border-collapse:collapse;"
+        "margin:8px 0}td,th{border:1px solid #cbd5e1;padding:3px 9px;"
+        "text-align:left;vertical-align:top}th{background:#f1f5f9}"
+        "code{background:#f1f5f9;padding:0 3px;border-radius:3px}"
+        ".dim{color:#64748b;font-size:12px}.lane{border:1px solid #cbd5e1;"
+        "border-radius:6px;padding:10px 14px;margin:14px 0}"
+        ".finding{color:#b91c1c;font-weight:600}"
+        ".clean{color:#15803d;font-weight:600}"
+        "h1{font-size:22px}h2{font-size:17px;margin-top:26px}"
+        "h3{font-size:15px;margin:4px 0 8px}</style></head><body>"
+        f"<h1>Chain health — <code>{esc(run['dir'])}</code></h1>"
+        f"<p>{summary['lanes']} lane(s) · {summary['slots_journaled']} slot "
+        f"rows · {summary['reorgs']} reorg(s) · {badge} · "
+        f"{summary['forensic_bundles']} forensic bundle(s) · "
+        f"{summary['torn_lines']} torn line(s) skipped</p>")
+    return (head + render_chain_section(run) + "</body></html>\n")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("dir", help="directory holding chain-*.jsonl journals")
+    parser.add_argument("--out", default=None,
+                        help="HTML output (default <dir>/chain-report.html)")
+    parser.add_argument("--json", dest="json_path", type=pathlib.Path,
+                        default=None, help="machine summary output")
+    ns = parser.parse_args(argv)
+
+    if not os.path.isdir(ns.dir):
+        print(f"chain report: no such directory {ns.dir}", file=sys.stderr)
+        return 2
+    run = load_chain(ns.dir)
+    if not run["lanes"]:
+        print(f"chain report: no chain journals under {ns.dir}",
+              file=sys.stderr)
+        return 2
+    summary = summarize_chain(run)
+    out = ns.out or os.path.join(ns.dir, "chain-report.html")
+    with open(out, "w") as f:
+        f.write(render_html(run))
+    kinds = ", ".join(f"{k}={v}" for k, v in
+                      summary["findings_by_kind"].items()) or "clean"
+    print(f"chain report: {summary['lanes']} lane(s), "
+          f"{summary['slots_journaled']} slot rows, "
+          f"{summary['findings']} finding(s) ({kinds}), "
+          f"{summary['forensic_bundles']} bundle(s) -> {out}")
+    if ns.json_path is not None:
+        with open(ns.json_path, "w") as f:
+            json.dump(summary, f, indent=2, sort_keys=True)
+        print(f"json summary written to {ns.json_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -192,7 +192,7 @@ def summarize(run: Dict[str, Any]) -> Dict[str, Any]:
     by_kind: Dict[str, int] = {}
     for f in findings:
         by_kind[str(f.get("kind"))] = by_kind.get(str(f.get("kind")), 0) + 1
-    return {
+    out = {
         "dir": run["dir"],
         "processes": len(run["processes"]),
         "samples": sum(len(p["samples"]) for p in run["processes"]),
@@ -205,6 +205,14 @@ def summarize(run: Dict[str, Any]) -> Dict[str, Any]:
         "wall_span_s": round((t1 - t0) / 1e6, 3) if t0 is not None else None,
         "roles": sorted({str(p["role"]) for p in run["processes"]}),
     }
+    try:
+        mod = _chain_report_mod()
+        chain_run = mod.load_chain(run["dir"])
+        if chain_run["lanes"] or chain_run["forensics"]:
+            out["chain"] = mod.summarize_chain(chain_run)
+    except Exception:
+        pass
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -384,8 +392,40 @@ def render_html(run: Dict[str, Any]) -> str:
                 f"<p class='dim'>{len(pm.get('tail', []))} tail sample(s), "
                 f"{len(pm.get('findings', []))} finding(s) at exit</p></div>")
 
+    # chain health (docs/OBSERVABILITY.md "Consensus health plane"): an
+    # armed sim run journals its chain timeline next to the series
+    # journals; render the same byte-stable lanes chain_report.py does
+    chain_section = _chain_section(run["dir"])
+    if chain_section:
+        parts.append("<h2>Chain health</h2>")
+        parts.append(chain_section)
+
     parts.append("</body></html>")
     return "\n".join(parts) + "\n"
+
+
+def _chain_report_mod():
+    import importlib.util
+
+    path = pathlib.Path(__file__).resolve().parent / "chain_report.py"
+    spec = importlib.util.spec_from_file_location("chain_report", str(path))
+    assert spec is not None and spec.loader is not None
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _chain_section(run_dir: str) -> str:
+    """The "Chain health" fragment, empty when the run journaled no
+    chain timeline (byte-stable, like the rest of the page)."""
+    try:
+        mod = _chain_report_mod()
+        chain_run = mod.load_chain(run_dir)
+    except Exception:
+        return ""
+    if not chain_run["lanes"] and not chain_run["forensics"]:
+        return ""
+    return mod.render_chain_section(chain_run)
 
 
 # ---------------------------------------------------------------------------

@@ -283,8 +283,16 @@ def html_report(led: ledger_mod.Ledger) -> str:
     serve_rows = [_metric_row(m, slo_col=True) for m in serve_metric_names]
     fuzz_metric_names = sorted(m for m in series if m.startswith("fuzz_"))
     fuzz_rows = [_metric_row(m) for m in fuzz_metric_names]
+    # the chain plane (docs/OBSERVABILITY.md "Consensus health plane"):
+    # sim throughput series + the chain-health series (finality lag,
+    # participation, convergence lag) read together as one story
+    chain_metric_names = sorted(
+        m for m in series
+        if m.startswith(("chain_", "sim_")) and m not in fuzz_metric_names)
+    chain_rows = [_metric_row(m) for m in chain_metric_names]
     rows = [_metric_row(m) for m in sorted(series)
-            if m not in serve_metric_names and m not in fuzz_metric_names]
+            if m not in serve_metric_names and m not in fuzz_metric_names
+            and m not in chain_metric_names]
 
     # the worker-sweep scaling curve (docs/GENPIPE.md "Sharded
     # generation"): latest gen_pipeline_w<N>_s point per worker count,
@@ -384,6 +392,15 @@ datapoints.</p>
 <th>points</th><th>sentinel</th></tr>
 {''.join(fuzz_rows)}
 </table>''' if fuzz_rows else '')}
+{(f'''<h2>Chain health (chain_* / sim_*)</h2>
+<p class="legend">The consensus-domain series: sim throughput and
+differential speedups next to finality lag, participation, and
+convergence lag (lower is better for the <code>_lag_*</code> and
+<code>_epochs</code> series — the sentinel's polarity carve-out).</p>
+<table><tr><th>metric</th><th>trajectory</th><th>latest</th><th>backend</th>
+<th>points</th><th>sentinel</th></tr>
+{''.join(chain_rows)}
+</table>''' if chain_rows else '')}
 {fleet_scaling_html}
 {gen_scaling_html}
 <h2>Metric trajectories</h2>

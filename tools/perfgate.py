@@ -101,7 +101,20 @@ jax import, no device, no tunnel):
                               relatively by the sentinel, from round 13
                               on (chaos: ``perfgate_obs=1.1``;
                               docs/OBSERVABILITY.md "Long-haul
-                              telemetry plane").
+                              telemetry plane");
+- ``perfgate_chain_health_overhead_pct`` the consensus health plane's
+                              armed tax: a short partitioned sim slice
+                              timed with the chain gauges/watchdogs/
+                              black box off vs on, armed-vs-unarmed
+                              chain digests asserted BIT-IDENTICAL
+                              inside the measurement, gated ABSOLUTELY
+                              against the <3% ceiling
+                              (:data:`CHAIN_HEALTH_OVERHEAD_CEILING`)
+                              as well as relatively by the sentinel,
+                              from round 15 on (chaos:
+                              ``perfgate_chain_health=1.1``;
+                              docs/OBSERVABILITY.md "Consensus health
+                              plane").
 
 Each run appends one ledger run (git sha + environment fingerprint) and
 is classified by :mod:`consensus_specs_tpu.obs.sentinel` against the
@@ -723,6 +736,91 @@ OVERLOAD_FLOOR = 0.6
 # a mainnet-day run (the acceptance bar in docs/OBSERVABILITY.md)
 OBS_OVERHEAD_CEILING = 3.0
 
+# same bar for the consensus health plane (docs/OBSERVABILITY.md
+# "Consensus health plane"): the chain-level watchdogs/gauges/black box
+# must cost <3% of an armed sim or the mainnet-day run ships blind
+CHAIN_HEALTH_OVERHEAD_CEILING = 3.0
+
+
+def measure_chain_health_overhead_pct() -> float:
+    """The consensus health plane's armed tax (docs/OBSERVABILITY.md
+    "Consensus health plane"): one short partitioned multi-node sim
+    slice — per-node Stores over the adversarial bus, the shape the
+    plane instruments per slot — run UNARMED
+    (``CONSENSUS_SPECS_TPU_CHAIN_HEALTH=off``: no gauges, no watchdogs,
+    no intake rings) and ARMED (the default). The metric is the
+    relative wall-time overhead in percent, gated ABSOLUTELY against
+    :data:`CHAIN_HEALTH_OVERHEAD_CEILING` as well as relatively by the
+    sentinel (chaos: ``perfgate_chain_health=1.1`` inflates the armed
+    time and must fail the gate). Two honesty asserts ride inside the
+    measurement: the armed run must actually produce the chain gauge
+    family, and the armed and unarmed chains must be BIT-IDENTICAL —
+    the plane is observational by construction, and a fast number from
+    a plane that perturbed the chain must fail here, not ship.
+
+    Same noise discipline as the obs slice: bracketed phases
+    (unarmed → armed → unarmed, min per phase), GC parked, the whole
+    bracket re-run up to :data:`_OBS_ROUNDS` times taking the round
+    minimum, early exit under half the ceiling."""
+    best = None
+    for _ in range(_OBS_ROUNDS):
+        value = _chain_health_round()
+        best = value if best is None else min(best, value)
+        if best < CHAIN_HEALTH_OVERHEAD_CEILING / 2:
+            break
+    assert best is not None
+    return best
+
+
+def _chain_health_round() -> float:
+    import gc
+
+    from consensus_specs_tpu.obs import metrics as obs_metrics
+    from consensus_specs_tpu.obs.chain import CHAIN_HEALTH_ENV
+    from consensus_specs_tpu.sim.partition import (
+        PartitionConfig,
+        run_partitioned,
+    )
+
+    cfg = PartitionConfig(seed=3, slots=16, nodes=2, validators=32,
+                          partitions=())
+
+    def one(armed: bool):
+        prev = os.environ.get(CHAIN_HEALTH_ENV)
+        os.environ[CHAIN_HEALTH_ENV] = "" if armed else "off"
+        try:
+            t0 = time.perf_counter()
+            result = run_partitioned(cfg, "interpreted")
+            return time.perf_counter() - t0, result
+        finally:
+            if prev is None:
+                os.environ.pop(CHAIN_HEALTH_ENV, None)
+            else:
+                os.environ[CHAIN_HEALTH_ENV] = prev
+
+    one(False)  # warm (spec build, committee caches)
+    gc_was_enabled = gc.isenabled()
+    gc.collect()
+    gc.disable()
+    try:
+        unarmed_pre, baseline = one(False)
+        armed_t, armed_result = one(True)
+        unarmed_post, _ = one(False)
+    finally:
+        if gc_was_enabled:
+            gc.enable()
+    sim = getattr(armed_result, "sim", None)
+    assert sim is not None and sim.health is not None, (
+        "armed slice ran without the chain-health plane")
+    gauges = obs_metrics.gauges()
+    assert "chain.n0.head_slot" in gauges, (
+        "armed run published no chain gauges")
+    assert armed_result.digest() == baseline.digest(), (
+        "chain-health plane perturbed the chain (digest mismatch)")
+    unarmed = min(unarmed_pre, unarmed_post)
+    armed_t *= _chaos_factor("perfgate_chain_health_overhead_pct")
+    return max(0.0, (armed_t - unarmed) / unarmed * 100.0)
+
 MEASUREMENTS: Tuple[Tuple[str, Callable[[], float]], ...] = (
     ("perfgate_hash_mibs", measure_hash_mibs),
     ("perfgate_reroot_ms", measure_reroot_ms),
@@ -735,6 +833,7 @@ MEASUREMENTS: Tuple[Tuple[str, Callable[[], float]], ...] = (
     ("perfgate_fleet_failover_ms", measure_fleet_failover_ms),
     ("perfgate_fuzz_execs_per_s", measure_fuzz_execs_per_s),
     ("perfgate_sim_checkpoint_ms", measure_sim_checkpoint_ms),
+    ("perfgate_chain_health_overhead_pct", measure_chain_health_overhead_pct),
     ("perfgate_obs_overhead_pct", measure_obs_overhead_pct),
 )
 
@@ -820,6 +919,19 @@ def run_gate(
                     else "over_ceiling"),
     }
 
+    # the chain-health gate: same ABSOLUTE contract for the consensus
+    # health plane's armed sim tax (docs/OBSERVABILITY.md)
+    ch_overhead = metrics.get("perfgate_chain_health_overhead_pct")
+    chain_result = {
+        "ok": (ch_overhead is None
+               or ch_overhead < CHAIN_HEALTH_OVERHEAD_CEILING),
+        "ceiling": CHAIN_HEALTH_OVERHEAD_CEILING,
+        "observed": ch_overhead,
+        "verdict": ("environmental" if ch_overhead is None
+                    else "ok" if ch_overhead < CHAIN_HEALTH_OVERHEAD_CEILING
+                    else "over_ceiling"),
+    }
+
     run_id = led.record_run(
         metrics, source="perfgate", backend="host", environment=env,
         extra={"skipped": skipped or None, "sentinel": verdict_counts,
@@ -828,7 +940,9 @@ def run_gate(
                "overload": {"ok": overload_result["ok"],
                             "verdict": overload_result["verdict"]},
                "obs_overhead": {"ok": obs_result["ok"],
-                                "verdict": obs_result["verdict"]}})
+                                "verdict": obs_result["verdict"]},
+               "chain_health": {"ok": chain_result["ok"],
+                                "verdict": chain_result["verdict"]}})
 
     summary = {
         "run_id": run_id,
@@ -839,10 +953,12 @@ def run_gate(
         "slo": slo_result,
         "overload": overload_result,
         "obs_overhead": obs_result,
+        "chain_health": chain_result,
     }
     code = 1 if (gate and not (report.ok and slo_result["ok"]
                                and overload_result["ok"]
-                               and obs_result["ok"])) else 0
+                               and obs_result["ok"]
+                               and chain_result["ok"])) else 0
     return code, summary
 
 
@@ -902,8 +1018,16 @@ def print_summary(summary: Dict[str, Any]) -> None:
         print(f"obs overhead: armed telemetry plane {oh_txt} "
               f"(ceiling {oh.get('ceiling', OBS_OVERHEAD_CEILING):g}%)  "
               f"[{oh.get('verdict', '?')}]")
+    ch = summary.get("chain_health") or {}
+    ch_ok = ch.get("ok", True)
+    if ch:
+        observed = ch.get("observed")
+        ch_txt = f"{observed:g}%" if observed is not None else "skipped"
+        print(f"chain health: armed consensus plane {ch_txt} "
+              f"(ceiling {ch.get('ceiling', CHAIN_HEALTH_OVERHEAD_CEILING):g}%)  "
+              f"[{ch.get('verdict', '?')}]")
     print(f"perfgate: gate "
-          f"{'PASSED' if (sentinel_ok and slo_ok and over_ok and oh_ok) else 'FAILED'}")
+          f"{'PASSED' if (sentinel_ok and slo_ok and over_ok and oh_ok and ch_ok) else 'FAILED'}")
 
 
 def main(argv: Optional[List[str]] = None) -> int:

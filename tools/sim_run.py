@@ -59,6 +59,7 @@ sys.path.insert(0, str(REPO))
 
 from consensus_specs_tpu import resilience  # noqa: E402
 from consensus_specs_tpu.obs import ledger as ledger_mod  # noqa: E402
+from consensus_specs_tpu.obs import metrics as obs_metrics  # noqa: E402
 from consensus_specs_tpu.obs import timeseries  # noqa: E402
 from consensus_specs_tpu.resilience import injection  # noqa: E402
 from consensus_specs_tpu.sim import (  # noqa: E402
@@ -201,6 +202,28 @@ def run_partition_mode(ns) -> int:
         lags = [c["lag"] for c in result.convergence if c["lag"] is not None]
         if lags:
             metrics["sim_convergence_lag_slots"] = float(max(lags))
+        # chain-health series (docs/OBSERVABILITY.md "Consensus health
+        # plane"): the run's final finality lag + participation, plus
+        # any watchdog findings as hard evidence in the run's extra
+        gauges = obs_metrics.gauges()
+        if gauges.get("chain.finality_lag_epochs") is not None:
+            metrics["chain_finality_lag_epochs"] = float(
+                gauges["chain.finality_lag_epochs"])
+        if gauges.get("chain.participation_rate") is not None:
+            # banked without the _rate suffix: the ledger's unit
+            # inference maps *_rate to "/s", and participation is a
+            # dimensionless fraction
+            metrics["chain_participation"] = round(
+                float(gauges["chain.participation_rate"]), 4)
+        sim_obj = getattr(result, "sim", None)
+        health = sim_obj.health if sim_obj is not None else None
+        if health is not None and health.findings:
+            print("sim: chain watchdog findings: "
+                  f"{[(f['kind'], f['slot']) for f in health.findings]}")
+            summary["chain_findings"] = list(health.findings)
+            if health.bundles:
+                print(f"sim: forensic bundles: {health.bundles}")
+                summary["forensic_bundles"] = list(health.bundles)
         net = result.net
         print(f"sim: net — {net['sent']} sent, {net['delivered']} "
               f"delivered, {net['dropped_attempts']} dropped attempts, "
